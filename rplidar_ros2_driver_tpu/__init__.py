@@ -1,0 +1,35 @@
+"""rplidar_ros2_driver_tpu — TPU-native RPLIDAR driver framework.
+
+A ground-up rebuild of the capabilities of frozenreboot/rplidar_ros2_driver
+(a fault-tolerant, lifecycle-managed ROS 2 driver for Slamtec RPLIDAR 2-D
+lidars) with an idiomatic JAX/XLA data plane:
+
+  * host runtime (channels, protocol engine, FSM, lifecycle) in Python + C++,
+  * every per-point computation (wire-format unpacking, angle compensation,
+    LaserScan resampling, the ScanFilterChain) as jit/vmap array kernels,
+  * multi-stream scale-out via ``jax.sharding`` meshes (parallel/).
+
+Layer map (top to bottom), mirroring SURVEY.md §1:
+  node/      — lifecycle node, 5-state fault-tolerant FSM, publishing
+  filters/   — pluggable ScanFilterChain (the TPU north star)
+  driver/    — driver abstraction + model strategy (wrapper layer)
+  models/    — device model tables & capability profiles
+  protocol/  — command/response framing codec, CRC, conf protocol
+  ops/       — JAX kernels: unpackers, resampler, filter math
+  channels/  — byte transports (serial / tcp / udp / loopback)
+  native/    — C++ runtime: raw serial, transceiver hot loop (ctypes)
+  parallel/  — device meshes, sharded multi-stream pipeline
+"""
+
+__version__ = "0.1.0"
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, LaserScanMsg, ScanBatch
+
+__all__ = [
+    "DriverParams",
+    "LaserScanMsg",
+    "MAX_SCAN_NODES",
+    "ScanBatch",
+    "__version__",
+]

@@ -1,0 +1,14 @@
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth, Result, is_fail, is_ok
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, LaserScanMsg, ScanBatch
+
+__all__ = [
+    "DeviceHealth",
+    "DriverParams",
+    "LaserScanMsg",
+    "MAX_SCAN_NODES",
+    "Result",
+    "ScanBatch",
+    "is_fail",
+    "is_ok",
+]

@@ -1,0 +1,124 @@
+"""Parameter surface.
+
+Mirrors the reference's 13 ROS 2 parameters (declared in the node
+constructor and ``init_parameters``, src/rplidar_node.cpp:80-90,268-289;
+defaults shipped in param/rplidar.yaml) and adds the TPU filter-chain
+parameters that are this framework's north star (BASELINE.json).
+
+Three tiers, like the reference:
+  * static params (read once at configure time),
+  * runtime-mutable params (rpm / scan_processing / scan_mode,
+    src/rplidar_node.cpp:689-774) — see node/reconfigure.py,
+  * device-side config (the GET/SET_LIDAR_CONF key space) — see
+    protocol/conf.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# Baud-rate table shipped in param/rplidar.yaml:9-15.
+MODEL_BAUD_TABLE = {
+    "A1": 115200,
+    "A2M8": 115200,
+    "A2M7": 256000,
+    "A2M12": 256000,
+    "A3": 256000,
+    "S1": 256000,
+    "C1": 460800,
+    "S2": 1000000,
+    "S3": 1000000,
+}
+
+RUNTIME_MUTABLE = ("rpm", "scan_processing", "scan_mode")
+
+VALID_QOS = ("reliable", "best_effort")
+VALID_BACKENDS = ("cpu", "tpu")
+VALID_CHANNELS = ("serial", "tcp", "udp", "dummy")
+
+
+@dataclasses.dataclass
+class DriverParams:
+    """All tunables, defaults matching param/rplidar.yaml."""
+
+    # -- connection (param/rplidar.yaml:5-15) --
+    serial_port: str = "/dev/rplidar"
+    serial_baudrate: int = 1000000
+    channel_type: str = "serial"      # serial | tcp | udp (sl channel factories)
+    tcp_host: str = "192.168.0.7"
+    tcp_port: int = 20108
+    udp_host: str = "192.168.11.2"
+    udp_port: int = 8089
+
+    # -- frame / geometry (param/rplidar.yaml:17-33) --
+    frame_id: str = "laser"
+    inverted: bool = False
+    angle_compensate: bool = True
+
+    # -- processing (param/rplidar.yaml:35-57) --
+    scan_processing: bool = False
+    scan_mode: str = ""               # "" => auto (DenseBoost > Sensitivity)
+    rpm: int = 0                      # 0 => device default (600)
+    max_distance: float = 0.0         # 0 => hardware limit
+
+    # -- simulation / recovery (param/rplidar.yaml:59-88) --
+    dummy_mode: bool = False
+    max_retries: int = 3
+
+    # -- publishing (param/rplidar.yaml:73-80) --
+    publish_tf: bool = True
+    qos_reliability: str = "best_effort"
+
+    # -- TPU filter chain (new; BASELINE.json north star) --
+    filter_backend: str = "tpu"       # cpu | tpu
+    filter_window: int = 16           # rolling scans kept on device (<= 64 typical)
+    filter_chain: tuple = ("clip", "polar", "median", "voxel")
+    range_clip_min_m: float = 0.15
+    range_clip_max_m: float = 40.0
+    intensity_min: float = 0.0
+    voxel_grid_size: int = 256        # cells per side of the 2-D occupancy grid
+    voxel_cell_m: float = 0.25        # metres per cell
+
+    def validate(self) -> None:
+        if self.qos_reliability not in VALID_QOS:
+            raise ValueError(f"qos_reliability must be one of {VALID_QOS}")
+        if self.filter_backend not in VALID_BACKENDS:
+            raise ValueError(f"filter_backend must be one of {VALID_BACKENDS}")
+        if self.channel_type not in VALID_CHANNELS:
+            raise ValueError(f"channel_type must be one of {VALID_CHANNELS}")
+        if not (0 <= self.rpm <= 1200):
+            # same bound the dynamic-param path enforces (src/rplidar_node.cpp:713)
+            raise ValueError("rpm must be within [0, 1200]")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.filter_window < 1:
+            raise ValueError("filter_window must be >= 1")
+        if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
+            raise ValueError("invalid voxel grid configuration")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "DriverParams":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(d) - known
+        if unknown:
+            raise KeyError(f"unknown parameters: {sorted(unknown)}")
+        p = cls(**{k: v for k, v in d.items() if k in known})
+        if isinstance(p.filter_chain, list):
+            p.filter_chain = tuple(p.filter_chain)
+        p.validate()
+        return p
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "DriverParams":
+        """Load a ROS-style YAML (node -> ros__parameters -> dict)."""
+        import yaml  # baked into the image via other deps
+
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        # unwrap ros2 param file nesting if present
+        if isinstance(doc, dict) and len(doc) == 1:
+            (inner,) = doc.values()
+            if isinstance(inner, dict) and "ros__parameters" in inner:
+                doc = inner["ros__parameters"]
+        return cls.from_dict(doc or {})

@@ -1,0 +1,49 @@
+"""Fixed-point Q-format helpers shared by the unpack kernels.
+
+The Slamtec wire formats speak in Q2/Q3/Q6/Q8/Q14/Q16 fixed point
+(e.g. handler_capsules.cpp:206-266).  These helpers centralize the exact
+int32 semantics so the JAX kernels and the numpy reference implementations
+agree bit-for-bit with the C++ arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+FULL_TURN_Q6 = 360 << 6
+FULL_TURN_Q8 = 360 << 8
+FULL_TURN_Q16 = 360 << 16
+
+
+def angle_q6_to_q14(angle_q6):
+    """(angle_q6 << 8) / 90 with C integer division semantics (non-negative)."""
+    return (angle_q6 << 8) // 90
+
+
+def wrap_angle_q6(angle_q6):
+    """Wrap into [0, 360<<6) the way the handlers do (single add/sub)."""
+    a = jnp.where(angle_q6 < 0, angle_q6 + FULL_TURN_Q6, angle_q6)
+    return jnp.where(a >= FULL_TURN_Q6, a - FULL_TURN_Q6, a)
+
+
+def diff_start_angle_q8(prev_q6: jnp.ndarray, cur_q6: jnp.ndarray) -> jnp.ndarray:
+    """Angular distance between consecutive capsule start angles in Q8.
+
+    Matches handler_capsules.cpp:210-217: mask the sync bit, promote Q6→Q8,
+    and add a full turn when the angle wrapped.
+    """
+    cur_q8 = (cur_q6 & 0x7FFF) << 2
+    prev_q8 = (prev_q6 & 0x7FFF) << 2
+    diff = cur_q8 - prev_q8
+    return jnp.where(prev_q8 > cur_q8, diff + FULL_TURN_Q8, diff)
+
+
+def angle_q14_to_rad(angle_q14):
+    """Q14 z-angle → radians (float32). 16384 == 90 deg."""
+    deg = angle_q14.astype(jnp.float32) * (90.0 / 16384.0)
+    return deg * (jnp.pi / 180.0)
+
+
+def dist_q2_to_m(dist_q2):
+    """Quarter-millimetres → metres (float32)."""
+    return dist_q2.astype(jnp.float32) * (1.0 / 4000.0)

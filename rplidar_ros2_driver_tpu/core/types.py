@@ -1,0 +1,143 @@
+"""Core array data model for the TPU-native RPLIDAR framework.
+
+The reference driver moves scans around as
+``std::vector<sl_lidar_response_measurement_node_hq_t>`` — an
+array-of-structs of ``{angle_z_q14:u16, dist_mm_q2:u32, quality:u8, flag:u8}``
+(reference: src/sdk/include/sl_lidar_cmd.h:272-278).  On TPU the same
+information lives as a struct-of-arrays with a *fixed padded shape* so that
+every downstream kernel compiles once: variable point counts (the reference's
+``count`` out-parameter, src/sdk/include/sl_lidar_driver.h:427-435) become a
+``count`` scalar plus a validity mask.
+
+All fields are int32: TPU vector units have no efficient u8/u16 lanes, and
+the fixed-point unpack arithmetic (ops/unpack_*.py) needs 32-bit integer
+semantics anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# The reference caps a complete scan at 8192 nodes
+# (src/sdk/src/sl_lidar_driver.cpp:378, src/lidar_driver_wrapper.cpp:316).
+# We keep the same cap as the padded static width of every scan array.
+MAX_SCAN_NODES = 8192
+
+# HQ node flag bits (sl_lidar_cmd.h:175-181).
+FLAG_SYNCBIT = 0x1
+
+# Angle is Q14 "Z-angle": 16384 units == 90 degrees => 65536 == 360 degrees.
+ANGLE_Q14_FULL_TURN = 1 << 16
+# Distance is millimetres in Q2 (quarter-millimetre resolution).
+DIST_Q2_PER_METER = 4000.0  # dist_mm_q2 / 4000 == metres (src/rplidar_node.cpp:588)
+
+
+def _field(**kw: Any) -> Any:
+    return dataclasses.field(**kw)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ScanBatch:
+    """A fixed-width batch of measurement nodes (one complete revolution).
+
+    Mirrors the information content of the reference's HQ node vector plus
+    its ``count``.  Shapes: all arrays are ``(..., MAX_SCAN_NODES)``; leading
+    batch dims are allowed (vmap/shard friendly).
+
+    ``valid`` is the padding mask; ``count`` is the number of valid nodes
+    (== valid.sum() along the node axis when constructed correctly).
+    """
+
+    angle_q14: jax.Array  # int32, 0..65535 (Q14 z-angle; 65536 == 360 deg)
+    dist_q2: jax.Array    # int32, quarter-mm; 0 == invalid measurement
+    quality: jax.Array    # int32, 0..255
+    flag: jax.Array       # int32, bit0 = scan-start sync
+    valid: jax.Array      # bool padding mask
+    count: jax.Array      # int32 scalar (or batch of scalars)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.angle_q14.shape[-1]
+
+    @staticmethod
+    def empty(n: int = MAX_SCAN_NODES, batch: tuple = ()) -> "ScanBatch":
+        shape = batch + (n,)
+        z = jnp.zeros(shape, jnp.int32)
+        return ScanBatch(
+            angle_q14=z,
+            dist_q2=z,
+            quality=z,
+            flag=z,
+            valid=jnp.zeros(shape, bool),
+            count=jnp.zeros(batch, jnp.int32),
+        )
+
+    @staticmethod
+    def from_numpy(
+        angle_q14: np.ndarray,
+        dist_q2: np.ndarray,
+        quality: np.ndarray,
+        flag: np.ndarray | None = None,
+        n: int = MAX_SCAN_NODES,
+    ) -> "ScanBatch":
+        """Pad host arrays of length ``count <= n`` into a ScanBatch."""
+        count = int(angle_q14.shape[0])
+        if count > n:
+            raise ValueError(f"scan of {count} nodes exceeds capacity {n}")
+
+        def pad(a: np.ndarray) -> jnp.ndarray:
+            out = np.zeros((n,), np.int32)
+            out[:count] = a.astype(np.int32)
+            return jnp.asarray(out)
+
+        if flag is None:
+            flag = np.zeros((count,), np.int32)
+        valid = np.zeros((n,), bool)
+        valid[:count] = True
+        return ScanBatch(
+            angle_q14=pad(angle_q14),
+            dist_q2=pad(dist_q2),
+            quality=pad(quality),
+            flag=pad(flag),
+            valid=jnp.asarray(valid),
+            count=jnp.asarray(count, jnp.int32),
+        )
+
+    def to_host(self) -> dict[str, np.ndarray]:
+        """Device → host, trimmed to the valid prefix (assumes prefix layout)."""
+        c = int(self.count)
+        return {
+            "angle_q14": np.asarray(self.angle_q14)[..., :c],
+            "dist_q2": np.asarray(self.dist_q2)[..., :c],
+            "quality": np.asarray(self.quality)[..., :c],
+            "flag": np.asarray(self.flag)[..., :c],
+        }
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LaserScanMsg:
+    """Array analog of ``sensor_msgs/LaserScan`` (fixed-width, padded).
+
+    ``ranges``/``intensities`` are padded to a static width; ``beam_count``
+    gives the number of live beams.  angle_min/angle_max/range_min/range_max/
+    scan_time/time_increment/angle_increment are scalars, matching the
+    message fields filled by the reference (src/rplidar_node.cpp:617-643).
+    """
+
+    ranges: jax.Array          # float32 (n,) padded with +inf
+    intensities: jax.Array     # float32 (n,)
+    beam_count: jax.Array      # int32 scalar
+    angle_min: jax.Array       # float32 scalar
+    angle_max: jax.Array       # float32 scalar
+    angle_increment: jax.Array # float32 scalar
+    time_increment: jax.Array  # float32 scalar
+    scan_time: jax.Array       # float32 scalar
+    range_min: jax.Array       # float32 scalar
+    range_max: jax.Array       # float32 scalar

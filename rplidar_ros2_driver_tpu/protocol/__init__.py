@@ -1,0 +1,18 @@
+from rplidar_ros2_driver_tpu.protocol.codec import (
+    AnsHeader,
+    ResponseDecoder,
+    encode_command,
+)
+from rplidar_ros2_driver_tpu.protocol.constants import Ans, Cmd, ConfKey, HealthStatus
+from rplidar_ros2_driver_tpu.protocol.crc import crc32_padded
+
+__all__ = [
+    "Ans",
+    "AnsHeader",
+    "Cmd",
+    "ConfKey",
+    "HealthStatus",
+    "ResponseDecoder",
+    "crc32_padded",
+    "encode_command",
+]

@@ -1,0 +1,17 @@
+"""Test config: CPU backend with 8 virtual devices.
+
+CI for this framework needs no TPU: all kernels are jit-compatible on the
+CPU backend, and the multi-chip sharding tests run against a virtual
+8-device host mesh (the driver's dryrun does the same).  Must run before
+JAX initializes a backend, hence the env mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,94 @@
+"""Protocol layer: command encoding, response framing, loop mode, CRC."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.protocol import (
+    Ans,
+    AnsHeader,
+    Cmd,
+    ResponseDecoder,
+    crc32_padded,
+    encode_command,
+)
+
+
+class TestEncodeCommand:
+    def test_simple_command_is_two_bytes(self):
+        assert encode_command(Cmd.STOP) == bytes([0xA5, 0x25])
+        assert encode_command(Cmd.SCAN) == bytes([0xA5, 0x20])
+        assert encode_command(Cmd.RESET) == bytes([0xA5, 0x40])
+
+    def test_payload_command_has_size_and_checksum(self):
+        payload = bytes([0x00, 0x01, 0x00, 0x00, 0x00])
+        pkt = encode_command(Cmd.EXPRESS_SCAN, payload)
+        assert pkt[0] == 0xA5
+        assert pkt[1] == 0x82
+        assert pkt[2] == len(payload)
+        checksum = 0
+        for b in pkt[:-1]:
+            checksum ^= b
+        assert pkt[-1] == checksum
+
+    def test_payload_on_payloadless_command_rejected(self):
+        with pytest.raises(ValueError):
+            encode_command(Cmd.STOP, b"\x01")
+
+
+class TestResponseDecoder:
+    def _header(self, ans, n, loop=False):
+        return AnsHeader(ans_type=int(ans), payload_len=n, is_loop=loop).encode()
+
+    def test_single_response(self):
+        dec = ResponseDecoder()
+        payload = bytes(range(20))
+        dec.feed(self._header(Ans.DEVINFO, 20) + payload)
+        assert dec.messages == [(int(Ans.DEVINFO), payload, False)]
+
+    def test_split_across_chunks(self):
+        dec = ResponseDecoder()
+        buf = self._header(Ans.DEVHEALTH, 3) + b"\x00\x01\x02"
+        for i in range(len(buf)):
+            dec.feed(buf[i : i + 1])
+        assert dec.messages == [(int(Ans.DEVHEALTH), b"\x00\x01\x02", False)]
+
+    def test_loop_mode_reemits_payloads(self):
+        dec = ResponseDecoder()
+        dec.feed(self._header(Ans.MEASUREMENT, 5, loop=True))
+        dec.feed(bytes(15))  # 3 complete 5-byte nodes
+        assert len(dec.messages) == 3
+        assert all(loop for (_, _, loop) in dec.messages)
+        # loop mode persists until reset
+        dec.feed(bytes(5))
+        assert len(dec.messages) == 4
+        dec.exit_loop_mode()
+        dec.feed(bytes(5))  # garbage, no header
+        assert len(dec.messages) == 4
+
+    def test_garbage_before_sync_is_skipped(self):
+        dec = ResponseDecoder()
+        dec.feed(b"\xff\x00\xa5" + self._header(Ans.DEVINFO, 1) + b"\x42")
+        assert dec.messages == [(int(Ans.DEVINFO), b"\x42", False)]
+
+    def test_lone_sync_byte_straddling_chunks(self):
+        dec = ResponseDecoder()
+        hdr = self._header(Ans.DEVINFO, 2)
+        dec.feed(b"\x00" + hdr[:1])
+        dec.feed(hdr[1:] + b"\xaa\xbb")
+        assert dec.messages == [(int(Ans.DEVINFO), b"\xaa\xbb", False)]
+
+    def test_zero_payload_header(self):
+        dec = ResponseDecoder()
+        dec.feed(self._header(Ans.SET_LIDAR_CONF, 0))
+        assert dec.messages == [(int(Ans.SET_LIDAR_CONF), b"", False)]
+
+
+class TestCrc:
+    def test_matches_zlib_with_device_padding(self):
+        rng = np.random.default_rng(0)
+        for n in (1, 3, 4, 7, 16, 773):
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            pad = 4 - (n & 3)
+            assert crc32_padded(data) == zlib.crc32(data + b"\x00" * pad)

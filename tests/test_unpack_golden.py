@@ -1,0 +1,238 @@
+"""Golden equivalence: vectorized JAX unpackers vs the scalar reference
+decoders, over randomized wire streams including scan restarts and
+corruption.  This is the bit-exactness contract for the fixed-point math
+(SURVEY.md §7 'fixed-point parity')."""
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.ops import unpack, unpack_ref, wire
+
+
+def _rng():
+    return np.random.default_rng(1234)
+
+
+def _frames_to_array(frames):
+    return np.frombuffer(b"".join(frames), np.uint8).reshape(len(frames), -1)
+
+
+def _angles(rng, m, step_q6=640):
+    """Monotonic wrapped start angles with jitter, like a spinning head."""
+    inc = rng.integers(step_q6 // 2, step_q6 * 2, m)
+    return (np.cumsum(inc) + rng.integers(0, 360 << 6)) % (360 << 6)
+
+
+def _collect_ref(decoder, frames):
+    """Run the stateful scalar decoder over the stream, keeping per-frame
+    node lists aligned to JAX's pair indexing (pair i -> nodes of frame i)."""
+    per_pair = []
+    for fr in frames:
+        nodes, _ = decoder.decode(fr)
+        per_pair.append(nodes)
+    return per_pair
+
+
+def _compare(dec, per_pair_ref, npts):
+    """per_pair_ref[i+1] holds nodes for pair i (emitted when cur arrived)."""
+    angle = np.asarray(dec.angle_q14)
+    dist = np.asarray(dec.dist_q2)
+    qual = np.asarray(dec.quality)
+    flag = np.asarray(dec.flag)
+    valid = np.asarray(dec.node_valid)
+    m = angle.shape[0]
+    for i in range(m):
+        ref_nodes = per_pair_ref[i + 1]
+        if not ref_nodes:
+            assert not valid[i].any(), f"pair {i}: JAX valid but reference emitted nothing"
+            continue
+        assert valid[i].all(), f"pair {i}: reference emitted nodes but JAX masked"
+        assert len(ref_nodes) == npts
+        for k, n in enumerate(ref_nodes):
+            assert angle[i, k] == n.angle_q14, (i, k, angle[i, k], n.angle_q14)
+            assert dist[i, k] == n.dist_q2, (i, k, dist[i, k], n.dist_q2)
+            assert qual[i, k] == n.quality, (i, k)
+            assert flag[i, k] == n.flag, (i, k, flag[i, k], n.flag)
+
+
+class TestNormalNodes:
+    def test_golden(self):
+        rng = _rng()
+        frames = []
+        expected = []
+        for i in range(100):
+            angle_q6 = int(rng.integers(0, 360 << 6))
+            dist_q2 = int(rng.integers(0, 1 << 16))
+            quality6 = int(rng.integers(0, 64))
+            fr = wire.encode_normal_node(angle_q6, dist_q2, quality6, syncbit=(i % 37 == 0))
+            frames.append(fr)
+            expected.append(unpack_ref.decode_normal_node(fr))
+        dec = unpack.unpack_normal_nodes(_frames_to_array(frames))
+        for i, exp in enumerate(expected):
+            assert exp is not None
+            assert np.asarray(dec.node_valid)[i, 0]
+            assert np.asarray(dec.angle_q14)[i, 0] == exp.angle_q14
+            assert np.asarray(dec.dist_q2)[i, 0] == exp.dist_q2
+            assert np.asarray(dec.quality)[i, 0] == exp.quality
+            assert np.asarray(dec.flag)[i, 0] == exp.flag
+
+    def test_bad_sync_bits_rejected(self):
+        fr = bytearray(wire.encode_normal_node(100, 100, 10, False))
+        fr[0] |= 0x3  # sync and inverse both set -> invalid
+        dec = unpack.unpack_normal_nodes(np.frombuffer(bytes(fr), np.uint8)[None, :])
+        assert unpack_ref.decode_normal_node(bytes(fr)) is None
+        assert not np.asarray(dec.node_valid)[0, 0]
+
+
+class TestCapsules:
+    def _make_stream(self, rng, m=24, corrupt=(), syncs=()):
+        starts = _angles(rng, m)
+        frames = []
+        for i in range(m):
+            dist = rng.integers(0, 1 << 14, (16, 2)) << 2
+            dist[rng.random((16, 2)) < 0.1] = 0  # invalid points
+            off = rng.integers(0, 64, (16, 2))
+            fr = bytearray(
+                wire.encode_capsule(int(starts[i]), i in syncs, dist, off)
+            )
+            if i in corrupt:
+                fr[10] ^= 0xFF
+            frames.append(bytes(fr))
+        return frames
+
+    @pytest.mark.parametrize("corrupt,syncs", [((), (0,)), ((), (0, 7)), ((5,), (0,)), ((3, 4), (0, 9))])
+    def test_golden(self, corrupt, syncs):
+        rng = _rng()
+        frames = self._make_stream(rng, corrupt=corrupt, syncs=syncs)
+        ref = _collect_ref(unpack_ref.CapsuleDecoder(), frames)
+        dec = unpack.unpack_capsules(_frames_to_array(frames))
+        _compare(dec, ref, 32)
+
+
+class TestUltraCapsules:
+    def _make_stream(self, rng, m=16, syncs=(0,), corrupt=()):
+        starts = _angles(rng, m, step_q6=1920)
+        frames = []
+        for i in range(m):
+            major = rng.integers(0, 4096, 32)
+            p1 = rng.integers(-512, 512, 32)
+            p2 = rng.integers(-512, 512, 32)
+            fr = bytearray(
+                wire.encode_ultra_capsule(int(starts[i]), i in syncs, major, p1, p2)
+            )
+            if i in corrupt:
+                fr[40] ^= 0x55
+            frames.append(bytes(fr))
+        return frames
+
+    @pytest.mark.parametrize("corrupt,syncs", [((), (0,)), ((6,), (0, 11))])
+    def test_golden(self, corrupt, syncs):
+        rng = _rng()
+        frames = self._make_stream(rng, corrupt=corrupt, syncs=syncs)
+        ref = _collect_ref(unpack_ref.UltraCapsuleDecoder(), frames)
+        dec = unpack.unpack_ultra_capsules(_frames_to_array(frames))
+        _compare(dec, ref, 96)
+
+    def test_varbitscale_roundtrip(self):
+        for lvl_base in (0, 300, 600, 1400, 2000, 3500, 4095):
+            val, lvl = unpack_ref.varbitscale_decode(lvl_base)
+            assert wire.varbitscale_encode(val) == lvl_base
+
+
+class TestDenseCapsules:
+    def _make_stream(self, rng, m=24, syncs=(0,), corrupt=(), jump_at=None):
+        starts = _angles(rng, m, step_q6=900)
+        if jump_at is not None:
+            starts[jump_at] = (starts[jump_at - 1] + (300 << 6)) % (360 << 6)
+        frames = []
+        for i in range(m):
+            dist = rng.integers(0, 1 << 15, 40)
+            dist[rng.random(40) < 0.05] = 0
+            fr = bytearray(wire.encode_dense_capsule(int(starts[i]), i in syncs, dist))
+            if i in corrupt:
+                fr[30] ^= 0x0F
+            frames.append(bytes(fr))
+        return frames
+
+    @pytest.mark.parametrize(
+        "corrupt,syncs,jump_at",
+        [((), (0,), None), ((4,), (0, 13), None), ((), (0,), 8)],
+    )
+    def test_golden(self, corrupt, syncs, jump_at):
+        rng = _rng()
+        frames = self._make_stream(rng, corrupt=corrupt, syncs=syncs, jump_at=jump_at)
+        ref_dec = unpack_ref.DenseCapsuleDecoder(sample_duration_us=476)
+        ref = _collect_ref(ref_dec, frames)
+        dec = unpack.unpack_dense_capsules(_frames_to_array(frames), 0, 476)
+        _compare(dec, ref, 40)
+
+
+class TestUltraDenseCapsules:
+    def _make_stream(self, rng, m=16, syncs=(0,), corrupt=()):
+        starts = _angles(rng, m, step_q6=1200)
+        frames = []
+        for i in range(m):
+            # mix of scales; include near-equal consecutive distances to
+            # exercise the +/-2 mm smoothing recurrence
+            base = int(rng.integers(100, 2000))
+            dmm = base + rng.integers(-2, 3, 64).cumsum() % 30000
+            qual = rng.integers(0, 256, 64)
+            words = np.array(
+                [wire.ultra_dense_encode_sample(int(d), int(q)) for d, q in zip(dmm, qual)]
+            )
+            fr = bytearray(
+                wire.encode_ultra_dense_capsule(int(starts[i]), i in syncs, words)
+            )
+            if i in corrupt:
+                fr[60] ^= 0xF0
+            frames.append(bytes(fr))
+        return frames
+
+    @pytest.mark.parametrize("corrupt,syncs", [((), (0,)), ((5,), (0, 9))])
+    def test_golden(self, corrupt, syncs):
+        rng = _rng()
+        frames = self._make_stream(rng, corrupt=corrupt, syncs=syncs)
+        ref_dec = unpack_ref.UltraDenseCapsuleDecoder(sample_duration_us=476)
+        ref = _collect_ref(ref_dec, frames)
+        dec = unpack.unpack_ultra_dense_capsules(_frames_to_array(frames), 0, 0, 476)
+        _compare(dec, ref, 64)
+
+
+class TestHqCapsules:
+    def test_golden(self):
+        rng = _rng()
+        frames = []
+        for i in range(8):
+            fr = wire.encode_hq_capsule(
+                rng.integers(0, 1 << 16, 96),
+                rng.integers(0, 1 << 18, 96),
+                rng.integers(0, 256, 96),
+                np.where(np.arange(96) == 0, i % 2, 2),
+                timestamp=1000 * i,
+            )
+            frames.append(fr)
+        arr = _frames_to_array(frames)
+        crc_ok = []
+        ref_nodes = []
+        for fr in frames:
+            nodes, _ = unpack_ref.decode_hq_capsule(fr)
+            crc_ok.append(bool(nodes))
+            ref_nodes.append(nodes)
+        dec = unpack.unpack_hq_capsules(arr, np.array(crc_ok))
+        for i in range(8):
+            assert np.asarray(dec.node_valid)[i].all()
+            for k, n in enumerate(ref_nodes[i]):
+                assert np.asarray(dec.angle_q14)[i, k] == n.angle_q14
+                assert np.asarray(dec.dist_q2)[i, k] == n.dist_q2
+                assert np.asarray(dec.quality)[i, k] == n.quality
+                assert np.asarray(dec.flag)[i, k] == n.flag
+
+    def test_crc_reject(self):
+        fr = bytearray(
+            wire.encode_hq_capsule(
+                np.zeros(96), np.zeros(96), np.zeros(96), np.zeros(96)
+            )
+        )
+        fr[100] ^= 1
+        nodes, _ = unpack_ref.decode_hq_capsule(bytes(fr))
+        assert nodes == []
