@@ -73,7 +73,9 @@ class DriverParams:
     # -- TPU filter chain (new; BASELINE.json north star) --
     filter_backend: str = "tpu"       # cpu | tpu
     filter_window: int = 16           # rolling scans kept on device (<= 64 typical)
-    filter_chain: tuple = ("clip", "polar", "median", "voxel")
+    # empty = raw passthrough (reference-parity default); enable stages for
+    # the TPU pipeline, e.g. ("clip", "polar", "median", "voxel")
+    filter_chain: tuple = ()
     range_clip_min_m: float = 0.15
     range_clip_max_m: float = 40.0
     intensity_min: float = 0.0

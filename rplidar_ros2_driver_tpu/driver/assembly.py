@@ -1,0 +1,149 @@
+"""Complete-scan assembly: decoded node batches -> whole revolutions.
+
+TPU-native re-design of the reference's ``ScanDataHolder``
+(sl_lidar_driver.cpp:237-371): the reference pushes one HQ node at a time
+and swaps double buffers when a sync-flagged node arrives; here the decode
+path delivers *batches* of nodes (the vectorized unpackers emit whole
+capsule pairs), so assembly is batched too — find sync positions in the
+batch, close out revolutions at each, keep the partial tail.
+
+Concurrency contract matches the reference: a producer thread feeds
+batches; one consumer blocks in ``wait_and_grab`` (Event-signalled, 2 s
+default timeout, sl_lidar_driver.h:332).  Completed scans are double
+buffered: if the consumer lags, the newest scan replaces the queued one
+(the reference replaces the last entry when full, :302-305).  Data before
+the first sync is discarded (:296-299).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
+
+
+class ScanAssembler:
+    """Accumulates flat node arrays, emits complete revolutions."""
+
+    def __init__(self, max_nodes: int = MAX_SCAN_NODES) -> None:
+        self._max_nodes = max_nodes
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._pending: Optional[dict] = None      # newest complete scan
+        self._partial: list[np.ndarray] = []      # [ (k,4) int32 chunks ]
+        self._partial_len = 0
+        self._seen_first_sync = False
+        self.scans_completed = 0
+        self.scans_dropped = 0                    # overwritten before grab
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending = None
+            self._partial = []
+            self._partial_len = 0
+            self._seen_first_sync = False
+            self._event.clear()
+
+    # -- producer side -----------------------------------------------------
+
+    def push_nodes(
+        self,
+        angle_q14: np.ndarray,
+        dist_q2: np.ndarray,
+        quality: np.ndarray,
+        flag: np.ndarray,
+    ) -> int:
+        """Feed a flat, time-ordered batch of valid nodes.
+
+        Returns the number of revolutions completed by this batch.  A node
+        with flag bit0 set starts a new revolution (the reference swaps
+        buffers on it, sl_lidar_driver.cpp:279-294).
+        """
+        n = len(angle_q14)
+        if n == 0:
+            return 0
+        stacked = np.stack(
+            [
+                np.asarray(angle_q14, np.int32),
+                np.asarray(dist_q2, np.int32),
+                np.asarray(quality, np.int32),
+                np.asarray(flag, np.int32),
+            ],
+            axis=1,
+        )
+        sync_pos = np.flatnonzero(stacked[:, 3] & 1)
+        completed = 0
+        with self._lock:
+            start = 0
+            for pos in sync_pos:
+                if self._seen_first_sync:
+                    self._append_partial(stacked[start:pos])
+                    self._close_partial()
+                    completed += 1
+                # data before the very first sync is dropped
+                self._partial = []
+                self._partial_len = 0
+                self._seen_first_sync = True
+                start = pos
+            self._append_partial(stacked[start:])
+            if completed:
+                self._event.set()
+        return completed
+
+    def _append_partial(self, chunk: np.ndarray) -> None:
+        if not self._seen_first_sync or len(chunk) == 0:
+            return
+        room = self._max_nodes - self._partial_len
+        if room <= 0:
+            return  # scan overflow: excess nodes dropped (cap 8192)
+        chunk = chunk[:room]
+        self._partial.append(chunk)
+        self._partial_len += len(chunk)
+
+    def _close_partial(self) -> None:
+        if self._partial_len == 0:
+            return
+        scan = np.concatenate(self._partial, axis=0)
+        if self._pending is not None:
+            self.scans_dropped += 1  # consumer lagging: newest wins
+        self._pending = {
+            "angle_q14": scan[:, 0],
+            "dist_q2": scan[:, 1],
+            "quality": scan[:, 2],
+            "flag": scan[:, 3],
+        }
+        self.scans_completed += 1
+        self._partial = []
+        self._partial_len = 0
+
+    # -- consumer side -----------------------------------------------------
+
+    def wait_and_grab(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
+        """Block until a complete revolution is available; None on timeout."""
+        if not self._event.wait(timeout_s):
+            return None
+        with self._lock:
+            scan = self._pending
+            self._pending = None
+            self._event.clear()
+        if scan is None:
+            return None
+        return ScanBatch.from_numpy(
+            scan["angle_q14"], scan["dist_q2"], scan["quality"], scan["flag"],
+            n=self._max_nodes,
+        )
+
+    def grab_nowait(self) -> Optional[ScanBatch]:
+        with self._lock:
+            scan = self._pending
+            self._pending = None
+            self._event.clear()
+        if scan is None:
+            return None
+        return ScanBatch.from_numpy(
+            scan["angle_q14"], scan["dist_q2"], scan["quality"], scan["flag"],
+            n=self._max_nodes,
+        )

@@ -1,0 +1,74 @@
+"""Abstract driver contract — the testability seam.
+
+Mirrors the reference's ``LidarDriverInterface``
+(include/lidar_driver_wrapper.hpp:139-267): the node layer depends on this
+and nothing below it, so the whole node stack (FSM, conversion, filters,
+publishing, diagnostics) runs against the dummy backend without hardware.
+
+TPU-native difference: ``grab_scan_data`` returns a :class:`ScanBatch`
+(padded SoA arrays ready for device kernels) instead of an
+array-of-structs vector.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+
+
+class LidarDriverInterface(abc.ABC):
+    """The 12-method driver contract the node layer programs against."""
+
+    @abc.abstractmethod
+    def connect(self, port: str, baudrate: int, use_geometric_compensation: bool) -> bool:
+        """Open the transport and fetch device info."""
+
+    @abc.abstractmethod
+    def disconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    def is_connected(self) -> bool: ...
+
+    @abc.abstractmethod
+    def start_motor(self, scan_mode: str, rpm: int) -> bool:
+        """Spin up and begin streaming (model-specific strategy)."""
+
+    @abc.abstractmethod
+    def stop_motor(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_health(self) -> DeviceHealth: ...
+
+    @abc.abstractmethod
+    def reset(self) -> None:
+        """Device soft reset (cmd 0x40)."""
+
+    @abc.abstractmethod
+    def grab_scan_data(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
+        """Block for the next complete revolution; None on timeout/failure."""
+
+    @abc.abstractmethod
+    def detect_and_init_strategy(self) -> None:
+        """Classify the device (A vs S/C series) and cache a DriverProfile."""
+
+    @abc.abstractmethod
+    def print_summary(self) -> None: ...
+
+    @abc.abstractmethod
+    def get_hw_max_distance(self) -> float: ...
+
+    @abc.abstractmethod
+    def set_motor_speed(self, rpm: int) -> bool: ...
+
+    # -- informational helpers used by the node (non-abstract) --
+
+    def is_new_type(self) -> bool:
+        """New-protocol devices publish quality unshifted
+        (src/rplidar_node.cpp:589-592)."""
+        return False
+
+    def get_device_info_str(self) -> str:
+        return "[Dummy] Virtual Driver"

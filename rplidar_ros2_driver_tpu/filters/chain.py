@@ -1,0 +1,93 @@
+"""Pluggable ScanFilterChain — the seam between wrapper and publisher.
+
+The BASELINE.json north star: a filter chain inserted between the driver
+wrapper and the ``/scan`` publisher, backend-selected via the parameter
+surface (``filter_backend: cpu | tpu``).  ``cpu``/``tpu`` pick the JAX
+backend the fused ``filter_step`` program runs on; the host FSM and
+publishing stay identical either way.
+
+Also owns the framework's checkpoint surface: the rolling window and voxel
+accumulator are real state (unlike the reference's stateless pipeline), so
+``snapshot``/``restore`` let a lifecycle deactivate/activate cycle — or a
+RESETTING recovery — either preserve or deterministically reset the window
+(SURVEY.md §5 checkpoint/resume note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.ops.filters import (
+    FilterConfig,
+    FilterOutput,
+    FilterState,
+    filter_step,
+)
+
+
+def _pick_device(backend: str):
+    if backend == "cpu":
+        return jax.devices("cpu")[0]
+    # "tpu": first accelerator if present, else fall back to host
+    for d in jax.devices():
+        if d.platform != "cpu":
+            return d
+    return jax.devices()[0]
+
+
+class ScanFilterChain:
+    """Stateful host wrapper around the fused filter_step program."""
+
+    def __init__(self, params: DriverParams, beams: int = 2048) -> None:
+        chain = set(params.filter_chain)
+        self.cfg = FilterConfig(
+            window=params.filter_window,
+            beams=beams,
+            grid=params.voxel_grid_size,
+            cell_m=params.voxel_cell_m,
+            range_min_m=params.range_clip_min_m,
+            range_max_m=params.range_clip_max_m,
+            intensity_min=params.intensity_min,
+            enable_clip="clip" in chain,
+            enable_median="median" in chain,
+            enable_voxel="voxel" in chain,
+        )
+        self.device = _pick_device(params.filter_backend)
+        self.backend = params.filter_backend
+        self._state = jax.device_put(
+            FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
+            self.device,
+        )
+
+    def process(self, batch: ScanBatch) -> FilterOutput:
+        batch = jax.device_put(batch, self.device)
+        self._state, out = filter_step(self._state, batch, self.cfg)
+        return out
+
+    # -- checkpoint surface -------------------------------------------------
+
+    def snapshot(self) -> dict[str, np.ndarray]:
+        """Host copy of the rolling window + accumulator."""
+        return {k: np.asarray(v) for k, v in vars(self._state).items()}
+
+    def restore(self, snap: Optional[dict[str, np.ndarray]]) -> None:
+        """Restore a snapshot, or reset deterministically when None."""
+        if snap is None:
+            self._state = jax.device_put(
+                FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
+                self.device,
+            )
+        else:
+            self._state = jax.device_put(FilterState(**snap), self.device)
+
+    def reset(self) -> None:
+        self.restore(None)
+
+    @property
+    def state(self) -> FilterState:
+        return self._state

@@ -1,0 +1,66 @@
+"""Diagnostics updater.
+
+Equivalent of the reference's diagnostic_updater wiring
+(src/rplidar_node.cpp:206-208, 490-545): hardware id ``rplidar-<port>``,
+a lifecycle-gated summary level and message, and key/value details (port,
+target RPM, cached device info).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from rplidar_ros2_driver_tpu.node.fsm import DriverState
+from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+from rplidar_ros2_driver_tpu.node.messages import DiagLevel, DiagnosticStatus
+
+
+def summarize(
+    lifecycle: LifecycleState, fsm_state: Optional[DriverState]
+) -> tuple[DiagLevel, str]:
+    """Level/message table mirroring update_diagnostics
+    (src/rplidar_node.cpp:497-520)."""
+    if lifecycle is not LifecycleState.ACTIVE:
+        return DiagLevel.OK, "Node Inactive (Lifecycle)"
+    if fsm_state is DriverState.RUNNING:
+        return DiagLevel.OK, "Scanning"
+    if fsm_state is DriverState.WARMUP:
+        return DiagLevel.WARN, "Warming Up"
+    if fsm_state in (DriverState.CONNECTING, DriverState.CHECK_HEALTH):
+        return DiagLevel.WARN, "Connecting"
+    if fsm_state is DriverState.RESETTING:
+        return DiagLevel.ERROR, "Resetting Hardware"
+    return DiagLevel.WARN, "Unknown"
+
+
+class DiagnosticsUpdater:
+    def __init__(self, hardware_id: str, publisher) -> None:
+        self.hardware_id = hardware_id
+        self._publisher = publisher
+        self.last: Optional[DiagnosticStatus] = None
+
+    def update(
+        self,
+        lifecycle: LifecycleState,
+        fsm_state: Optional[DriverState],
+        port: str,
+        rpm: int,
+        device_info: str,
+    ) -> DiagnosticStatus:
+        level, message = summarize(lifecycle, fsm_state)
+        status = DiagnosticStatus(
+            level=level,
+            name="rplidar_node: Device Status",
+            message=message,
+            hardware_id=self.hardware_id,
+            values={
+                "Serial Port": port,
+                "Target RPM": str(rpm),
+                "Device Info": device_info,
+                "FSM State": fsm_state.value if fsm_state else "n/a",
+                "Lifecycle": lifecycle.value,
+            },
+        )
+        self.last = status
+        self._publisher.publish_diagnostics(status)
+        return status

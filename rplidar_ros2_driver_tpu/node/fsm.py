@@ -1,0 +1,259 @@
+"""The 5-state fault-tolerant scan loop FSM.
+
+Behavioral mirror of the reference's ``scan_loop``
+(src/rplidar_node.cpp:304-484):
+
+    CONNECTING -> CHECK_HEALTH -> WARMUP -> RUNNING
+         ^------------- RESETTING <-- (errors) --'
+
+  * CONNECTING   — (re)create driver (dummy vs real factory), retry connect
+    every 1 s, detect model strategy, cache device-info string
+  * CHECK_HEALTH — gate on health (OK/WARNING pass; ERROR -> disconnect,
+    1 s, back to CONNECTING)
+  * WARMUP       — start motor + scan mode; failure -> RESETTING
+  * RUNNING      — grab + publish; consecutive failures > max_retries ->
+    RESETTING (1 ms between retries)
+  * RESETTING    — destroy and recreate the driver object, 2 s backoff
+
+Timings are injected (FsmTimings) so tests run the same logic at speed;
+defaults match the reference constants (:336,:438,:468,:479).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.driver.interface import LidarDriverInterface
+
+log = logging.getLogger("rplidar_tpu.fsm")
+
+
+class DriverState(enum.Enum):
+    CONNECTING = "connecting"
+    CHECK_HEALTH = "check_health"
+    WARMUP = "warmup"
+    RUNNING = "running"
+    RESETTING = "resetting"
+
+
+@dataclasses.dataclass
+class FsmTimings:
+    connect_retry_s: float = 1.0
+    health_retry_s: float = 1.0
+    reset_backoff_s: float = 2.0
+    idle_tick_s: float = 0.01
+    grab_retry_s: float = 0.001
+    grab_timeout_s: float = 2.0
+    warmup_motor_s: float = 0.0  # motor warm-up handled inside drivers
+
+    @classmethod
+    def fast(cls) -> "FsmTimings":
+        """Millisecond-scale variant for tests."""
+        return cls(0.01, 0.01, 0.02, 0.001, 0.0005, 0.25)
+
+
+class ScanLoopFsm:
+    """Runs the fault-tolerant acquisition loop on a dedicated thread.
+
+    The node supplies the driver factory, the scan consumer callback and
+    (optionally) a state-change hook for diagnostics.  The driver mutex
+    serializes grabs against dynamic reconfigure, exactly like the
+    reference's ``driver_mutex_`` (include/rplidar_node.hpp:322) — and we
+    hold it in CONNECTING/WARMUP too, closing the reference's documented
+    race (SURVEY.md §5 race notes).
+    """
+
+    def __init__(
+        self,
+        driver_factory: Callable[[], LidarDriverInterface],
+        on_scan: Callable[[ScanBatch, float, float], None],
+        *,
+        params,
+        timings: Optional[FsmTimings] = None,
+        on_state_change: Optional[Callable[[DriverState], None]] = None,
+        on_connected: Optional[Callable[[LidarDriverInterface], None]] = None,
+    ) -> None:
+        self._factory = driver_factory
+        self._on_scan = on_scan
+        self._params = params
+        self._t = timings or FsmTimings()
+        self._on_state_change = on_state_change
+        self._on_connected = on_connected
+
+        self.driver: Optional[LidarDriverInterface] = None
+        self.driver_mutex = threading.RLock()
+        self._state = DriverState.CONNECTING
+        self._state_lock = threading.Lock()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.cached_device_info = ""
+        self.cached_max_range = 0.0
+        self.error_count = 0
+        self.reset_count = 0
+
+    # -- state accessors ----------------------------------------------------
+
+    @property
+    def state(self) -> DriverState:
+        with self._state_lock:
+            return self._state
+
+    def _set_state(self, s: DriverState) -> None:
+        with self._state_lock:
+            if s is self._state:
+                return
+            self._state = s
+        log.info("[FSM] -> %s", s.value)
+        if self._on_state_change:
+            self._on_state_change(s)
+
+    @property
+    def is_scanning(self) -> bool:
+        return self._running.is_set()
+
+    # -- thread lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread and self._thread.is_alive():
+            return
+        self._running.set()
+        self._thread = threading.Thread(target=self._loop, name="scan_loop", daemon=True)
+        self._thread.start()
+
+    def stop(self, join_timeout_s: float = 5.0) -> None:
+        self._running.clear()
+        if self._thread:
+            self._thread.join(join_timeout_s)
+            self._thread = None
+        with self.driver_mutex:
+            if self.driver is not None:
+                try:
+                    self.driver.stop_motor()
+                    self.driver.disconnect()
+                except Exception:
+                    log.exception("driver shutdown failed")
+
+    # -- the loop -----------------------------------------------------------
+
+    def _interruptible_sleep(self, seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while self._running.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.05))
+
+    def _loop(self) -> None:
+        self._set_state(DriverState.CONNECTING)
+        self.error_count = 0
+        log.info("[FSM] Scan loop started.")
+        while self._running.is_set():
+            state = self.state
+            if state is DriverState.CONNECTING:
+                self._do_connecting()
+            elif state is DriverState.CHECK_HEALTH:
+                self._do_check_health()
+            elif state is DriverState.WARMUP:
+                self._do_warmup()
+            elif state is DriverState.RUNNING:
+                self._do_running()
+            elif state is DriverState.RESETTING:
+                self._do_resetting()
+            if self.state is not DriverState.RUNNING:
+                self._interruptible_sleep(self._t.idle_tick_s)
+        log.info("[FSM] Scan loop terminated.")
+
+    def _do_connecting(self) -> None:
+        with self.driver_mutex:
+            if self.driver is None:
+                self.driver = self._factory()
+            if not self.driver.is_connected():
+                ok = self.driver.connect(
+                    self._params.serial_port,
+                    self._params.serial_baudrate,
+                    self._params.angle_compensate,
+                )
+                if not ok:
+                    log.warning("[FSM] Connection failed. Retrying...")
+                    self._interruptible_sleep(self._t.connect_retry_s)
+                    return
+                log.info("[FSM] Connection established.")
+            self.driver.detect_and_init_strategy()
+            self.cached_device_info = self.driver.get_device_info_str()
+            log.info("[Hardware Detail] %s", self.cached_device_info)
+            if self._on_connected:
+                self._on_connected(self.driver)
+        self._set_state(DriverState.CHECK_HEALTH)
+
+    def _do_check_health(self) -> None:
+        with self.driver_mutex:
+            health = self.driver.get_health()
+        if health in (DeviceHealth.OK, DeviceHealth.WARNING):
+            self._set_state(DriverState.WARMUP)
+        else:
+            log.error("[FSM] Health error: %d. Disconnecting...", int(health))
+            with self.driver_mutex:
+                self.driver.disconnect()
+            self._interruptible_sleep(self._t.health_retry_s)
+            self._set_state(DriverState.CONNECTING)
+
+    def _do_warmup(self) -> None:
+        log.info("[FSM] Starting motor...")
+        with self.driver_mutex:
+            ok = self.driver.start_motor(self._params.scan_mode, self._params.rpm)
+            if ok:
+                self.driver.print_summary()
+                hw_limit = self.driver.get_hw_max_distance()
+                if self._params.max_distance > 0.0:
+                    self.cached_max_range = min(self._params.max_distance, hw_limit)
+                else:
+                    self.cached_max_range = hw_limit
+        if ok:
+            log.info("[Config] Max Range: %.2f m", self.cached_max_range)
+            self.error_count = 0
+            self._set_state(DriverState.RUNNING)
+        else:
+            log.error("[FSM] Failed to start motor.")
+            self._set_state(DriverState.RESETTING)
+
+    def _do_running(self) -> None:
+        start_time = time.monotonic()
+        batch: Optional[ScanBatch] = None
+        with self.driver_mutex:
+            if self.driver is not None and self.driver.is_connected():
+                batch = self.driver.grab_scan_data(self._t.grab_timeout_s)
+        if batch is None:
+            self.error_count += 1
+            if self.error_count > self._params.max_retries:
+                log.error(
+                    "[FSM] Hardware unresponsive (Over %d errors). Resetting...",
+                    self._params.max_retries,
+                )
+                self._set_state(DriverState.RESETTING)
+            else:
+                self._interruptible_sleep(self._t.grab_retry_s)
+            return
+        self.error_count = 0
+        duration = time.monotonic() - start_time
+        self._on_scan(batch, start_time, duration)
+
+    def _do_resetting(self) -> None:
+        log.warning("[FSM] Performing hardware reset (recreating driver)...")
+        with self.driver_mutex:
+            if self.driver is not None:
+                try:
+                    self.driver.disconnect()
+                except Exception:
+                    pass
+            self.driver = self._factory()
+        self.reset_count += 1
+        self._interruptible_sleep(self._t.reset_backoff_s)
+        self._set_state(DriverState.CONNECTING)
+        self.error_count = 0

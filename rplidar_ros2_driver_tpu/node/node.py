@@ -1,0 +1,281 @@
+"""RPlidarNode — the top-level lifecycle node.
+
+Behavioral mirror of the reference node (src/rplidar_node.cpp):
+
+  * on_configure  — load params, build the driver factory (dummy vs real),
+    set up publishing + static TF + diagnostics, build the filter chain
+    (:116-211)
+  * on_activate   — spawn the scan-loop FSM thread (:213-225)
+  * on_deactivate — stop the thread, stop the motor (:227-242)
+  * on_cleanup    — drop driver + chain state (:244-256)
+  * dynamic reconfigure — rpm / scan_processing / scan_mode at runtime
+    (:689-774), rejected while disconnected
+
+New capability (the north star): when ``filter_chain`` stages are
+configured, each revolution runs through the TPU ScanFilterChain between
+grab and publish; the LaserScan then carries the temporal-median ranges and
+a PointCloud + voxel grid are published alongside.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.node.diagnostics import DiagnosticsUpdater
+from rplidar_ros2_driver_tpu.node.fsm import DriverState, FsmTimings, ScanLoopFsm
+from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleNode, LifecycleState
+from rplidar_ros2_driver_tpu.node.messages import (
+    LaserScanHost,
+    PointCloudHost,
+    StaticTransform,
+)
+from rplidar_ros2_driver_tpu.node.publisher import CollectingPublisher, PublisherBase
+from rplidar_ros2_driver_tpu.ops.laserscan import to_laserscan
+from rplidar_ros2_driver_tpu.utils.tracing import StageTimer
+
+log = logging.getLogger("rplidar_tpu.node")
+
+
+class RPlidarNode(LifecycleNode):
+    def __init__(
+        self,
+        params: Optional[DriverParams] = None,
+        publisher: Optional[PublisherBase] = None,
+        *,
+        driver_factory=None,
+        fsm_timings: Optional[FsmTimings] = None,
+        name: str = "rplidar_node",
+    ) -> None:
+        super().__init__(name)
+        self.params = params or DriverParams()
+        self.params.validate()
+        self.publisher = publisher or CollectingPublisher()
+        self._driver_factory = driver_factory
+        self._fsm_timings = fsm_timings
+        self.fsm: Optional[ScanLoopFsm] = None
+        self.chain: Optional[ScanFilterChain] = None
+        self.diagnostics: Optional[DiagnosticsUpdater] = None
+        self.tracer = StageTimer()
+        self._param_lock = threading.Lock()
+        self._chain_snapshot = None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def _default_factory(self):
+        if self.params.dummy_mode:
+            return DummyLidarDriver()
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+
+        return RealLidarDriver(channel_type=self.params.channel_type)
+
+    def on_configure(self) -> bool:
+        log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
+        factory = self._driver_factory or self._default_factory
+        self.fsm = ScanLoopFsm(
+            factory,
+            self._on_scan,
+            params=self.params,
+            timings=self._fsm_timings,
+            on_state_change=lambda s: self._update_diagnostics(),
+        )
+        if self.params.filter_chain:
+            self.chain = ScanFilterChain(self.params)
+            if self._chain_snapshot is not None:
+                self.chain.restore(self._chain_snapshot)
+        self.diagnostics = DiagnosticsUpdater(
+            hardware_id=f"rplidar-{self.params.serial_port}",
+            publisher=self.publisher,
+        )
+        if self.params.publish_tf:
+            self.publisher.publish_tf_static(
+                StaticTransform(child=self.params.frame_id)
+            )
+        self._update_diagnostics()
+        return True
+
+    def on_activate(self) -> bool:
+        assert self.fsm is not None
+        self.fsm.start()
+        self._update_diagnostics()
+        return True
+
+    def on_deactivate(self) -> bool:
+        if self.fsm:
+            self.fsm.stop()
+        # preserve the rolling window across deactivate/activate — the
+        # framework's checkpoint surface (SURVEY.md §5)
+        if self.chain is not None:
+            self._chain_snapshot = self.chain.snapshot()
+        self._update_diagnostics()
+        return True
+
+    def on_cleanup(self) -> bool:
+        self.fsm = None
+        self.chain = None
+        self._chain_snapshot = None
+        return True
+
+    def on_shutdown(self) -> bool:
+        return True
+
+    # ------------------------------------------------------------------
+    # hot path: one revolution
+    # ------------------------------------------------------------------
+
+    def _on_scan(self, batch: ScanBatch, start_time: float, duration: float) -> None:
+        params = self.params
+        max_range = self.fsm.cached_max_range or 40.0
+        is_new = True
+        if self.fsm.driver is not None:
+            is_new = self.fsm.driver.is_new_type()
+
+        with self.tracer.stage("filter"):
+            out = None
+            if self.chain is not None:
+                out = self.chain.process(batch)
+
+        with self.tracer.stage("convert"):
+            if out is not None:
+                # chain output is already on the fixed angular grid
+                beams = self.chain.cfg.beams
+                ranges = np.asarray(out.ranges)
+                inten = np.asarray(out.intensities)
+                msg = LaserScanHost(
+                    stamp=start_time,
+                    frame_id=params.frame_id,
+                    angle_min=0.0,
+                    angle_max=2.0 * np.pi,
+                    angle_increment=2.0 * np.pi / beams,
+                    time_increment=duration / beams,
+                    scan_time=duration,
+                    range_min=params.range_clip_min_m,
+                    range_max=max_range,
+                    ranges=ranges,
+                    intensities=inten,
+                )
+            else:
+                scan = to_laserscan(
+                    batch,
+                    duration,
+                    max_range,
+                    scan_processing=params.scan_processing,
+                    inverted=params.inverted,
+                    is_new_type=is_new,
+                )
+                bc = int(scan.beam_count)
+                if bc == 0:
+                    return
+                msg = LaserScanHost(
+                    stamp=start_time,
+                    frame_id=params.frame_id,
+                    angle_min=float(scan.angle_min),
+                    angle_max=float(scan.angle_max),
+                    angle_increment=float(scan.angle_increment),
+                    time_increment=float(scan.time_increment),
+                    scan_time=float(scan.scan_time),
+                    range_min=float(scan.range_min),
+                    range_max=float(scan.range_max),
+                    ranges=np.asarray(scan.ranges)[:bc],
+                    intensities=np.asarray(scan.intensities)[:bc],
+                )
+
+        with self.tracer.stage("publish"):
+            self.publisher.publish_scan(msg)
+            if out is not None:
+                self.publisher.publish_cloud(
+                    PointCloudHost(
+                        stamp=start_time,
+                        frame_id=params.frame_id,
+                        points_xy=np.asarray(out.points_xy)[np.asarray(out.point_mask)],
+                        voxel=np.asarray(out.voxel),
+                    )
+                )
+
+    # ------------------------------------------------------------------
+    # diagnostics (src/rplidar_node.cpp:490-545)
+    # ------------------------------------------------------------------
+
+    def _update_diagnostics(self) -> None:
+        if self.diagnostics is None:
+            return
+        lc = self.lifecycle_state
+        fsm_state = self.fsm.state if self.fsm else None
+        self.diagnostics.update(
+            lifecycle=lc,
+            fsm_state=fsm_state,
+            port=self.params.serial_port,
+            rpm=self.params.rpm,
+            device_info=self.fsm.cached_device_info if self.fsm else "",
+        )
+
+    # ------------------------------------------------------------------
+    # dynamic reconfigure (src/rplidar_node.cpp:689-774)
+    # ------------------------------------------------------------------
+
+    def set_parameters(self, updates: dict) -> tuple[bool, str]:
+        """Runtime parameter updates; returns (successful, reason)."""
+        with self._param_lock:
+            if self.fsm is None or self.fsm.driver is None:
+                return False, "Driver not ready"
+            with self.fsm.driver_mutex:
+                if not self.fsm.driver.is_connected():
+                    return False, "Driver not ready"
+                for key, value in updates.items():
+                    if key == "rpm":
+                        if not isinstance(value, int) or not (0 <= value <= 1200):
+                            return False, f"rpm {value} out of range [0, 1200]"
+                        if not self.fsm.driver.set_motor_speed(value):
+                            return False, "failed to apply motor speed"
+                        self.params.rpm = value
+                    elif key == "scan_processing":
+                        self.params.scan_processing = bool(value)
+                    elif key == "scan_mode":
+                        ok = self._hot_swap_scan_mode(str(value))
+                        if not ok:
+                            return False, f"scan mode '{value}' rejected"
+                    else:
+                        return False, f"parameter '{key}' is not runtime-mutable"
+            self._update_diagnostics()
+            return True, "success"
+
+    def _hot_swap_scan_mode(self, mode: str) -> bool:
+        """stop motor -> 500 ms -> restart in new mode; fall back to auto
+        on failure (src/rplidar_node.cpp:740-770)."""
+        drv = self.fsm.driver
+        drv.stop_motor()
+        time.sleep(0.5 if self._fsm_timings is None else self._fsm_timings.idle_tick_s)
+        if drv.start_motor(mode, self.params.rpm):
+            self.params.scan_mode = mode
+            return True
+        log.error("scan mode '%s' failed; falling back to auto", mode)
+        drv.start_motor("", self.params.rpm)
+        self.params.scan_mode = ""
+        return False
+
+
+def make_node_from_yaml(path: str, **kwargs) -> RPlidarNode:
+    """Launch-file equivalent: YAML is the single source of truth
+    (launch/rplidar.launch.py:86-93)."""
+    return RPlidarNode(DriverParams.from_yaml(path), **kwargs)
+
+
+def launch(node: RPlidarNode) -> RPlidarNode:
+    """Auto lifecycle bringup: CONFIGURE on start, ACTIVATE once inactive
+    (launch/rplidar.launch.py:109-141)."""
+    if node.lifecycle_state is LifecycleState.UNCONFIGURED:
+        if not node.configure():
+            return node
+    if node.lifecycle_state is LifecycleState.INACTIVE:
+        node.activate()
+    return node

@@ -1,0 +1,71 @@
+"""Angle-compensation: synthesize angles for invalid points, then sort.
+
+Equivalent of the reference's ``ascendScanData_``
+(sl_lidar_driver.cpp:128-184), applied by the wrapper when
+``angle_compensate`` is on (src/lidar_driver_wrapper.cpp:329).
+
+The reference tunes the head backwards from the first valid point, tunes
+the tail, then *overwrites every invalid index >= 1* with
+``angle[0] + i * inc`` (so only the head-tuned ``angle[0]`` actually
+survives), and finally sorts by angle.  The vectorized form computes
+exactly that net effect:
+
+  * ``angle[0]``   — first-valid angle walked back ``fv`` steps of
+    ``360/count`` deg, floor-clamped at 0 (computed closed-form; the
+    reference quantizes through u16 Q14 at each step, so synthesized
+    angles of *invalid* points may differ by ~1 LSB — they carry no range
+    data, dist == 0),
+  * invalid ``i``  — ``angle[0] + i*inc`` with a single 360-wrap,
+  * sort by (quantized) angle; invalid-count scans return ``ok=False``
+    and the batch unchanged (the reference returns OPERATION_FAIL and the
+    wrapper falls back to the raw scan).
+
+Operates on the valid prefix of a padded ScanBatch; padding stays at the
+tail (sort key +inf).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+
+
+@jax.jit
+def ascend_scan(batch: ScanBatch) -> tuple[ScanBatch, jax.Array]:
+    n = batch.num_nodes
+    live = batch.valid
+    has_range = live & (batch.dist_q2 != 0)
+    count = jnp.maximum(batch.count, 1)
+    any_valid = has_range.any()
+
+    angle_f = batch.angle_q14.astype(jnp.float32) * (90.0 / 16384.0)
+    inc = 360.0 / count.astype(jnp.float32)
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+    fv = jnp.argmax(has_range)  # first index with a real measurement
+    a_fv = angle_f[fv]
+
+    a0 = jnp.where(
+        has_range[0], angle_f[0], jnp.maximum(a_fv - fv.astype(jnp.float32) * inc, 0.0)
+    )
+    synth = a0 + idx.astype(jnp.float32) * inc
+    synth = jnp.where(synth > 360.0, synth - 360.0, synth)
+    new_angle_f = jnp.where(has_range | (idx == 0), jnp.where(idx == 0, a0, angle_f), synth)
+    new_q14 = (new_angle_f * (16384.0 / 90.0)).astype(jnp.int32)
+
+    # keep original values when compensation cannot run
+    q14_out = jnp.where(any_valid & live, new_q14, batch.angle_q14)
+
+    sort_key = jnp.where(live, q14_out, jnp.int32(0x7FFFFFFF))
+    order = jnp.argsort(sort_key)
+    out = ScanBatch(
+        angle_q14=q14_out[order],
+        dist_q2=batch.dist_q2[order],
+        quality=batch.quality[order],
+        flag=batch.flag[order],
+        valid=live[order],
+        count=batch.count,
+    )
+    return out, any_valid

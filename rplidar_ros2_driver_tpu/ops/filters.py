@@ -1,0 +1,225 @@
+"""ScanFilterChain kernels — the TPU north star (BASELINE.json).
+
+Everything here is pure, jit-stable array math over padded ScanBatch /
+gridded range images:
+
+  * range/intensity clip        (elementwise validity update)
+  * angular-grid resample       (scatter-min range image, B fixed beams)
+  * rolling-window temporal median (lower median over a (W, B) device ring)
+  * polar -> Cartesian          (for PointCloud output)
+  * 2-D voxel occupancy         (scatter-add histogram, W-scan accumulation)
+
+The rolling window and voxel accumulator are device-resident state
+(:class:`FilterState`) threaded functionally through ``filter_step`` — the
+checkpoint/restore surface of the framework (SURVEY.md §5 checkpoint note).
+The reference has no analog: its pipeline is stateless per scan
+(src/rplidar_node.cpp:558-683); this chain is the new capability layered
+between the wrapper and the publisher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+
+TWO_PI = 2.0 * jnp.pi
+_INT_INF = jnp.int32(0x7FFFFFFF)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FilterState:
+    """Device-resident rolling state for the filter chain."""
+
+    range_window: jax.Array   # (W, B) float32, +inf = no return
+    inten_window: jax.Array   # (W, B) float32
+    hit_window: jax.Array     # (W, G, G) int32 per-scan voxel grids
+    voxel_acc: jax.Array      # (G, G) int32 running sum over the window
+    cursor: jax.Array         # int32 ring write position
+    filled: jax.Array         # int32 number of scans pushed (saturates at W)
+
+    @staticmethod
+    def create(window: int, beams: int, grid: int) -> "FilterState":
+        return FilterState(
+            range_window=jnp.full((window, beams), jnp.inf, jnp.float32),
+            inten_window=jnp.zeros((window, beams), jnp.float32),
+            hit_window=jnp.zeros((window, grid, grid), jnp.int32),
+            voxel_acc=jnp.zeros((grid, grid), jnp.int32),
+            cursor=jnp.asarray(0, jnp.int32),
+            filled=jnp.asarray(0, jnp.int32),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterConfig:
+    """Static (compile-time) chain configuration."""
+
+    window: int = 16
+    beams: int = 2048
+    grid: int = 256
+    cell_m: float = 0.25
+    range_min_m: float = 0.15
+    range_max_m: float = 40.0
+    intensity_min: float = 0.0
+    enable_clip: bool = True
+    enable_median: bool = True
+    enable_voxel: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterOutput:
+    """One step's outputs (all device arrays)."""
+
+    ranges: jax.Array        # (B,) median-filtered (or raw gridded) ranges
+    intensities: jax.Array   # (B,)
+    points_xy: jax.Array     # (B, 2) Cartesian projection of `ranges`
+    point_mask: jax.Array    # (B,) finite-range mask
+    voxel: jax.Array         # (G, G) occupancy counts over the window
+
+
+jax.tree_util.register_dataclass(
+    FilterOutput,
+    data_fields=["ranges", "intensities", "points_xy", "point_mask", "voxel"],
+    meta_fields=[],
+)
+
+
+# ---------------------------------------------------------------------------
+# individual kernels
+# ---------------------------------------------------------------------------
+
+
+def clip_filter(batch: ScanBatch, cfg: FilterConfig) -> ScanBatch:
+    """Drop returns outside [range_min, range_max] or below intensity_min."""
+    dist_m = batch.dist_q2.astype(jnp.float32) * (1.0 / 4000.0)
+    ok = (
+        batch.valid
+        & (batch.dist_q2 != 0)
+        & (dist_m >= cfg.range_min_m)
+        & (dist_m <= cfg.range_max_m)
+        & (batch.quality.astype(jnp.float32) >= cfg.intensity_min)
+    )
+    return dataclasses.replace(
+        batch,
+        dist_q2=jnp.where(ok, batch.dist_q2, 0),
+        valid=batch.valid,  # node slots stay; zero dist marks the drop
+        count=batch.count,
+    )
+
+
+def grid_resample(batch: ScanBatch, beams: int):
+    """Scatter-min a scan onto a fixed angular grid of ``beams`` cells.
+
+    Returns (ranges (B,), intensities (B,)) with +inf where no return —
+    the aligned representation the temporal window needs (scan point
+    counts vary; the grid is the jit-stable common shape).
+    """
+    ok = batch.valid & (batch.dist_q2 != 0)
+    beam = (batch.angle_q14 * beams) // 65536  # Q14 full turn == 65536
+    beam = jnp.clip(beam, 0, beams - 1)
+    packed = (batch.dist_q2 << 8) | jnp.clip(batch.quality, 0, 255)
+    packed = jnp.where(ok, packed, _INT_INF)
+    grid = jnp.full((beams,), _INT_INF, jnp.int32).at[beam].min(packed, mode="drop")
+    hit = grid != _INT_INF
+    ranges = jnp.where(hit, (grid >> 8).astype(jnp.float32) * (1.0 / 4000.0), jnp.inf)
+    inten = jnp.where(hit, (grid & 0xFF).astype(jnp.float32), 0.0)
+    return ranges, inten
+
+
+def temporal_median(window: jax.Array, filled: jax.Array) -> jax.Array:
+    """Per-beam lower median over the filled part of the (W, B) ring.
+
+    +inf marks missing returns; they sort to the tail so the median is
+    taken over actual returns only.  Beams with no return in the whole
+    window stay +inf.
+    """
+    w = window.shape[0]
+    s = jnp.sort(window, axis=0)  # inf sorts last
+    nvalid = jnp.sum(jnp.isfinite(window), axis=0)  # (B,)
+    pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)
+    med = jnp.take_along_axis(s, pick[None, :], axis=0)[0]
+    return jnp.where(nvalid > 0, med, jnp.inf)
+
+
+def polar_to_cartesian(ranges: jax.Array, beams: int):
+    """Beam-grid ranges -> (B, 2) XY metres + finite mask."""
+    theta = (jnp.arange(beams, dtype=jnp.float32) + 0.5) * (TWO_PI / beams)
+    finite = jnp.isfinite(ranges)
+    r = jnp.where(finite, ranges, 0.0)
+    xy = jnp.stack([r * jnp.cos(theta), r * jnp.sin(theta)], axis=-1)
+    return xy, finite
+
+
+def voxel_hits(xy: jax.Array, mask: jax.Array, grid: int, cell_m: float) -> jax.Array:
+    """(G, G) occupancy counts for one scan, origin at the grid centre."""
+    half = grid // 2
+    ij = jnp.floor(xy / cell_m).astype(jnp.int32) + half
+    inb = mask & (ij[:, 0] >= 0) & (ij[:, 0] < grid) & (ij[:, 1] >= 0) & (ij[:, 1] < grid)
+    flat = jnp.where(inb, ij[:, 0] * grid + ij[:, 1], grid * grid)
+    counts = jnp.zeros((grid * grid,), jnp.int32).at[flat].add(1, mode="drop")
+    return counts.reshape(grid, grid)
+
+
+# ---------------------------------------------------------------------------
+# fused chain step
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def filter_step(
+    state: FilterState, batch: ScanBatch, cfg: FilterConfig
+) -> tuple[FilterState, FilterOutput]:
+    """One revolution through the full chain; single fused XLA program.
+
+    clip -> grid resample -> ring-buffer update -> temporal median ->
+    polar->Cartesian -> voxel accumulate (incremental: add the new scan's
+    hit grid, retire the one falling out of the window).
+    """
+    if cfg.enable_clip:
+        batch = clip_filter(batch, cfg)
+    ranges, inten = grid_resample(batch, cfg.beams)
+
+    rw = jax.lax.dynamic_update_index_in_dim(state.range_window, ranges, state.cursor, 0)
+    iw = jax.lax.dynamic_update_index_in_dim(state.inten_window, inten, state.cursor, 0)
+    filled = jnp.minimum(state.filled + 1, rw.shape[0])
+
+    if cfg.enable_median:
+        med = temporal_median(rw, filled)
+    else:
+        med = ranges
+    xy, mask = polar_to_cartesian(med, cfg.beams)
+
+    if cfg.enable_voxel:
+        new_hits = voxel_hits(xy, mask, cfg.grid, cfg.cell_m)
+        old_hits = jax.lax.dynamic_index_in_dim(
+            state.hit_window, state.cursor, 0, keepdims=False
+        )
+        voxel_acc = state.voxel_acc + new_hits - old_hits
+        hw = jax.lax.dynamic_update_index_in_dim(
+            state.hit_window, new_hits, state.cursor, 0
+        )
+    else:
+        voxel_acc = state.voxel_acc
+        hw = state.hit_window
+
+    new_state = FilterState(
+        range_window=rw,
+        inten_window=iw,
+        hit_window=hw,
+        voxel_acc=voxel_acc,
+        cursor=(state.cursor + 1) % rw.shape[0],
+        filled=filled,
+    )
+    out = FilterOutput(
+        ranges=med,
+        intensities=inten,
+        points_xy=xy,
+        point_mask=mask,
+        voxel=voxel_acc,
+    )
+    return new_state, out

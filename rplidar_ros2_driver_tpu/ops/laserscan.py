@@ -1,0 +1,122 @@
+"""ScanBatch -> LaserScan conversion as a single jit kernel.
+
+Array reformulation of the reference's ``publish_scan``
+(src/rplidar_node.cpp:558-683): drop zero-distance nodes, Q14->radians,
+Q2->metres, quality->intensity (legacy protocol shifts right by 2), wrap
+angles, sort by angle, then either
+
+  * Mode A (``scan_processing``): resample onto a fixed angular grid with
+    min-range conflict resolution and REP-117 +inf padding (:632-662), or
+  * Mode B: raw CW-reversed mapping (:663-680).
+
+The reference's per-point loop + std::sort become a masked sort plus a
+scatter-min.  Conflict resolution packs ``(dist_q2 << 8) | intensity`` into
+one int32 so a single ``min``-scatter picks the winning range *and* its
+intensity atomically (ties resolve to the lowest intensity rather than
+first-seen — same distance either way).
+
+Output arrays stay padded at the ScanBatch width; ``beam_count`` gives the
+live prefix, and the host trims before serializing a ROS message.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rplidar_ros2_driver_tpu.core.types import LaserScanMsg, ScanBatch
+
+TWO_PI = 2.0 * jnp.pi
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scan_processing", "inverted", "is_new_type")
+)
+def to_laserscan(
+    batch: ScanBatch,
+    scan_duration_s,
+    max_range_m,
+    *,
+    scan_processing: bool = False,
+    inverted: bool = False,
+    is_new_type: bool = True,
+) -> LaserScanMsg:
+    n = batch.num_nodes
+    valid = batch.valid & (batch.dist_q2 != 0)
+    count = valid.sum().astype(jnp.int32)
+
+    angle_deg = batch.angle_q14.astype(jnp.float32) * (90.0 / 16384.0)
+    angle = angle_deg * (jnp.pi / 180.0)
+    angle = jnp.where(angle < 0.0, angle + TWO_PI, angle)
+    angle = jnp.where(angle >= TWO_PI, angle - TWO_PI, angle)
+    dist_m = batch.dist_q2.astype(jnp.float32) * (1.0 / 4000.0)
+    intensity = (
+        batch.quality if is_new_type else (batch.quality >> 2)
+    ).astype(jnp.float32)
+
+    # masked sort by angle: invalid nodes to the tail
+    key = jnp.where(valid, angle, jnp.inf)
+    order = jnp.argsort(key)
+    angle_s = key[order]
+    dist_s = dist_m[order]
+    dist_q2_s = batch.dist_q2[order]
+    inten_s = intensity[order]
+    qual_s = batch.quality[order] if is_new_type else (batch.quality[order] >> 2)
+    valid_s = valid[order]
+
+    countf = jnp.maximum(count, 1).astype(jnp.float32)
+    scan_duration_s = jnp.asarray(scan_duration_s, jnp.float32)
+
+    if scan_processing:
+        # Mode A: fixed angular grid, one beam per valid point count
+        angle_increment = TWO_PI / countf
+        time_increment = scan_duration_s / countf
+        a = angle_s
+        if inverted:
+            a = TWO_PI - a
+            a = jnp.where(a >= TWO_PI, a - TWO_PI, a)
+        index = (a / angle_increment).astype(jnp.int32)  # trunc, matches C cast
+        in_range = valid_s & (index >= 0) & (index < count)
+        index = jnp.clip(index, 0, n - 1)
+        # pack (dist_q2, intensity byte) for atomic min-conflict resolution
+        packed = (dist_q2_s << 8) | jnp.clip(qual_s, 0, 255)
+        packed = jnp.where(in_range, packed, jnp.int32(0x7FFFFFFF))
+        grid = jnp.full((n,), 0x7FFFFFFF, jnp.int32).at[index].min(
+            packed, mode="drop"
+        )
+        hit = grid != 0x7FFFFFFF
+        ranges = jnp.where(hit, (grid >> 8).astype(jnp.float32) * (1.0 / 4000.0), jnp.inf)
+        intensities = jnp.where(hit, (grid & 0xFF).astype(jnp.float32), 0.0)
+        beam_count = count
+    else:
+        # Mode B: raw mapping, rplidar turns CW so order is reversed unless
+        # inverted (src/rplidar_node.cpp:672-678)
+        denom = jnp.maximum(count - 1, 1).astype(jnp.float32)
+        angle_increment = TWO_PI / denom
+        time_increment = scan_duration_s / denom
+        i = jnp.arange(n, dtype=jnp.int32)
+        idx = jnp.where(inverted, i, count - 1 - i)
+        # route invalid (padding) points out of bounds so mode="drop" skips them
+        idx = jnp.where(valid_s, idx, n)
+        ranges = jnp.full((n,), jnp.inf, jnp.float32).at[idx].set(
+            dist_s, mode="drop"
+        )
+        intensities = jnp.zeros((n,), jnp.float32).at[idx].set(
+            inten_s, mode="drop"
+        )
+        beam_count = count
+
+    return LaserScanMsg(
+        ranges=ranges,
+        intensities=intensities,
+        beam_count=beam_count,
+        angle_min=jnp.float32(0.0),
+        angle_max=jnp.float32(TWO_PI),
+        angle_increment=angle_increment.astype(jnp.float32),
+        time_increment=time_increment.astype(jnp.float32),
+        scan_time=scan_duration_s,
+        range_min=jnp.float32(0.15),
+        range_max=jnp.asarray(max_range_m, jnp.float32),
+    )

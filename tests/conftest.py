@@ -1,17 +1,25 @@
-"""Test config: CPU backend with 8 virtual devices.
+"""Test config: force the CPU backend with 8 virtual devices.
 
 CI for this framework needs no TPU: all kernels are jit-compatible on the
 CPU backend, and the multi-chip sharding tests run against a virtual
-8-device host mesh (the driver's dryrun does the same).  Must run before
-JAX initializes a backend, hence the env mutation at import time.
+8-device host mesh (the driver's dryrun does the same).
+
+NOTE: the env var JAX_PLATFORMS is NOT enough in this image — the axon
+site shim overrides the jax *config* value to "axon,cpu" at interpreter
+startup, which makes backend init dial the TPU tunnel first.  We must win
+the override race with jax.config.update() before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (sitecustomize has already imported it anyway)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,226 @@
+"""Filter-chain kernels: clip, grid resample, temporal median, voxel
+occupancy, state ring semantics, checkpoint/restore, and the LaserScan /
+ascend kernels against numpy oracles."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.driver.dummy import synth_scan
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.ops import filters
+from rplidar_ros2_driver_tpu.ops.ascend import ascend_scan
+from rplidar_ros2_driver_tpu.ops.laserscan import to_laserscan
+
+
+def make_batch(angles_deg, dists_m, quality=200, n=1024):
+    angles_q14 = (np.asarray(angles_deg) * 16384.0 / 90.0).astype(np.int64)
+    dist_q2 = (np.asarray(dists_m) * 4000.0).astype(np.int64)
+    q = np.full(len(angles_deg), quality, np.int64)
+    return ScanBatch.from_numpy(angles_q14, dist_q2, q, n=n)
+
+
+CFG = filters.FilterConfig(window=4, beams=256, grid=64, cell_m=0.25)
+
+
+class TestClip:
+    def test_out_of_range_zeroed(self):
+        b = make_batch([0, 10, 20, 30], [0.05, 1.0, 50.0, 2.0])
+        cfg = dataclasses.replace(CFG, range_max_m=40.0)
+        out = filters.clip_filter(b, cfg)
+        d = np.asarray(out.dist_q2)[:4]
+        assert d[0] == 0       # below 0.15 m
+        assert d[1] == 4000
+        assert d[2] == 0       # above 40 m
+        assert d[3] == 8000
+
+
+class TestGridResample:
+    def test_min_range_wins_per_beam(self):
+        # two points in the same beam: nearer one wins
+        b = make_batch([10.0, 10.4, 100.0], [3.0, 2.0, 5.0])
+        ranges, inten = filters.grid_resample(b, 256)
+        ranges = np.asarray(ranges)
+        beam = int((10.0 * 65536 / 360) * 256 // 65536)
+        assert ranges[beam] == pytest.approx(2.0)
+        assert np.isfinite(ranges).sum() == 2
+
+    def test_empty_beams_are_inf(self):
+        b = make_batch([0.0], [1.0])
+        ranges, _ = filters.grid_resample(b, 64)
+        assert np.isinf(np.asarray(ranges)).sum() == 63
+
+
+class TestTemporalMedian:
+    def test_median_ignores_missing(self):
+        w = jnp.asarray(
+            np.array(
+                [
+                    [1.0, np.inf],
+                    [3.0, np.inf],
+                    [2.0, 5.0],
+                    [np.inf, np.inf],
+                ],
+                np.float32,
+            )
+        )
+        med = np.asarray(filters.temporal_median(w, jnp.int32(4)))
+        assert med[0] == pytest.approx(2.0)  # lower median of {1,2,3}
+        assert med[1] == pytest.approx(5.0)
+        empty = filters.temporal_median(jnp.full((4, 1), jnp.inf), jnp.int32(4))
+        assert np.isinf(np.asarray(empty)[0])
+
+    def test_median_denoises_outlier(self):
+        state = filters.FilterState.create(CFG.window, CFG.beams, CFG.grid)
+        clean = make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0), n=1024)
+        spiky = make_batch(np.arange(0, 360, 1.5), np.full(240, 9.0), n=1024)
+        for b in (clean, clean, spiky, clean):
+            state, out = filters.filter_step(state, b, CFG)
+        med = np.asarray(out.ranges)
+        finite = med[np.isfinite(med)]
+        assert np.allclose(finite, 2.0)  # the 9 m spike scan is voted out
+
+
+class TestVoxel:
+    def test_hits_land_in_cells(self):
+        xy = jnp.asarray(np.array([[0.3, 0.3], [-0.3, 0.3], [100.0, 0.0]], np.float32))
+        mask = jnp.asarray([True, True, True])
+        grid = np.asarray(filters.voxel_hits(xy, mask, 64, 0.25))
+        assert grid.sum() == 2  # out-of-grid point dropped
+        assert grid[32 + 1, 32 + 1] == 1
+        assert grid[32 - 2, 32 + 1] == 1
+
+    def test_window_accumulation_retires_old_scans(self):
+        state = filters.FilterState.create(CFG.window, CFG.beams, CFG.grid)
+        b = make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0), n=1024)
+        sums = []
+        for _ in range(CFG.window + 3):
+            state, out = filters.filter_step(state, b, CFG)
+            sums.append(int(np.asarray(out.voxel).sum()))
+        per_scan = sums[0]
+        # grows until the ring is full, then plateaus at window * per-scan
+        assert sums[CFG.window - 1] == CFG.window * per_scan
+        assert sums[-1] == CFG.window * per_scan
+        assert (np.asarray(state.voxel_acc) >= 0).all()
+
+
+class TestChainHost:
+    def _params(self, **kw):
+        return DriverParams(
+            dummy_mode=True,
+            filter_backend="cpu",
+            filter_window=4,
+            filter_chain=("clip", "polar", "median", "voxel"),
+            voxel_grid_size=64,
+            **kw,
+        )
+
+    def test_process_and_snapshot_roundtrip(self):
+        chain = ScanFilterChain(self._params(), beams=256)
+        b = synth_scan(jnp.float32(0.0), count=360, capacity=8192)
+        out1 = chain.process(b)
+        snap = chain.snapshot()
+        assert int(np.asarray(chain.state.filled)) == 1
+        chain.reset()
+        assert int(np.asarray(chain.state.filled)) == 0
+        chain.restore(snap)
+        assert int(np.asarray(chain.state.filled)) == 1
+        out2 = chain.process(b)
+        assert np.isfinite(np.asarray(out2.ranges)).sum() > 0
+        assert np.asarray(out1.voxel).sum() > 0
+
+
+class TestLaserScanKernel:
+    """to_laserscan vs a direct numpy transliteration of publish_scan."""
+
+    def _numpy_oracle(self, batch, duration, max_range, scan_processing, inverted, is_new):
+        # float32 at every step, mirroring both the kernel and the C++
+        # reference's all-float arithmetic (src/rplidar_node.cpp:586-603)
+        angle = (
+            np.asarray(batch.angle_q14).astype(np.float32) * np.float32(90.0 / 16384.0)
+        ) * np.float32(np.pi / 180.0)
+        dist = np.asarray(batch.dist_q2).astype(np.float32) * np.float32(1.0 / 4000.0)
+        qual = np.asarray(batch.quality)
+        valid = np.asarray(batch.valid) & (np.asarray(batch.dist_q2) != 0)
+        inten = qual if is_new else (qual >> 2)
+        a_v, d_v, q_v = angle[valid] % np.float32(2 * np.pi), dist[valid], inten[valid]
+        # stable sort by angle alone — ties keep stream order, matching the
+        # kernel (the reference's std::sort is unstable; tie order is free)
+        order = np.argsort(a_v, kind="stable")
+        pts = list(zip(a_v[order], d_v[order], q_v[order].astype(float)))
+        count = len(pts)
+        if scan_processing:
+            # float32 throughout: both the kernel and the C++ reference
+            # compute the beam index in single precision
+            inc = np.float32(2 * np.pi) / np.float32(count)
+            ranges = np.full(count, np.inf, np.float32)
+            intens = np.zeros(count, np.float32)
+            for a, d, q in pts:
+                a = np.float32(a)
+                if inverted:
+                    a = np.float32(2 * np.pi) - a
+                    if a >= np.float32(2 * np.pi):
+                        a -= np.float32(2 * np.pi)
+                idx = int(np.float32(a) / inc)
+                if 0 <= idx < count and d < ranges[idx]:
+                    ranges[idx] = d
+                    intens[idx] = q
+        else:
+            ranges = np.zeros(count, np.float32)
+            intens = np.zeros(count, np.float32)
+            for i, (a, d, q) in enumerate(pts):
+                idx = i if inverted else count - 1 - i
+                ranges[idx] = d
+                intens[idx] = q
+        return ranges, intens, count
+
+    @pytest.mark.parametrize("scan_processing", [False, True])
+    @pytest.mark.parametrize("inverted", [False, True])
+    @pytest.mark.parametrize("is_new", [False, True])
+    def test_matches_oracle(self, scan_processing, inverted, is_new):
+        rng = np.random.default_rng(7)
+        n = 400
+        angles_deg = np.sort(rng.uniform(0, 360, n))
+        dists = rng.uniform(0.2, 10.0, n)
+        dists[rng.random(n) < 0.1] = 0.0  # invalid points dropped
+        b = make_batch(angles_deg, dists, quality=180, n=1024)
+        msg = to_laserscan(
+            b, 0.1, 12.0,
+            scan_processing=scan_processing, inverted=inverted, is_new_type=is_new,
+        )
+        bc = int(msg.beam_count)
+        ranges = np.asarray(msg.ranges)[:bc]
+        intens = np.asarray(msg.intensities)[:bc]
+        oracle_r, oracle_i, oracle_c = self._numpy_oracle(
+            b, 0.1, 12.0, scan_processing, inverted, is_new
+        )
+        assert bc == oracle_c
+        np.testing.assert_allclose(ranges, oracle_r, rtol=1e-6)
+        np.testing.assert_allclose(intens, oracle_i, rtol=1e-6)
+
+    def test_empty_scan(self):
+        b = make_batch([10.0], [0.0])
+        msg = to_laserscan(b, 0.1, 12.0)
+        assert int(msg.beam_count) == 0
+
+
+class TestAscend:
+    def test_invalid_angles_interpolated_and_sorted(self):
+        angles = np.array([350.0, 10.0, 20.0, 30.0, 40.0])
+        dists = np.array([0.0, 1.0, 0.0, 1.0, 1.0])
+        b = make_batch(angles, dists, n=16)
+        out, ok = ascend_scan(b)
+        assert bool(ok)
+        a = np.asarray(out.angle_q14)[:5] * 90.0 / 16384.0
+        assert (np.diff(a) >= 0).all()  # sorted
+        d = np.asarray(out.dist_q2)[:5]
+        assert (d >= 0).all()
+
+    def test_all_invalid_returns_not_ok(self):
+        b = make_batch([10.0, 20.0], [0.0, 0.0], n=16)
+        _, ok = ascend_scan(b)
+        assert not bool(ok)

@@ -1,0 +1,220 @@
+"""End-to-end node tests against the dummy backend: lifecycle transitions,
+the 5-state FSM, publishing, hot-plug recovery via fault injection, and
+dynamic reconfigure — the automated version of the reference's manual
+'unplug the cable' protocol (README.md:27-38, SURVEY.md §4)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
+from rplidar_ros2_driver_tpu.node.fsm import DriverState, FsmTimings
+from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleError, LifecycleState
+from rplidar_ros2_driver_tpu.node.node import RPlidarNode, launch
+from rplidar_ros2_driver_tpu.node.publisher import CollectingPublisher
+
+
+def _wait(predicate, timeout=10.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_node(params=None, factory=None):
+    params = params or DriverParams(dummy_mode=True)
+    pub = CollectingPublisher()
+    node = RPlidarNode(
+        params,
+        pub,
+        driver_factory=factory or (lambda: DummyLidarDriver(scan_rate_hz=200.0)),
+        fsm_timings=FsmTimings.fast(),
+    )
+    return node, pub
+
+
+class TestLifecycle:
+    def test_full_cycle(self):
+        node, pub = make_node()
+        assert node.lifecycle_state is LifecycleState.UNCONFIGURED
+        assert node.configure()
+        assert node.lifecycle_state is LifecycleState.INACTIVE
+        assert node.activate()
+        assert node.lifecycle_state is LifecycleState.ACTIVE
+        assert _wait(lambda: pub.scan_count >= 3)
+        assert node.deactivate()
+        assert node.lifecycle_state is LifecycleState.INACTIVE
+        assert node.cleanup()
+        assert node.lifecycle_state is LifecycleState.UNCONFIGURED
+        assert node.shutdown()
+        assert node.lifecycle_state is LifecycleState.FINALIZED
+
+    def test_illegal_transition_raises(self):
+        node, _ = make_node()
+        with pytest.raises(LifecycleError):
+            node.activate()  # must configure first
+
+    def test_tf_published_on_configure(self):
+        node, pub = make_node()
+        node.configure()
+        assert len(pub.tf_static) == 1
+        assert pub.tf_static[0].child == "laser"
+
+    def test_launch_helper_reaches_active(self):
+        node, pub = make_node()
+        launch(node)
+        assert node.lifecycle_state is LifecycleState.ACTIVE
+        assert _wait(lambda: pub.scan_count >= 1)
+        node.shutdown()
+
+
+class TestScanContent:
+    def test_dummy_scan_shape_and_values(self):
+        node, pub = make_node()
+        launch(node)
+        assert _wait(lambda: pub.scan_count >= 2)
+        node.shutdown()
+        msg = pub.scans[-1]
+        # dummy synthesizes 360 points, 2m +/- 0.5m ring
+        assert len(msg.ranges) == 360
+        finite = msg.ranges[np.isfinite(msg.ranges)]
+        assert len(finite) == 360
+        assert finite.min() > 1.4 and finite.max() < 2.6
+        # dummy is not a "new type" driver, so quality 200 >> 2 == 50 —
+        # same as the reference's dynamic_cast path (src/rplidar_node.cpp:585-592)
+        assert (msg.intensities[np.isfinite(msg.ranges)] == 50).all()
+        assert msg.range_min == pytest.approx(0.15)
+        assert msg.range_max == pytest.approx(40.0)
+
+    def test_scan_processing_mode_resamples(self):
+        params = DriverParams(dummy_mode=True, scan_processing=True)
+        node, pub = make_node(params)
+        launch(node)
+        assert _wait(lambda: pub.scan_count >= 2)
+        node.shutdown()
+        msg = pub.scans[-1]
+        assert len(msg.ranges) == 360
+        assert np.isfinite(msg.ranges).sum() > 300
+
+
+class FlakyDriver(DummyLidarDriver):
+    """Fault-injecting fake: healthy scans, then grab failures, then
+    recovery after the FSM recreates the driver."""
+
+    fail_after = 3
+    instances = 0
+
+    def __init__(self):
+        super().__init__(scan_rate_hz=500.0)
+        FlakyDriver.instances += 1
+        self.generation = FlakyDriver.instances
+        self.grabs = 0
+
+    def grab_scan_data(self, timeout_s=2.0):
+        self.grabs += 1
+        if self.generation == 1 and self.grabs > self.fail_after:
+            return None  # simulate unplugged device
+        return super().grab_scan_data(timeout_s)
+
+
+class DeadDriver(DummyLidarDriver):
+    """Never connects — exercises the CONNECTING retry loop."""
+
+    def __init__(self):
+        super().__init__(scan_rate_hz=500.0)
+        self.attempts = 0
+
+    def connect(self, *a):
+        self.attempts += 1
+        return False
+
+    def is_connected(self):
+        return False
+
+
+class SickDriver(DummyLidarDriver):
+    """Health ERROR until the third check — exercises the health gate."""
+
+    checks = 0
+
+    def get_health(self):
+        SickDriver.checks += 1
+        return DeviceHealth.ERROR if SickDriver.checks < 3 else DeviceHealth.OK
+
+
+class TestFaultRecovery:
+    def test_grab_failures_trigger_reset_and_recovery(self):
+        FlakyDriver.instances = 0
+        params = DriverParams(dummy_mode=True, max_retries=2)
+        node, pub = make_node(params, factory=FlakyDriver)
+        launch(node)
+        # first generation fails after 3 grabs -> RESETTING -> new driver scans
+        assert _wait(lambda: node.fsm.reset_count >= 1)
+        before = pub.scan_count
+        assert _wait(lambda: pub.scan_count > before + 2)
+        assert FlakyDriver.instances >= 2
+        node.shutdown()
+
+    def test_connect_retry_loop(self):
+        node, pub = make_node(factory=DeadDriver)
+        launch(node)
+        assert _wait(lambda: node.fsm.driver is not None and node.fsm.driver.attempts >= 3)
+        assert node.fsm.state is DriverState.CONNECTING
+        assert pub.scan_count == 0
+        node.shutdown()
+
+    def test_health_gate_blocks_then_passes(self):
+        SickDriver.checks = 0
+        node, pub = make_node(factory=SickDriver)
+        launch(node)
+        assert _wait(lambda: pub.scan_count >= 1)
+        assert SickDriver.checks >= 3
+        node.shutdown()
+
+
+class TestDynamicReconfigure:
+    def test_rejected_when_not_ready(self):
+        node, _ = make_node()
+        node.configure()  # not activated: no driver yet
+        ok, reason = node.set_parameters({"rpm": 700})
+        assert not ok
+        assert "not ready" in reason.lower()
+
+    def test_rpm_update_and_validation(self):
+        node, pub = make_node()
+        launch(node)
+        assert _wait(lambda: node.fsm.state is DriverState.RUNNING)
+        ok, _ = node.set_parameters({"rpm": 700})
+        assert ok
+        assert node.params.rpm == 700
+        ok, reason = node.set_parameters({"rpm": 1300})
+        assert not ok and "range" in reason
+        ok, _ = node.set_parameters({"scan_processing": True})
+        assert ok and node.params.scan_processing
+        node.shutdown()
+
+    def test_unknown_parameter_rejected(self):
+        node, _ = make_node()
+        launch(node)
+        assert _wait(lambda: node.fsm.state is DriverState.RUNNING)
+        ok, reason = node.set_parameters({"frame_id": "x"})
+        assert not ok and "not runtime-mutable" in reason
+        node.shutdown()
+
+
+class TestDiagnostics:
+    def test_states_reported(self):
+        node, pub = make_node()
+        node.configure()
+        assert pub.diagnostics[-1].message == "Node Inactive (Lifecycle)"
+        launch(node)
+        assert _wait(lambda: node.fsm.state is DriverState.RUNNING)
+        node._update_diagnostics()
+        assert pub.diagnostics[-1].message == "Scanning"
+        assert pub.diagnostics[-1].hardware_id.startswith("rplidar-")
+        node.shutdown()
