@@ -36,6 +36,7 @@ RUNTIME_MUTABLE = ("rpm", "scan_processing", "scan_mode")
 VALID_QOS = ("reliable", "best_effort")
 VALID_BACKENDS = ("cpu", "tpu")
 VALID_CHANNELS = ("serial", "tcp", "udp", "dummy")
+VALID_FILTER_STAGES = ("clip", "polar", "median", "voxel")
 
 
 @dataclasses.dataclass
@@ -96,6 +97,11 @@ class DriverParams:
             raise ValueError("max_retries must be >= 0")
         if self.filter_window < 1:
             raise ValueError("filter_window must be >= 1")
+        bad = set(self.filter_chain) - set(VALID_FILTER_STAGES)
+        if bad:
+            raise ValueError(
+                f"unknown filter_chain stages {sorted(bad)}; valid: {VALID_FILTER_STAGES}"
+            )
         if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
             raise ValueError("invalid voxel grid configuration")
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
@@ -27,6 +28,8 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterOutput,
     FilterState,
     filter_step,
+    pack_host_scan,
+    packed_filter_step,
 )
 
 
@@ -67,6 +70,19 @@ class ScanFilterChain:
     def process(self, batch: ScanBatch) -> FilterOutput:
         batch = jax.device_put(batch, self.device)
         self._state, out = filter_step(self._state, batch, self.cfg)
+        return out
+
+    def process_raw(self, angle_q14, dist_q2, quality, flag=None) -> FilterOutput:
+        """Streaming ingest of raw host arrays via the packed one-transfer path.
+
+        This is the production hot path: one (4, N) device_put + one donated
+        step dispatch per revolution (see ops.filters packed-ingest note).
+        """
+        buf, count = pack_host_scan(angle_q14, dist_q2, quality, flag)
+        packed = jax.device_put(buf, self.device)
+        self._state, out = packed_filter_step(
+            self._state, packed, jnp.asarray(count, jnp.int32), self.cfg
+        )
         return out
 
     # -- checkpoint surface -------------------------------------------------

@@ -156,16 +156,26 @@ class ScanLoopFsm:
         log.info("[FSM] Scan loop started.")
         while self._running.is_set():
             state = self.state
-            if state is DriverState.CONNECTING:
-                self._do_connecting()
-            elif state is DriverState.CHECK_HEALTH:
-                self._do_check_health()
-            elif state is DriverState.WARMUP:
-                self._do_warmup()
-            elif state is DriverState.RUNNING:
-                self._do_running()
-            elif state is DriverState.RESETTING:
-                self._do_resetting()
+            try:
+                if state is DriverState.CONNECTING:
+                    self._do_connecting()
+                elif state is DriverState.CHECK_HEALTH:
+                    self._do_check_health()
+                elif state is DriverState.WARMUP:
+                    self._do_warmup()
+                elif state is DriverState.RUNNING:
+                    self._do_running()
+                elif state is DriverState.RESETTING:
+                    self._do_resetting()
+            except Exception:
+                # A raising driver (or factory) must never kill the loop —
+                # that would defeat the whole recovery design.  Treat it as a
+                # hardware fault and go through RESETTING like any other.
+                log.exception("[FSM] Unhandled error in state %s; resetting", state.value)
+                if state is DriverState.RESETTING:
+                    # factory itself is failing: back off before retrying
+                    self._interruptible_sleep(self._t.reset_backoff_s)
+                self._set_state(DriverState.RESETTING)
             if self.state is not DriverState.RUNNING:
                 self._interruptible_sleep(self._t.idle_tick_s)
         log.info("[FSM] Scan loop terminated.")

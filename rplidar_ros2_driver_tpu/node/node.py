@@ -81,6 +81,14 @@ class RPlidarNode(LifecycleNode):
 
     def on_configure(self) -> bool:
         log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
+        if self._driver_factory is None and not self.params.dummy_mode:
+            # fail fast here, not inside the scan thread (finding: a factory
+            # error in the FSM thread would otherwise surface as silence)
+            try:
+                import rplidar_ros2_driver_tpu.driver.real  # noqa: F401
+            except ImportError as e:
+                log.error("real driver backend unavailable: %s", e)
+                return False
         factory = self._driver_factory or self._default_factory
         self.fsm = ScanLoopFsm(
             factory,
@@ -123,8 +131,14 @@ class RPlidarNode(LifecycleNode):
     def on_cleanup(self) -> bool:
         self.fsm = None
         self.chain = None
-        self._chain_snapshot = None
+        # _chain_snapshot intentionally survives cleanup: it is the
+        # checkpoint/resume surface (SURVEY.md §5) — a later configure
+        # restores the rolling window.  discard_checkpoint() drops it.
         return True
+
+    def discard_checkpoint(self) -> None:
+        """Forget the saved filter-window snapshot (next configure starts cold)."""
+        self._chain_snapshot = None
 
     def on_shutdown(self) -> bool:
         return True

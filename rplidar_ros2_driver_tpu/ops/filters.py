@@ -131,12 +131,13 @@ def grid_resample(batch: ScanBatch, beams: int):
     return ranges, inten
 
 
-def temporal_median(window: jax.Array, filled: jax.Array) -> jax.Array:
-    """Per-beam lower median over the filled part of the (W, B) ring.
+def temporal_median(window: jax.Array) -> jax.Array:
+    """Per-beam lower median over the (W, B) ring.
 
-    +inf marks missing returns; they sort to the tail so the median is
-    taken over actual returns only.  Beams with no return in the whole
-    window stay +inf.
+    +inf marks both missing returns and unfilled ring slots; they sort to
+    the tail so the median is taken over actual returns only.  Beams with
+    no return in the whole window stay +inf.  (Correctness depends on the
+    ring being initialized to +inf — never seed it with finite values.)
     """
     w = window.shape[0]
     s = jnp.sort(window, axis=0)  # inf sorts last
@@ -174,6 +175,12 @@ def voxel_hits(xy: jax.Array, mask: jax.Array, grid: int, cell_m: float) -> jax.
 def filter_step(
     state: FilterState, batch: ScanBatch, cfg: FilterConfig
 ) -> tuple[FilterState, FilterOutput]:
+    return _filter_step_impl(state, batch, cfg)
+
+
+def _filter_step_impl(
+    state: FilterState, batch: ScanBatch, cfg: FilterConfig
+) -> tuple[FilterState, FilterOutput]:
     """One revolution through the full chain; single fused XLA program.
 
     clip -> grid resample -> ring-buffer update -> temporal median ->
@@ -189,7 +196,7 @@ def filter_step(
     filled = jnp.minimum(state.filled + 1, rw.shape[0])
 
     if cfg.enable_median:
-        med = temporal_median(rw, filled)
+        med = temporal_median(rw)
     else:
         med = ranges
     xy, mask = polar_to_cartesian(med, cfg.beams)
@@ -223,3 +230,56 @@ def filter_step(
         voxel=voxel_acc,
     )
     return new_state, out
+
+
+# ---------------------------------------------------------------------------
+# packed streaming ingest — the production host->device path
+# ---------------------------------------------------------------------------
+#
+# Shipping a ScanBatch field-by-field costs one transfer dispatch per array;
+# through a remote-attached TPU each dispatch carries link overhead (measured
+# ~5 ms/scan on the axon tunnel).  The streaming path instead ships ONE
+# (4, N) int32 array [angle_q14; dist_q2; quality; flag] plus a count scalar
+# and rebuilds the ScanBatch inside the jitted program.  The state is donated
+# so the rolling window updates in place (no HBM churn at W x B scale).
+
+PACKED_FIELDS = 4  # rows: angle_q14, dist_q2, quality, flag
+
+
+def pack_host_scan(
+    angle_q14, dist_q2, quality, flag=None, n: int | None = None
+):
+    """Pack raw host arrays into the single (4, n) transfer buffer + count."""
+    import numpy as np
+
+    from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
+
+    n = n or MAX_SCAN_NODES
+    count = int(len(angle_q14))
+    if count > n:
+        raise ValueError(f"scan of {count} nodes exceeds capacity {n}")
+    buf = np.zeros((PACKED_FIELDS, n), np.int32)
+    buf[0, :count] = angle_q14
+    buf[1, :count] = dist_q2
+    buf[2, :count] = quality
+    if flag is not None:
+        buf[3, :count] = flag
+    return buf, count
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def packed_filter_step(
+    state: FilterState, packed: jax.Array, count: jax.Array, cfg: FilterConfig
+) -> tuple[FilterState, FilterOutput]:
+    """filter_step over the single-buffer wire form (see module note above)."""
+    i = jnp.arange(packed.shape[1], dtype=jnp.int32)
+    live = i < count
+    batch = ScanBatch(
+        angle_q14=packed[0],
+        dist_q2=packed[1],
+        quality=packed[2],
+        flag=packed[3],
+        valid=live,
+        count=count,
+    )
+    return _filter_step_impl(state, batch, cfg)

@@ -1,0 +1,266 @@
+"""SPMD scale-out of the filter chain over a TPU device mesh.
+
+The reference is a single-process driver for ONE lidar (SURVEY.md §2.3:
+DP/TP/SP are absent there).  The TPU framework makes scale-out first-class:
+
+  * **stream parallelism** (the data-parallel axis): many lidar units —
+    a multi-sensor rig or a fleet gateway — each with its own rolling
+    window/voxel state, mapped onto mesh axis ``"stream"``.
+  * **beam parallelism** (the sequence-parallel axis): the fixed angular
+    grid of B beams is sharded across mesh axis ``"beam"``.  The temporal
+    median is beam-local (window axis is on-device everywhere), the voxel
+    accumulation is a partial-sum per shard reduced with ``psum`` over
+    the beam axis — a single ICI all-reduce per revolution.
+
+Everything is expressed with ``jax.sharding.Mesh`` + ``shard_map`` so XLA
+inserts the collectives; there is no hand-written communication.  The
+reference's analog of the interconnect is its serial/TCP byte channel
+(SURVEY.md §2.3 note 1); here the interconnect is ICI and the "bytes" are
+sharded device arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.ops.filters import (
+    FilterConfig,
+    FilterOutput,
+    FilterState,
+    clip_filter,
+    temporal_median,
+)
+
+_INT_INF = jnp.int32(0x7FFFFFFF)
+TWO_PI = 2.0 * jnp.pi
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    stream: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a 2-D ``(stream, beam)`` mesh over the available devices.
+
+    ``stream`` fixes the data-parallel extent; the beam (sequence-parallel)
+    axis takes the rest.  Defaults to the squarest split with stream <= beam.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    devices = np.asarray(devices[:n_devices])
+    if stream is None:
+        stream = 1
+        for s in range(int(np.sqrt(n_devices)), 0, -1):
+            if n_devices % s == 0:
+                stream = s
+                break
+    if n_devices % stream:
+        raise ValueError(f"stream={stream} does not divide {n_devices} devices")
+    beam = n_devices // stream
+    return Mesh(devices.reshape(stream, beam), axis_names=("stream", "beam"))
+
+
+# ---------------------------------------------------------------------------
+# per-shard kernels (run inside shard_map; see ops/filters.py for the
+# single-device originals they re-derive with global-index arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def _grid_resample_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
+    """Scatter-min the (replicated) point set into this shard's beam slice.
+
+    Each beam shard sees every point of its stream's scan but keeps only
+    those whose global beam index lands in its [offset, offset+b_local)
+    slice — out-of-slice points scatter with ``mode="drop"``.  No
+    communication: the drop IS the partition.
+    """
+    offset = jax.lax.axis_index("beam") * b_local
+    ok = batch.valid & (batch.dist_q2 != 0)
+    # same clip as the single-device grid_resample: malformed angles land in
+    # the edge beams rather than being dropped (bit-identical contract)
+    beam_global = jnp.clip((batch.angle_q14 * cfg.beams) // 65536, 0, cfg.beams - 1)
+    beam_local = beam_global - offset
+    in_slice = ok & (beam_local >= 0) & (beam_local < b_local)
+    packed = (batch.dist_q2 << 8) | jnp.clip(batch.quality, 0, 255)
+    packed = jnp.where(in_slice, packed, _INT_INF)
+    idx = jnp.where(in_slice, beam_local, b_local)  # b_local scatters to drop
+    grid = jnp.full((b_local,), _INT_INF, jnp.int32).at[idx].min(packed, mode="drop")
+    hit = grid != _INT_INF
+    ranges = jnp.where(hit, (grid >> 8).astype(jnp.float32) * (1.0 / 4000.0), jnp.inf)
+    inten = jnp.where(hit, (grid & 0xFF).astype(jnp.float32), 0.0)
+    return ranges, inten
+
+
+def _polar_to_cartesian_shard(ranges: jax.Array, cfg: FilterConfig, b_local: int):
+    """Like ops.filters.polar_to_cartesian but with global beam angles."""
+    offset = jax.lax.axis_index("beam") * b_local
+    gidx = offset + jnp.arange(b_local, dtype=jnp.int32)
+    theta = (gidx.astype(jnp.float32) + 0.5) * (TWO_PI / cfg.beams)
+    finite = jnp.isfinite(ranges)
+    r = jnp.where(finite, ranges, 0.0)
+    xy = jnp.stack([r * jnp.cos(theta), r * jnp.sin(theta)], axis=-1)
+    return xy, finite
+
+
+def _voxel_hits_partial(xy: jax.Array, mask: jax.Array, cfg: FilterConfig) -> jax.Array:
+    """This beam shard's partial (G, G) occupancy counts for one scan."""
+    grid = cfg.grid
+    half = grid // 2
+    ij = jnp.floor(xy / cfg.cell_m).astype(jnp.int32) + half
+    inb = mask & (ij[:, 0] >= 0) & (ij[:, 0] < grid) & (ij[:, 1] >= 0) & (ij[:, 1] < grid)
+    flat = jnp.where(inb, ij[:, 0] * grid + ij[:, 1], grid * grid)
+    counts = jnp.zeros((grid * grid,), jnp.int32).at[flat].add(1, mode="drop")
+    return counts.reshape(grid, grid)
+
+
+def _filter_step_shard(
+    state: FilterState, batch: ScanBatch, cfg: FilterConfig, b_local: int
+) -> tuple[FilterState, FilterOutput]:
+    """One stream's chain step on one (stream, beam) shard.
+
+    Beam-local throughout except the voxel partial-sum psum at the end.
+    """
+    if cfg.enable_clip:
+        batch = clip_filter(batch, cfg)
+    ranges, inten = _grid_resample_shard(batch, cfg, b_local)
+
+    rw = jax.lax.dynamic_update_index_in_dim(state.range_window, ranges, state.cursor, 0)
+    iw = jax.lax.dynamic_update_index_in_dim(state.inten_window, inten, state.cursor, 0)
+    filled = jnp.minimum(state.filled + 1, rw.shape[0])
+
+    med = temporal_median(rw) if cfg.enable_median else ranges
+    xy, mask = _polar_to_cartesian_shard(med, cfg, b_local)
+
+    if cfg.enable_voxel:
+        # partial hits per beam shard -> one all-reduce over the beam axis
+        new_hits = jax.lax.psum(_voxel_hits_partial(xy, mask, cfg), "beam")
+        old_hits = jax.lax.dynamic_index_in_dim(
+            state.hit_window, state.cursor, 0, keepdims=False
+        )
+        voxel_acc = state.voxel_acc + new_hits - old_hits
+        hw = jax.lax.dynamic_update_index_in_dim(
+            state.hit_window, new_hits, state.cursor, 0
+        )
+    else:
+        voxel_acc = state.voxel_acc
+        hw = state.hit_window
+
+    new_state = FilterState(
+        range_window=rw,
+        inten_window=iw,
+        hit_window=hw,
+        voxel_acc=voxel_acc,
+        cursor=(state.cursor + 1) % rw.shape[0],
+        filled=filled,
+    )
+    out = FilterOutput(
+        ranges=med, intensities=inten, points_xy=xy, point_mask=mask, voxel=voxel_acc
+    )
+    return new_state, out
+
+
+# ---------------------------------------------------------------------------
+# public builder
+# ---------------------------------------------------------------------------
+
+# PartitionSpecs for the batched (leading stream axis) pytrees.
+STATE_SPEC = FilterState(
+    range_window=P("stream", None, "beam"),
+    inten_window=P("stream", None, "beam"),
+    hit_window=P("stream", None, None, None),   # replicated over beam (post-psum)
+    voxel_acc=P("stream", None, None),
+    cursor=P("stream"),
+    filled=P("stream"),
+)
+BATCH_SPEC = ScanBatch(
+    angle_q14=P("stream", None),
+    dist_q2=P("stream", None),
+    quality=P("stream", None),
+    flag=P("stream", None),
+    valid=P("stream", None),
+    count=P("stream"),
+)
+OUT_SPEC = FilterOutput(
+    ranges=P("stream", "beam"),
+    intensities=P("stream", "beam"),
+    points_xy=P("stream", "beam", None),
+    point_mask=P("stream", "beam"),
+    voxel=P("stream", None, None),
+)
+
+
+def build_sharded_step(mesh: Mesh, cfg: FilterConfig) -> Callable:
+    """Jit-compiled multi-stream filter step over ``mesh``.
+
+    Signature: ``step(state, batch) -> (state, out)`` where every leaf of
+    ``state``/``batch`` has a leading stream axis divisible by the mesh's
+    stream extent and ``cfg.beams`` is divisible by its beam extent.
+    """
+    n_beam = mesh.shape["beam"]
+    if cfg.beams % n_beam:
+        raise ValueError(f"beams={cfg.beams} not divisible by beam axis {n_beam}")
+    b_local = cfg.beams // n_beam
+
+    def per_shard(state: FilterState, batch: ScanBatch):
+        # leading local-stream axis: vmap the per-stream shard step
+        step = functools.partial(_filter_step_shard, cfg=cfg, b_local=b_local)
+        return jax.vmap(step)(state, batch)
+
+    kwargs = dict(
+        mesh=mesh, in_specs=(STATE_SPEC, BATCH_SPEC), out_specs=(STATE_SPEC, OUT_SPEC)
+    )
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        sharded = shard_map(per_shard, **kwargs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        sharded = shard_map(per_shard, **kwargs, check_rep=False)
+    return jax.jit(sharded)
+
+
+def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterState:
+    """Batched FilterState with leading stream axis, placed per STATE_SPEC."""
+    if streams % mesh.shape["stream"]:
+        raise ValueError(
+            f"streams={streams} not divisible by stream axis {mesh.shape['stream']}"
+        )
+    base = FilterState(
+        range_window=jnp.full((streams, cfg.window, cfg.beams), jnp.inf, jnp.float32),
+        inten_window=jnp.zeros((streams, cfg.window, cfg.beams), jnp.float32),
+        hit_window=jnp.zeros((streams, cfg.window, cfg.grid, cfg.grid), jnp.int32),
+        voxel_acc=jnp.zeros((streams, cfg.grid, cfg.grid), jnp.int32),
+        cursor=jnp.zeros((streams,), jnp.int32),
+        filled=jnp.zeros((streams,), jnp.int32),
+    )
+    return jax.device_put(
+        base,
+        jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            STATE_SPEC,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+
+
+def shard_batch(mesh: Mesh, batch: ScanBatch) -> ScanBatch:
+    """Place a stream-batched ScanBatch according to BATCH_SPEC."""
+    return jax.device_put(
+        batch,
+        jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            BATCH_SPEC,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )

@@ -68,10 +68,10 @@ class TestTemporalMedian:
                 np.float32,
             )
         )
-        med = np.asarray(filters.temporal_median(w, jnp.int32(4)))
+        med = np.asarray(filters.temporal_median(w))
         assert med[0] == pytest.approx(2.0)  # lower median of {1,2,3}
         assert med[1] == pytest.approx(5.0)
-        empty = filters.temporal_median(jnp.full((4, 1), jnp.inf), jnp.int32(4))
+        empty = filters.temporal_median(jnp.full((4, 1), jnp.inf))
         assert np.isinf(np.asarray(empty)[0])
 
     def test_median_denoises_outlier(self):

@@ -147,7 +147,37 @@ class SickDriver(DummyLidarDriver):
         return DeviceHealth.ERROR if SickDriver.checks < 3 else DeviceHealth.OK
 
 
+class RaisingDriver(DummyLidarDriver):
+    """Throws from grab — the FSM loop must route it through RESETTING
+    instead of dying (the reference loop survives all hardware faults)."""
+
+    instances = 0
+
+    def __init__(self):
+        super().__init__(scan_rate_hz=500.0)
+        RaisingDriver.instances += 1
+        self.generation = RaisingDriver.instances
+        self.grabs = 0
+
+    def grab_scan_data(self, timeout_s=2.0):
+        self.grabs += 1
+        if self.generation == 1 and self.grabs > 2:
+            raise OSError("device vanished mid-read")
+        return super().grab_scan_data(timeout_s)
+
+
 class TestFaultRecovery:
+    def test_raising_driver_recovers_via_reset(self):
+        RaisingDriver.instances = 0
+        node, pub = make_node(factory=RaisingDriver)
+        launch(node)
+        assert _wait(lambda: node.fsm.reset_count >= 1)
+        before = pub.scan_count
+        assert _wait(lambda: pub.scan_count > before + 2)
+        assert RaisingDriver.instances >= 2
+        assert node.fsm._thread.is_alive()
+        node.shutdown()
+
     def test_grab_failures_trigger_reset_and_recovery(self):
         FlakyDriver.instances = 0
         params = DriverParams(dummy_mode=True, max_retries=2)
