@@ -1,0 +1,105 @@
+/* C API of the native runtime for the TPU lidar framework.
+ *
+ * Native equivalents of the reference's I/O stack, redesigned rather than
+ * translated (behavioral contracts cited per function):
+ *   - request/response protocol codec   (ref: src/sdk/src/sl_lidarprotocol_codec.cpp)
+ *   - serial channel, termios2 BOTHER   (ref: src/sdk/src/arch/linux/net_serial.cpp)
+ *   - TCP / UDP channels                (ref: src/sdk/src/sl_tcp_channel.cpp, sl_udp_channel.cpp)
+ *   - async transceiver (rx thread + decoded-message queue)
+ *                                       (ref: src/sdk/src/sl_async_transceiver.cpp)
+ *
+ * Everything is exposed through a flat extern "C" surface so the Python side
+ * binds with ctypes (no pybind11 in this image).
+ */
+
+#ifndef RPL_NATIVE_H_
+#define RPL_NATIVE_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------- result codes ---------------- */
+enum {
+  RPL_OK = 0,
+  RPL_TIMEOUT = -1,
+  RPL_ERR = -2,
+  RPL_CLOSED = -3,
+  RPL_TOOSMALL = -4,
+};
+
+/* ---------------- codec ---------------- */
+
+/* Encode a request: A5 | cmd [| size | payload | xor-checksum].
+ * Returns packet length, or RPL_TOOSMALL / RPL_ERR. */
+int rpl_encode_command(uint8_t cmd, const uint8_t* payload, size_t payload_len,
+                       uint8_t* out, size_t out_cap);
+
+typedef struct rpl_decoder rpl_decoder;
+
+rpl_decoder* rpl_decoder_create(void);
+void rpl_decoder_destroy(rpl_decoder* d);
+/* Reset decode state == exitLoopMode (ref codec :66-68). */
+void rpl_decoder_reset(rpl_decoder* d);
+/* Feed a chunk of rx bytes; decoded messages queue internally. */
+void rpl_decoder_feed(rpl_decoder* d, const uint8_t* data, size_t len);
+/* Number of complete messages waiting. */
+size_t rpl_decoder_pending(const rpl_decoder* d);
+/* Pop the oldest message.  Returns payload length (>= 0), RPL_TIMEOUT if
+ * none pending, RPL_TOOSMALL if cap is insufficient (message stays queued). */
+int rpl_decoder_pop(rpl_decoder* d, uint8_t* ans_type, int* is_loop,
+                    uint8_t* payload, size_t cap);
+
+/* ---------------- channels ---------------- */
+
+typedef struct rpl_channel rpl_channel;
+
+rpl_channel* rpl_serial_channel_create(const char* device, uint32_t baudrate);
+rpl_channel* rpl_tcp_channel_create(const char* host, int port);
+rpl_channel* rpl_udp_channel_create(const char* host, int port);
+
+int rpl_channel_open(rpl_channel* c);
+void rpl_channel_close(rpl_channel* c);
+int rpl_channel_is_open(const rpl_channel* c);
+/* Write all bytes; returns count written or RPL_ERR. */
+int rpl_channel_write(rpl_channel* c, const uint8_t* data, size_t len);
+/* Wait up to timeout_ms for data, then read at most cap bytes.
+ * Returns bytes read (> 0), RPL_TIMEOUT, RPL_CLOSED or RPL_ERR. */
+int rpl_channel_read(rpl_channel* c, uint8_t* out, size_t cap, int timeout_ms);
+/* DTR line (serial only; motor control on A-series).  RPL_ERR otherwise. */
+int rpl_channel_set_dtr(rpl_channel* c, int level);
+/* Unblock a pending read from another thread (self-pipe). */
+void rpl_channel_cancel(rpl_channel* c);
+void rpl_channel_destroy(rpl_channel* c);
+
+/* ---------------- async transceiver ---------------- */
+
+typedef struct rpl_transceiver rpl_transceiver;
+
+/* Borrows the channel (caller keeps ownership; destroy transceiver first). */
+rpl_transceiver* rpl_transceiver_create(rpl_channel* ch);
+void rpl_transceiver_destroy(rpl_transceiver* t);
+/* Opens the channel and spawns the rx thread. */
+int rpl_transceiver_start(rpl_transceiver* t);
+/* Joins the rx thread and closes the channel. */
+void rpl_transceiver_stop(rpl_transceiver* t);
+/* Synchronous encoded-packet send (ref sendMessage :261-297). */
+int rpl_transceiver_send(rpl_transceiver* t, const uint8_t* pkt, size_t len);
+/* Block up to timeout_ms for one decoded message.  Returns payload length,
+ * RPL_TIMEOUT, RPL_CLOSED (rx thread gone / channel error), RPL_TOOSMALL. */
+int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
+                                 uint8_t* ans_type, int* is_loop,
+                                 uint8_t* payload, size_t cap);
+/* Drop queued messages and reset decode state (scan-mode changes). */
+void rpl_transceiver_reset_decoder(rpl_transceiver* t);
+/* Nonzero once the rx thread observed a channel error (hot-unplug). */
+int rpl_transceiver_error(const rpl_transceiver* t);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* RPL_NATIVE_H_ */

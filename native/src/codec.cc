@@ -1,0 +1,165 @@
+// Protocol codec: request encoder + streaming response decoder.
+//
+// Behavioral contract from the reference codec
+// (src/sdk/src/sl_lidarprotocol_codec.cpp): requests are
+// A5 | cmd [| size | payload | xor-checksum] where the checksum covers every
+// preceding byte (:78-130); responses are A5 5A | u32le size(30b)+subtype(2b)
+// | type | payload, and when subtype bit0 (loop flag) is set the decoder
+// keeps re-emitting fixed-size payloads without new headers until reset
+// (:142-233).  This implementation is a fresh state machine over whole
+// buffers with an internal message queue (the reference delivers through a
+// listener callback from its decoder thread; here the queue decouples the
+// decoder from any threading model so the same codec serves both the
+// transceiver's rx thread and offline unit tests).
+
+#include "rpl_native.h"
+
+#include <cstring>
+#include <deque>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kCmdSync = 0xA5;
+constexpr uint8_t kAnsSync1 = 0xA5;
+constexpr uint8_t kAnsSync2 = 0x5A;
+constexpr uint8_t kCmdFlagHasPayload = 0x80;
+constexpr uint32_t kSizeMask = 0x3FFFFFFFu;
+constexpr int kSubtypeShift = 30;
+constexpr uint32_t kPktFlagLoop = 0x1;
+
+struct Message {
+  uint8_t ans_type;
+  bool is_loop;
+  std::vector<uint8_t> payload;
+};
+
+}  // namespace
+
+extern "C" int rpl_encode_command(uint8_t cmd, const uint8_t* payload,
+                                  size_t payload_len, uint8_t* out,
+                                  size_t out_cap) {
+  if (cmd & kCmdFlagHasPayload) {
+    if (payload_len > 0xFF) return RPL_ERR;
+    const size_t total = 3 + payload_len + 1;
+    if (out_cap < total) return RPL_TOOSMALL;
+    out[0] = kCmdSync;
+    out[1] = cmd;
+    out[2] = static_cast<uint8_t>(payload_len);
+    if (payload_len) std::memcpy(out + 3, payload, payload_len);
+    uint8_t checksum = 0;
+    for (size_t i = 0; i < total - 1; ++i) checksum ^= out[i];
+    out[total - 1] = checksum;
+    return static_cast<int>(total);
+  }
+  if (payload_len) return RPL_ERR;  // plain commands carry no payload
+  if (out_cap < 2) return RPL_TOOSMALL;
+  out[0] = kCmdSync;
+  out[1] = cmd;
+  return 2;
+}
+
+struct rpl_decoder {
+  enum class State { kSync1, kSync2, kHeader, kPayload } state = State::kSync1;
+  uint8_t header[5];  // u32 size/subtype + type byte
+  size_t header_got = 0;
+  uint8_t ans_type = 0;
+  uint32_t payload_len = 0;
+  bool in_loop = false;
+  std::vector<uint8_t> payload;
+  std::deque<Message> queue;
+
+  void Reset() {
+    state = State::kSync1;
+    header_got = 0;
+    payload.clear();
+    in_loop = false;
+  }
+
+  void Emit() {
+    Message m;
+    m.ans_type = ans_type;
+    m.is_loop = in_loop;
+    m.payload = std::move(payload);
+    payload.clear();
+    queue.push_back(std::move(m));
+  }
+
+  void Feed(const uint8_t* data, size_t len) {
+    for (size_t i = 0; i < len; ++i) {
+      const uint8_t b = data[i];
+      switch (state) {
+        case State::kSync1:
+          if (b == kAnsSync1) state = State::kSync2;
+          break;
+        case State::kSync2:
+          if (b == kAnsSync2) {
+            state = State::kHeader;
+            header_got = 0;
+          } else if (b != kAnsSync1) {
+            // A5 A5 5A must still sync (second A5 restarts the hunt)
+            state = State::kSync1;
+          }
+          break;
+        case State::kHeader:
+          header[header_got++] = b;
+          if (header_got == sizeof(header)) {
+            uint32_t word;
+            std::memcpy(&word, header, 4);  // wire is little-endian
+            payload_len = word & kSizeMask;
+            in_loop = ((word >> kSubtypeShift) & kPktFlagLoop) != 0;
+            ans_type = header[4];
+            payload.clear();
+            if (payload_len == 0) {
+              // header-only packet (ref :196-199)
+              Emit();
+              state = State::kSync1;
+            } else {
+              state = State::kPayload;
+            }
+          }
+          break;
+        case State::kPayload:
+          payload.push_back(b);
+          if (payload.size() == payload_len) {
+            Emit();
+            // loop mode: same header keeps producing payloads (ref :205-228)
+            state = in_loop ? State::kPayload : State::kSync1;
+          }
+          break;
+      }
+    }
+  }
+};
+
+extern "C" {
+
+rpl_decoder* rpl_decoder_create(void) { return new rpl_decoder(); }
+
+void rpl_decoder_destroy(rpl_decoder* d) { delete d; }
+
+void rpl_decoder_reset(rpl_decoder* d) {
+  d->Reset();
+  d->queue.clear();
+}
+
+void rpl_decoder_feed(rpl_decoder* d, const uint8_t* data, size_t len) {
+  d->Feed(data, len);
+}
+
+size_t rpl_decoder_pending(const rpl_decoder* d) { return d->queue.size(); }
+
+int rpl_decoder_pop(rpl_decoder* d, uint8_t* ans_type, int* is_loop,
+                    uint8_t* payload, size_t cap) {
+  if (d->queue.empty()) return RPL_TIMEOUT;
+  const Message& m = d->queue.front();
+  if (m.payload.size() > cap) return RPL_TOOSMALL;
+  *ans_type = m.ans_type;
+  *is_loop = m.is_loop ? 1 : 0;
+  if (!m.payload.empty()) std::memcpy(payload, m.payload.data(), m.payload.size());
+  const int n = static_cast<int>(m.payload.size());
+  d->queue.pop_front();
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,172 @@
+// Async transceiver: rx thread feeding the codec, condvar-signaled queue.
+//
+// Native re-design of the reference's two-thread AsyncTransceiver
+// (src/sdk/src/sl_async_transceiver.cpp:299-409: rx thread reads into a
+// queue, a second decoder thread drains it through the codec).  Here one
+// thread reads AND decodes — the decode is a trivial state machine that
+// never blocks, so a second thread only adds a hand-off — and completed
+// messages land in a mutex+condvar queue the consumer pops with a timeout
+// (the Waiter role, hal/waiter.h).  Channel errors set an error flag the
+// driver's FSM polls for hot-unplug detection (ref :311-321,340-347).
+
+#include "rpl_native.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Message {
+  uint8_t ans_type;
+  bool is_loop;
+  std::vector<uint8_t> payload;
+};
+
+constexpr size_t kReadChunk = 4096;
+constexpr size_t kMaxQueued = 8192;  // bound memory if the consumer stalls
+
+}  // namespace
+
+struct rpl_transceiver {
+  rpl_channel* channel = nullptr;  // borrowed
+  rpl_decoder* decoder = nullptr;
+  std::thread rx_thread;
+  std::atomic<bool> running{false};
+  std::atomic<bool> channel_error{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<Message> queue;
+  bool reset_requested = false;
+
+  void RxLoop();
+};
+
+void rpl_transceiver::RxLoop() {
+  std::vector<uint8_t> buf(kReadChunk);
+  std::vector<uint8_t> payload(64 * 1024);
+  while (running.load(std::memory_order_relaxed)) {
+    int n = rpl_channel_read(channel, buf.data(), buf.size(), 1000);
+    if (n == RPL_TIMEOUT) continue;
+    if (n <= 0) {
+      if (!running.load(std::memory_order_relaxed)) break;
+      channel_error.store(true, std::memory_order_relaxed);
+      std::lock_guard<std::mutex> lk(mu);
+      cv.notify_all();
+      break;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (reset_requested) {
+        rpl_decoder_reset(decoder);
+        queue.clear();
+        reset_requested = false;
+      }
+      rpl_decoder_feed(decoder, buf.data(), static_cast<size_t>(n));
+      bool pushed = false;
+      for (;;) {
+        uint8_t ans_type;
+        int is_loop;
+        int plen = rpl_decoder_pop(decoder, &ans_type, &is_loop, payload.data(),
+                                   payload.size());
+        if (plen < 0) break;
+        if (queue.size() >= kMaxQueued) queue.pop_front();  // drop oldest
+        Message m;
+        m.ans_type = ans_type;
+        m.is_loop = is_loop != 0;
+        m.payload.assign(payload.begin(), payload.begin() + plen);
+        queue.push_back(std::move(m));
+        pushed = true;
+      }
+      if (pushed) cv.notify_all();
+    }
+  }
+}
+
+extern "C" {
+
+rpl_transceiver* rpl_transceiver_create(rpl_channel* ch) {
+  if (!ch) return nullptr;
+  rpl_transceiver* t = new rpl_transceiver();
+  t->channel = ch;
+  t->decoder = rpl_decoder_create();
+  return t;
+}
+
+void rpl_transceiver_destroy(rpl_transceiver* t) {
+  if (!t) return;
+  rpl_transceiver_stop(t);
+  rpl_decoder_destroy(t->decoder);
+  delete t;
+}
+
+int rpl_transceiver_start(rpl_transceiver* t) {
+  if (!t) return RPL_ERR;
+  if (t->running.load()) return RPL_OK;
+  if (rpl_channel_open(t->channel) != RPL_OK) return RPL_ERR;
+  t->channel_error.store(false);
+  t->running.store(true);
+  t->rx_thread = std::thread(&rpl_transceiver::RxLoop, t);
+  return RPL_OK;
+}
+
+void rpl_transceiver_stop(rpl_transceiver* t) {
+  if (!t) return;
+  if (t->running.exchange(false)) {
+    rpl_channel_cancel(t->channel);  // unblock the select()
+    if (t->rx_thread.joinable()) t->rx_thread.join();
+  }
+  rpl_channel_close(t->channel);
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->queue.clear();
+  rpl_decoder_reset(t->decoder);
+}
+
+int rpl_transceiver_send(rpl_transceiver* t, const uint8_t* pkt, size_t len) {
+  if (!t || !t->running.load()) return RPL_ERR;
+  return rpl_channel_write(t->channel, pkt, len);
+}
+
+int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
+                                 uint8_t* ans_type, int* is_loop,
+                                 uint8_t* payload, size_t cap) {
+  if (!t) return RPL_ERR;
+  std::unique_lock<std::mutex> lk(t->mu);
+  if (t->queue.empty()) {
+    auto pred = [&] { return !t->queue.empty() || t->channel_error.load(); };
+    if (timeout_ms < 0) {
+      t->cv.wait(lk, pred);
+    } else if (!t->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+      return RPL_TIMEOUT;
+    }
+  }
+  if (t->queue.empty()) {
+    return t->channel_error.load() ? RPL_CLOSED : RPL_TIMEOUT;
+  }
+  const Message& m = t->queue.front();
+  if (m.payload.size() > cap) return RPL_TOOSMALL;
+  *ans_type = m.ans_type;
+  *is_loop = m.is_loop ? 1 : 0;
+  if (!m.payload.empty()) std::memcpy(payload, m.payload.data(), m.payload.size());
+  const int n = static_cast<int>(m.payload.size());
+  t->queue.pop_front();
+  return n;
+}
+
+void rpl_transceiver_reset_decoder(rpl_transceiver* t) {
+  if (!t) return;
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->queue.clear();
+  t->reset_requested = true;  // applied by the rx thread before next feed
+}
+
+int rpl_transceiver_error(const rpl_transceiver* t) {
+  return (t && t->channel_error.load()) ? 1 : 0;
+}
+
+}  // extern "C"

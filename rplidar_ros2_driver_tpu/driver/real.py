@@ -1,0 +1,378 @@
+"""RealLidarDriver — hardware backend over the native I/O runtime.
+
+Equivalent of the reference's ``RealLidarDriver`` wrapper plus the driver
+core it delegates to (src/lidar_driver_wrapper.cpp:97-405 over
+sl_lidar_driver.cpp), re-composed for this framework:
+
+  * transport: native C++ channel + transceiver (native/src/*.cc) selected
+    by ``channel_type`` (serial/tcp/udp — the reference's channel factories,
+    sl_lidar_driver.h:260-274)
+  * request plane: CommandEngine (protocol/engine.py) + conf protocol
+    (protocol/conf.py)
+  * scan plane: measurement payloads stream off the pump thread into the
+    per-format scalar decoders (ops/unpack_ref.py — golden-tested against
+    the vectorized JAX unpackers) and assemble into revolutions
+    (driver/assembly.ScanAssembler, the ScanDataHolder equivalent)
+  * strategy: model detection via models/tables.detect_profile; start_motor
+    follows the reference's two strategies (src/lidar_driver_wrapper.cpp:
+    193-268): NEW_TYPE = RPM control + mode enumeration with
+    user-pref → DenseBoost → Sensitivity fallback + express scan;
+    OLD_TYPE = 600 RPM default + legacy startScan.
+"""
+
+from __future__ import annotations
+
+import logging
+import struct
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+from rplidar_ros2_driver_tpu.driver.interface import LidarDriverInterface
+from rplidar_ros2_driver_tpu.models.tables import (
+    DeviceInfo,
+    DriverProfile,
+    ProtocolType,
+    detect_profile,
+)
+from rplidar_ros2_driver_tpu.ops import unpack_ref
+from rplidar_ros2_driver_tpu.protocol import conf as confproto
+from rplidar_ros2_driver_tpu.protocol.constants import Ans, Cmd
+from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine, TransceiverLike
+
+log = logging.getLogger("rplidar_tpu.real")
+
+DEFAULT_RPM = 600  # src/lidar_driver_wrapper.cpp:187,262
+LEGACY_MAX_DISTANCE = 12.0
+NEW_TYPE_MAX_DISTANCE = 40.0
+
+
+def _default_transceiver_factory(
+    channel_type: str, port: str, baudrate: int, host: str, net_port: int
+) -> TransceiverLike:
+    from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
+
+    if channel_type == "serial":
+        ch = NativeChannel("serial", port, baud=baudrate)
+    elif channel_type == "tcp":
+        ch = NativeChannel("tcp", host, port=net_port)
+    elif channel_type == "udp":
+        ch = NativeChannel("udp", host, port=net_port)
+    else:
+        raise ValueError(f"unsupported channel_type {channel_type!r}")
+    return NativeTransceiver(ch)
+
+
+class _ScanDecoder:
+    """Routes measurement payloads to the right per-format scalar decoder
+    and pushes decoded nodes into the assembler (the role of the reference's
+    data-unpacker engine, dataunpacker.cpp:123-202, with auto-select on
+    answer-type change + reset)."""
+
+    def __init__(self, assembler: ScanAssembler) -> None:
+        self._assembler = assembler
+        self._active_ans: Optional[int] = None
+        self._decoder = None
+
+    def reset(self) -> None:
+        self._active_ans = None
+        self._decoder = None
+
+    def _make(self, ans_type: int):
+        if ans_type == Ans.MEASUREMENT_CAPSULED:
+            return unpack_ref.CapsuleDecoder()
+        if ans_type == Ans.MEASUREMENT_CAPSULED_ULTRA:
+            return unpack_ref.UltraCapsuleDecoder()
+        if ans_type == Ans.MEASUREMENT_DENSE_CAPSULED:
+            return unpack_ref.DenseCapsuleDecoder()
+        if ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+            return unpack_ref.UltraDenseCapsuleDecoder()
+        return None  # normal nodes / HQ capsules handled inline
+
+    def on_measurement(self, ans_type: int, payload: bytes) -> None:
+        if ans_type != self._active_ans:
+            # answer type changed: new scan mode — reset decode state
+            self._active_ans = ans_type
+            self._decoder = self._make(ans_type)
+            self._assembler.reset()
+        nodes: list[unpack_ref.HqNode] = []
+        if ans_type == Ans.MEASUREMENT:
+            node = unpack_ref.decode_normal_node(payload)
+            if node is not None:
+                nodes = [node]
+        elif ans_type == Ans.MEASUREMENT_HQ:
+            decoded, crc_ok = unpack_ref.decode_hq_capsule(payload)
+            if crc_ok:
+                nodes = decoded
+        elif self._decoder is not None:
+            nodes, _new_scan = self._decoder.decode(payload)
+        if not nodes:
+            return
+        self._assembler.push_nodes(
+            np.fromiter((n.angle_q14 for n in nodes), np.int32, len(nodes)),
+            np.fromiter((n.dist_q2 for n in nodes), np.int32, len(nodes)),
+            np.fromiter((n.quality for n in nodes), np.int32, len(nodes)),
+            np.fromiter((n.flag for n in nodes), np.int32, len(nodes)),
+        )
+
+
+class RealLidarDriver(LidarDriverInterface):
+    """Hardware driver: native transport + command engine + scan decode."""
+
+    def __init__(
+        self,
+        channel_type: str = "serial",
+        *,
+        tcp_host: str = "192.168.0.7",
+        tcp_port: int = 20108,
+        udp_host: str = "192.168.11.2",
+        udp_port: int = 8089,
+        transceiver_factory: Optional[Callable[..., TransceiverLike]] = None,
+        motor_warmup_s: float = 1.0,   # ref waits 1 s after setMotorSpeed (:197)
+        legacy_warmup_s: float = 0.2,  # ref waits 200 ms on OLD_TYPE (:264)
+    ) -> None:
+        self._channel_type = channel_type
+        self._tcp = (tcp_host, tcp_port)
+        self._udp = (udp_host, udp_port)
+        self._tx_factory = transceiver_factory or _default_transceiver_factory
+        self._motor_warmup_s = motor_warmup_s
+        self._legacy_warmup_s = legacy_warmup_s
+
+        self._engine: Optional[CommandEngine] = None
+        self._assembler = ScanAssembler()
+        self._scan_decoder = _ScanDecoder(self._assembler)
+        self._lock = threading.RLock()
+        self._connected = False
+        self._scanning = False
+        self._angle_compensate = True
+        self.device_info: Optional[DeviceInfo] = None
+        self.profile = DriverProfile()
+        self.scan_modes: list = []
+
+    # ------------------------------------------------------------------
+    # connection
+    # ------------------------------------------------------------------
+
+    def connect(self, port: str, baudrate: int, use_geometric_compensation: bool) -> bool:
+        with self._lock:
+            if self._connected:
+                return True
+            self._angle_compensate = use_geometric_compensation
+            try:
+                tx = self._tx_factory(
+                    self._channel_type, port, baudrate, *self._net_target()
+                )
+            except Exception as e:
+                log.error("channel creation failed: %s", e)
+                return False
+            engine = CommandEngine(tx, on_measurement=self._scan_decoder.on_measurement)
+            if not engine.start():
+                log.warning("could not open %s channel on %s", self._channel_type, port)
+                return False
+            # quiesce any previous streaming, then identify the device
+            engine.send_only(Cmd.STOP)
+            time.sleep(0.01)
+            engine.reset_decoder()
+            info_payload = engine.request(
+                Cmd.GET_DEVICE_INFO, Ans.DEVINFO, timeout_s=1.0
+            )
+            if info_payload is None or len(info_payload) < 20:
+                log.warning("device did not answer GET_DEVICE_INFO")
+                engine.stop()
+                return False
+            self.device_info = DeviceInfo.from_payload(info_payload)
+            self._engine = engine
+            self._connected = True
+            log.info("connected: %s", self.device_info.summary())
+            return True
+
+    def _net_target(self) -> tuple[str, int]:
+        return self._tcp if self._channel_type == "tcp" else self._udp
+
+    def disconnect(self) -> None:
+        with self._lock:
+            if self._engine is not None:
+                if self._scanning:
+                    try:
+                        self.stop_motor()
+                    except Exception:
+                        pass
+                self._engine.stop()
+                self._engine = None
+            self._connected = False
+            self._scanning = False
+            self._assembler.reset()
+            self._scan_decoder.reset()
+
+    def is_connected(self) -> bool:
+        with self._lock:
+            if self._engine is not None and not self._engine.healthy:
+                return False  # hot-unplug detected by the pump thread
+            return self._connected
+
+    # ------------------------------------------------------------------
+    # strategy detection (src/lidar_driver_wrapper.cpp:145-178)
+    # ------------------------------------------------------------------
+
+    def detect_and_init_strategy(self) -> None:
+        with self._lock:
+            if self.device_info is None:
+                return
+            self.profile = detect_profile(self.device_info, self._angle_compensate)
+
+    # ------------------------------------------------------------------
+    # motor + scan startup (src/lidar_driver_wrapper.cpp:180-268)
+    # ------------------------------------------------------------------
+
+    def start_motor(self, scan_mode: str, rpm: int) -> bool:
+        with self._lock:
+            if self._engine is None:
+                return False
+            if self.profile.protocol is ProtocolType.NEW_TYPE:
+                return self._start_new_type(scan_mode, rpm)
+            return self._start_old_type(rpm)
+
+    def _start_new_type(self, scan_mode: str, rpm: int) -> bool:
+        target_rpm = rpm if rpm > 0 else DEFAULT_RPM
+        if not self.set_motor_speed(target_rpm):
+            return False
+        time.sleep(self._motor_warmup_s)
+        self.scan_modes = confproto.enumerate_scan_modes(self._engine)
+        mode = self._select_mode(scan_mode)
+        if mode is None:
+            log.error("no usable scan mode enumerated")
+            return False
+        return self._start_express(mode, target_rpm)
+
+    def _select_mode(self, preferred: str):
+        """user pref -> 'DenseBoost' -> 'Sensitivity' -> typical/first
+        (src/lidar_driver_wrapper.cpp:207-245)."""
+        if not self.scan_modes:
+            return None
+        by_name = {m.name: m for m in self.scan_modes}
+        if preferred and preferred in by_name:
+            return by_name[preferred]
+        if preferred:
+            log.warning("scan mode %r not supported; falling back to auto", preferred)
+        for fallback in ("DenseBoost", "Sensitivity"):
+            if fallback in by_name:
+                return by_name[fallback]
+        typical = confproto.get_typical_mode(self._engine)
+        if typical is not None:
+            for m in self.scan_modes:
+                if m.id == typical:
+                    return m
+        return self.scan_modes[0]
+
+    def _start_express(self, mode, target_rpm: int) -> bool:
+        # EXPRESS_SCAN payload: u8 mode, u16 flags, u16 reserved
+        # (startScanExpress, sl_lidar_driver.cpp:745-758).  working_flags
+        # stays 0 like the reference wrapper's startScanExpress(false, id, 0)
+        # call (src/lidar_driver_wrapper.cpp:249): the mode id alone selects
+        # boost variants; setting EXPRESS_FLAG_BOOST here could make real
+        # firmware stream a format that mismatches the enumerated ans_type.
+        self._begin_streaming()
+        payload = struct.pack("<BHH", mode.id, 0, 0)
+        if not self._engine.send_only(Cmd.EXPRESS_SCAN, payload):
+            return False
+        self._scanning = True
+        self.profile.active_mode = mode.name
+        self.profile.active_rpm = target_rpm
+        self.profile.hw_max_distance = mode.max_distance or NEW_TYPE_MAX_DISTANCE
+        return True
+
+    def _start_old_type(self, rpm: int) -> bool:
+        # legacy: fixed 600 RPM, brief spin-up, plain SCAN
+        # (src/lidar_driver_wrapper.cpp:262-268)
+        self.set_motor_speed(DEFAULT_RPM)
+        time.sleep(self._legacy_warmup_s)
+        self._begin_streaming()
+        if not self._engine.send_only(Cmd.SCAN):
+            return False
+        self._scanning = True
+        self.profile.active_mode = "Standard"
+        self.profile.active_rpm = DEFAULT_RPM
+        return True
+
+    def _begin_streaming(self) -> None:
+        self._engine.send_only(Cmd.STOP)
+        time.sleep(0.002)
+        self._engine.reset_decoder()
+        self._assembler.reset()
+        self._scan_decoder.reset()
+
+    def stop_motor(self) -> None:
+        with self._lock:
+            if self._engine is None:
+                return
+            self._engine.send_only(Cmd.STOP)
+            self._scanning = False
+            self._engine.reset_decoder()
+            if self.profile.protocol is ProtocolType.NEW_TYPE:
+                self.set_motor_speed(0)
+
+    def set_motor_speed(self, rpm: int) -> bool:
+        """RPM path of the reference's 3-way motor control (cmd 0xA8,
+        sl_lidar_driver.cpp:990-1019).  PWM/DTR variants are A-series
+        hardware paths exercised only with a physical motor control board."""
+        with self._lock:
+            if self._engine is None:
+                return False
+            return self._engine.send_only(
+                Cmd.HQ_MOTOR_SPEED_CTRL, struct.pack("<H", rpm)
+            )
+
+    # ------------------------------------------------------------------
+    # health / reset / info
+    # ------------------------------------------------------------------
+
+    def get_health(self) -> DeviceHealth:
+        with self._lock:
+            if self._engine is None:
+                return DeviceHealth.ERROR
+            ans = self._engine.request(Cmd.GET_DEVICE_HEALTH, Ans.DEVHEALTH, timeout_s=1.0)
+        if ans is None or len(ans) < 3:
+            return DeviceHealth.ERROR
+        status = ans[0]
+        if status >= 2:
+            return DeviceHealth.ERROR
+        return DeviceHealth(status)
+
+    def reset(self) -> None:
+        with self._lock:
+            if self._engine is not None:
+                self._engine.send_only(Cmd.RESET)
+
+    def get_device_info_str(self) -> str:
+        return self.device_info.summary() if self.device_info else "N/A"
+
+    def print_summary(self) -> None:
+        for line in self.profile.summary_lines():
+            log.info("%s", line)
+
+    def get_hw_max_distance(self) -> float:
+        return self.profile.hw_max_distance
+
+    def is_new_type(self) -> bool:
+        return self.profile.protocol is ProtocolType.NEW_TYPE
+
+    # ------------------------------------------------------------------
+    # scan consumption
+    # ------------------------------------------------------------------
+
+    def grab_scan_data(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
+        if not self.is_connected() or not self._scanning:
+            return None
+        batch = self._assembler.wait_and_grab(timeout_s)
+        if batch is None:
+            return None
+        if self._angle_compensate:
+            from rplidar_ros2_driver_tpu.ops.ascend import ascend_scan
+
+            batch, _ = ascend_scan(batch)
+        return batch

@@ -1,0 +1,371 @@
+"""Protocol-accurate simulated lidar device (software device emulator).
+
+The reference's only hardware-free backend is the node-layer
+``DummyLidarDriver`` (src/lidar_driver_wrapper.cpp:417-471), which bypasses
+the entire SDK.  This emulator goes further: it speaks the *wire protocol*
+over a real TCP socket — request parsing, devinfo/health/conf answers, and
+loop-mode measurement streaming built with the ops/wire.py encoders — so
+tests (and users without hardware) can exercise the full stack: native
+channel -> transceiver -> codec -> command engine -> per-format decoders ->
+scan assembly -> FSM -> filter chain.  ``unplug()`` severs the link
+mid-stream, automating the reference's manual hot-unplug protocol
+(README.md:27-38).
+
+Default identity is an S2-class DTOF unit (model 0x71 -> NEW_TYPE strategy);
+pass ``model_id=0x18`` (A1M8) to exercise the legacy path.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.models.tables import DeviceInfo
+from rplidar_ros2_driver_tpu.ops import wire
+from rplidar_ros2_driver_tpu.protocol.codec import AnsHeader
+from rplidar_ros2_driver_tpu.protocol.constants import (
+    Ans,
+    Cmd,
+    CMDFLAG_HAS_PAYLOAD,
+    CMD_SYNC_BYTE,
+    ConfKey,
+    DENSE_CAPSULE_BYTES,
+    CAPSULE_BYTES,
+    NORMAL_NODE_BYTES,
+)
+
+log = logging.getLogger("rplidar_tpu.sim")
+
+
+@dataclass
+class SimScanMode:
+    id: int
+    name: str
+    ans_type: int
+    us_per_sample: float
+    max_distance: float
+
+
+DEFAULT_MODES = [
+    SimScanMode(0, "Standard", Ans.MEASUREMENT, 476.0, 12.0),
+    SimScanMode(1, "DenseBoost", Ans.MEASUREMENT_DENSE_CAPSULED, 31.25, 40.0),
+    SimScanMode(2, "Sensitivity", Ans.MEASUREMENT_CAPSULED, 63.0, 25.0),
+]
+
+
+@dataclass
+class SimConfig:
+    model_id: int = 0x71           # S2M1 -> NEW_TYPE
+    firmware: int = 0x0105
+    hardware: int = 0x12
+    serial: bytes = bytes(range(1, 17))  # nonzero first byte: "connected" S/N
+    health_status: int = 0         # 0 ok / 1 warning / 2 error
+    points_per_rev: int = 400
+    dist_base_mm: float = 2000.0
+    dist_amp_mm: float = 500.0
+    frame_rate_hz: float = 0.0     # 0 = stream as fast as possible (tests)
+    modes: list = field(default_factory=lambda: list(DEFAULT_MODES))
+
+
+class SimulatedDevice:
+    """One-client TCP server emulating lidar firmware."""
+
+    TARGET = "127.0.0.1"
+
+    def __init__(self, config: Optional[SimConfig] = None) -> None:
+        self.cfg = config or SimConfig()
+        self._srv: Optional[socket.socket] = None
+        self._conn: Optional[socket.socket] = None
+        self._conn_lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stream_thread: Optional[threading.Thread] = None
+        self._streaming = threading.Event()
+        self._running = threading.Event()
+        self.port = 0
+        # observability for tests
+        self.motor_rpm = 0
+        self.commands: list[int] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "SimulatedDevice":
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self.TARGET, 0))
+        self._srv.listen(1)
+        self.port = self._srv.getsockname()[1]
+        self._running.set()
+        self.motor_rpm = 0
+        self.commands: list[int] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="sim_accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running.clear()
+        self._streaming.clear()
+        self.unplug()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            self._srv = None
+        for t in (self._accept_thread, self._stream_thread):
+            if t is not None:
+                t.join(3.0)
+        self._accept_thread = self._stream_thread = None
+
+    def unplug(self) -> None:
+        """Sever the client link abruptly (hot-unplug fault injection)."""
+        self._streaming.clear()
+        with self._conn_lock:
+            if self._conn is not None:
+                try:
+                    self._conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while self._running.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conn = conn
+            try:
+                self._serve(conn)
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                self._streaming.clear()
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = bytearray()
+        conn.settimeout(0.2)
+        while self._running.is_set():
+            try:
+                chunk = conn.recv(256)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while True:
+                consumed = self._try_parse_request(bytes(buf))
+                if consumed == 0:
+                    break
+                del buf[:consumed]
+
+    def _try_parse_request(self, data: bytes) -> int:
+        """Parse one request packet; returns bytes consumed (0 = need more)."""
+        # resync to A5
+        idx = data.find(bytes([CMD_SYNC_BYTE]))
+        if idx < 0:
+            return len(data)
+        if idx > 0:
+            return idx
+        if len(data) < 2:
+            return 0
+        cmd = data[1]
+        if cmd & CMDFLAG_HAS_PAYLOAD:
+            if len(data) < 3:
+                return 0
+            size = data[2]
+            total = 3 + size + 1
+            if len(data) < total:
+                return 0
+            payload = data[3 : 3 + size]
+            checksum = 0
+            for b in data[: total - 1]:
+                checksum ^= b
+            if checksum != data[total - 1]:
+                log.warning("sim: bad request checksum for cmd %#x", cmd)
+                return total
+            self._handle(cmd, payload)
+            return total
+        self._handle(cmd, b"")
+        return 2
+
+    # ------------------------------------------------------------------
+    # request handlers
+    # ------------------------------------------------------------------
+
+    def _send(self, data: bytes) -> None:
+        with self._conn_lock:
+            conn = self._conn
+        if conn is None:
+            return
+        try:
+            conn.sendall(data)
+        except OSError:
+            pass
+
+    def _answer(self, ans_type: int, payload: bytes, is_loop: bool = False) -> None:
+        hdr = AnsHeader(ans_type=ans_type, payload_len=len(payload), is_loop=is_loop)
+        self._send(hdr.encode() + payload)
+
+    def _handle(self, cmd: int, payload: bytes) -> None:
+        self.commands.append(cmd)
+        if cmd == Cmd.STOP:
+            self._streaming.clear()
+        elif cmd == Cmd.RESET:
+            self._streaming.clear()
+            self.motor_rpm = 0
+        elif cmd == Cmd.GET_DEVICE_INFO:
+            info = DeviceInfo(
+                model=self.cfg.model_id,
+                firmware_version=self.cfg.firmware,
+                hardware_version=self.cfg.hardware,
+                serialnum=self.cfg.serial,
+            )
+            self._answer(Ans.DEVINFO, info.to_payload())
+        elif cmd == Cmd.GET_DEVICE_HEALTH:
+            self._answer(
+                Ans.DEVHEALTH, struct.pack("<BH", self.cfg.health_status, 0)
+            )
+        elif cmd == Cmd.HQ_MOTOR_SPEED_CTRL:
+            if len(payload) >= 2:
+                self.motor_rpm = struct.unpack_from("<H", payload)[0]
+        elif cmd == Cmd.SET_MOTOR_PWM:
+            if len(payload) >= 2:
+                self.motor_rpm = struct.unpack_from("<H", payload)[0]
+        elif cmd == Cmd.GET_LIDAR_CONF:
+            self._handle_conf(payload)
+        elif cmd == Cmd.SCAN:
+            self._start_stream(self.cfg.modes[0])
+        elif cmd == Cmd.EXPRESS_SCAN:
+            mode_id = payload[0] if payload else 0
+            mode = next((m for m in self.cfg.modes if m.id == mode_id), None)
+            if mode is not None:
+                self._start_stream(mode)
+        # unknown commands are ignored, like real firmware
+
+    def _handle_conf(self, payload: bytes) -> None:
+        if len(payload) < 4:
+            return
+        key = struct.unpack_from("<I", payload)[0]
+        extra = payload[4:]
+        mode_id = struct.unpack_from("<H", extra)[0] if len(extra) >= 2 else 0
+        mode = next((m for m in self.cfg.modes if m.id == mode_id), None)
+        echo = struct.pack("<I", key)
+        if key == ConfKey.SCAN_MODE_COUNT:
+            self._answer(Ans.GET_LIDAR_CONF, echo + struct.pack("<H", len(self.cfg.modes)))
+        elif key == ConfKey.SCAN_MODE_TYPICAL:
+            dense = next(
+                (m for m in self.cfg.modes if m.name == "DenseBoost"), self.cfg.modes[0]
+            )
+            self._answer(Ans.GET_LIDAR_CONF, echo + struct.pack("<H", dense.id))
+        elif key == ConfKey.SCAN_MODE_US_PER_SAMPLE and mode:
+            self._answer(
+                Ans.GET_LIDAR_CONF, echo + struct.pack("<I", int(mode.us_per_sample * 256))
+            )
+        elif key == ConfKey.SCAN_MODE_MAX_DISTANCE and mode:
+            self._answer(
+                Ans.GET_LIDAR_CONF, echo + struct.pack("<I", int(mode.max_distance * 256))
+            )
+        elif key == ConfKey.SCAN_MODE_ANS_TYPE and mode:
+            self._answer(Ans.GET_LIDAR_CONF, echo + bytes([mode.ans_type]))
+        elif key == ConfKey.SCAN_MODE_NAME and mode:
+            self._answer(Ans.GET_LIDAR_CONF, echo + mode.name.encode() + b"\x00")
+        # unknown keys: no answer (requester times out, like a real device)
+
+    # ------------------------------------------------------------------
+    # measurement streaming
+    # ------------------------------------------------------------------
+
+    def _start_stream(self, mode: SimScanMode) -> None:
+        self._streaming.clear()
+        if self._stream_thread is not None:
+            self._stream_thread.join(2.0)
+        self._streaming.set()
+        self._stream_thread = threading.Thread(
+            target=self._stream_loop, args=(mode,), name="sim_stream", daemon=True
+        )
+        self._stream_thread.start()
+
+    def _scene_dist_mm(self, theta_deg: float, rev: int) -> float:
+        return self.cfg.dist_base_mm + self.cfg.dist_amp_mm * math.sin(
+            math.radians(theta_deg) + 0.1 * rev
+        )
+
+    def _stream_loop(self, mode: SimScanMode) -> None:
+        frame_bytes = {
+            Ans.MEASUREMENT: NORMAL_NODE_BYTES,
+            Ans.MEASUREMENT_DENSE_CAPSULED: DENSE_CAPSULE_BYTES,
+            Ans.MEASUREMENT_CAPSULED: CAPSULE_BYTES,
+        }[mode.ans_type]
+        self._send(
+            AnsHeader(ans_type=mode.ans_type, payload_len=frame_bytes, is_loop=True).encode()
+        )
+        pts_per_frame = {
+            Ans.MEASUREMENT: 1,
+            Ans.MEASUREMENT_DENSE_CAPSULED: 40,
+            Ans.MEASUREMENT_CAPSULED: 32,
+        }[mode.ans_type]
+        period = (
+            pts_per_frame / (1e6 / mode.us_per_sample)
+            if self.cfg.frame_rate_hz == 0
+            else 1.0 / self.cfg.frame_rate_hz
+        )
+        ppr = self.cfg.points_per_rev
+        idx = 0  # global point index
+        first = True
+        while self._streaming.is_set() and self._running.is_set():
+            rev, pos = divmod(idx, ppr)
+            theta = 360.0 * pos / ppr
+            start_q6 = int(theta * 64) & 0x7FFF
+            if mode.ans_type == Ans.MEASUREMENT:
+                dist = self._scene_dist_mm(theta, rev)
+                frame = wire.encode_normal_node(
+                    int(theta * 64), int(dist * 4), 0x2F, syncbit=(pos == 0)
+                )
+            elif mode.ans_type == Ans.MEASUREMENT_DENSE_CAPSULED:
+                thetas = 360.0 * ((np.arange(40) + idx) % ppr) / ppr
+                revs = (np.arange(40) + idx) // ppr
+                dists = np.array(
+                    [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
+                )
+                frame = wire.encode_dense_capsule(start_q6, first, dists.astype(int))
+            else:  # express capsule: 16 cabins x 2 points
+                thetas = 360.0 * ((np.arange(32) + idx) % ppr) / ppr
+                revs = (np.arange(32) + idx) // ppr
+                dists = np.array(
+                    [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
+                )
+                dist_q2 = (dists.astype(int) * 4) & ~0x3
+                frame = wire.encode_capsule(
+                    start_q6, first, dist_q2.reshape(16, 2), np.zeros((16, 2), int)
+                )
+            self._send(frame)
+            idx += pts_per_frame
+            first = False
+            if period > 0:
+                # tests run with frame_rate_hz unset -> tiny pacing sleep so
+                # the rx thread interleaves; realtime uses the mode's rate
+                time.sleep(min(period, 0.02) if self.cfg.frame_rate_hz == 0 else period)

@@ -15,6 +15,7 @@ RESETTING recovery — either preserve or deterministically reset the window
 
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
@@ -92,7 +93,22 @@ class ScanFilterChain:
         return {k: np.asarray(v) for k, v in vars(self._state).items()}
 
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> None:
-        """Restore a snapshot, or reset deterministically when None."""
+        """Restore a snapshot, or reset deterministically when None.
+
+        A snapshot taken under different chain parameters (window/beams/
+        grid changed across a cleanup->configure cycle) is incompatible
+        with the compiled step; restoring it would crash the hot path, so
+        it is discarded with a warning and the window starts cold.
+        """
+        if snap is not None:
+            fresh = FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid)
+            expected = {k: v.shape for k, v in vars(fresh).items()}
+            got = {k: np.asarray(v).shape for k, v in snap.items()}
+            if expected != got:
+                logging.getLogger("rplidar_tpu.chain").warning(
+                    "discarding incompatible filter snapshot (%s != %s)", got, expected
+                )
+                snap = None
         if snap is None:
             self._state = jax.device_put(
                 FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),

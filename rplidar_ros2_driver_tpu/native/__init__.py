@@ -1,0 +1,124 @@
+"""ctypes bindings for the native runtime (native/librpl_native.so).
+
+The compute path of this framework is JAX; the I/O runtime around it —
+protocol codec, serial/TCP/UDP channels, async transceiver — is C++ (like
+the reference's SDK core) and is exposed here through a small ctypes
+surface.  ``load()`` builds the library on first use if the checked-in
+sources haven't been compiled yet (g++ is part of the supported toolchain);
+callers that can run without native I/O should catch ``NativeUnavailable``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "librpl_native.so")
+
+# result codes (rpl_native.h)
+RPL_OK = 0
+RPL_TIMEOUT = -1
+RPL_ERR = -2
+RPL_CLOSED = -3
+RPL_TOOSMALL = -4
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    """The native library could not be built/loaded on this host."""
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.rpl_encode_command.restype = ctypes.c_int
+    lib.rpl_encode_command.argtypes = [ctypes.c_uint8, u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+
+    lib.rpl_decoder_create.restype = ctypes.c_void_p
+    lib.rpl_decoder_destroy.argtypes = [ctypes.c_void_p]
+    lib.rpl_decoder_reset.argtypes = [ctypes.c_void_p]
+    lib.rpl_decoder_feed.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t]
+    lib.rpl_decoder_pending.restype = ctypes.c_size_t
+    lib.rpl_decoder_pending.argtypes = [ctypes.c_void_p]
+    lib.rpl_decoder_pop.restype = ctypes.c_int
+    lib.rpl_decoder_pop.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_size_t,
+    ]
+
+    for name in ("rpl_serial_channel_create",):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_void_p
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+    for name in ("rpl_tcp_channel_create", "rpl_udp_channel_create"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_void_p
+        fn.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rpl_channel_open.restype = ctypes.c_int
+    lib.rpl_channel_open.argtypes = [ctypes.c_void_p]
+    lib.rpl_channel_close.argtypes = [ctypes.c_void_p]
+    lib.rpl_channel_is_open.restype = ctypes.c_int
+    lib.rpl_channel_is_open.argtypes = [ctypes.c_void_p]
+    lib.rpl_channel_write.restype = ctypes.c_int
+    lib.rpl_channel_write.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t]
+    lib.rpl_channel_read.restype = ctypes.c_int
+    lib.rpl_channel_read.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t, ctypes.c_int]
+    lib.rpl_channel_set_dtr.restype = ctypes.c_int
+    lib.rpl_channel_set_dtr.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.rpl_channel_cancel.argtypes = [ctypes.c_void_p]
+    lib.rpl_channel_destroy.argtypes = [ctypes.c_void_p]
+
+    lib.rpl_transceiver_create.restype = ctypes.c_void_p
+    lib.rpl_transceiver_create.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_destroy.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_start.restype = ctypes.c_int
+    lib.rpl_transceiver_start.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_stop.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_send.restype = ctypes.c_int
+    lib.rpl_transceiver_send.argtypes = [ctypes.c_void_p, u8p, ctypes.c_size_t]
+    lib.rpl_transceiver_wait_message.restype = ctypes.c_int
+    lib.rpl_transceiver_wait_message.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_size_t,
+    ]
+    lib.rpl_transceiver_reset_decoder.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_error.restype = ctypes.c_int
+    lib.rpl_transceiver_error.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load(rebuild: bool = False) -> ctypes.CDLL:
+    """Load (building if necessary) the native library."""
+    global _lib
+    with _lock:
+        if _lib is not None and not rebuild:
+            return _lib
+        if rebuild or not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-j4"],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except (subprocess.CalledProcessError, FileNotFoundError) as e:
+                detail = getattr(e, "stderr", "") or str(e)
+                raise NativeUnavailable(f"native build failed: {detail}") from e
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError as e:
+            raise NativeUnavailable(f"cannot load {_LIB_PATH}: {e}") from e
+        return _lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeUnavailable:
+        return False

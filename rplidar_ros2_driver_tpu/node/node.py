@@ -77,17 +77,25 @@ class RPlidarNode(LifecycleNode):
             return DummyLidarDriver()
         from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
 
-        return RealLidarDriver(channel_type=self.params.channel_type)
+        return RealLidarDriver(
+            channel_type=self.params.channel_type,
+            tcp_host=self.params.tcp_host,
+            tcp_port=self.params.tcp_port,
+            udp_host=self.params.udp_host,
+            udp_port=self.params.udp_port,
+        )
 
     def on_configure(self) -> bool:
         log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
         if self._driver_factory is None and not self.params.dummy_mode:
-            # fail fast here, not inside the scan thread (finding: a factory
-            # error in the FSM thread would otherwise surface as silence)
-            try:
-                import rplidar_ros2_driver_tpu.driver.real  # noqa: F401
-            except ImportError as e:
-                log.error("real driver backend unavailable: %s", e)
+            # fail fast here, not inside the scan thread: the real backend
+            # needs the native I/O library (built/loaded lazily), and a
+            # factory error in the FSM thread would surface as silence
+            from rplidar_ros2_driver_tpu import native
+
+            if not native.available():
+                log.error("real driver backend unavailable: native I/O library "
+                          "could not be built/loaded (see native/Makefile)")
                 return False
         factory = self._driver_factory or self._default_factory
         self.fsm = ScanLoopFsm(

@@ -82,7 +82,7 @@ def _capsule_frame_valid(frames: jax.Array, payload_from: int = 2) -> jax.Array:
     """Express-style validity: sync nibbles 0xA/0x5 + split XOR checksum
     (handler_capsules.cpp:107-155)."""
     sync_ok = ((frames[:, 0] >> 4) == EXP_SYNC_1) & ((frames[:, 1] >> 4) == EXP_SYNC_2)
-    recv = (frames[:, 0] & 0xF) | ((frames[:, 1] >> 4) << 4)
+    recv = (frames[:, 0] & 0xF) | ((frames[:, 1] & 0xF) << 4)
     calc = _xor_reduce(frames[:, payload_from:], 1)
     return sync_ok & (recv == calc)
 

@@ -61,7 +61,10 @@ def _wrap_angle_q6(a: int) -> int:
 
 
 def _check_capsule_checksum(frame: bytes, payload_from: int = 2) -> bool:
-    recv = (frame[0] & 0xF) | ((frame[1] >> 4) << 4)
+    # low nibble of byte0 = checksum low nibble, low nibble of byte1 = high
+    # nibble (sl_lidar_cmd.h capsule struct: s_checksum_1/2 are the :4 low
+    # bitfields beside the 0xA/0x5 sync nibbles)
+    recv = (frame[0] & 0xF) | ((frame[1] & 0xF) << 4)
     c = 0
     for b in frame[payload_from:]:
         c ^= b

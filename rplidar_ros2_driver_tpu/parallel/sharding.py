@@ -61,6 +61,8 @@ def make_mesh(
         devices = jax.devices()
     if n_devices is None:
         n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"requested {n_devices} devices, only {len(devices)} available")
     devices = np.asarray(devices[:n_devices])
     if stream is None:
         stream = 1

@@ -1,0 +1,98 @@
+"""Device-side configuration protocol (GET/SET_LIDAR_CONF).
+
+The typed key space of the reference (sl_lidar_cmd.h:289-317; getLidarConf
+sl_lidar_driver.cpp:1261-1304, setLidarConf :1215-1259) and the derived
+scan-mode getters (:1199-1379): a GET request carries ``u32 key [+ extra]``
+and the answer echoes the key followed by the data; scan-mode metadata is
+keyed by ``u16 mode`` appended as the payload.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from rplidar_ros2_driver_tpu.models.tables import ScanMode
+from rplidar_ros2_driver_tpu.protocol.constants import Ans, Cmd, ConfKey
+from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine
+
+
+def get_conf(
+    engine: CommandEngine, key: int, extra: bytes = b"", timeout_s: float = 1.0
+) -> Optional[bytes]:
+    """Raw GET_LIDAR_CONF: returns the data after the echoed key, or None."""
+    payload = struct.pack("<I", key) + extra
+    ans = engine.request(Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF, payload, timeout_s)
+    if ans is None or len(ans) < 4:
+        return None
+    echoed = struct.unpack_from("<I", ans)[0]
+    if echoed != key:
+        return None
+    return ans[4:]
+
+
+def set_conf(
+    engine: CommandEngine, key: int, data: bytes = b"", timeout_s: float = 1.0
+) -> bool:
+    """SET_LIDAR_CONF; answer is ``u32 result`` (0 == ok)."""
+    payload = struct.pack("<I", key) + data
+    ans = engine.request(Cmd.SET_LIDAR_CONF, Ans.SET_LIDAR_CONF, payload, timeout_s)
+    if ans is None or len(ans) < 4:
+        return False
+    return struct.unpack_from("<I", ans)[0] == 0
+
+
+def _mode_extra(mode_id: int) -> bytes:
+    return struct.pack("<H", mode_id)
+
+
+def get_scan_mode_count(engine: CommandEngine) -> Optional[int]:
+    data = get_conf(engine, ConfKey.SCAN_MODE_COUNT)
+    return struct.unpack_from("<H", data)[0] if data and len(data) >= 2 else None
+
+
+def get_typical_mode(engine: CommandEngine) -> Optional[int]:
+    data = get_conf(engine, ConfKey.SCAN_MODE_TYPICAL)
+    return struct.unpack_from("<H", data)[0] if data and len(data) >= 2 else None
+
+
+def get_mode_us_per_sample(engine: CommandEngine, mode_id: int) -> Optional[float]:
+    # u32 Q8 fixed point (ref :1317-1331)
+    data = get_conf(engine, ConfKey.SCAN_MODE_US_PER_SAMPLE, _mode_extra(mode_id))
+    return struct.unpack_from("<I", data)[0] / 256.0 if data and len(data) >= 4 else None
+
+
+def get_mode_max_distance(engine: CommandEngine, mode_id: int) -> Optional[float]:
+    # u32 Q8 metres (ref :1333-1347)
+    data = get_conf(engine, ConfKey.SCAN_MODE_MAX_DISTANCE, _mode_extra(mode_id))
+    return struct.unpack_from("<I", data)[0] / 256.0 if data and len(data) >= 4 else None
+
+
+def get_mode_ans_type(engine: CommandEngine, mode_id: int) -> Optional[int]:
+    data = get_conf(engine, ConfKey.SCAN_MODE_ANS_TYPE, _mode_extra(mode_id))
+    return data[0] if data else None
+
+
+def get_mode_name(engine: CommandEngine, mode_id: int) -> Optional[str]:
+    data = get_conf(engine, ConfKey.SCAN_MODE_NAME, _mode_extra(mode_id))
+    return data.split(b"\x00", 1)[0].decode("ascii", "replace") if data else None
+
+
+def enumerate_scan_modes(engine: CommandEngine) -> list[ScanMode]:
+    """All supported modes with metadata (ref getAllSupportedScanModes
+    sl_lidar_driver.cpp:518-554)."""
+    count = get_scan_mode_count(engine)
+    if count is None:
+        return []
+    modes: list[ScanMode] = []
+    for mode_id in range(count):
+        us = get_mode_us_per_sample(engine, mode_id)
+        dist = get_mode_max_distance(engine, mode_id)
+        ans = get_mode_ans_type(engine, mode_id)
+        name = get_mode_name(engine, mode_id)
+        if None in (us, dist, ans, name):
+            continue
+        modes.append(
+            ScanMode(id=mode_id, us_per_sample=us, max_distance=dist, ans_type=ans, name=name)
+        )
+    return modes

@@ -1,0 +1,161 @@
+"""Command/response engine over an async transceiver.
+
+Equivalent of the reference driver's send paths
+(`_sendCommandWithoutResponse` sl_lidar_driver.cpp:1600-1610,
+`_sendCommandWithResponse` :1612-1641) and its listener routing
+(:1655-1672): measurement (loop-mode) messages flow to the scan handler;
+anything else completes the pending request if the answer type matches.
+
+The reference parks the requester on a ``Waiter`` signalled from the decoder
+thread; here a pump thread drains the transceiver's message queue and hands
+responses over a one-slot queue.  One operation lock serializes requests
+(the recursive op-lock of sl_lidar_driver.cpp:401).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional, Protocol
+
+from rplidar_ros2_driver_tpu.protocol.codec import encode_command
+from rplidar_ros2_driver_tpu.protocol.constants import SCAN_ANS_TYPES
+
+log = logging.getLogger("rplidar_tpu.engine")
+
+
+class TransceiverLike(Protocol):
+    """Duck-typed transceiver contract (NativeTransceiver or a test fake)."""
+
+    def start(self) -> bool: ...
+    def stop(self) -> None: ...
+    def send(self, packet: bytes) -> bool: ...
+    def wait_message(self, timeout_ms: int = 1000) -> Optional[tuple[int, bytes, bool]]: ...
+    def reset_decoder(self) -> None: ...
+    @property
+    def had_error(self) -> bool: ...
+
+
+# measurement callback: (ans_type, payload)
+MeasurementHandler = Callable[[int, bytes], None]
+
+
+class CommandEngine:
+    def __init__(
+        self,
+        transceiver: TransceiverLike,
+        on_measurement: Optional[MeasurementHandler] = None,
+    ) -> None:
+        self._tx = transceiver
+        self._on_measurement = on_measurement
+        self._op_lock = threading.RLock()
+        self._pending_lock = threading.Lock()
+        self._pending_ans: Optional[int] = None
+        self._pending_q: Optional[queue.Queue] = None
+        # answers still owed by timed-out requests, per ans type: a late
+        # answer must not complete the NEXT request of the same type (the
+        # conf protocol reuses one ans type for every per-mode query, and
+        # the echoed key alone cannot distinguish modes).  Maps ans_type ->
+        # monotonic expiry; an answer arriving before expiry is dropped
+        # once, after expiry flows normally (so a device that stays silent
+        # can only cost one extra timeout, never a permanent drop loop).
+        self._stale: dict[int, float] = {}
+        self._pump: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self.link_error = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> bool:
+        if not self._tx.start():
+            return False
+        self.link_error.clear()
+        self._running.set()
+        self._pump = threading.Thread(target=self._pump_loop, name="rpl_pump", daemon=True)
+        self._pump.start()
+        return True
+
+    def stop(self) -> None:
+        self._running.clear()
+        self._tx.stop()  # unblocks wait_message via channel close
+        if self._pump:
+            self._pump.join(5.0)
+            self._pump = None
+
+    @property
+    def healthy(self) -> bool:
+        return self._running.is_set() and not self.link_error.is_set()
+
+    # -- request API --------------------------------------------------------
+
+    def send_only(self, cmd: int, payload: bytes = b"") -> bool:
+        """Fire-and-forget (ref :1600-1610)."""
+        with self._op_lock:
+            return self._tx.send(encode_command(cmd, payload))
+
+    def request(
+        self, cmd: int, ans_type: int, payload: bytes = b"", timeout_s: float = 1.0
+    ) -> Optional[bytes]:
+        """Send and block for the matching answer; None on timeout/error."""
+        with self._op_lock:
+            slot: queue.Queue = queue.Queue(maxsize=1)
+            with self._pending_lock:
+                self._pending_ans = ans_type
+                self._pending_q = slot
+            try:
+                if not self._tx.send(encode_command(cmd, payload)):
+                    return None
+                try:
+                    return slot.get(timeout=timeout_s)
+                except queue.Empty:
+                    log.debug("request %#x timed out waiting for ans %#x", cmd, ans_type)
+                    with self._pending_lock:
+                        # the device may still answer later: discard one
+                        # message of this type if it lands within another
+                        # timeout window
+                        self._stale[ans_type] = time.monotonic() + timeout_s
+                    return None
+            finally:
+                with self._pending_lock:
+                    self._pending_ans = None
+                    self._pending_q = None
+
+    def reset_decoder(self) -> None:
+        self._tx.reset_decoder()
+
+    # -- pump ---------------------------------------------------------------
+
+    def _pump_loop(self) -> None:
+        from rplidar_ros2_driver_tpu.native.runtime import ChannelError
+
+        while self._running.is_set():
+            try:
+                m = self._tx.wait_message(timeout_ms=200)
+            except ChannelError:
+                if self._running.is_set():
+                    log.warning("channel error detected by pump (hot-unplug?)")
+                    self.link_error.set()
+                break
+            if m is None:
+                continue
+            ans_type, data, is_loop = m
+            if is_loop or ans_type in SCAN_ANS_TYPES:
+                if self._on_measurement is not None:
+                    try:
+                        self._on_measurement(ans_type, data)
+                    except Exception:
+                        log.exception("measurement handler failed")
+                continue
+            with self._pending_lock:
+                stale_until = self._stale.pop(ans_type, None)
+                if stale_until is not None and time.monotonic() < stale_until:
+                    log.debug("dropping stale ans %#x (%d bytes)", ans_type, len(data))
+                elif self._pending_ans == ans_type and self._pending_q is not None:
+                    try:
+                        self._pending_q.put_nowait(data)
+                    except queue.Full:
+                        pass
+                else:
+                    log.debug("dropping unexpected ans %#x (%d bytes)", ans_type, len(data))

@@ -61,3 +61,25 @@ def test_pack_host_scan_overflow():
     angle = np.zeros(2048, np.int32)
     with pytest.raises(ValueError):
         pack_host_scan(angle, angle, angle, n=1024)
+
+
+def test_incompatible_snapshot_discarded():
+    """Restoring a snapshot taken under different chain geometry must fall
+    back to a cold start, not crash the hot path."""
+    small = ScanFilterChain(
+        DriverParams(filter_backend="cpu", filter_window=4,
+                     filter_chain=("clip", "median"), voxel_grid_size=32),
+        beams=128,
+    )
+    angle, dist, qual = _raw_scan(1)
+    small.process_raw(angle, dist, qual)
+    snap = small.snapshot()
+
+    big = ScanFilterChain(
+        DriverParams(filter_backend="cpu", filter_window=8,
+                     filter_chain=("clip", "median"), voxel_grid_size=32),
+        beams=128,
+    )
+    big.restore(snap)  # incompatible: discarded with a warning
+    out = big.process_raw(angle, dist, qual)  # must not raise
+    assert np.asarray(out.ranges).shape == (128,)

@@ -1,0 +1,201 @@
+"""RealLidarDriver against the protocol-accurate SimulatedDevice over TCP:
+the full stack — native channel + transceiver -> codec -> command engine ->
+conf protocol -> per-format decode -> scan assembly — without hardware.
+Also drives the whole node FSM over it, including automated hot-unplug."""
+
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu import native as native_mod
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+from rplidar_ros2_driver_tpu.driver.sim_device import SimConfig, SimulatedDevice
+
+pytestmark = pytest.mark.skipif(
+    not native_mod.available(), reason="native library unavailable"
+)
+
+
+def _wait(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_driver(sim: SimulatedDevice, **kw) -> RealLidarDriver:
+    return RealLidarDriver(
+        channel_type="tcp",
+        tcp_host=SimulatedDevice.TARGET,
+        tcp_port=sim.port,
+        motor_warmup_s=0.0,
+        legacy_warmup_s=0.0,
+        **kw,
+    )
+
+
+@pytest.fixture
+def sim():
+    dev = SimulatedDevice().start()
+    yield dev
+    dev.stop()
+
+
+class TestConnect:
+    def test_connect_and_identify(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, True)
+        assert drv.is_connected()
+        info = drv.device_info
+        assert info.model == 0x71
+        assert "S7M1" not in info.summary()
+        drv.detect_and_init_strategy()
+        assert drv.is_new_type()
+        assert drv.get_hw_max_distance() == 40.0
+        drv.disconnect()
+        assert not drv.is_connected()
+
+    def test_connect_failure_no_server(self):
+        drv = RealLidarDriver(channel_type="tcp", tcp_host="127.0.0.1", tcp_port=1)
+        assert not drv.connect("ignored", 0, True)
+
+    def test_health(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, True)
+        assert drv.get_health() is DeviceHealth.OK
+        sim.cfg.health_status = 2
+        assert drv.get_health() is DeviceHealth.ERROR
+        drv.disconnect()
+
+    def test_legacy_model_profile(self):
+        dev = SimulatedDevice(SimConfig(model_id=0x18)).start()  # A1M8
+        try:
+            drv = make_driver(dev)
+            assert drv.connect("ignored", 0, True)
+            drv.detect_and_init_strategy()
+            assert not drv.is_new_type()
+            assert drv.get_hw_max_distance() == 12.0
+            drv.disconnect()
+        finally:
+            dev.stop()
+
+
+class TestScanStreaming:
+    def _grab_scans(self, drv, n=2, timeout=3.0):
+        scans = []
+        deadline = time.monotonic() + 10
+        while len(scans) < n and time.monotonic() < deadline:
+            b = drv.grab_scan_data(timeout)
+            if b is not None:
+                scans.append(b)
+        return scans
+
+    def test_denseboost_auto_selection_and_scan(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, False)
+        drv.detect_and_init_strategy()
+        assert drv.start_motor("", 720)
+        assert drv.profile.active_mode == "DenseBoost"
+        assert sim.motor_rpm == 720
+        scans = self._grab_scans(drv, 2)
+        assert len(scans) == 2
+        batch = scans[-1]
+        count = int(batch.count)
+        # 400 points per simulated revolution (exact: frame-aligned assembly)
+        assert 320 <= count <= 440
+        dist_m = np.asarray(batch.dist_q2)[:count] / 4000.0
+        assert dist_m.min() > 1.2 and dist_m.max() < 2.8  # 2m +/- 0.5m scene
+        drv.stop_motor()
+        drv.disconnect()
+
+    def test_user_mode_preference(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, False)
+        drv.detect_and_init_strategy()
+        assert drv.start_motor("Sensitivity", 0)
+        assert drv.profile.active_mode == "Sensitivity"
+        scans = self._grab_scans(drv, 1)
+        assert scans and int(scans[0].count) > 100
+        drv.stop_motor()
+        drv.disconnect()
+
+    def test_unknown_mode_falls_back(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, False)
+        drv.detect_and_init_strategy()
+        assert drv.start_motor("NoSuchMode", 0)
+        assert drv.profile.active_mode == "DenseBoost"
+        drv.stop_motor()
+        drv.disconnect()
+
+    def test_legacy_scan_path(self):
+        dev = SimulatedDevice(SimConfig(model_id=0x18, points_per_rev=80)).start()
+        try:
+            drv = make_driver(dev)
+            assert drv.connect("ignored", 0, False)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 0)
+            assert drv.profile.active_mode == "Standard"
+            scans = self._grab_scans(drv, 1)
+            assert scans and 40 <= int(scans[0].count) <= 90
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            dev.stop()
+
+    def test_angle_compensation_sorts_angles(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, True)  # compensation on
+        drv.detect_and_init_strategy()
+        assert drv.start_motor("", 0)
+        scans = self._grab_scans(drv, 2)
+        assert scans
+        b = scans[-1]
+        c = int(b.count)
+        ang = np.asarray(b.angle_q14)[:c]
+        # ascend_scan interpolates invalid + returns monotone-ish angles
+        assert (np.diff(ang.astype(np.int64)) >= 0).mean() > 0.95
+        drv.stop_motor()
+        drv.disconnect()
+
+
+class TestHotUnplug:
+    def test_unplug_detected_and_grab_fails(self, sim):
+        drv = make_driver(sim)
+        assert drv.connect("ignored", 0, False)
+        drv.detect_and_init_strategy()
+        assert drv.start_motor("", 0)
+        assert drv.grab_scan_data(3.0) is not None
+        sim.unplug()
+        assert _wait(lambda: not drv.is_connected(), timeout=5.0)
+        assert drv.grab_scan_data(0.3) is None
+        drv.disconnect()
+
+    def test_fsm_recovers_after_unplug(self, sim):
+        """Full node stack over the simulated device: hot-unplug mid-scan,
+        FSM resets, reconnects to the (re-listening) device, scans resume —
+        the automated version of the reference's unplug protocol."""
+        from rplidar_ros2_driver_tpu.node.fsm import FsmTimings
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode, launch
+        from rplidar_ros2_driver_tpu.node.publisher import CollectingPublisher
+
+        params = DriverParams(channel_type="tcp", max_retries=2)
+        pub = CollectingPublisher()
+        node = RPlidarNode(
+            params,
+            pub,
+            driver_factory=lambda: make_driver(sim),
+            fsm_timings=FsmTimings.fast(),
+        )
+        launch(node)
+        assert _wait(lambda: pub.scan_count >= 2, timeout=10.0)
+        sim.unplug()
+        assert _wait(lambda: node.fsm.reset_count >= 1, timeout=10.0)
+        before = pub.scan_count
+        assert _wait(lambda: pub.scan_count > before + 1, timeout=10.0)
+        node.shutdown()
