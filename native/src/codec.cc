@@ -27,6 +27,11 @@ constexpr uint8_t kCmdFlagHasPayload = 0x80;
 constexpr uint32_t kSizeMask = 0x3FFFFFFFu;
 constexpr int kSubtypeShift = 30;
 constexpr uint32_t kPktFlagLoop = 0x1;
+// Sanity cap on the 30-bit wire size field.  The largest real frame is the
+// HQ capsule (777 bytes); anything near the 1 GiB field limit is a corrupted
+// header (e.g. wrong-baud noise that happened to contain A5 5A) and must
+// trigger a resync instead of swallowing the stream into a giant payload.
+constexpr uint32_t kMaxSanePayload = 8192;
 
 struct Message {
   uint8_t ans_type;
@@ -110,6 +115,10 @@ struct rpl_decoder {
             in_loop = ((word >> kSubtypeShift) & kPktFlagLoop) != 0;
             ans_type = header[4];
             payload.clear();
+            if (payload_len > kMaxSanePayload) {
+              state = State::kSync1;  // corrupted header: resync
+              break;
+            }
             if (payload_len == 0) {
               // header-only packet (ref :196-199)
               Emit();

@@ -74,6 +74,14 @@ void rpl_transceiver::RxLoop() {
         int is_loop;
         int plen = rpl_decoder_pop(decoder, &ans_type, &is_loop, payload.data(),
                                    payload.size());
+        if (plen == RPL_TOOSMALL) {
+          // a message bigger than our pop buffer can only come from a
+          // corrupted stream (codec caps frames well below this); drop the
+          // decoder's queue + state rather than wedging the pipeline on a
+          // permanently stuck head message
+          rpl_decoder_reset(decoder);
+          break;
+        }
         if (plen < 0) break;
         if (queue.size() >= kMaxQueued) queue.pop_front();  // drop oldest
         Message m;

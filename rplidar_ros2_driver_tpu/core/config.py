@@ -82,6 +82,9 @@ class DriverParams:
     intensity_min: float = 0.0
     voxel_grid_size: int = 256        # cells per side of the 2-D occupancy grid
     voxel_cell_m: float = 0.25        # metres per cell
+    # temporal-median implementation: "xla" (jnp.sort) or "pallas" (VMEM
+    # bitonic-network kernel, ops/pallas_kernels.py)
+    median_backend: str = "xla"
 
     def validate(self) -> None:
         if self.qos_reliability not in VALID_QOS:
@@ -104,6 +107,8 @@ class DriverParams:
             )
         if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
             raise ValueError("invalid voxel grid configuration")
+        if self.median_backend not in ("xla", "pallas"):
+            raise ValueError("median_backend must be 'xla' or 'pallas'")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

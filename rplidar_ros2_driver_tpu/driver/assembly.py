@@ -147,3 +147,63 @@ class ScanAssembler:
             scan["angle_q14"], scan["dist_q2"], scan["quality"], scan["flag"],
             n=self._max_nodes,
         )
+
+
+class RawNodeHolder:
+    """Bounded buffer of raw nodes for incomplete-scan interval retrieval.
+
+    Analog of the reference's ``RawSampleNodeHolder`` (bounded deque of
+    8192, sl_lidar_driver.cpp:186-235) behind ``getScanDataWithIntervalHq``
+    (:962-966): a consumer fetches whatever arrived since its last fetch,
+    without waiting for a sync-complete revolution — the low-latency tap
+    for consumers that do their own scan segmentation.  When full, the
+    oldest nodes are dropped.
+    """
+
+    def __init__(self, capacity: int = MAX_SCAN_NODES) -> None:
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._chunks: list[np.ndarray] = []   # (k, 4) int32, time-ordered
+        self._len = 0
+        self.nodes_dropped = 0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._chunks = []
+            self._len = 0
+
+    def push(self, stacked: np.ndarray) -> None:
+        """Append a (k, 4) [angle_q14, dist_q2, quality, flag] batch."""
+        if len(stacked) == 0:
+            return
+        with self._lock:
+            self._chunks.append(np.asarray(stacked, np.int32))
+            self._len += len(stacked)
+            while self._len > self._capacity:
+                overflow = self._len - self._capacity
+                head = self._chunks[0]
+                if len(head) <= overflow:
+                    self._chunks.pop(0)
+                    self._len -= len(head)
+                    self.nodes_dropped += len(head)
+                else:
+                    self._chunks[0] = head[overflow:]
+                    self._len -= overflow
+                    self.nodes_dropped += overflow
+
+    def fetch(self, max_nodes: Optional[int] = None) -> Optional[np.ndarray]:
+        """Non-blocking: drain up to ``max_nodes`` accumulated nodes as a
+        (k, 4) array in arrival order; None when nothing is pending."""
+        with self._lock:
+            if self._len == 0:
+                return None
+            data = np.concatenate(self._chunks, axis=0)
+            if max_nodes is not None and len(data) > max_nodes:
+                keep = data[max_nodes:]
+                self._chunks = [keep]
+                self._len = len(keep)
+                data = data[:max_nodes]
+            else:
+                self._chunks = []
+                self._len = 0
+            return data

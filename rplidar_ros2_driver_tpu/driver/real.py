@@ -32,17 +32,26 @@ import numpy as np
 
 from rplidar_ros2_driver_tpu.core.results import DeviceHealth
 from rplidar_ros2_driver_tpu.core.types import ScanBatch
-from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+from rplidar_ros2_driver_tpu.driver.assembly import RawNodeHolder, ScanAssembler
 from rplidar_ros2_driver_tpu.driver.interface import LidarDriverInterface
 from rplidar_ros2_driver_tpu.models.tables import (
+    A2A3_MINUM_MAJOR_ID,
     DeviceInfo,
     DriverProfile,
+    MotorCtrlSupport,
     ProtocolType,
     detect_profile,
+    has_builtin_motor_ctrl,
 )
 from rplidar_ros2_driver_tpu.ops import unpack_ref
 from rplidar_ros2_driver_tpu.protocol import conf as confproto
-from rplidar_ros2_driver_tpu.protocol.constants import Ans, Cmd
+from rplidar_ros2_driver_tpu.protocol.constants import (
+    ACC_BOARD_FLAG_MOTOR_CTRL_SUPPORT_MASK,
+    Ans,
+    AUTOBAUD_CONFIRM_FLAG,
+    AUTOBAUD_MAGICBYTE,
+    Cmd,
+)
 from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine, TransceiverLike
 
 log = logging.getLogger("rplidar_tpu.real")
@@ -74,8 +83,11 @@ class _ScanDecoder:
     data-unpacker engine, dataunpacker.cpp:123-202, with auto-select on
     answer-type change + reset)."""
 
-    def __init__(self, assembler: ScanAssembler) -> None:
+    def __init__(
+        self, assembler: ScanAssembler, raw_holder: Optional[RawNodeHolder] = None
+    ) -> None:
         self._assembler = assembler
+        self._raw_holder = raw_holder
         self._active_ans: Optional[int] = None
         self._decoder = None
 
@@ -113,12 +125,15 @@ class _ScanDecoder:
             nodes, _new_scan = self._decoder.decode(payload)
         if not nodes:
             return
-        self._assembler.push_nodes(
-            np.fromiter((n.angle_q14 for n in nodes), np.int32, len(nodes)),
-            np.fromiter((n.dist_q2 for n in nodes), np.int32, len(nodes)),
-            np.fromiter((n.quality for n in nodes), np.int32, len(nodes)),
-            np.fromiter((n.flag for n in nodes), np.int32, len(nodes)),
-        )
+        angle = np.fromiter((n.angle_q14 for n in nodes), np.int32, len(nodes))
+        dist = np.fromiter((n.dist_q2 for n in nodes), np.int32, len(nodes))
+        quality = np.fromiter((n.quality for n in nodes), np.int32, len(nodes))
+        flag = np.fromiter((n.flag for n in nodes), np.int32, len(nodes))
+        self._assembler.push_nodes(angle, dist, quality, flag)
+        if self._raw_holder is not None:
+            # same feed, pre-assembly (ref pushes to both holders,
+            # sl_lidar_driver.cpp:1645-1648)
+            self._raw_holder.push(np.stack([angle, dist, quality, flag], axis=1))
 
 
 class RealLidarDriver(LidarDriverInterface):
@@ -145,7 +160,8 @@ class RealLidarDriver(LidarDriverInterface):
 
         self._engine: Optional[CommandEngine] = None
         self._assembler = ScanAssembler()
-        self._scan_decoder = _ScanDecoder(self._assembler)
+        self._raw_holder = RawNodeHolder()
+        self._scan_decoder = _ScanDecoder(self._assembler, self._raw_holder)
         self._lock = threading.RLock()
         self._connected = False
         self._scanning = False
@@ -153,6 +169,7 @@ class RealLidarDriver(LidarDriverInterface):
         self.device_info: Optional[DeviceInfo] = None
         self.profile = DriverProfile()
         self.scan_modes: list = []
+        self.motor_ctrl = MotorCtrlSupport.NONE
 
     # ------------------------------------------------------------------
     # connection
@@ -188,7 +205,12 @@ class RealLidarDriver(LidarDriverInterface):
             self.device_info = DeviceInfo.from_payload(info_payload)
             self._engine = engine
             self._connected = True
-            log.info("connected: %s", self.device_info.summary())
+            self.motor_ctrl = self._check_motor_ctrl_support()
+            log.info(
+                "connected: %s (motor ctrl: %s)",
+                self.device_info.summary(),
+                self.motor_ctrl.value,
+            )
             return True
 
     def _net_target(self) -> tuple[str, int]:
@@ -207,6 +229,7 @@ class RealLidarDriver(LidarDriverInterface):
             self._connected = False
             self._scanning = False
             self._assembler.reset()
+            self._raw_holder.reset()
             self._scan_decoder.reset()
 
     def is_connected(self) -> bool:
@@ -304,6 +327,7 @@ class RealLidarDriver(LidarDriverInterface):
         time.sleep(0.002)
         self._engine.reset_decoder()
         self._assembler.reset()
+        self._raw_holder.reset()
         self._scan_decoder.reset()
 
     def stop_motor(self) -> None:
@@ -313,19 +337,138 @@ class RealLidarDriver(LidarDriverInterface):
             self._engine.send_only(Cmd.STOP)
             self._scanning = False
             self._engine.reset_decoder()
-            if self.profile.protocol is ProtocolType.NEW_TYPE:
-                self.set_motor_speed(0)
+            # speed 0 stops every motor variant: RPM/PWM command 0, or DTR
+            # raised on DTR-driven A-series units
+            self.set_motor_speed(0)
 
-    def set_motor_speed(self, rpm: int) -> bool:
-        """RPM path of the reference's 3-way motor control (cmd 0xA8,
-        sl_lidar_driver.cpp:990-1019).  PWM/DTR variants are A-series
-        hardware paths exercised only with a physical motor control board."""
+    def _check_motor_ctrl_support(self) -> MotorCtrlSupport:
+        """3-way capability probe (checkMotorCtrlSupport,
+        sl_lidar_driver.cpp:833-878): built-in RPM control for major id
+        >= 6; A2/A3-class units ask the accessory board (cmd 0xFF, u32
+        reserved payload) and get PWM if bit 0 of the answer is set;
+        everything else is DTR-toggled."""
+        if self.device_info is None:
+            return MotorCtrlSupport.NONE
+        if has_builtin_motor_ctrl(self.device_info.model):
+            return MotorCtrlSupport.RPM
+        major = self.device_info.model >> 4
+        if major >= A2A3_MINUM_MAJOR_ID:
+            ans = self._engine.request(
+                Cmd.GET_ACC_BOARD_FLAG,
+                Ans.ACC_BOARD_FLAG,
+                struct.pack("<I", 0),
+                timeout_s=0.5,
+            )
+            if ans is not None and len(ans) >= 4:
+                flag = struct.unpack_from("<I", ans)[0]
+                if flag & ACC_BOARD_FLAG_MOTOR_CTRL_SUPPORT_MASK:
+                    return MotorCtrlSupport.PWM
+        return MotorCtrlSupport.NONE
+
+    def set_motor_speed(self, rpm: Optional[int] = None) -> bool:
+        """3-way motor control (setMotorSpeed, sl_lidar_driver.cpp:968-1021):
+        RPM via cmd 0xA8, accessory-board PWM via 0xF0, otherwise the serial
+        DTR line (clear = run, set = stop).  ``rpm=None`` asks the device for
+        its desired speed (DESIRED_ROT_FREQ), defaulting to 600."""
         with self._lock:
             if self._engine is None:
                 return False
-            return self._engine.send_only(
-                Cmd.HQ_MOTOR_SPEED_CTRL, struct.pack("<H", rpm)
+            if rpm is None:
+                desired = confproto.get_desired_speed(self._engine)
+                if desired is not None:
+                    rpm_d, pwm_ref = desired
+                    rpm = pwm_ref if self.motor_ctrl is MotorCtrlSupport.PWM else rpm_d
+                else:
+                    rpm = DEFAULT_RPM
+            if self.motor_ctrl is MotorCtrlSupport.RPM:
+                return self._engine.send_only(
+                    Cmd.HQ_MOTOR_SPEED_CTRL, struct.pack("<H", rpm)
+                )
+            if self.motor_ctrl is MotorCtrlSupport.PWM:
+                return self._engine.send_only(
+                    Cmd.SET_MOTOR_PWM, struct.pack("<H", rpm)
+                )
+            # no motor controller: DTR low spins the motor, high stops it
+            channel = getattr(self._engine, "channel", None)
+            if channel is not None and getattr(channel, "kind", "") == "serial":
+                return bool(channel.set_dtr(rpm == 0))
+            return True  # network units have no host-driven motor line
+
+    def get_motor_info(self) -> Optional[confproto.MotorInfo]:
+        """min/max/desired rotation speed (getMotorInfo :1023-1056)."""
+        with self._lock:
+            if self._engine is None:
+                return None
+            return confproto.get_motor_info(
+                self._engine, pwm_ctrl=self.motor_ctrl is MotorCtrlSupport.PWM
             )
+
+    def get_mac_addr(self) -> Optional[bytes]:
+        with self._lock:
+            return confproto.get_mac_addr(self._engine) if self._engine else None
+
+    def get_ip_conf(self) -> Optional[confproto.IpConf]:
+        with self._lock:
+            return confproto.get_ip_conf(self._engine) if self._engine else None
+
+    def set_ip_conf(self, conf: confproto.IpConf) -> bool:
+        with self._lock:
+            return confproto.set_ip_conf(self._engine, conf) if self._engine else False
+
+    # ------------------------------------------------------------------
+    # serial autobaud negotiation (sl_lidar_driver.cpp:1058-1155)
+    # ------------------------------------------------------------------
+
+    def negotiate_serial_baud(self, required_baud: int) -> Optional[int]:
+        """Ask the device to measure and switch its UART baud rate.
+
+        Serial-only.  The transceiver is shut down so the raw channel can
+        be driven directly: stream 16-byte bursts of the 0x41 magic for up
+        to 1.5 s (the device needs >100 B/s to trigger measurement), read
+        back the 4-byte detected bps, then restart the transceiver and
+        confirm with NEW_BAUDRATE_CONFIRM {0x5F5F, required_bps, 0} — an
+        unconfirmed device reverts.  Returns the detected bps, or None.
+        """
+        with self._lock:
+            if self._engine is None:
+                return None
+            channel = getattr(self._engine, "channel", None)
+            if channel is None or getattr(channel, "kind", "") != "serial":
+                return None
+            self._engine.send_only(Cmd.STOP)
+            self._scanning = False
+            self._engine.stop()  # closes the channel; we reopen it raw
+            detected: Optional[int] = None
+            try:
+                if not channel.open():
+                    return None
+                magic = bytes([AUTOBAUD_MAGICBYTE]) * 16
+                deadline = time.monotonic() + 1.5
+                while time.monotonic() < deadline:
+                    if channel.write(magic) < 0:
+                        break
+                    first = channel.read(1, timeout_ms=1)
+                    if first:
+                        # device replied: collect the 4-byte measured bps
+                        raw = bytearray(first)
+                        stop_at = time.monotonic() + 0.5
+                        while len(raw) < 4 and time.monotonic() < stop_at:
+                            more = channel.read(4 - len(raw), timeout_ms=100)
+                            if more:
+                                raw += more
+                        if len(raw) >= 4:
+                            detected = struct.unpack_from("<I", raw)[0]
+                        break
+            finally:
+                channel.close()
+                restarted = self._engine.start()
+            if detected is None or not restarted:
+                return None
+            self._engine.send_only(
+                Cmd.NEW_BAUDRATE_CONFIRM,
+                struct.pack("<HIH", AUTOBAUD_CONFIRM_FLAG, required_baud, 0),
+            )
+            return detected
 
     # ------------------------------------------------------------------
     # health / reset / info
@@ -376,3 +519,12 @@ class RealLidarDriver(LidarDriverInterface):
 
             batch, _ = ascend_scan(batch)
         return batch
+
+    def grab_scan_data_with_interval(self, max_nodes: Optional[int] = None):
+        """Raw nodes accumulated since the last interval grab, as a (k, 4)
+        [angle_q14, dist_q2, quality, flag] array — without waiting for a
+        complete revolution (getScanDataWithIntervalHq,
+        sl_lidar_driver.cpp:962-966).  None when nothing arrived."""
+        if not self.is_connected() or not self._scanning:
+            return None
+        return self._raw_holder.fetch(max_nodes)

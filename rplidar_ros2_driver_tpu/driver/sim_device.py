@@ -73,6 +73,15 @@ class SimConfig:
     dist_amp_mm: float = 500.0
     frame_rate_hz: float = 0.0     # 0 = stream as fast as possible (tests)
     modes: list = field(default_factory=lambda: list(DEFAULT_MODES))
+    # accessory-board / motor metadata (checkMotorCtrlSupport + getMotorInfo)
+    acc_board_pwm: bool = False    # A2/A3 acc-board flag bit 0
+    min_rpm: int = 200
+    max_rpm: int = 1200
+    desired_rpm: int = 600
+    desired_pwm: int = 660
+    # network identity (MAC / static-IP conf keys)
+    mac: bytes = b"\xaa\xbb\xcc\xdd\xee\xff"
+    ip_conf: bytes = bytes([192, 168, 11, 2, 255, 255, 255, 0, 192, 168, 11, 1])
 
 
 class SimulatedDevice:
@@ -255,8 +264,13 @@ class SimulatedDevice:
         elif cmd == Cmd.SET_MOTOR_PWM:
             if len(payload) >= 2:
                 self.motor_rpm = struct.unpack_from("<H", payload)[0]
+        elif cmd == Cmd.GET_ACC_BOARD_FLAG:
+            flag = 0x1 if self.cfg.acc_board_pwm else 0x0
+            self._answer(Ans.ACC_BOARD_FLAG, struct.pack("<I", flag))
         elif cmd == Cmd.GET_LIDAR_CONF:
             self._handle_conf(payload)
+        elif cmd == Cmd.SET_LIDAR_CONF:
+            self._handle_set_conf(payload)
         elif cmd == Cmd.SCAN:
             self._start_stream(self.cfg.modes[0])
         elif cmd == Cmd.EXPRESS_SCAN:
@@ -293,7 +307,32 @@ class SimulatedDevice:
             self._answer(Ans.GET_LIDAR_CONF, echo + bytes([mode.ans_type]))
         elif key == ConfKey.SCAN_MODE_NAME and mode:
             self._answer(Ans.GET_LIDAR_CONF, echo + mode.name.encode() + b"\x00")
+        elif key == ConfKey.MIN_ROT_FREQ:
+            self._answer(Ans.GET_LIDAR_CONF, echo + struct.pack("<H", self.cfg.min_rpm))
+        elif key == ConfKey.MAX_ROT_FREQ:
+            self._answer(Ans.GET_LIDAR_CONF, echo + struct.pack("<H", self.cfg.max_rpm))
+        elif key == ConfKey.DESIRED_ROT_FREQ:
+            self._answer(
+                Ans.GET_LIDAR_CONF,
+                echo + struct.pack("<HH", self.cfg.desired_rpm, self.cfg.desired_pwm),
+            )
+        elif key == ConfKey.LIDAR_MAC_ADDR:
+            self._answer(Ans.GET_LIDAR_CONF, echo + self.cfg.mac)
+        elif key == ConfKey.LIDAR_STATIC_IP_ADDR:
+            self._answer(Ans.GET_LIDAR_CONF, echo + self.cfg.ip_conf)
         # unknown keys: no answer (requester times out, like a real device)
+
+    def _handle_set_conf(self, payload: bytes) -> None:
+        if len(payload) < 4:
+            return
+        key = struct.unpack_from("<I", payload)[0]
+        data = payload[4:]
+        if key == ConfKey.LIDAR_STATIC_IP_ADDR and len(data) >= 12:
+            self.cfg.ip_conf = bytes(data[:12])
+            self._answer(Ans.SET_LIDAR_CONF, struct.pack("<I", 0))
+        else:
+            # unsupported key: result code 1 (device rejects the set)
+            self._answer(Ans.SET_LIDAR_CONF, struct.pack("<I", 1))
 
     # ------------------------------------------------------------------
     # measurement streaming
@@ -314,20 +353,27 @@ class SimulatedDevice:
             math.radians(theta_deg) + 0.1 * rev
         )
 
+    # wire formats the emulator can stream (the other answer types are
+    # covered by the offline golden tests against ops/wire.py encoders)
+    STREAMABLE = {
+        Ans.MEASUREMENT: (NORMAL_NODE_BYTES, 1),
+        Ans.MEASUREMENT_DENSE_CAPSULED: (DENSE_CAPSULE_BYTES, 40),
+        Ans.MEASUREMENT_CAPSULED: (CAPSULE_BYTES, 32),
+    }
+
     def _stream_loop(self, mode: SimScanMode) -> None:
-        frame_bytes = {
-            Ans.MEASUREMENT: NORMAL_NODE_BYTES,
-            Ans.MEASUREMENT_DENSE_CAPSULED: DENSE_CAPSULE_BYTES,
-            Ans.MEASUREMENT_CAPSULED: CAPSULE_BYTES,
-        }[mode.ans_type]
+        if mode.ans_type not in self.STREAMABLE:
+            log.error(
+                "sim: ans type %#x is not streamable; ignoring scan start",
+                mode.ans_type,
+            )
+            self._streaming.clear()
+            return
+        frame_bytes, _ = self.STREAMABLE[mode.ans_type]
         self._send(
             AnsHeader(ans_type=mode.ans_type, payload_len=frame_bytes, is_loop=True).encode()
         )
-        pts_per_frame = {
-            Ans.MEASUREMENT: 1,
-            Ans.MEASUREMENT_DENSE_CAPSULED: 40,
-            Ans.MEASUREMENT_CAPSULED: 32,
-        }[mode.ans_type]
+        pts_per_frame = self.STREAMABLE[mode.ans_type][1]
         period = (
             pts_per_frame / (1e6 / mode.us_per_sample)
             if self.cfg.frame_rate_hz == 0

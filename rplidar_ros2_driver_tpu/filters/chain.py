@@ -60,6 +60,7 @@ class ScanFilterChain:
             enable_clip="clip" in chain,
             enable_median="median" in chain,
             enable_voxel="voxel" in chain,
+            median_backend=params.median_backend,
         )
         self.device = _pick_device(params.filter_backend)
         self.backend = params.filter_backend

@@ -120,6 +120,17 @@ def has_builtin_motor_ctrl(model_id: int) -> bool:
     return (model_id >> 4) >= BUILTIN_MOTORCTL_MINUM_MAJOR_ID
 
 
+class MotorCtrlSupport(enum.Enum):
+    """How the motor is driven (checkMotorCtrlSupport,
+    sl_lidar_driver.cpp:833-878): built-in RPM control for major id >= 6,
+    accessory-board PWM for A2/A3-class units that report the acc-board
+    flag, serial DTR toggling otherwise."""
+
+    NONE = "dtr"
+    PWM = "pwm"
+    RPM = "rpm"
+
+
 @dataclasses.dataclass
 class DeviceInfo:
     """Decoded devinfo response (sl_lidar_cmd.h:334-340)."""

@@ -178,6 +178,11 @@ class NativeTransceiver:
         self._lib.rpl_transceiver_reset_decoder(self._h)
 
     @property
+    def channel(self) -> NativeChannel:
+        """The borrowed byte channel (raw access for DTR / autobaud)."""
+        return self._channel
+
+    @property
     def had_error(self) -> bool:
         return bool(self._lib.rpl_transceiver_error(self._h))
 

@@ -69,6 +69,9 @@ class FilterConfig:
     enable_clip: bool = True
     enable_median: bool = True
     enable_voxel: bool = True
+    # "xla" = jnp.sort path; "pallas" = VMEM bitonic-network kernel
+    # (ops/pallas_kernels.temporal_median_pallas)
+    median_backend: str = "xla"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,7 +199,14 @@ def _filter_step_impl(
     filled = jnp.minimum(state.filled + 1, rw.shape[0])
 
     if cfg.enable_median:
-        med = temporal_median(rw)
+        if cfg.median_backend == "pallas":
+            from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
+                temporal_median_pallas,
+            )
+
+            med = temporal_median_pallas(rw)
+        else:
+            med = temporal_median(rw)
     else:
         med = ranges
     xy, mask = polar_to_cartesian(med, cfg.beams)

@@ -1,0 +1,130 @@
+"""Pallas TPU kernels for the filter chain's hot ops.
+
+The rolling temporal median is the chain's dominant cost (SURVEY.md §7
+"hard parts": a W x B median per revolution).  The XLA path sorts the
+whole (W, B) window in HBM via ``jnp.sort``; this kernel instead runs a
+fully vectorized bitonic sorting network over the window axis inside
+VMEM, tiled over beams, so each (W, TB) tile is read from HBM exactly
+once and the median selection fuses with the sort.
+
+The network is expressed with static reshapes + min/max only (no
+gathers, no data-dependent control flow) so Mosaic vectorizes every
+compare-exchange onto the VPU:
+
+  * stage (k, j): rows viewed as (W/(2j), 2, j); partners (i, i^j) are
+    the two slices of the middle axis; the ascending/descending
+    direction depends only on the leading group index — a compile-time
+    boolean vector.
+
+On non-TPU backends the kernel runs in interpreter mode, which keeps CI
+(CPU pytest) covering the exact kernel code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _bitonic_sort_rows(x: jax.Array) -> jax.Array:
+    """Ascending bitonic sort along axis 0 (static power-of-2 length)."""
+    w = x.shape[0]
+    assert w & (w - 1) == 0, "bitonic network needs power-of-2 rows"
+    tail = x.shape[1:]
+    k = 2
+    while k <= w:
+        j = k // 2
+        while j >= 1:
+            g = w // (2 * j)
+            v = x.reshape((g, 2, j) + tail)
+            a, b = v[:, 0], v[:, 1]
+            lo = jnp.minimum(a, b)
+            hi = jnp.maximum(a, b)
+            # bit k of row index i = (i // (2j)) // (k // (2j)) & 1 —
+            # constant per leading group; iota keeps it kernel-local
+            # (pallas_call rejects captured host constants).
+            gshape = (g, 1) + (1,) * len(tail)
+            gidx = jax.lax.broadcasted_iota(jnp.int32, gshape, 0)
+            asc = (gidx // max(k // (2 * j), 1)) % 2 == 0
+            new_a = jnp.where(asc, lo, hi)
+            new_b = jnp.where(asc, hi, lo)
+            x = jnp.concatenate([new_a[:, None], new_b[:, None]], axis=1).reshape((w,) + tail)
+            j //= 2
+        k *= 2
+    return x
+
+
+def _median_kernel(win_ref, out_ref):
+    """One (W, TB) tile: sort rows, pick the lower median of finite values."""
+    win = win_ref[:]
+    w = win.shape[0]
+    nvalid = jnp.sum(jnp.isfinite(win), axis=0)                 # (TB,)
+    s = _bitonic_sort_rows(win)                                 # inf sorts last
+    pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)                # (TB,)
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    med = jnp.sum(jnp.where(rows == pick[None, :], s, 0.0), axis=0)
+    out_ref[:] = jnp.where(nvalid > 0, med, jnp.inf)[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("block_beams", "interpret"))
+def _median_call(window: jax.Array, block_beams: int, interpret: bool) -> jax.Array:
+    w, b = window.shape
+    grid = (b // block_beams,)
+    return pl.pallas_call(
+        _median_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((w, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        # 2-D (1, TB) output blocks: a bare 1-D f32 output hits an XLA/Mosaic
+        # tiled-layout mismatch (T(1024) vs T(512)) on v5e.
+        out_specs=pl.BlockSpec((1, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, b), jnp.float32),
+        interpret=interpret,
+    )(window)[0]
+
+
+def temporal_median_pallas(
+    window: jax.Array,
+    *,
+    block_beams: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Per-beam lower median over the (W, B) ring — Pallas backend.
+
+    Drop-in equivalent of :func:`ops.filters.temporal_median` (+inf marks
+    missing returns / unfilled slots; all-inf beams stay +inf).  W is
+    padded to the next power of two with +inf (sorts to the tail, does
+    not shift the lower median); B is padded to the beam-tile multiple.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    w, b = window.shape
+    window = window.astype(jnp.float32)
+
+    w_pad = _next_pow2(max(w, 2))
+    if w_pad != w:
+        window = jnp.pad(window, ((0, w_pad - w), (0, 0)), constant_values=jnp.inf)
+
+    tb = min(block_beams, _next_pow2(max(b, _LANES)))
+    tb = max(tb, _LANES) if not interpret else min(tb, max(b, 1))
+    b_pad = ((b + tb - 1) // tb) * tb
+    if b_pad != b:
+        window = jnp.pad(window, ((0, 0), (0, b_pad - b)), constant_values=jnp.inf)
+
+    out = _median_call(window, tb, interpret)
+    return out[:b]

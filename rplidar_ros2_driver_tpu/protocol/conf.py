@@ -9,6 +9,7 @@ keyed by ``u16 mode`` appended as the payload.
 
 from __future__ import annotations
 
+import dataclasses
 import struct
 from typing import Optional
 
@@ -76,6 +77,83 @@ def get_mode_ans_type(engine: CommandEngine, mode_id: int) -> Optional[int]:
 def get_mode_name(engine: CommandEngine, mode_id: int) -> Optional[str]:
     data = get_conf(engine, ConfKey.SCAN_MODE_NAME, _mode_extra(mode_id))
     return data.split(b"\x00", 1)[0].decode("ascii", "replace") if data else None
+
+
+# ---------------------------------------------------------------------------
+# motor / network conf getters (sl_lidar_driver.cpp:887-955, 1023-1056,
+# 1163-1174)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MotorInfo:
+    """LidarMotorInfo analog (min/max/desired rotation speed)."""
+
+    min_speed: int
+    max_speed: int
+    desired_speed: int
+
+
+@dataclasses.dataclass(frozen=True)
+class IpConf:
+    """Static-IP configuration triple (sl_lidar_ip_conf_t: 3 x 4 bytes)."""
+
+    ip: tuple[int, int, int, int]
+    netmask: tuple[int, int, int, int]
+    gateway: tuple[int, int, int, int]
+
+    def to_payload(self) -> bytes:
+        return bytes(self.ip) + bytes(self.netmask) + bytes(self.gateway)
+
+    @staticmethod
+    def from_payload(data: bytes) -> "IpConf":
+        if len(data) < 12:
+            raise ValueError(f"ip conf payload too short: {len(data)}")
+        return IpConf(tuple(data[0:4]), tuple(data[4:8]), tuple(data[8:12]))
+
+
+def get_desired_speed(engine: CommandEngine) -> Optional[tuple[int, int]]:
+    """(rpm, pwm_ref) from DESIRED_ROT_FREQ (getDesiredSpeed :1163-1174)."""
+    data = get_conf(engine, ConfKey.DESIRED_ROT_FREQ)
+    if data is None or len(data) < 4:
+        return None
+    return struct.unpack_from("<HH", data)
+
+
+def get_motor_info(engine: CommandEngine, pwm_ctrl: bool = False) -> Optional[MotorInfo]:
+    """min/max/desired rotation speed (getMotorInfo :1023-1056); the desired
+    field is the PWM reference when the motor is PWM-driven."""
+    lo = get_conf(engine, ConfKey.MIN_ROT_FREQ)
+    hi = get_conf(engine, ConfKey.MAX_ROT_FREQ)
+    desired = get_desired_speed(engine)
+    if lo is None or hi is None or desired is None or len(lo) < 2 or len(hi) < 2:
+        return None
+    rpm, pwm_ref = desired
+    return MotorInfo(
+        min_speed=struct.unpack_from("<H", lo)[0],
+        max_speed=struct.unpack_from("<H", hi)[0],
+        desired_speed=pwm_ref if pwm_ctrl else rpm,
+    )
+
+
+def get_mac_addr(engine: CommandEngine) -> Optional[bytes]:
+    """6-byte MAC (getDeviceMacAddr :937-955)."""
+    data = get_conf(engine, ConfKey.LIDAR_MAC_ADDR)
+    return data[:6] if data and len(data) >= 6 else None
+
+
+def get_ip_conf(engine: CommandEngine) -> Optional[IpConf]:
+    """Static IP/netmask/gateway; the GET carries a 2-byte reserved extra
+    for backward compatibility (getLidarIpConf :896-913)."""
+    data = get_conf(engine, ConfKey.LIDAR_STATIC_IP_ADDR, extra=b"\x00\x00")
+    if data is None or len(data) < 12:
+        return None
+    return IpConf.from_payload(data)
+
+
+def set_ip_conf(engine: CommandEngine, conf: IpConf) -> bool:
+    """SET_LIDAR_CONF of the static-IP key (setLidarIpConf :887-894)."""
+    return set_conf(engine, ConfKey.LIDAR_STATIC_IP_ADDR, conf.to_payload())
 
 
 def enumerate_scan_modes(engine: CommandEngine) -> list[ScanMode]:

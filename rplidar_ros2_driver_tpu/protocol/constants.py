@@ -44,6 +44,12 @@ class Cmd(enum.IntEnum):
 
 
 AUTOBAUD_MAGICBYTE = 0x41
+# NEW_BAUDRATE_CONFIRM payload flag (sl_lidar_cmd.h:133-137)
+AUTOBAUD_CONFIRM_FLAG = 0x5F5F
+# ACC_BOARD_FLAG answer bit 0: accessory board drives the motor via PWM
+# (sl_lidar_cmd.h acc_board_flag response + checkMotorCtrlSupport,
+# sl_lidar_driver.cpp:833-878)
+ACC_BOARD_FLAG_MOTOR_CTRL_SUPPORT_MASK = 0x1
 
 
 class Ans(enum.IntEnum):

@@ -88,6 +88,12 @@ class CommandEngine:
     def healthy(self) -> bool:
         return self._running.is_set() and not self.link_error.is_set()
 
+    @property
+    def channel(self):
+        """Underlying byte channel, when the transceiver exposes one (the
+        raw-access escape hatch for DTR motor control and autobaud)."""
+        return getattr(self._tx, "channel", None)
+
     # -- request API --------------------------------------------------------
 
     def send_only(self, cmd: int, payload: bytes = b"") -> bool:

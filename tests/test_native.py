@@ -282,3 +282,31 @@ class TestTransceiver:
         tx.reset_decoder()  # as the driver does on stop/exitLoopMode
         tx.stop()
         t.join(3)
+
+
+class TestDecoderRobustness:
+    def test_corrupted_giant_size_header_resyncs(self):
+        """A noise header claiming a ~1 GiB payload must not swallow the
+        stream — the decoder resyncs and the next real frame decodes."""
+        import struct
+
+        from rplidar_ros2_driver_tpu.native.runtime import NativeDecoder
+
+        d = NativeDecoder()
+        d.feed(b"\xa5\x5a" + struct.pack("<I", 0x3FFFFFFF) + b"\x04")
+        d.feed(b"\xa5\x5a" + struct.pack("<I", 3) + b"\x06" + b"\x00\x01\x02")
+        msgs = d.drain()
+        assert len(msgs) == 1
+        ans_type, payload, is_loop = msgs[0]
+        assert ans_type == 0x06 and payload == b"\x00\x01\x02" and not is_loop
+
+    def test_max_sane_payload_accepted(self):
+        import struct
+
+        from rplidar_ros2_driver_tpu.native.runtime import NativeDecoder
+
+        d = NativeDecoder()
+        body = bytes(8192)
+        d.feed(b"\xa5\x5a" + struct.pack("<I", 8192) + b"\x20" + body)
+        msgs = d.drain()
+        assert len(msgs) == 1 and len(msgs[0][1]) == 8192
