@@ -1,0 +1,115 @@
+"""Standalone entry point — equivalent of src/standalone_main.cpp.
+
+The reference's main is rclcpp::init -> RPlidarNode -> executor spin
+(src/standalone_main.cpp:6-17).  Here:
+
+    python -m rplidar_ros2_driver_tpu run [--params FILE] [--dummy] [--duration S]
+    python -m rplidar_ros2_driver_tpu view [--scans N] [--pgm PATH]
+    python -m rplidar_ros2_driver_tpu udev [--install]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+
+def _cmd_run(args) -> int:
+    from rplidar_ros2_driver_tpu.launch import launch_lifecycle
+    from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+
+    overrides = {}
+    if args.dummy:
+        overrides["dummy_mode"] = True
+    node = launch_lifecycle(args.params, overrides=overrides or None)
+    if node.lifecycle_state is not LifecycleState.ACTIVE:
+        print("bringup failed (see log)", file=sys.stderr)
+        return 1
+    pub = node.publisher
+    deadline = time.monotonic() + args.duration if args.duration else None
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            time.sleep(1.0)
+            node._update_diagnostics()
+            diag = pub.diagnostics[-1] if getattr(pub, "diagnostics", None) else None
+            scans = getattr(pub, "scan_count", 0)
+            state = diag.message if diag else "?"
+            print(f"[{node.name}] scans={scans} state={state}")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if node.lifecycle_state is LifecycleState.ACTIVE:
+            node.deactivate()
+        if node.lifecycle_state is LifecycleState.INACTIVE:
+            node.cleanup()
+        node.shutdown()
+    return 0
+
+
+def _cmd_view(args) -> int:
+    import dataclasses
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+    from rplidar_ros2_driver_tpu.tools.viz import ascii_preview, save_pgm, scan_to_image
+
+    params = DriverParams(dummy_mode=True)
+    node = RPlidarNode(params)
+    node.configure()
+    node.activate()
+    pub = node.publisher
+    try:
+        t0 = time.monotonic()
+        while pub.scan_count < args.scans and time.monotonic() - t0 < 30:
+            time.sleep(0.05)
+    finally:
+        node.deactivate()
+        node.cleanup()
+        node.shutdown()
+    if not pub.scans:
+        print("no scans captured", file=sys.stderr)
+        return 1
+    img = scan_to_image(pub.scans[-1], view_range_m=args.range_m)
+    if args.pgm:
+        save_pgm(img, args.pgm)
+        print(f"wrote {args.pgm}")
+    else:
+        print(ascii_preview(img))
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+    ap = argparse.ArgumentParser(prog="rplidar_ros2_driver_tpu")
+    sub = ap.add_subparsers(dest="cmd")
+
+    run = sub.add_parser("run", help="bring up the lifecycle node and spin")
+    run.add_argument("--params", default=None, help="parameter YAML (default: param/rplidar.yaml)")
+    run.add_argument("--dummy", action="store_true", help="force the synthetic backend")
+    run.add_argument("--duration", type=float, default=0.0, help="seconds to run (0 = forever)")
+
+    view = sub.add_parser("view", help="capture dummy scans and render a top-down view")
+    view.add_argument("--scans", type=int, default=3)
+    view.add_argument("--range-m", type=float, default=4.0)
+    view.add_argument("--pgm", default=None, help="write image here instead of ASCII preview")
+
+    udev = sub.add_parser("udev", help="generate/install udev rules")
+    udev.add_argument("--install", action="store_true")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "run":
+        return _cmd_run(args)
+    if args.cmd == "view":
+        return _cmd_view(args)
+    if args.cmd == "udev":
+        from rplidar_ros2_driver_tpu.tools import udev as udev_mod
+
+        return udev_mod.main(["--install"] if args.install else [])
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

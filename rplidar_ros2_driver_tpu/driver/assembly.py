@@ -193,9 +193,10 @@ class RawNodeHolder:
 
     def fetch(self, max_nodes: Optional[int] = None) -> Optional[np.ndarray]:
         """Non-blocking: drain up to ``max_nodes`` accumulated nodes as a
-        (k, 4) array in arrival order; None when nothing is pending."""
+        (k, 4) array in arrival order; None when nothing is pending (or
+        when ``max_nodes=0`` asks for nothing)."""
         with self._lock:
-            if self._len == 0:
+            if self._len == 0 or max_nodes == 0:
                 return None
             data = np.concatenate(self._chunks, axis=0)
             if max_nodes is not None and len(data) > max_nodes:

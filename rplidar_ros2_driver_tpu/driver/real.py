@@ -374,7 +374,14 @@ class RealLidarDriver(LidarDriverInterface):
             if self._engine is None:
                 return False
             if rpm is None:
-                desired = confproto.get_desired_speed(self._engine)
+                # DTR-driven legacy units can't use a fetched speed (the DTR
+                # path only distinguishes stop/run) — skip the blocking conf
+                # query there.
+                desired = (
+                    confproto.get_desired_speed(self._engine)
+                    if self.motor_ctrl is not MotorCtrlSupport.NONE
+                    else None
+                )
                 if desired is not None:
                     rpm_d, pwm_ref = desired
                     rpm = pwm_ref if self.motor_ctrl is MotorCtrlSupport.PWM else rpm_d
@@ -389,7 +396,7 @@ class RealLidarDriver(LidarDriverInterface):
                     Cmd.SET_MOTOR_PWM, struct.pack("<H", rpm)
                 )
             # no motor controller: DTR low spins the motor, high stops it
-            channel = getattr(self._engine, "channel", None)
+            channel = self._engine.channel
             if channel is not None and getattr(channel, "kind", "") == "serial":
                 return bool(channel.set_dtr(rpm == 0))
             return True  # network units have no host-driven motor line
@@ -425,14 +432,18 @@ class RealLidarDriver(LidarDriverInterface):
         Serial-only.  The transceiver is shut down so the raw channel can
         be driven directly: stream 16-byte bursts of the 0x41 magic for up
         to 1.5 s (the device needs >100 B/s to trigger measurement), read
-        back the 4-byte detected bps, then restart the transceiver and
-        confirm with NEW_BAUDRATE_CONFIRM {0x5F5F, required_bps, 0} — an
-        unconfirmed device reverts.  Returns the detected bps, or None.
+        back the 4-byte detected bps, then restart the transceiver and —
+        only when the device measured the ``required_baud`` we are already
+        transmitting at — confirm with NEW_BAUDRATE_CONFIRM
+        {0x5F5F, required_bps, 0}.  An unconfirmed device reverts, which
+        is exactly what we want on a mismatch: confirming a rate different
+        from the host channel's would switch the device's UART away from
+        the link we keep using.  Returns the detected bps, or None.
         """
         with self._lock:
             if self._engine is None:
                 return None
-            channel = getattr(self._engine, "channel", None)
+            channel = self._engine.channel
             if channel is None or getattr(channel, "kind", "") != "serial":
                 return None
             self._engine.send_only(Cmd.STOP)
@@ -464,10 +475,11 @@ class RealLidarDriver(LidarDriverInterface):
                 restarted = self._engine.start()
             if detected is None or not restarted:
                 return None
-            self._engine.send_only(
-                Cmd.NEW_BAUDRATE_CONFIRM,
-                struct.pack("<HIH", AUTOBAUD_CONFIRM_FLAG, required_baud, 0),
-            )
+            if detected == required_baud:
+                self._engine.send_only(
+                    Cmd.NEW_BAUDRATE_CONFIRM,
+                    struct.pack("<HIH", AUTOBAUD_CONFIRM_FLAG, required_baud, 0),
+                )
             return detected
 
     # ------------------------------------------------------------------

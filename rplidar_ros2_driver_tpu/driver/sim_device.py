@@ -369,11 +369,10 @@ class SimulatedDevice:
             )
             self._streaming.clear()
             return
-        frame_bytes, _ = self.STREAMABLE[mode.ans_type]
+        frame_bytes, pts_per_frame = self.STREAMABLE[mode.ans_type]
         self._send(
             AnsHeader(ans_type=mode.ans_type, payload_len=frame_bytes, is_loop=True).encode()
         )
-        pts_per_frame = self.STREAMABLE[mode.ans_type][1]
         period = (
             pts_per_frame / (1e6 / mode.us_per_sample)
             if self.cfg.frame_rate_hz == 0

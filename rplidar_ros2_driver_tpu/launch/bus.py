@@ -1,0 +1,115 @@
+"""Intra-process pub/sub bus — the zero-copy composition transport.
+
+Equivalent of rclcpp intra-process comms enabled by the reference's
+composition launch (launch/composition.launch.py:67): messages published by
+a node in the container are delivered to same-process subscribers as the
+same object reference, never serialized.  QoS semantics follow the
+reference's two modes (src/rplidar_node.cpp:154-172): ``best_effort``
+subscribers get a bounded newest-wins queue; ``reliable`` subscribers get an
+unbounded queue.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Optional
+
+from rplidar_ros2_driver_tpu.node.messages import (
+    DiagnosticStatus,
+    LaserScanHost,
+    PointCloudHost,
+    StaticTransform,
+)
+from rplidar_ros2_driver_tpu.node.publisher import PublisherBase
+
+
+class _Subscription:
+    def __init__(self, callback: Optional[Callable], reliable: bool, maxlen: int) -> None:
+        self.callback = callback
+        self.queue: collections.deque = collections.deque(
+            maxlen=None if reliable else maxlen
+        )
+        self.lock = threading.Lock()
+
+    def deliver(self, msg: Any) -> None:
+        if self.callback is not None:
+            self.callback(msg)
+        else:
+            with self.lock:
+                self.queue.append(msg)
+
+    def drain(self) -> list:
+        with self.lock:
+            out = list(self.queue)
+            self.queue.clear()
+        return out
+
+
+class IntraProcessBus:
+    """Topic registry shared by every node in a :class:`NodeContainer`."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._topics: dict[str, list[_Subscription]] = {}
+        # latched topics replay the last message to late subscribers —
+        # the transient-local behaviour /tf_static relies on in ROS 2.
+        self._latched: dict[str, Any] = {}
+
+    def subscribe(
+        self,
+        topic: str,
+        callback: Optional[Callable] = None,
+        *,
+        reliable: bool = False,
+        maxlen: int = 64,
+    ) -> _Subscription:
+        sub = _Subscription(callback, reliable, maxlen)
+        with self._lock:
+            self._topics.setdefault(topic, []).append(sub)
+            if topic in self._latched:
+                sub.deliver(self._latched[topic])
+        return sub
+
+    def publish(self, topic: str, msg: Any, *, latched: bool = False) -> int:
+        """Deliver ``msg`` (by reference — zero copy) to all subscribers."""
+        with self._lock:
+            subs = list(self._topics.get(topic, ()))
+            if latched:
+                self._latched[topic] = msg
+        for sub in subs:
+            sub.deliver(msg)
+        return len(subs)
+
+    def topic_names(self) -> list[str]:
+        with self._lock:
+            return sorted(set(self._topics) | set(self._latched))
+
+
+class BusPublisher(PublisherBase):
+    """PublisherBase adapter that routes onto an :class:`IntraProcessBus`.
+
+    Topic names mirror the reference node's: ``<ns>/scan``,
+    ``<ns>/points``, ``/tf_static``, ``/diagnostics``
+    (src/rplidar_node.cpp:154-208).
+    """
+
+    def __init__(self, bus: IntraProcessBus, namespace: str = "") -> None:
+        self.bus = bus
+        ns = namespace.rstrip("/")
+        self.scan_topic = f"{ns}/scan"
+        self.cloud_topic = f"{ns}/points"
+        self.tf_topic = "/tf_static"
+        self.diag_topic = "/diagnostics"
+
+    def publish_scan(self, msg: LaserScanHost) -> None:
+        self.bus.publish(self.scan_topic, msg)
+
+    def publish_cloud(self, msg: PointCloudHost) -> None:
+        self.bus.publish(self.cloud_topic, msg)
+
+    def publish_tf_static(self, tf: StaticTransform) -> None:
+        self.bus.publish(self.tf_topic, tf, latched=True)
+
+    def publish_diagnostics(self, status: DiagnosticStatus) -> None:
+        self.bus.publish(self.diag_topic, status)

@@ -1,0 +1,1 @@
+"""Deployment utilities: udev rules, scan visualization."""

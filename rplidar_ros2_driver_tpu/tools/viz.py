@@ -1,0 +1,67 @@
+"""Scan visualization — the rviz-config analog (config/rplidar.rviz).
+
+The reference ships a preconfigured rviz LaserScan view.  Without a GUI in
+scope, the equivalent deliverable is a renderer: LaserScan -> 2-D top-down
+occupancy image (numpy array / PGM file / terminal preview), honoring the
+same view parameters the rviz file fixes (range, point style, frame).  View
+defaults ship in config/rplidar_view.yaml.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.node.messages import LaserScanHost
+
+
+def scan_to_image(
+    scan: LaserScanHost,
+    *,
+    size_px: int = 256,
+    view_range_m: Optional[float] = None,
+    point_weight: int = 255,
+) -> np.ndarray:
+    """Rasterize a LaserScan to a top-down (size_px, size_px) uint8 image.
+
+    Sensor at the center, +x right, +y up, matching the rviz top-down
+    orthographic view.  Out-of-range and non-finite returns are dropped.
+    """
+    rng = view_range_m or (scan.range_max if math.isfinite(scan.range_max) else 40.0)
+    n = scan.ranges.shape[0]
+    angles = scan.angle_min + np.arange(n) * scan.angle_increment
+    r = np.asarray(scan.ranges, np.float64)
+    ok = np.isfinite(r) & (r >= scan.range_min) & (r <= rng)
+    x = r[ok] * np.cos(angles[ok])
+    y = r[ok] * np.sin(angles[ok])
+    half = size_px / 2.0
+    scale = half / rng
+    col = np.clip((x * scale + half).astype(np.int64), 0, size_px - 1)
+    row = np.clip((half - y * scale).astype(np.int64), 0, size_px - 1)
+    img = np.zeros((size_px, size_px), np.uint8)
+    img[row, col] = point_weight
+    return img
+
+
+def save_pgm(img: np.ndarray, path: str) -> None:
+    """Write a binary PGM (viewable everywhere, zero dependencies)."""
+    h, w = img.shape
+    with open(path, "wb") as f:
+        f.write(b"P5\n%d %d\n255\n" % (w, h))
+        f.write(np.ascontiguousarray(img, np.uint8).tobytes())
+
+
+def ascii_preview(img: np.ndarray, width: int = 64) -> str:
+    """Downsample to a terminal-sized ASCII view (the `rviz -d` stand-in)."""
+    h, w = img.shape
+    step = max(1, w // width)
+    rows = []
+    for r0 in range(0, h - step + 1, step * 2):  # chars are ~2x tall
+        line = []
+        for c0 in range(0, w - step + 1, step):
+            block = img[r0 : r0 + step * 2, c0 : c0 + step]
+            line.append("#" if block.any() else ".")
+        rows.append("".join(line))
+    return "\n".join(rows)

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Thin wrapper over the packaged generator (tools/udev.py) — parity with the
+# reference's scripts/create_udev_rules.sh: CP210x (10c4:ea60) -> /dev/rplidar,
+# MODE 0666, group dialout, then udev reload + trigger.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m rplidar_ros2_driver_tpu.tools.udev --install "$@"

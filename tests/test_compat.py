@@ -1,0 +1,50 @@
+"""Legacy compat shim tests (rplidar_driver.cpp facade + RPLIDAR_* aliases)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu import compat
+from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
+from rplidar_ros2_driver_tpu.core.results import DeviceHealth
+from rplidar_ros2_driver_tpu.protocol import constants as c
+
+
+def test_alias_values_match_modern_enums():
+    # spot checks mirroring rplidar_cmd.h:42-70
+    assert compat.RPLIDAR_CMD_STOP == 0x25
+    assert compat.RPLIDAR_CMD_SCAN == 0x20
+    assert compat.RPLIDAR_CMD_FORCE_SCAN == 0x21
+    assert compat.RPLIDAR_CMD_RESET == 0x40
+    assert compat.RPLIDAR_CMD_EXPRESS_SCAN == 0x82
+    assert compat.RPLIDAR_CMD_SET_MOTOR_PWM == 0xF0
+    assert compat.RPLIDAR_ANS_TYPE_MEASUREMENT == int(c.Ans.MEASUREMENT)
+    assert compat.RPLIDAR_ANS_TYPE_DEVINFO == 0x04
+    assert compat.RPLIDAR_STATUS_OK == 0
+    assert compat.RPLIDAR_STATUS_ERROR == 2
+    assert compat.RPLIDAR_CMD_SYNC_BYTE == 0xA5
+    assert compat.MAX_SCAN_NODES == 8192
+
+
+def test_facade_forwards_to_impl():
+    drv = compat.RPlidarDriver(DummyLidarDriver())
+    assert drv.connect("/dev/fake", 115200)
+    assert drv.isConnected()
+    assert drv.getHealth() == DeviceHealth.OK
+    assert drv.startScan()
+    batch = drv.grabScanDataHq(2000)
+    assert batch is not None
+    host = batch.to_host()
+    assert host["angle_q14"].shape[0] > 0
+    asc = drv.ascendScanData(batch)
+    ang = np.asarray(asc.angle_q14)[: int(asc.count)]
+    assert (np.diff(ang.astype(np.int64)) >= 0).all()
+    drv.stop()
+    drv.stopMotor()
+    compat.RPlidarDriver.DisposeDriver(drv)  # dummy stays "connected" by design
+
+
+def test_create_driver_warns_deprecated():
+    with pytest.warns(DeprecationWarning):
+        compat.RPlidarDriver.CreateDriver(impl=DummyLidarDriver())

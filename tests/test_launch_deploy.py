@@ -1,0 +1,205 @@
+"""Deployment-layer tests: param YAML, lifecycle launch, composition
+container + intra-process bus, udev generator, viz renderer, CLI.
+
+Covers the reference's L0 layer (launch/rplidar.launch.py,
+launch/composition.launch.py, param/rplidar.yaml,
+scripts/create_udev_rules.sh, config/rplidar.rviz).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.launch import (
+    IntraProcessBus,
+    NodeContainer,
+    default_params_path,
+    launch_lifecycle,
+)
+from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+from rplidar_ros2_driver_tpu.tools import udev, viz
+
+
+def test_shipped_param_yaml_matches_defaults():
+    """param/rplidar.yaml must parse and agree with DriverParams defaults."""
+    p = DriverParams.from_yaml(default_params_path())
+    assert p == DriverParams()
+
+
+def test_launch_lifecycle_brings_node_to_active():
+    node = launch_lifecycle(overrides={"dummy_mode": True})
+    try:
+        assert node.lifecycle_state is LifecycleState.ACTIVE
+        deadline = time.monotonic() + 10
+        while node.publisher.scan_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert node.publisher.scan_count > 0
+    finally:
+        node.deactivate()
+        node.cleanup()
+        node.shutdown()
+
+
+def test_launch_no_auto_activate():
+    node = launch_lifecycle(overrides={"dummy_mode": True}, auto_activate=False)
+    try:
+        assert node.lifecycle_state is LifecycleState.INACTIVE
+    finally:
+        node.cleanup()
+        node.shutdown()
+
+
+class TestIntraProcessBus:
+    def test_zero_copy_delivery(self):
+        bus = IntraProcessBus()
+        got = []
+        bus.subscribe("/scan", got.append)
+        msg = object()
+        n = bus.publish("/scan", msg)
+        assert n == 1
+        assert got[0] is msg  # same object, no serialization
+
+    def test_best_effort_bounded_newest_wins(self):
+        bus = IntraProcessBus()
+        sub = bus.subscribe("/scan", maxlen=2)
+        for k in range(5):
+            bus.publish("/scan", k)
+        assert sub.drain() == [3, 4]
+
+    def test_reliable_keeps_all(self):
+        bus = IntraProcessBus()
+        sub = bus.subscribe("/scan", reliable=True, maxlen=2)
+        for k in range(5):
+            bus.publish("/scan", k)
+        assert sub.drain() == [0, 1, 2, 3, 4]
+
+    def test_latched_topic_replays_to_late_subscriber(self):
+        """/tf_static transient-local behaviour."""
+        bus = IntraProcessBus()
+        bus.publish("/tf_static", "tf0", latched=True)
+        sub = bus.subscribe("/tf_static")
+        assert sub.drain() == ["tf0"]
+
+
+def test_container_composition_end_to_end():
+    """Two composed nodes publish on namespaced topics over one bus."""
+    with NodeContainer() as cont:
+        cont.add_node("lidar_a", DriverParams(dummy_mode=True))
+        cont.add_node("lidar_b", DriverParams(dummy_mode=True))
+        sub_a = cont.bus.subscribe("/lidar_a/scan")
+        sub_b = cont.bus.subscribe("/lidar_b/scan")
+        assert cont.configure_all()
+        assert cont.activate_all()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if sub_a.drain() and sub_b.drain():
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("composed nodes did not both publish")
+    assert not cont.nodes  # shutdown_all unloaded them
+
+
+def test_container_duplicate_name_rejected():
+    cont = NodeContainer()
+    cont.add_node("x", DriverParams(dummy_mode=True))
+    with pytest.raises(ValueError):
+        cont.add_node("x", DriverParams(dummy_mode=True))
+    cont.shutdown_all()
+
+
+def test_udev_rules_text():
+    text = udev.udev_rules_text()
+    assert '"10c4"' in text and '"ea60"' in text
+    assert 'SYMLINK+="rplidar"' in text
+    assert 'MODE:="0666"' in text
+    assert 'GROUP:="dialout"' in text
+
+
+def test_udev_install_requires_root(tmp_path):
+    import os
+
+    if os.geteuid() == 0:
+        path = tmp_path / "99-rplidar.rules"
+        udev.install(str(path), reload_udev=False)
+        assert "10c4" in path.read_text()
+    else:
+        with pytest.raises(PermissionError):
+            udev.install(str(tmp_path / "r.rules"), reload_udev=False)
+
+
+def _fake_scan(n=360, r=2.0):
+    from rplidar_ros2_driver_tpu.node.messages import LaserScanHost
+
+    inc = 2 * np.pi / n
+    return LaserScanHost(
+        stamp=0.0,
+        frame_id="laser",
+        angle_min=-np.pi,
+        angle_max=np.pi - inc,
+        angle_increment=inc,
+        time_increment=0.0,
+        scan_time=0.1,
+        range_min=0.15,
+        range_max=12.0,
+        ranges=np.full(n, r, np.float32),
+        intensities=np.full(n, 47.0, np.float32),
+    )
+
+
+def test_viz_renders_ring(tmp_path):
+    img = viz.scan_to_image(_fake_scan(), size_px=128, view_range_m=4.0)
+    assert img.shape == (128, 128)
+    assert img.sum() > 0
+    # a constant-radius ring leaves the center empty
+    assert img[60:68, 60:68].sum() == 0
+    pgm = tmp_path / "scan.pgm"
+    viz.save_pgm(img, str(pgm))
+    head = pgm.read_bytes()[:15]
+    assert head.startswith(b"P5\n128 128\n255")
+    txt = viz.ascii_preview(img, width=32)
+    assert "#" in txt
+
+
+def test_viz_drops_nonfinite_points():
+    scan = _fake_scan()
+    scan.ranges[:180] = np.inf
+    img = viz.scan_to_image(scan, size_px=64, view_range_m=4.0)
+    assert img.sum() > 0
+
+
+def test_cli_view_subcommand():
+    """Standalone main equivalent: `python -m ... view` runs end-to-end."""
+    out = subprocess.run(
+        [sys.executable, "-m", "rplidar_ros2_driver_tpu", "view", "--scans", "1"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "#" in out.stdout
+
+
+def test_cli_run_duration():
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "rplidar_ros2_driver_tpu",
+            "run",
+            "--dummy",
+            "--duration",
+            "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "scans=" in out.stdout

@@ -200,7 +200,7 @@ class FakeTransceiver:
 
 
 def test_autobaud_negotiation_flow():
-    ch = FakeSerialChannel(detected_baud=460800)
+    ch = FakeSerialChannel(detected_baud=256000)
     tx = FakeTransceiver(ch)
     drv = RealLidarDriver(transceiver_factory=lambda *a, **k: tx)
     # hand-wire a started engine (connect() would need a devinfo answer)
@@ -211,7 +211,7 @@ def test_autobaud_negotiation_flow():
     drv._connected = True
 
     detected = drv.negotiate_serial_baud(256000)
-    assert detected == 460800
+    assert detected == 256000
     # confirmation packet went out with flag 0x5F5F + required bps
     confirm = [p for p in tx.sent if len(p) > 2 and p[1] == Cmd.NEW_BAUDRATE_CONFIRM]
     assert confirm, f"no NEW_BAUDRATE_CONFIRM among {tx.sent!r}"
@@ -219,6 +219,26 @@ def test_autobaud_negotiation_flow():
     flag, bps, _ = struct.unpack("<HIH", payload)
     assert flag == 0x5F5F and bps == 256000
     # transceiver restarted after raw-mode negotiation
+    assert tx.running
+    drv._engine.stop()
+
+
+def test_autobaud_mismatch_not_confirmed():
+    """A detected rate != required must NOT be confirmed: confirming would
+    switch the device's UART away from the link the host keeps using."""
+    ch = FakeSerialChannel(detected_baud=115200)
+    tx = FakeTransceiver(ch)
+    drv = RealLidarDriver(transceiver_factory=lambda *a, **k: tx)
+    from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine
+
+    drv._engine = CommandEngine(tx)
+    assert drv._engine.start()
+    drv._connected = True
+
+    detected = drv.negotiate_serial_baud(256000)
+    assert detected == 115200  # measurement still reported to the caller
+    confirm = [p for p in tx.sent if len(p) > 2 and p[1] == Cmd.NEW_BAUDRATE_CONFIRM]
+    assert not confirm, "mismatched baud must not be confirmed"
     assert tx.running
     drv._engine.stop()
 
