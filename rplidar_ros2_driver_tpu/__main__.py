@@ -48,13 +48,30 @@ def _cmd_run(args) -> int:
     return 0
 
 
-def _cmd_view(args) -> int:
-    import dataclasses
+def _view_defaults(path=None) -> dict:
+    """Load config/rplidar_view.yaml (the rviz-config analog); CLI flags win."""
+    import os
 
+    import yaml
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = path or os.path.join(os.path.dirname(here), "config", "rplidar_view.yaml")
+    defaults = {"size_px": 256, "view_range_m": 4.0, "ascii_width": 64, "point_weight": 255}
+    try:
+        with open(path) as f:
+            doc = yaml.safe_load(f) or {}
+        defaults.update(doc.get("view", {}))
+    except OSError:
+        pass
+    return defaults
+
+
+def _cmd_view(args) -> int:
     from rplidar_ros2_driver_tpu.core.config import DriverParams
     from rplidar_ros2_driver_tpu.node.node import RPlidarNode
     from rplidar_ros2_driver_tpu.tools.viz import ascii_preview, save_pgm, scan_to_image
 
+    view_cfg = _view_defaults(args.view_config)
     params = DriverParams(dummy_mode=True)
     node = RPlidarNode(params)
     node.configure()
@@ -71,12 +88,17 @@ def _cmd_view(args) -> int:
     if not pub.scans:
         print("no scans captured", file=sys.stderr)
         return 1
-    img = scan_to_image(pub.scans[-1], view_range_m=args.range_m)
+    img = scan_to_image(
+        pub.scans[-1],
+        size_px=int(view_cfg["size_px"]),
+        view_range_m=args.range_m if args.range_m is not None else float(view_cfg["view_range_m"]),
+        point_weight=int(view_cfg["point_weight"]),
+    )
     if args.pgm:
         save_pgm(img, args.pgm)
         print(f"wrote {args.pgm}")
     else:
-        print(ascii_preview(img))
+        print(ascii_preview(img, width=int(view_cfg["ascii_width"])))
     return 0
 
 
@@ -92,8 +114,11 @@ def main(argv=None) -> int:
 
     view = sub.add_parser("view", help="capture dummy scans and render a top-down view")
     view.add_argument("--scans", type=int, default=3)
-    view.add_argument("--range-m", type=float, default=4.0)
+    view.add_argument("--range-m", type=float, default=None, help="overrides view config")
     view.add_argument("--pgm", default=None, help="write image here instead of ASCII preview")
+    view.add_argument(
+        "--view-config", default=None, help="view YAML (default: config/rplidar_view.yaml)"
+    )
 
     udev = sub.add_parser("udev", help="generate/install udev rules")
     udev.add_argument("--install", action="store_true")

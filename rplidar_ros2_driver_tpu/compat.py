@@ -136,11 +136,29 @@ class RPlidarDriver:
 
     # -- scanning -----------------------------------------------------------
     def startScan(self, force: bool = False, use_typical: bool = True) -> bool:
-        """Legacy auto-start: detect + start in the preferred mode."""
+        """Legacy auto-start: detect + start in the preferred mode.
+
+        ``force`` (FORCE_SCAN 0x21: scan despite failed health check) has
+        no equivalent here — the FSM health-gates starts by design — so it
+        warns loudly instead of silently differing from the legacy API.
+        """
+        if force:
+            warnings.warn(
+                "startScan(force=True): FORCE_SCAN is not supported; "
+                "starting with the normal health-gated path",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         self._impl.detect_and_init_strategy()
         return self._impl.start_motor("", 0)
 
     def startScanExpress(self, fixed_angle: bool, scan_mode: str, rpm: int = 0) -> bool:
+        if fixed_angle:
+            warnings.warn(
+                "startScanExpress(fixed_angle=True) is not supported and is ignored",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return self._impl.start_motor(scan_mode, rpm)
 
     def stop(self) -> None:

@@ -93,14 +93,17 @@ class ScanFilterChain:
         """Host copy of the rolling window + accumulator."""
         return {k: np.asarray(v) for k, v in vars(self._state).items()}
 
-    def restore(self, snap: Optional[dict[str, np.ndarray]]) -> None:
+    def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
         """Restore a snapshot, or reset deterministically when None.
 
         A snapshot taken under different chain parameters (window/beams/
         grid changed across a cleanup->configure cycle) is incompatible
         with the compiled step; restoring it would crash the hot path, so
         it is discarded with a warning and the window starts cold.
+        Returns True when the snapshot was restored, False when the chain
+        cold-started (no snapshot given, or geometry mismatch).
         """
+        restored = snap is not None
         if snap is not None:
             fresh = FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid)
             expected = {k: v.shape for k, v in vars(fresh).items()}
@@ -110,6 +113,7 @@ class ScanFilterChain:
                     "discarding incompatible filter snapshot (%s != %s)", got, expected
                 )
                 snap = None
+                restored = False
         if snap is None:
             self._state = jax.device_put(
                 FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
@@ -117,6 +121,7 @@ class ScanFilterChain:
             )
         else:
             self._state = jax.device_put(FilterState(**snap), self.device)
+        return restored
 
     def reset(self) -> None:
         self.restore(None)

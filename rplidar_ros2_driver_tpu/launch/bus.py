@@ -46,6 +46,9 @@ class _Subscription:
         return out
 
 
+_NO_LATCHED = object()  # sentinel: None is a publishable message
+
+
 class IntraProcessBus:
     """Topic registry shared by every node in a :class:`NodeContainer`."""
 
@@ -67,8 +70,11 @@ class IntraProcessBus:
         sub = _Subscription(callback, reliable, maxlen)
         with self._lock:
             self._topics.setdefault(topic, []).append(sub)
-            if topic in self._latched:
-                sub.deliver(self._latched[topic])
+            replay = self._latched.get(topic, _NO_LATCHED)
+        # deliver the latched replay outside the bus lock (like publish),
+        # so a callback that re-enters the bus cannot deadlock
+        if replay is not _NO_LATCHED:
+            sub.deliver(replay)
         return sub
 
     def publish(self, topic: str, msg: Any, *, latched: bool = False) -> int:

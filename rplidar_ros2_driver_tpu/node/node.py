@@ -148,6 +148,51 @@ class RPlidarNode(LifecycleNode):
         """Forget the saved filter-window snapshot (next configure starts cold)."""
         self._chain_snapshot = None
 
+    def save_checkpoint(self, path: str) -> bool:
+        """Persist the filter-chain state to disk (utils/checkpoint.py).
+
+        Uses the live chain state when active/inactive-with-chain, else the
+        last deactivate-time snapshot.  Returns False when there is nothing
+        to save (no chain configured and no snapshot held).
+        """
+        from rplidar_ros2_driver_tpu.utils.checkpoint import save_checkpoint
+
+        snap = self.chain.snapshot() if self.chain is not None else self._chain_snapshot
+        if snap is None:
+            return False
+        save_checkpoint(path, snap, extra={"node": self.name})
+        return True
+
+    def load_checkpoint(self, path: str) -> bool:
+        """Stage an on-disk checkpoint for the next configure (or restore it
+        immediately into an already-configured chain).
+
+        Returns False — and stages nothing — when the file is absent/torn
+        or its geometry doesn't match the current chain parameters, so a
+        True return means the state genuinely resumed (or will on the next
+        configure)."""
+        from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+        from rplidar_ros2_driver_tpu.utils.checkpoint import load_checkpoint
+
+        loaded = load_checkpoint(path)
+        if loaded is None:
+            return False
+        snap, _meta = loaded
+        if self.chain is not None:
+            if not self.chain.restore(snap):
+                return False
+            self._chain_snapshot = snap
+            return True
+        # no live chain: validate against the geometry the next configure
+        # will build, instead of staging a snapshot doomed to be discarded
+        if not self.params.filter_chain:
+            return False
+        probe = ScanFilterChain(self.params)
+        if not probe.restore(snap):
+            return False
+        self._chain_snapshot = snap
+        return True
+
     def on_shutdown(self) -> bool:
         return True
 

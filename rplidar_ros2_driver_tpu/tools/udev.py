@@ -29,12 +29,14 @@ def udev_rules_text(symlink: str = "rplidar", mode: str = "0666", group: str = "
     )
 
 
-def install(rules_path: str = RULES_PATH, *, reload_udev: bool = True) -> None:
+def install(
+    rules_path: str = RULES_PATH, *, symlink: str = "rplidar", reload_udev: bool = True
+) -> None:
     """Write the rules file and reload udev (requires root)."""
     if os.geteuid() != 0:
         raise PermissionError("installing udev rules requires root")
     with open(rules_path, "w") as f:
-        f.write(udev_rules_text())
+        f.write(udev_rules_text(symlink))
     if reload_udev:
         # same reload+trigger sequence as the reference script
         subprocess.run(["udevadm", "control", "--reload-rules"], check=False)
@@ -47,7 +49,7 @@ def main(argv=None) -> int:
     ap.add_argument("--symlink", default="rplidar")
     args = ap.parse_args(argv)
     if args.install:
-        install()
+        install(symlink=args.symlink)
         print(f"installed {RULES_PATH}")
     else:
         sys.stdout.write(udev_rules_text(args.symlink))

@@ -1,0 +1,80 @@
+"""Durable checkpoint/resume for the filter-chain state.
+
+The reference is stateless streaming — its only "resume" surface is the
+lifecycle state machine (SURVEY.md §5).  In this framework the rolling
+scan window and voxel accumulator are real device-resident state, so they
+get a real checkpoint format: an atomically-written ``.npz`` of the host
+snapshot plus a JSON sidecar fingerprinting the chain geometry
+(window/beams/grid), so a restore into a reconfigured chain is detected
+and refused instead of crashing the compiled step.
+
+Kept dependency-light (numpy only): the snapshots are a few MB at most,
+and a single-file atomic rename is exactly the durability contract needed.
+For multi-host meshes, each host saves its addressable shards under its
+process index.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zipfile
+from typing import Any, Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _fingerprint(snap: dict[str, np.ndarray]) -> dict[str, Any]:
+    return {
+        "version": FORMAT_VERSION,
+        "arrays": {k: {"shape": list(v.shape), "dtype": str(v.dtype)} for k, v in snap.items()},
+    }
+
+
+def save_checkpoint(path: str, snap: dict[str, np.ndarray], extra: Optional[dict] = None) -> None:
+    """Atomically write ``snap`` to ``path`` (an .npz file).
+
+    Write-to-temp + rename in the destination directory, so a crash
+    mid-save never leaves a torn checkpoint, and a concurrent reader sees
+    either the old file or the new one.
+    """
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    meta = _fingerprint(snap)
+    if extra:
+        meta["extra"] = extra
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8), **snap)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_checkpoint(path: str) -> Optional[tuple[dict[str, np.ndarray], dict]]:
+    """Read a checkpoint; None when absent or unreadable/torn."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            raw_meta = z["__meta__"].tobytes()
+            meta = json.loads(raw_meta)
+            if meta.get("version") != FORMAT_VERSION:
+                return None
+            snap = {k: z[k] for k in z.files if k != "__meta__"}
+    except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile):
+        return None
+    # verify the payload matches its own manifest (truncation guard)
+    want = meta.get("arrays", {})
+    for k, spec in want.items():
+        if k not in snap or list(snap[k].shape) != spec["shape"] or str(snap[k].dtype) != spec["dtype"]:
+            return None
+    return snap, meta

@@ -1,0 +1,164 @@
+"""Durable checkpoint/resume tests (utils/checkpoint.py + node wiring).
+
+The reference has no checkpointing (stateless streaming, SURVEY.md §5);
+this framework's rolling window + voxel accumulator are real state, so
+snapshot/save/load/restore must round-trip bit-exactly and refuse
+geometry mismatches.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+from rplidar_ros2_driver_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+
+def _params(**kw) -> DriverParams:
+    base = dict(
+        dummy_mode=True,
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=32,
+    )
+    base.update(kw)
+    return DriverParams(**base)
+
+
+def _fill_chain(chain: ScanFilterChain, n: int = 6) -> None:
+    rng = np.random.default_rng(7)
+    for k in range(n):
+        pts = 180
+        chain.process_raw(
+            ((np.arange(pts) * 65536) // pts).astype(np.int32),
+            (rng.uniform(1000, 9000, pts)).astype(np.int32),
+            np.full(pts, 150, np.int32),
+        )
+
+
+class TestFileFormat:
+    def test_roundtrip_bit_exact(self, tmp_path):
+        snap = {
+            "window": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+            "cursor": np.asarray(5, np.int32),
+        }
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, snap, extra={"node": "x"})
+        loaded = load_checkpoint(p)
+        assert loaded is not None
+        got, meta = loaded
+        assert set(got) == set(snap)
+        for k in snap:
+            np.testing.assert_array_equal(got[k], snap[k])
+        assert meta["extra"]["node"] == "x"
+
+    def test_missing_file(self, tmp_path):
+        assert load_checkpoint(str(tmp_path / "nope.npz")) is None
+
+    def test_torn_file_rejected(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": np.zeros(64, np.float32)})
+        raw = open(p, "rb").read()
+        with open(p, "wb") as f:
+            f.write(raw[: len(raw) // 2])  # simulate crash mid-write of a NON-atomic writer
+        assert load_checkpoint(p) is None
+
+    def test_no_tmp_residue(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": np.zeros(4, np.float32)})
+        assert [f for f in os.listdir(tmp_path)] == ["ck.npz"]
+
+
+class TestChainResume:
+    def test_chain_state_survives_disk_roundtrip(self, tmp_path):
+        params = _params()
+        chain = ScanFilterChain(params, beams=256)
+        _fill_chain(chain)
+        snap = chain.snapshot()
+        p = str(tmp_path / "chain.npz")
+        save_checkpoint(p, snap)
+        snap2, _ = load_checkpoint(p)
+
+        chain2 = ScanFilterChain(params, beams=256)
+        chain2.restore(snap2)
+        for k, v in chain.snapshot().items():
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(chain2.snapshot()[k]))
+
+    def test_geometry_mismatch_starts_cold(self, tmp_path):
+        chain = ScanFilterChain(_params(), beams=256)
+        _fill_chain(chain)
+        p = str(tmp_path / "chain.npz")
+        save_checkpoint(p, chain.snapshot())
+        snap, _ = load_checkpoint(p)
+        bigger = ScanFilterChain(_params(filter_window=8), beams=256)
+        bigger.restore(snap)  # incompatible -> warn + cold start, no crash
+        cold = ScanFilterChain(_params(filter_window=8), beams=256)
+        for k, v in vars(cold.state).items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(vars(bigger.state)[k])
+            )
+
+
+class TestNodeWiring:
+    def _run_node(self, params, scans=2, timeout=10.0):
+        node = RPlidarNode(params)
+        assert node.configure() and node.activate()
+        t0 = time.monotonic()
+        while node.publisher.scan_count < scans and time.monotonic() - t0 < timeout:
+            time.sleep(0.02)
+        node.deactivate()
+        return node
+
+    def test_node_save_load_resume(self, tmp_path):
+        p = str(tmp_path / "node.npz")
+        node = self._run_node(_params())
+        assert node.save_checkpoint(p)
+        ref = node._chain_snapshot
+        node.cleanup()
+        node.shutdown()
+
+        node2 = RPlidarNode(_params())
+        assert node2.load_checkpoint(p)
+        assert node2.configure()
+        for k, v in ref.items():
+            np.testing.assert_array_equal(
+                np.asarray(v), np.asarray(node2.chain.snapshot()[k])
+            )
+        node2.cleanup()
+        node2.shutdown()
+
+    def test_save_without_chain_is_false(self, tmp_path):
+        node = RPlidarNode(DriverParams(dummy_mode=True))  # no filter chain
+        assert not node.save_checkpoint(str(tmp_path / "x.npz"))
+
+    def test_load_missing_is_false(self, tmp_path):
+        node = RPlidarNode(_params())
+        assert not node.load_checkpoint(str(tmp_path / "absent.npz"))
+
+    def test_load_incompatible_geometry_is_false(self, tmp_path):
+        """A saved window=4 checkpoint must not claim to resume into a
+        window=8 node, nor stay staged for later configures."""
+        p = str(tmp_path / "node.npz")
+        node = self._run_node(_params(filter_window=4))
+        assert node.save_checkpoint(p)
+        node.cleanup()
+        node.shutdown()
+
+        node2 = RPlidarNode(_params(filter_window=8))
+        assert not node2.load_checkpoint(p)
+        assert node2._chain_snapshot is None
+
+    def test_load_without_filter_chain_is_false(self, tmp_path):
+        p = str(tmp_path / "node.npz")
+        node = self._run_node(_params())
+        assert node.save_checkpoint(p)
+        node.cleanup()
+        node.shutdown()
+        plain = RPlidarNode(DriverParams(dummy_mode=True))
+        assert not plain.load_checkpoint(p)

@@ -48,3 +48,11 @@ def test_facade_forwards_to_impl():
 def test_create_driver_warns_deprecated():
     with pytest.warns(DeprecationWarning):
         compat.RPlidarDriver.CreateDriver(impl=DummyLidarDriver())
+
+
+def test_unsupported_legacy_args_warn():
+    drv = compat.RPlidarDriver(DummyLidarDriver())
+    with pytest.warns(RuntimeWarning, match="FORCE_SCAN"):
+        drv.startScan(force=True)
+    with pytest.warns(RuntimeWarning, match="fixed_angle"):
+        drv.startScanExpress(True, "Standard")

@@ -86,6 +86,23 @@ class TestIntraProcessBus:
         sub = bus.subscribe("/tf_static")
         assert sub.drain() == ["tf0"]
 
+    def test_latched_replay_callback_may_reenter_bus(self):
+        """Replay is delivered outside the bus lock: a callback that
+        republishes or subscribes must not deadlock."""
+        bus = IntraProcessBus()
+        bus.publish("/tf_static", "tf0", latched=True)
+        got = []
+
+        def reenter(msg):
+            got.append(msg)
+            bus.publish("/echo", msg)  # re-enters the bus
+            bus.topic_names()
+
+        echo = bus.subscribe("/echo")
+        bus.subscribe("/tf_static", reenter)  # would deadlock pre-fix
+        assert got == ["tf0"]
+        assert echo.drain() == ["tf0"]
+
 
 def test_container_composition_end_to_end():
     """Two composed nodes publish on namespaced topics over one bus."""
@@ -127,8 +144,10 @@ def test_udev_install_requires_root(tmp_path):
 
     if os.geteuid() == 0:
         path = tmp_path / "99-rplidar.rules"
-        udev.install(str(path), reload_udev=False)
-        assert "10c4" in path.read_text()
+        udev.install(str(path), symlink="lidar2", reload_udev=False)
+        text = path.read_text()
+        assert "10c4" in text
+        assert 'SYMLINK+="lidar2"' in text  # --symlink honored by install
     else:
         with pytest.raises(PermissionError):
             udev.install(str(tmp_path / "r.rules"), reload_udev=False)
