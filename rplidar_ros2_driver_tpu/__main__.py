@@ -59,10 +59,16 @@ def _view_defaults(path=None) -> dict:
     defaults = {"size_px": 256, "view_range_m": 4.0, "ascii_width": 64, "point_weight": 255}
     try:
         with open(path) as f:
-            doc = yaml.safe_load(f) or {}
-        defaults.update(doc.get("view", {}))
+            doc = yaml.safe_load(f)
+        view = doc.get("view") if isinstance(doc, dict) else None
+        if isinstance(view, dict):
+            defaults.update(view)
+        elif doc is not None:
+            print(f"warning: ignoring malformed view config {path}", file=sys.stderr)
     except OSError:
         pass
+    except yaml.YAMLError as e:
+        print(f"warning: unreadable view config {path}: {e}", file=sys.stderr)
     return defaults
 
 

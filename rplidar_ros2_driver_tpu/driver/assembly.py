@@ -18,6 +18,7 @@ the first sync is discarded (:296-299).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -35,6 +36,7 @@ class ScanAssembler:
         self._pending: Optional[dict] = None      # newest complete scan
         self._partial: list[np.ndarray] = []      # [ (k,4) int32 chunks ]
         self._partial_len = 0
+        self._partial_ts = 0.0                    # revolution-begin timestamp
         self._seen_first_sync = False
         self.scans_completed = 0
         self.scans_dropped = 0                    # overwritten before grab
@@ -55,12 +57,18 @@ class ScanAssembler:
         dist_q2: np.ndarray,
         quality: np.ndarray,
         flag: np.ndarray,
+        ts: Optional[float] = None,
     ) -> int:
         """Feed a flat, time-ordered batch of valid nodes.
 
         Returns the number of revolutions completed by this batch.  A node
         with flag bit0 set starts a new revolution (the reference swaps
         buffers on it, sl_lidar_driver.cpp:279-294).
+
+        ``ts`` is the (already back-dated, protocol/timing.py) measurement
+        time of the batch's first node; it stamps revolution boundaries the
+        way the reference records per-scan begin timestamps
+        (sl_lidar_driver.cpp:293).  Defaults to now.
         """
         n = len(angle_q14)
         if n == 0:
@@ -75,17 +83,20 @@ class ScanAssembler:
             axis=1,
         )
         sync_pos = np.flatnonzero(stacked[:, 3] & 1)
+        if ts is None:
+            ts = time.monotonic()
         completed = 0
         with self._lock:
             start = 0
             for pos in sync_pos:
                 if self._seen_first_sync:
                     self._append_partial(stacked[start:pos])
-                    self._close_partial()
+                    self._close_partial(end_ts=ts)
                     completed += 1
                 # data before the very first sync is dropped
                 self._partial = []
                 self._partial_len = 0
+                self._partial_ts = ts
                 self._seen_first_sync = True
                 start = pos
             self._append_partial(stacked[start:])
@@ -103,7 +114,7 @@ class ScanAssembler:
         self._partial.append(chunk)
         self._partial_len += len(chunk)
 
-    def _close_partial(self) -> None:
+    def _close_partial(self, end_ts: float = 0.0) -> None:
         if self._partial_len == 0:
             return
         scan = np.concatenate(self._partial, axis=0)
@@ -114,6 +125,8 @@ class ScanAssembler:
             "dist_q2": scan[:, 1],
             "quality": scan[:, 2],
             "flag": scan[:, 3],
+            "ts0": self._partial_ts,
+            "duration": max(end_ts - self._partial_ts, 0.0),
         }
         self.scans_completed += 1
         self._partial = []
@@ -121,32 +134,42 @@ class ScanAssembler:
 
     # -- consumer side -----------------------------------------------------
 
-    def wait_and_grab(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
-        """Block until a complete revolution is available; None on timeout."""
-        if not self._event.wait(timeout_s):
-            return None
+    def _take_pending(self) -> Optional[dict]:
         with self._lock:
             scan = self._pending
             self._pending = None
             self._event.clear()
-        if scan is None:
-            return None
+        return scan
+
+    def _to_batch(self, scan: dict) -> ScanBatch:
         return ScanBatch.from_numpy(
             scan["angle_q14"], scan["dist_q2"], scan["quality"], scan["flag"],
             n=self._max_nodes,
         )
 
-    def grab_nowait(self) -> Optional[ScanBatch]:
-        with self._lock:
-            scan = self._pending
-            self._pending = None
-            self._event.clear()
+    def wait_and_grab(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
+        """Block until a complete revolution is available; None on timeout."""
+        got = self.wait_and_grab_with_timestamp(timeout_s)
+        return got[0] if got is not None else None
+
+    def wait_and_grab_with_timestamp(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[ScanBatch, float, float]]:
+        """Like wait_and_grab, plus the revolution's back-dated begin
+        timestamp and measured duration (grabScanDataHqWithTimeStamp,
+        sl_lidar_driver.cpp:783-806)."""
+        if not self._event.wait(timeout_s):
+            return None
+        scan = self._take_pending()
         if scan is None:
             return None
-        return ScanBatch.from_numpy(
-            scan["angle_q14"], scan["dist_q2"], scan["quality"], scan["flag"],
-            n=self._max_nodes,
-        )
+        return self._to_batch(scan), scan["ts0"], scan["duration"]
+
+    def grab_nowait(self) -> Optional[ScanBatch]:
+        scan = self._take_pending()
+        if scan is None:
+            return None
+        return self._to_batch(scan)
 
 
 class RawNodeHolder:

@@ -52,6 +52,7 @@ from rplidar_ros2_driver_tpu.protocol.constants import (
     AUTOBAUD_MAGICBYTE,
     Cmd,
 )
+from rplidar_ros2_driver_tpu.protocol import timing as timingmod
 from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine, TransceiverLike
 
 log = logging.getLogger("rplidar_tpu.real")
@@ -90,6 +91,9 @@ class _ScanDecoder:
         self._raw_holder = raw_holder
         self._active_ans: Optional[int] = None
         self._decoder = None
+        # updated by the driver on scan start (the reference's
+        # _updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554)
+        self.timing = timingmod.TimingDesc()
 
     def reset(self) -> None:
         self._active_ans = None
@@ -129,7 +133,11 @@ class _ScanDecoder:
         dist = np.fromiter((n.dist_q2 for n in nodes), np.int32, len(nodes))
         quality = np.fromiter((n.quality for n in nodes), np.int32, len(nodes))
         flag = np.fromiter((n.flag for n in nodes), np.int32, len(nodes))
-        self._assembler.push_nodes(angle, dist, quality, flag)
+        # back-date to measurement time (protocol/timing.py delay models)
+        ts = time.monotonic() - 1e-6 * timingmod.frame_rx_delay_us(
+            ans_type, self.timing
+        )
+        self._assembler.push_nodes(angle, dist, quality, flag, ts=ts)
         if self._raw_holder is not None:
             # same feed, pre-assembly (ref pushes to both holders,
             # sl_lidar_driver.cpp:1645-1648)
@@ -165,6 +173,7 @@ class RealLidarDriver(LidarDriverInterface):
         self._lock = threading.RLock()
         self._connected = False
         self._scanning = False
+        self._baudrate = 0
         self._angle_compensate = True
         self.device_info: Optional[DeviceInfo] = None
         self.profile = DriverProfile()
@@ -180,6 +189,7 @@ class RealLidarDriver(LidarDriverInterface):
             if self._connected:
                 return True
             self._angle_compensate = use_geometric_compensation
+            self._baudrate = baudrate
             try:
                 tx = self._tx_factory(
                     self._channel_type, port, baudrate, *self._net_target()
@@ -299,6 +309,7 @@ class RealLidarDriver(LidarDriverInterface):
         # call (src/lidar_driver_wrapper.cpp:249): the mode id alone selects
         # boost variants; setting EXPRESS_FLAG_BOOST here could make real
         # firmware stream a format that mismatches the enumerated ans_type.
+        self._update_timing_desc(mode.us_per_sample)
         self._begin_streaming()
         payload = struct.pack("<BHH", mode.id, 0, 0)
         if not self._engine.send_only(Cmd.EXPRESS_SCAN, payload):
@@ -314,6 +325,7 @@ class RealLidarDriver(LidarDriverInterface):
         # (src/lidar_driver_wrapper.cpp:262-268)
         self.set_motor_speed(DEFAULT_RPM)
         time.sleep(self._legacy_warmup_s)
+        self._update_timing_desc(timingmod.LEGACY_SAMPLE_DURATION_US)
         self._begin_streaming()
         if not self._engine.send_only(Cmd.SCAN):
             return False
@@ -321,6 +333,15 @@ class RealLidarDriver(LidarDriverInterface):
         self.profile.active_mode = "Standard"
         self.profile.active_rpm = DEFAULT_RPM
         return True
+
+    def _update_timing_desc(self, us_per_sample: Optional[float]) -> None:
+        """Push link+mode timing into the decoder for timestamp back-dating
+        (_updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554)."""
+        self._scan_decoder.timing = timingmod.TimingDesc(
+            sample_duration_us=us_per_sample or timingmod.LEGACY_SAMPLE_DURATION_US,
+            baudrate=self._baudrate,
+            is_serial=self._channel_type == "serial",
+        )
 
     def _begin_streaming(self) -> None:
         self._engine.send_only(Cmd.STOP)
@@ -521,16 +542,25 @@ class RealLidarDriver(LidarDriverInterface):
     # ------------------------------------------------------------------
 
     def grab_scan_data(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
+        got = self.grab_scan_data_with_timestamp(timeout_s)
+        return got[0] if got is not None else None
+
+    def grab_scan_data_with_timestamp(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[ScanBatch, float, float]]:
+        """(batch, back-dated revolution-begin time, measured duration) —
+        grabScanDataHqWithTimeStamp parity (sl_lidar_driver.cpp:783-806)."""
         if not self.is_connected() or not self._scanning:
             return None
-        batch = self._assembler.wait_and_grab(timeout_s)
-        if batch is None:
+        got = self._assembler.wait_and_grab_with_timestamp(timeout_s)
+        if got is None:
             return None
+        batch, ts0, duration = got
         if self._angle_compensate:
             from rplidar_ros2_driver_tpu.ops.ascend import ascend_scan
 
             batch, _ = ascend_scan(batch)
-        return batch
+        return batch, ts0, duration
 
     def grab_scan_data_with_interval(self, max_nodes: Optional[int] = None):
         """Raw nodes accumulated since the last interval grab, as a (k, 4)

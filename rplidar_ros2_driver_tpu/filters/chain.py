@@ -44,10 +44,13 @@ def _pick_device(backend: str):
     return jax.devices()[0]
 
 
+DEFAULT_BEAMS = 2048
+
+
 class ScanFilterChain:
     """Stateful host wrapper around the fused filter_step program."""
 
-    def __init__(self, params: DriverParams, beams: int = 2048) -> None:
+    def __init__(self, params: DriverParams, beams: int = DEFAULT_BEAMS) -> None:
         chain = set(params.filter_chain)
         self.cfg = FilterConfig(
             window=params.filter_window,
@@ -93,35 +96,37 @@ class ScanFilterChain:
         """Host copy of the rolling window + accumulator."""
         return {k: np.asarray(v) for k, v in vars(self._state).items()}
 
+    def compatible(self, snap: dict[str, np.ndarray]) -> bool:
+        """Host-side geometry check — no device transfer."""
+        expected = FilterState.shapes(self.cfg.window, self.cfg.beams, self.cfg.grid)
+        got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+        return expected == got
+
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
         """Restore a snapshot, or reset deterministically when None.
 
         A snapshot taken under different chain parameters (window/beams/
         grid changed across a cleanup->configure cycle) is incompatible
         with the compiled step; restoring it would crash the hot path, so
-        it is discarded with a warning and the window starts cold.
-        Returns True when the snapshot was restored, False when the chain
-        cold-started (no snapshot given, or geometry mismatch).
+        it is rejected with a warning — the chain's CURRENT state is left
+        untouched.  Returns True when the snapshot was restored, False
+        when it wasn't (cold reset for None, or rejected mismatch).
         """
-        restored = snap is not None
-        if snap is not None:
-            fresh = FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid)
-            expected = {k: v.shape for k, v in vars(fresh).items()}
-            got = {k: np.asarray(v).shape for k, v in snap.items()}
-            if expected != got:
-                logging.getLogger("rplidar_tpu.chain").warning(
-                    "discarding incompatible filter snapshot (%s != %s)", got, expected
-                )
-                snap = None
-                restored = False
+        if snap is not None and not self.compatible(snap):
+            expected = FilterState.shapes(self.cfg.window, self.cfg.beams, self.cfg.grid)
+            got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+            logging.getLogger("rplidar_tpu.chain").warning(
+                "rejecting incompatible filter snapshot (%s != %s)", got, expected
+            )
+            return False
         if snap is None:
             self._state = jax.device_put(
                 FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
                 self.device,
             )
-        else:
-            self._state = jax.device_put(FilterState(**snap), self.device)
-        return restored
+            return False
+        self._state = jax.device_put(FilterState(**snap), self.device)
+        return True
 
     def reset(self) -> None:
         self.restore(None)

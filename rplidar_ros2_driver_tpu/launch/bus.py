@@ -31,13 +31,25 @@ class _Subscription:
             maxlen=None if reliable else maxlen
         )
         self.lock = threading.Lock()
+        self._latest_seq = -1
 
-    def deliver(self, msg: Any) -> None:
-        if self.callback is not None:
-            self.callback(msg)
-        else:
-            with self.lock:
+    def deliver(self, msg: Any, seq: int = -1, *, replay: bool = False) -> None:
+        """Deliver msg.  A stale latched REPLAY (older seq than something
+        already delivered on this subscription) is dropped, so a publish
+        racing the replay can never be overwritten by the older message;
+        live publishes are never dropped (reliable keeps all)."""
+        run_callback = False
+        with self.lock:
+            if seq >= 0:
+                if replay and seq < self._latest_seq:
+                    return
+                self._latest_seq = max(self._latest_seq, seq)
+            if self.callback is not None:
+                run_callback = True
+            else:
                 self.queue.append(msg)
+        if run_callback:
+            self.callback(msg)
 
     def drain(self) -> list:
         with self.lock:
@@ -57,7 +69,10 @@ class IntraProcessBus:
         self._topics: dict[str, list[_Subscription]] = {}
         # latched topics replay the last message to late subscribers —
         # the transient-local behaviour /tf_static relies on in ROS 2.
-        self._latched: dict[str, Any] = {}
+        # values are (msg, seq): the per-topic sequence number orders a
+        # replay against concurrent publishes.
+        self._latched: dict[str, tuple[Any, int]] = {}
+        self._seq: dict[str, int] = {}
 
     def subscribe(
         self,
@@ -72,19 +87,23 @@ class IntraProcessBus:
             self._topics.setdefault(topic, []).append(sub)
             replay = self._latched.get(topic, _NO_LATCHED)
         # deliver the latched replay outside the bus lock (like publish),
-        # so a callback that re-enters the bus cannot deadlock
+        # so a callback that re-enters the bus cannot deadlock; the seq
+        # guard in deliver() drops it if a newer publish won the race
         if replay is not _NO_LATCHED:
-            sub.deliver(replay)
+            msg, seq = replay
+            sub.deliver(msg, seq, replay=True)
         return sub
 
     def publish(self, topic: str, msg: Any, *, latched: bool = False) -> int:
         """Deliver ``msg`` (by reference — zero copy) to all subscribers."""
         with self._lock:
             subs = list(self._topics.get(topic, ()))
+            seq = self._seq.get(topic, 0) + 1
+            self._seq[topic] = seq
             if latched:
-                self._latched[topic] = msg
+                self._latched[topic] = (msg, seq)
         for sub in subs:
-            sub.deliver(msg)
+            sub.deliver(msg, seq)
         return len(subs)
 
     def topic_names(self) -> list[str]:

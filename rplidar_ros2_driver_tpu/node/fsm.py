@@ -236,9 +236,18 @@ class ScanLoopFsm:
     def _do_running(self) -> None:
         start_time = time.monotonic()
         batch: Optional[ScanBatch] = None
+        ts0 = duration = None
         with self.driver_mutex:
             if self.driver is not None and self.driver.is_connected():
-                batch = self.driver.grab_scan_data(self._t.grab_timeout_s)
+                # prefer the timestamped grab (back-dated revolution begin,
+                # grabScanDataHqWithTimeStamp parity) when the backend has it
+                grab_ts = getattr(self.driver, "grab_scan_data_with_timestamp", None)
+                if grab_ts is not None:
+                    got = grab_ts(self._t.grab_timeout_s)
+                    if got is not None:
+                        batch, ts0, duration = got
+                else:
+                    batch = self.driver.grab_scan_data(self._t.grab_timeout_s)
         if batch is None:
             self.error_count += 1
             if self.error_count > self._params.max_retries:
@@ -251,8 +260,10 @@ class ScanLoopFsm:
                 self._interruptible_sleep(self._t.grab_retry_s)
             return
         self.error_count = 0
-        duration = time.monotonic() - start_time
-        self._on_scan(batch, start_time, duration)
+        if ts0 is None or duration is None or duration <= 0:
+            ts0 = start_time
+            duration = time.monotonic() - start_time
+        self._on_scan(batch, ts0, duration)
 
     def _do_resetting(self) -> None:
         log.warning("[FSM] Performing hardware reset (recreating driver)...")

@@ -108,7 +108,10 @@ class RPlidarNode(LifecycleNode):
         if self.params.filter_chain:
             self.chain = ScanFilterChain(self.params)
             if self._chain_snapshot is not None:
-                self.chain.restore(self._chain_snapshot)
+                if not self.chain.restore(self._chain_snapshot):
+                    # geometry changed since the snapshot: drop it rather
+                    # than re-trying (and re-warning) every configure
+                    self._chain_snapshot = None
         self.diagnostics = DiagnosticsUpdater(
             hardware_id=f"rplidar-{self.params.serial_port}",
             publisher=self.publisher,
@@ -167,28 +170,32 @@ class RPlidarNode(LifecycleNode):
         """Stage an on-disk checkpoint for the next configure (or restore it
         immediately into an already-configured chain).
 
-        Returns False — and stages nothing — when the file is absent/torn
+        Returns False — touching nothing — when the file is absent/torn
         or its geometry doesn't match the current chain parameters, so a
         True return means the state genuinely resumed (or will on the next
         configure)."""
-        from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+        from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS
+        from rplidar_ros2_driver_tpu.ops.filters import FilterState
         from rplidar_ros2_driver_tpu.utils.checkpoint import load_checkpoint
 
+        if not self.params.filter_chain:
+            return False
         loaded = load_checkpoint(path)
         if loaded is None:
             return False
         snap, _meta = loaded
         if self.chain is not None:
-            if not self.chain.restore(snap):
+            if not self.chain.restore(snap):  # rejects mismatch untouched
                 return False
             self._chain_snapshot = snap
             return True
-        # no live chain: validate against the geometry the next configure
-        # will build, instead of staging a snapshot doomed to be discarded
-        if not self.params.filter_chain:
-            return False
-        probe = ScanFilterChain(self.params)
-        if not probe.restore(snap):
+        # no live chain yet: validate host-side against the geometry the
+        # next configure will build (no device transfers)
+        expected = FilterState.shapes(
+            self.params.filter_window, DEFAULT_BEAMS, self.params.voxel_grid_size
+        )
+        got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+        if expected != got:
             return False
         self._chain_snapshot = snap
         return True

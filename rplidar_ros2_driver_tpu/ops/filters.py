@@ -44,6 +44,19 @@ class FilterState:
     filled: jax.Array         # int32 number of scans pushed (saturates at W)
 
     @staticmethod
+    def shapes(window: int, beams: int, grid: int) -> dict[str, tuple[int, ...]]:
+        """Array shapes of a state with this geometry — host-side, no
+        allocation (used to validate checkpoints before touching devices)."""
+        return {
+            "range_window": (window, beams),
+            "inten_window": (window, beams),
+            "hit_window": (window, grid, grid),
+            "voxel_acc": (grid, grid),
+            "cursor": (),
+            "filled": (),
+        }
+
+    @staticmethod
     def create(window: int, beams: int, grid: int) -> "FilterState":
         return FilterState(
             range_window=jnp.full((window, beams), jnp.inf, jnp.float32),

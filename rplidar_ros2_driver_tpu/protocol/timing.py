@@ -1,0 +1,83 @@
+"""Timestamp back-dating — the reference's per-format delay models.
+
+The reference stamps every decoded node with ``now − delay`` where the
+delay models how long the sample took to reach the host: UART
+transmission time of the frame, the device-side sample/filter latency,
+and (for capsule formats) the grouping delay of samples measured earlier
+in the frame (handler_normalnode.cpp:51-68, handler_capsules.cpp:55-76,
+272-293, 586-607, 796-817, handler_hqnode.cpp:54-73).  The per-mode
+sample duration arrives via a timing descriptor the driver pushes into
+the unpackers on scan start (``_updateTimingDesc``,
+sl_lidar_driver.cpp:1538-1554).
+
+Here the same model is computed once per received frame (not per node):
+the returned delay dates the *first* sample in the frame; downstream
+per-node times are ``begin + i * us_per_sample`` (the LaserScan
+``time_increment`` contract, ops/laserscan.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from rplidar_ros2_driver_tpu.protocol.constants import (
+    ANS_PAYLOAD_BYTES,
+    Ans,
+)
+
+# Conservative device-side latency between a sample being measured and it
+# entering the UART FIFO (filter + packetization), matching the reference's
+# fixed per-format constants.
+_LINKAGE_DELAY_US = {
+    Ans.MEASUREMENT: 20,
+    Ans.MEASUREMENT_CAPSULED: 45,
+    Ans.MEASUREMENT_CAPSULED_ULTRA: 45,
+    Ans.MEASUREMENT_DENSE_CAPSULED: 45,
+    Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED: 45,
+    Ans.MEASUREMENT_HQ: 45,
+}
+
+# Samples carried per frame of each streaming format (sl_lidar_cmd.h wire
+# structs; SURVEY.md §2.2 handler table).
+SAMPLES_PER_FRAME = {
+    Ans.MEASUREMENT: 1,
+    Ans.MEASUREMENT_CAPSULED: 32,
+    Ans.MEASUREMENT_CAPSULED_ULTRA: 96,
+    Ans.MEASUREMENT_DENSE_CAPSULED: 40,
+    Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED: 64,
+    Ans.MEASUREMENT_HQ: 96,
+}
+
+LEGACY_SAMPLE_DURATION_US = 476.0  # old A-series (sl_lidar_driver.cpp:1559)
+
+
+@dataclasses.dataclass(frozen=True)
+class TimingDesc:
+    """What the driver knows about the active link + scan mode."""
+
+    sample_duration_us: float = LEGACY_SAMPLE_DURATION_US
+    baudrate: int = 0          # 0: non-serial link (TCP/UDP) -> no UART delay
+    is_serial: bool = True
+
+    def transmission_us(self, frame_bytes: int) -> float:
+        """UART time for the frame: 10 bits/byte (8N1) at the link baud."""
+        if not self.is_serial or self.baudrate <= 0:
+            return 0.0
+        return frame_bytes * 10.0 * 1e6 / self.baudrate
+
+
+def frame_rx_delay_us(ans_type: int, timing: TimingDesc) -> float:
+    """Age of the frame's FIRST sample at the moment the frame is fully
+    received: all samples in the frame were measured before it could be
+    sent, so the first one is (n_samples × sample_duration) old, plus the
+    wire time and the fixed linkage latency."""
+    try:
+        at = Ans(ans_type)
+    except ValueError:
+        return 0.0
+    n = SAMPLES_PER_FRAME.get(at)
+    if n is None:
+        return 0.0
+    frame_bytes = ANS_PAYLOAD_BYTES.get(at, 0)
+    grouping_us = n * timing.sample_duration_us
+    return timing.transmission_us(frame_bytes) + grouping_us + _LINKAGE_DELAY_US.get(at, 0)

@@ -50,7 +50,15 @@ def save_checkpoint(path: str, snap: dict[str, np.ndarray], extra: Optional[dict
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, __meta__=np.frombuffer(json.dumps(meta).encode(), np.uint8), **snap)
+            f.flush()
+            os.fsync(f.fileno())  # data durable before the rename is
         os.replace(tmp, path)
+        # fsync the directory so the rename itself survives power loss
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
     except BaseException:
         try:
             os.unlink(tmp)

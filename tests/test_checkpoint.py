@@ -90,6 +90,20 @@ class TestChainResume:
         for k, v in chain.snapshot().items():
             np.testing.assert_array_equal(np.asarray(v), np.asarray(chain2.snapshot()[k]))
 
+    def test_rejected_restore_leaves_live_state_untouched(self, tmp_path):
+        """A bad restore must not cold-reset a populated chain."""
+        chain = ScanFilterChain(_params(), beams=256)
+        _fill_chain(chain)
+        before = chain.snapshot()
+        bad = ScanFilterChain(_params(filter_window=8), beams=256)
+        _fill_chain(bad, n=2)
+        assert not bad.restore(before)  # mismatch rejected...
+        after = bad.snapshot()
+        populated = ScanFilterChain(_params(filter_window=8), beams=256)
+        _fill_chain(populated, n=2)
+        for k in after:  # ...and bad's own accumulated state survived
+            np.testing.assert_array_equal(after[k], populated.snapshot()[k])
+
     def test_geometry_mismatch_starts_cold(self, tmp_path):
         chain = ScanFilterChain(_params(), beams=256)
         _fill_chain(chain)
@@ -97,7 +111,7 @@ class TestChainResume:
         save_checkpoint(p, chain.snapshot())
         snap, _ = load_checkpoint(p)
         bigger = ScanFilterChain(_params(filter_window=8), beams=256)
-        bigger.restore(snap)  # incompatible -> warn + cold start, no crash
+        assert not bigger.restore(snap)  # incompatible -> rejected, no crash
         cold = ScanFilterChain(_params(filter_window=8), beams=256)
         for k, v in vars(cold.state).items():
             np.testing.assert_array_equal(

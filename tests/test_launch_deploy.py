@@ -103,6 +103,24 @@ class TestIntraProcessBus:
         assert got == ["tf0"]
         assert echo.drain() == ["tf0"]
 
+    def test_stale_replay_dropped_after_newer_publish(self):
+        """A latched replay that lost the race to a newer publish must not
+        overwrite the newer message (delivered outside the lock)."""
+        from rplidar_ros2_driver_tpu.launch.bus import _Subscription
+
+        sub = _Subscription(None, reliable=True, maxlen=8)
+        sub.deliver("m2", 2)               # live publish won the race
+        sub.deliver("m1", 1, replay=True)  # stale replay arrives late
+        assert sub.drain() == ["m2"]
+
+    def test_racing_live_publishes_never_dropped(self):
+        from rplidar_ros2_driver_tpu.launch.bus import _Subscription
+
+        sub = _Subscription(None, reliable=True, maxlen=8)
+        sub.deliver("m2", 2)
+        sub.deliver("m1", 1)  # out-of-order live delivery: kept (reliable)
+        assert sub.drain() == ["m2", "m1"]
+
 
 def test_container_composition_end_to_end():
     """Two composed nodes publish on namespaced topics over one bus."""

@@ -1,0 +1,137 @@
+"""Timestamp back-dating tests (protocol/timing.py + assembler/driver wiring).
+
+The reference dates every node ``now − (uart transmission + sample +
+grouping delay)`` (handler_normalnode.cpp:51-68, handler_capsules.cpp:55-76)
+and exposes per-scan begin timestamps via grabScanDataHqWithTimeStamp
+(sl_lidar_driver.cpp:783-806).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+from rplidar_ros2_driver_tpu.protocol.constants import (
+    ANS_PAYLOAD_BYTES,
+    Ans,
+)
+from rplidar_ros2_driver_tpu.protocol.timing import (
+    LEGACY_SAMPLE_DURATION_US,
+    SAMPLES_PER_FRAME,
+    TimingDesc,
+    frame_rx_delay_us,
+)
+
+
+class TestDelayModel:
+    def test_transmission_time_matches_8n1(self):
+        t = TimingDesc(sample_duration_us=65.0, baudrate=1_000_000, is_serial=True)
+        # 84-byte capsule at 1 Mbaud: 84*10 bits / 1e6 = 840 us
+        assert t.transmission_us(84) == pytest.approx(840.0)
+
+    def test_network_link_has_no_uart_delay(self):
+        t = TimingDesc(sample_duration_us=65.0, baudrate=0, is_serial=False)
+        assert t.transmission_us(84) == 0.0
+
+    def test_frame_delay_orders_by_density(self):
+        """Denser frames carry older first samples (more grouping delay)."""
+        t = TimingDesc(sample_duration_us=65.0, baudrate=256000)
+        d_norm = frame_rx_delay_us(Ans.MEASUREMENT, t)
+        d_caps = frame_rx_delay_us(Ans.MEASUREMENT_CAPSULED, t)
+        d_ultra = frame_rx_delay_us(Ans.MEASUREMENT_CAPSULED_ULTRA, t)
+        assert d_norm < d_caps < d_ultra
+
+    def test_frame_delay_formula(self):
+        t = TimingDesc(sample_duration_us=100.0, baudrate=115200)
+        d = frame_rx_delay_us(Ans.MEASUREMENT_DENSE_CAPSULED, t)
+        expect = (
+            ANS_PAYLOAD_BYTES[Ans.MEASUREMENT_DENSE_CAPSULED] * 10.0 * 1e6 / 115200
+            + SAMPLES_PER_FRAME[Ans.MEASUREMENT_DENSE_CAPSULED] * 100.0
+            + 45
+        )
+        assert d == pytest.approx(expect)
+
+    def test_unknown_ans_type_is_zero(self):
+        assert frame_rx_delay_us(0x42, TimingDesc()) == 0.0
+        assert frame_rx_delay_us(int(Ans.DEVINFO), TimingDesc()) == 0.0
+
+    def test_legacy_default(self):
+        assert TimingDesc().sample_duration_us == LEGACY_SAMPLE_DURATION_US
+
+
+def _push_rev(asm: ScanAssembler, n: int, ts: float, sync_first=True) -> None:
+    flag = np.zeros(n, np.int32)
+    if sync_first:
+        flag[0] = 1
+    asm.push_nodes(
+        ((np.arange(n) * 65536) // n).astype(np.int32),
+        np.full(n, 4000, np.int32),
+        np.full(n, 200, np.int32),
+        flag,
+        ts=ts,
+    )
+
+
+class TestAssemblerTimestamps:
+    def test_begin_ts_and_duration(self):
+        asm = ScanAssembler()
+        _push_rev(asm, 90, ts=100.0)   # opens rev @100
+        _push_rev(asm, 90, ts=100.1)   # closes rev -> duration 0.1, opens @100.1
+        got = asm.wait_and_grab_with_timestamp(0.1)
+        assert got is not None
+        batch, ts0, dur = got
+        assert ts0 == pytest.approx(100.0)
+        assert dur == pytest.approx(0.1)
+        assert int(batch.count) == 90
+
+    def test_default_ts_is_now(self):
+        asm = ScanAssembler()
+        t0 = time.monotonic()
+        _push_rev(asm, 10, ts=None)
+        _push_rev(asm, 10, ts=None)
+        _, ts0, dur = asm.wait_and_grab_with_timestamp(0.1)
+        assert abs(ts0 - t0) < 1.0
+        assert dur >= 0
+
+    def test_wait_and_grab_still_returns_batch_only(self):
+        asm = ScanAssembler()
+        _push_rev(asm, 10, ts=1.0)
+        _push_rev(asm, 10, ts=2.0)
+        batch = asm.wait_and_grab(0.1)
+        assert int(batch.count) == 10
+
+
+class TestDriverWiring:
+    def test_decoder_backdates_against_sim(self):
+        """End-to-end: driver + protocol simulator; revolution begin
+        timestamps must trail wall clock (back-dated) and durations must
+        approximate the simulated spin period."""
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="tcp",
+                tcp_host="127.0.0.1",
+                tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, True)
+            assert drv.start_motor("", 600)
+            got = drv.grab_scan_data_with_timestamp(5.0)
+            assert got is not None
+            batch, ts0, dur = got
+            assert int(batch.count) > 0
+            assert ts0 <= time.monotonic()
+            assert dur > 0
+            # timing desc was pushed on scan start
+            assert drv._scan_decoder.timing.sample_duration_us > 0
+            assert not drv._scan_decoder.timing.is_serial  # tcp link
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            sim.stop()
