@@ -50,6 +50,20 @@ class LidarDriverInterface(abc.ABC):
     def grab_scan_data(self, timeout_s: float = 2.0) -> Optional[ScanBatch]:
         """Block for the next complete revolution; None on timeout/failure."""
 
+    def grab_scan_data_with_timestamp(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[ScanBatch, float, float]]:
+        """(batch, revolution-begin time, duration) — hardware-timestamped
+        grab (grabScanDataHqWithTimeStamp, sl_lidar_driver.cpp:783-806).
+        Backends without hardware timing inherit this default: grab time and
+        zero duration, which consumers treat as 'derive times yourself'."""
+        import time
+
+        batch = self.grab_scan_data(timeout_s)
+        if batch is None:
+            return None
+        return batch, time.monotonic(), 0.0
+
     @abc.abstractmethod
     def detect_and_init_strategy(self) -> None:
         """Classify the device (A vs S/C series) and cache a DriverProfile."""

@@ -28,9 +28,9 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
     FilterState,
+    compact_filter_step,
     filter_step,
-    pack_host_scan,
-    packed_filter_step,
+    pack_host_scan_compact,
 )
 
 
@@ -80,12 +80,13 @@ class ScanFilterChain:
     def process_raw(self, angle_q14, dist_q2, quality, flag=None) -> FilterOutput:
         """Streaming ingest of raw host arrays via the packed one-transfer path.
 
-        This is the production hot path: one (4, N) device_put + one donated
-        step dispatch per revolution (see ops.filters packed-ingest note).
+        This is the production hot path: one bit-packed (2, N) uint32
+        device_put (8 bytes/point) + one donated step dispatch per
+        revolution (see ops.filters packed-ingest note).
         """
-        buf, count = pack_host_scan(angle_q14, dist_q2, quality, flag)
+        buf, count = pack_host_scan_compact(angle_q14, dist_q2, quality, flag)
         packed = jax.device_put(buf, self.device)
-        self._state, out = packed_filter_step(
+        self._state, out = compact_filter_step(
             self._state, packed, jnp.asarray(count, jnp.int32), self.cfg
         )
         return out
@@ -96,11 +97,37 @@ class ScanFilterChain:
         """Host copy of the rolling window + accumulator."""
         return {k: np.asarray(v) for k, v in vars(self._state).items()}
 
-    def compatible(self, snap: dict[str, np.ndarray]) -> bool:
-        """Host-side geometry check — no device transfer."""
-        expected = FilterState.shapes(self.cfg.window, self.cfg.beams, self.cfg.grid)
+    @staticmethod
+    def _shape_mismatch(
+        snap: dict[str, np.ndarray], window: int, beams: int, grid: int
+    ) -> Optional[tuple[dict, dict]]:
+        """(got, expected) when incompatible, None when compatible.
+        Host-side — no device transfer."""
+        expected = FilterState.shapes(window, beams, grid)
         got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
-        return expected == got
+        return None if expected == got else (got, expected)
+
+    @classmethod
+    def snapshot_compatible(
+        cls, params: DriverParams, snap: dict[str, np.ndarray], beams: Optional[int] = None
+    ) -> bool:
+        """Would a chain built from ``params`` accept this snapshot?  The
+        single source of truth for pre-validation (node.load_checkpoint)."""
+        return (
+            cls._shape_mismatch(
+                snap,
+                params.filter_window,
+                beams if beams is not None else DEFAULT_BEAMS,
+                params.voxel_grid_size,
+            )
+            is None
+        )
+
+    def compatible(self, snap: dict[str, np.ndarray]) -> bool:
+        return (
+            self._shape_mismatch(snap, self.cfg.window, self.cfg.beams, self.cfg.grid)
+            is None
+        )
 
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
         """Restore a snapshot, or reset deterministically when None.
@@ -112,13 +139,16 @@ class ScanFilterChain:
         untouched.  Returns True when the snapshot was restored, False
         when it wasn't (cold reset for None, or rejected mismatch).
         """
-        if snap is not None and not self.compatible(snap):
-            expected = FilterState.shapes(self.cfg.window, self.cfg.beams, self.cfg.grid)
-            got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
-            logging.getLogger("rplidar_tpu.chain").warning(
-                "rejecting incompatible filter snapshot (%s != %s)", got, expected
+        if snap is not None:
+            mismatch = self._shape_mismatch(
+                snap, self.cfg.window, self.cfg.beams, self.cfg.grid
             )
-            return False
+            if mismatch is not None:
+                got, expected = mismatch
+                logging.getLogger("rplidar_tpu.chain").warning(
+                    "rejecting incompatible filter snapshot (%s != %s)", got, expected
+                )
+                return False
         if snap is None:
             self._state = jax.device_put(
                 FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),

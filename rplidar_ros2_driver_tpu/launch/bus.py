@@ -30,7 +30,10 @@ class _Subscription:
         self.queue: collections.deque = collections.deque(
             maxlen=None if reliable else maxlen
         )
-        self.lock = threading.Lock()
+        # RLock: delivery (queue append or callback) happens under it, so
+        # seq-ordering is race-free on both paths, while a callback that
+        # re-enters the bus and self-delivers stays re-entrant.
+        self.lock = threading.RLock()
         self._latest_seq = -1
 
     def deliver(self, msg: Any, seq: int = -1, *, replay: bool = False) -> None:
@@ -38,18 +41,15 @@ class _Subscription:
         already delivered on this subscription) is dropped, so a publish
         racing the replay can never be overwritten by the older message;
         live publishes are never dropped (reliable keeps all)."""
-        run_callback = False
         with self.lock:
             if seq >= 0:
                 if replay and seq < self._latest_seq:
                     return
                 self._latest_seq = max(self._latest_seq, seq)
             if self.callback is not None:
-                run_callback = True
+                self.callback(msg)
             else:
                 self.queue.append(msg)
-        if run_callback:
-            self.callback(msg)
 
     def drain(self) -> list:
         with self.lock:

@@ -239,15 +239,12 @@ class ScanLoopFsm:
         ts0 = duration = None
         with self.driver_mutex:
             if self.driver is not None and self.driver.is_connected():
-                # prefer the timestamped grab (back-dated revolution begin,
-                # grabScanDataHqWithTimeStamp parity) when the backend has it
-                grab_ts = getattr(self.driver, "grab_scan_data_with_timestamp", None)
-                if grab_ts is not None:
-                    got = grab_ts(self._t.grab_timeout_s)
-                    if got is not None:
-                        batch, ts0, duration = got
-                else:
-                    batch = self.driver.grab_scan_data(self._t.grab_timeout_s)
+                # timestamped grab (back-dated revolution begin,
+                # grabScanDataHqWithTimeStamp parity); backends without
+                # hardware timing return duration 0 via the interface default
+                got = self.driver.grab_scan_data_with_timestamp(self._t.grab_timeout_s)
+                if got is not None:
+                    batch, ts0, duration = got
         if batch is None:
             self.error_count += 1
             if self.error_count > self._params.max_retries:

@@ -174,8 +174,6 @@ class RPlidarNode(LifecycleNode):
         or its geometry doesn't match the current chain parameters, so a
         True return means the state genuinely resumed (or will on the next
         configure)."""
-        from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS
-        from rplidar_ros2_driver_tpu.ops.filters import FilterState
         from rplidar_ros2_driver_tpu.utils.checkpoint import load_checkpoint
 
         if not self.params.filter_chain:
@@ -191,11 +189,7 @@ class RPlidarNode(LifecycleNode):
             return True
         # no live chain yet: validate host-side against the geometry the
         # next configure will build (no device transfers)
-        expected = FilterState.shapes(
-            self.params.filter_window, DEFAULT_BEAMS, self.params.voxel_grid_size
-        )
-        got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
-        if expected != got:
+        if not ScanFilterChain.snapshot_compatible(self.params, snap):
             return False
         self._chain_snapshot = snap
         return True

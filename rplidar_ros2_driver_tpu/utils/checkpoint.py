@@ -53,12 +53,17 @@ def save_checkpoint(path: str, snap: dict[str, np.ndarray], extra: Optional[dict
             f.flush()
             os.fsync(f.fileno())  # data durable before the rename is
         os.replace(tmp, path)
-        # fsync the directory so the rename itself survives power loss
-        dfd = os.open(d, os.O_RDONLY)
+        # fsync the directory so the rename itself survives power loss —
+        # best-effort: by now the checkpoint IS at its final path, so a
+        # platform that can't fsync a directory must not fail the save
         try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
     except BaseException:
         try:
             os.unlink(tmp)

@@ -9,8 +9,10 @@ from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
 from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterState,
+    compact_filter_step,
     filter_step,
     pack_host_scan,
+    pack_host_scan_compact,
     packed_filter_step,
 )
 
@@ -53,6 +55,41 @@ def test_chain_process_raw_matches_process():
         out_b = c_b.process_raw(angle, dist, qual)
         np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
         np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
+
+
+def test_compact_step_matches_scanbatch_step():
+    """The 8-byte/point bit-packed wire form must be lossless."""
+    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
+    s_a = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    s_b = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    for k in range(6):
+        angle, dist, qual = _raw_scan(k)
+        flag = np.zeros(len(angle), np.int32)
+        flag[0] = 1
+        batch = ScanBatch.from_numpy(angle, dist, qual, flag, n=1024)
+        s_a, out_a = filter_step(s_a, batch, cfg)
+        buf, count = pack_host_scan_compact(angle, dist, qual, flag, n=1024)
+        assert buf.dtype == np.uint32 and buf.shape == (2, 1024)
+        s_b, out_b = compact_filter_step(s_b, buf, jnp.asarray(count, jnp.int32), cfg)
+        np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
+        np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
+    np.testing.assert_array_equal(np.asarray(s_a.voxel_acc), np.asarray(s_b.voxel_acc))
+
+
+def test_compact_roundtrip_field_ranges():
+    """Boundary values of every field survive the bit packing."""
+    angle = np.array([0, 1, 65535], np.int32)
+    dist = np.array([0, 123456, 0x7FFFFFFF], np.int32)
+    qual = np.array([0, 128, 255], np.int32)
+    flag = np.array([1, 0, 255], np.int32)
+    buf, count = pack_host_scan_compact(angle, dist, qual, flag, n=8)
+    row0 = buf[0, :3]
+    np.testing.assert_array_equal(row0 & 0xFFFF, angle.astype(np.uint32))
+    np.testing.assert_array_equal((row0 >> 16) & 0xFF, qual.astype(np.uint32))
+    np.testing.assert_array_equal(row0 >> 24, flag.astype(np.uint32))
+    np.testing.assert_array_equal(
+        buf[1, :3].astype(np.int64), dist.astype(np.int64)
+    )
 
 
 def test_pack_host_scan_overflow():
