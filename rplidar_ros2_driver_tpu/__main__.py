@@ -117,6 +117,7 @@ def main(argv=None) -> int:
     run.add_argument("--params", default=None, help="parameter YAML (default: param/rplidar.yaml)")
     run.add_argument("--dummy", action="store_true", help="force the synthetic backend")
     run.add_argument("--duration", type=float, default=0.0, help="seconds to run (0 = forever)")
+    run.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
 
     view = sub.add_parser("view", help="capture dummy scans and render a top-down view")
     view.add_argument("--scans", type=int, default=3)
@@ -125,11 +126,18 @@ def main(argv=None) -> int:
     view.add_argument(
         "--view-config", default=None, help="view YAML (default: config/rplidar_view.yaml)"
     )
+    view.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
 
     udev = sub.add_parser("udev", help="generate/install udev rules")
     udev.add_argument("--install", action="store_true")
 
     args = ap.parse_args(argv)
+    if getattr(args, "cpu", False):
+        # must run before the first jax backend init; the env var is not
+        # enough on hosts whose site config pre-selects an accelerator
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if args.cmd == "run":
         return _cmd_run(args)
     if args.cmd == "view":

@@ -158,12 +158,25 @@ class ScanAssembler:
         """Like wait_and_grab, plus the revolution's back-dated begin
         timestamp and measured duration (grabScanDataHqWithTimeStamp,
         sl_lidar_driver.cpp:783-806)."""
+        got = self.wait_and_grab_host(timeout_s)
+        if got is None:
+            return None
+        scan, ts0, duration = got
+        return self._to_batch(scan), ts0, duration
+
+    def wait_and_grab_host(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[dict, float, float]]:
+        """Zero-device-touch grab: the revolution as plain numpy arrays
+        (keys angle_q14/dist_q2/quality/flag) + begin timestamp + duration.
+        The production chain path uses this so the ONLY host->device
+        transfer per revolution is the single bit-packed ingest buffer."""
         if not self._event.wait(timeout_s):
             return None
         scan = self._take_pending()
         if scan is None:
             return None
-        return self._to_batch(scan), scan["ts0"], scan["duration"]
+        return scan, scan["ts0"], scan["duration"]
 
     def grab_nowait(self) -> Optional[ScanBatch]:
         scan = self._take_pending()

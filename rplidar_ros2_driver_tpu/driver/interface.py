@@ -64,6 +64,19 @@ class LidarDriverInterface(abc.ABC):
             return None
         return batch, time.monotonic(), 0.0
 
+    def grab_scan_host(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[dict, float, float]]:
+        """(host arrays, begin time, duration): the revolution as numpy
+        angle_q14/dist_q2/quality/flag — the transfer-free form the filter
+        chain ingests.  Hardware backends override this to avoid touching
+        any device in the grab path; the default pulls from the batch."""
+        got = self.grab_scan_data_with_timestamp(timeout_s)
+        if got is None:
+            return None
+        batch, ts0, duration = got
+        return batch.to_host(), ts0, duration
+
     @abc.abstractmethod
     def detect_and_init_strategy(self) -> None:
         """Classify the device (A vs S/C series) and cache a DriverProfile."""

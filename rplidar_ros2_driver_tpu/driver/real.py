@@ -534,6 +534,15 @@ class RealLidarDriver(LidarDriverInterface):
     def get_hw_max_distance(self) -> float:
         return self.profile.hw_max_distance
 
+    def get_frequency(self, node_count: int) -> Optional[float]:
+        """Scan rate in Hz derived from the active mode's sample duration
+        and the points in one revolution (getFrequency,
+        sl_lidar_driver.cpp:880-885).  None before a mode is active."""
+        us = self._scan_decoder.timing.sample_duration_us
+        if not self._scanning or us <= 0 or node_count <= 0:
+            return None
+        return 1e6 / (us * node_count)
+
     def is_new_type(self) -> bool:
         return self.profile.protocol is ProtocolType.NEW_TYPE
 
@@ -556,11 +565,21 @@ class RealLidarDriver(LidarDriverInterface):
         if got is None:
             return None
         batch, ts0, duration = got
-        if self._angle_compensate:
-            from rplidar_ros2_driver_tpu.ops.ascend import ascend_scan
+        from rplidar_ros2_driver_tpu.ops.ascend import apply_angle_compensation
 
-            batch, _ = ascend_scan(batch)
-        return batch, ts0, duration
+        return apply_angle_compensation(batch, self._angle_compensate), ts0, duration
+
+    def grab_scan_host(
+        self, timeout_s: float = 2.0
+    ) -> Optional[tuple[dict, float, float]]:
+        """Host-native grab: raw numpy arrays straight from the assembler,
+        no device work at all.  Angle compensation is NOT applied here —
+        the chain's grid resampler is ordering-independent (scatter-min)
+        and its clip stage drops invalid nodes, so ascend would only add a
+        per-scan device dispatch to the latency path."""
+        if not self.is_connected() or not self._scanning:
+            return None
+        return self._assembler.wait_and_grab_host(timeout_s)
 
     def grab_scan_data_with_interval(self, max_nodes: Optional[int] = None):
         """Raw nodes accumulated since the last interval grab, as a (k, 4)

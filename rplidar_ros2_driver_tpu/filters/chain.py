@@ -28,9 +28,10 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
     FilterState,
-    compact_filter_step,
+    compact_filter_step_wire,
     filter_step,
     pack_host_scan_compact,
+    unpack_output_wire,
 )
 
 
@@ -80,16 +81,17 @@ class ScanFilterChain:
     def process_raw(self, angle_q14, dist_q2, quality, flag=None) -> FilterOutput:
         """Streaming ingest of raw host arrays via the packed one-transfer path.
 
-        This is the production hot path: one bit-packed (2, N) uint32
-        device_put (8 bytes/point) + one donated step dispatch per
-        revolution (see ops.filters packed-ingest note).
+        This is the production hot path: per revolution, exactly one
+        host->device transfer (bit-packed (2, N) uint32, 8 bytes/point),
+        one donated step dispatch, and one device->host fetch (the fused
+        flat output vector).  Returns a numpy-backed FilterOutput.
         """
         buf, count = pack_host_scan_compact(angle_q14, dist_q2, quality, flag)
         packed = jax.device_put(buf, self.device)
-        self._state, out = compact_filter_step(
+        self._state, wire = compact_filter_step_wire(
             self._state, packed, jnp.asarray(count, jnp.int32), self.cfg
         )
-        return out
+        return unpack_output_wire(wire, self.cfg)
 
     # -- checkpoint surface -------------------------------------------------
 

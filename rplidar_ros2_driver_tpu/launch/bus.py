@@ -30,15 +30,18 @@ class _Subscription:
         self.queue: collections.deque = collections.deque(
             maxlen=None if reliable else maxlen
         )
-        # RLock: delivery (queue append or callback) happens under it, so
-        # seq-ordering is race-free on both paths, while a callback that
-        # re-enters the bus and self-delivers stays re-entrant.
-        self.lock = threading.RLock()
+        self.lock = threading.Lock()
         self._latest_seq = -1
+        # pending callback deliveries; drained by exactly one thread at a
+        # time so callbacks run OUTSIDE the lock (no ABBA deadlock, no
+        # serialization of publishers behind a slow callback) yet stay
+        # ordered per subscription (enqueue order is decided under the lock)
+        self._cb_pending: collections.deque = collections.deque()
+        self._draining = False
 
     def deliver(self, msg: Any, seq: int = -1, *, replay: bool = False) -> None:
         """Deliver msg.  A stale latched REPLAY (older seq than something
-        already delivered on this subscription) is dropped, so a publish
+        already enqueued on this subscription) is dropped, so a publish
         racing the replay can never be overwritten by the older message;
         live publishes are never dropped (reliable keeps all)."""
         with self.lock:
@@ -46,10 +49,20 @@ class _Subscription:
                 if replay and seq < self._latest_seq:
                     return
                 self._latest_seq = max(self._latest_seq, seq)
-            if self.callback is not None:
-                self.callback(msg)
-            else:
+            if self.callback is None:
                 self.queue.append(msg)
+                return
+            self._cb_pending.append(msg)
+            if self._draining:
+                return  # the draining thread will pick it up, in order
+            self._draining = True
+        while True:
+            with self.lock:
+                if not self._cb_pending:
+                    self._draining = False
+                    return
+                nxt = self._cb_pending.popleft()
+            self.callback(nxt)
 
     def drain(self) -> list:
         with self.lock:

@@ -46,20 +46,27 @@ class DiagnosticsUpdater:
         port: str,
         rpm: int,
         device_info: str,
+        latency_p99_ms: Optional[dict[str, float]] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
+        values = {
+            "Serial Port": port,
+            "Target RPM": str(rpm),
+            "Device Info": device_info,
+            "FSM State": fsm_state.value if fsm_state else "n/a",
+            "Lifecycle": lifecycle.value,
+        }
+        # per-stage p99 latencies (utils/tracing.py) — the observability for
+        # the <10 ms added-p99 publish-latency north star (BASELINE.md)
+        if latency_p99_ms:
+            for stage, ms in sorted(latency_p99_ms.items()):
+                values[f"p99 {stage} (ms)"] = f"{ms:.3f}"
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",
             message=message,
             hardware_id=self.hardware_id,
-            values={
-                "Serial Port": port,
-                "Target RPM": str(rpm),
-                "Device Info": device_info,
-                "FSM State": fsm_state.value if fsm_state else "n/a",
-                "Lifecycle": lifecycle.value,
-            },
+            values=values,
         )
         self.last = status
         self._publisher.publish_diagnostics(status)

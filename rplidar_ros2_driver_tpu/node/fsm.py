@@ -29,7 +29,6 @@ import time
 from typing import Callable, Optional
 
 from rplidar_ros2_driver_tpu.core.results import DeviceHealth
-from rplidar_ros2_driver_tpu.core.types import ScanBatch
 from rplidar_ros2_driver_tpu.driver.interface import LidarDriverInterface
 
 log = logging.getLogger("rplidar_tpu.fsm")
@@ -73,7 +72,7 @@ class ScanLoopFsm:
     def __init__(
         self,
         driver_factory: Callable[[], LidarDriverInterface],
-        on_scan: Callable[[ScanBatch, float, float], None],
+        on_scan: Callable[[dict, float, float], None],
         *,
         params,
         timings: Optional[FsmTimings] = None,
@@ -235,17 +234,17 @@ class ScanLoopFsm:
 
     def _do_running(self) -> None:
         start_time = time.monotonic()
-        batch: Optional[ScanBatch] = None
+        scan: Optional[dict] = None
         ts0 = duration = None
         with self.driver_mutex:
             if self.driver is not None and self.driver.is_connected():
-                # timestamped grab (back-dated revolution begin,
-                # grabScanDataHqWithTimeStamp parity); backends without
-                # hardware timing return duration 0 via the interface default
-                got = self.driver.grab_scan_data_with_timestamp(self._t.grab_timeout_s)
+                # host-native timestamped grab (back-dated revolution begin,
+                # grabScanDataHqWithTimeStamp parity): raw numpy arrays, so
+                # the consumer controls the one host->device transfer
+                got = self.driver.grab_scan_host(self._t.grab_timeout_s)
                 if got is not None:
-                    batch, ts0, duration = got
-        if batch is None:
+                    scan, ts0, duration = got
+        if scan is None:
             self.error_count += 1
             if self.error_count > self._params.max_retries:
                 log.error(
@@ -260,7 +259,7 @@ class ScanLoopFsm:
         if ts0 is None or duration is None or duration <= 0:
             ts0 = start_time
             duration = time.monotonic() - start_time
-        self._on_scan(batch, ts0, duration)
+        self._on_scan(scan, ts0, duration)
 
     def _do_resetting(self) -> None:
         log.warning("[FSM] Performing hardware reset (recreating driver)...")

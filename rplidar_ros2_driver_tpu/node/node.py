@@ -201,7 +201,11 @@ class RPlidarNode(LifecycleNode):
     # hot path: one revolution
     # ------------------------------------------------------------------
 
-    def _on_scan(self, batch: ScanBatch, start_time: float, duration: float) -> None:
+    def _on_scan(self, scan: dict, start_time: float, duration: float) -> None:
+        """One revolution, as raw host arrays (angle_q14/dist_q2/quality/
+        flag numpy).  Chain path: one bit-packed transfer + one dispatch.
+        Raw path: ScanBatch conversion + optional angle compensation +
+        the to_laserscan kernel (publish_scan, src/rplidar_node.cpp:558-683)."""
         params = self.params
         max_range = self.fsm.cached_max_range or 40.0
         is_new = True
@@ -211,7 +215,10 @@ class RPlidarNode(LifecycleNode):
         with self.tracer.stage("filter"):
             out = None
             if self.chain is not None:
-                out = self.chain.process(batch)
+                out = self.chain.process_raw(
+                    scan["angle_q14"], scan["dist_q2"], scan["quality"],
+                    scan.get("flag"),
+                )
 
         with self.tracer.stage("convert"):
             if out is not None:
@@ -233,7 +240,18 @@ class RPlidarNode(LifecycleNode):
                     intensities=inten,
                 )
             else:
-                scan = to_laserscan(
+                from rplidar_ros2_driver_tpu.ops.ascend import (
+                    apply_angle_compensation,
+                )
+
+                batch = apply_angle_compensation(
+                    ScanBatch.from_numpy(
+                        scan["angle_q14"], scan["dist_q2"], scan["quality"],
+                        scan.get("flag"),
+                    ),
+                    params.angle_compensate,
+                )
+                ls = to_laserscan(
                     batch,
                     duration,
                     max_range,
@@ -241,21 +259,21 @@ class RPlidarNode(LifecycleNode):
                     inverted=params.inverted,
                     is_new_type=is_new,
                 )
-                bc = int(scan.beam_count)
+                bc = int(ls.beam_count)
                 if bc == 0:
                     return
                 msg = LaserScanHost(
                     stamp=start_time,
                     frame_id=params.frame_id,
-                    angle_min=float(scan.angle_min),
-                    angle_max=float(scan.angle_max),
-                    angle_increment=float(scan.angle_increment),
-                    time_increment=float(scan.time_increment),
-                    scan_time=float(scan.scan_time),
-                    range_min=float(scan.range_min),
-                    range_max=float(scan.range_max),
-                    ranges=np.asarray(scan.ranges)[:bc],
-                    intensities=np.asarray(scan.intensities)[:bc],
+                    angle_min=float(ls.angle_min),
+                    angle_max=float(ls.angle_max),
+                    angle_increment=float(ls.angle_increment),
+                    time_increment=float(ls.time_increment),
+                    scan_time=float(ls.scan_time),
+                    range_min=float(ls.range_min),
+                    range_max=float(ls.range_max),
+                    ranges=np.asarray(ls.ranges)[:bc],
+                    intensities=np.asarray(ls.intensities)[:bc],
                 )
 
         with self.tracer.stage("publish"):
@@ -279,12 +297,18 @@ class RPlidarNode(LifecycleNode):
             return
         lc = self.lifecycle_state
         fsm_state = self.fsm.state if self.fsm else None
+        lat = {}
+        for stage in ("filter", "convert", "publish"):
+            p = self.tracer.percentile(stage, 99.0)
+            if p > 0:
+                lat[stage] = 1e3 * p
         self.diagnostics.update(
             lifecycle=lc,
             fsm_state=fsm_state,
             port=self.params.serial_port,
             rpm=self.params.rpm,
             device_info=self.fsm.cached_device_info if self.fsm else "",
+            latency_p99_ms=lat or None,
         )
 
     # ------------------------------------------------------------------

@@ -69,3 +69,15 @@ def ascend_scan(batch: ScanBatch) -> tuple[ScanBatch, jax.Array]:
         count=batch.count,
     )
     return out, any_valid
+
+
+def apply_angle_compensation(batch: ScanBatch, enabled: bool) -> ScanBatch:
+    """The single 'ascend if configured' policy point, shared by the driver
+    grab path (RealLidarDriver.grab_scan_data_with_timestamp) and the
+    node's raw publish path — keep the conditional here so the two layers
+    cannot drift (reference: ascendScanData applied inside grab when
+    angle_compensate, src/lidar_driver_wrapper.cpp:329)."""
+    if not enabled:
+        return batch
+    out, _ = ascend_scan(batch)
+    return out

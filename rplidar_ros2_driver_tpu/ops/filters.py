@@ -340,10 +340,14 @@ def compact_filter_step(
     state: FilterState, packed: jax.Array, count: jax.Array, cfg: FilterConfig
 ) -> tuple[FilterState, FilterOutput]:
     """filter_step over the bit-packed (2, n) uint32 wire form."""
+    return _filter_step_impl(state, _unpack_compact(packed, count), cfg)
+
+
+def _unpack_compact(packed: jax.Array, count: jax.Array) -> ScanBatch:
     i = jnp.arange(packed.shape[1], dtype=jnp.int32)
     live = i < count
     row0 = packed[0]
-    batch = ScanBatch(
+    return ScanBatch(
         angle_q14=(row0 & 0xFFFF).astype(jnp.int32),
         dist_q2=packed[1].astype(jnp.int32),
         quality=((row0 >> 16) & 0xFF).astype(jnp.int32),
@@ -351,4 +355,59 @@ def compact_filter_step(
         valid=live,
         count=count,
     )
-    return _filter_step_impl(state, batch, cfg)
+
+
+# -- fused single-fetch output -----------------------------------------------
+#
+# Pulling FilterOutput field-by-field costs one device->host round trip per
+# array (5/scan); over a remote-attached TPU each trip is link RTT, which
+# dwarfs the compute.  The wire variant concatenates every output into ONE
+# flat float32 vector inside the jitted step, so the host pays exactly one
+# fetch per revolution and slices it back apart locally.
+
+
+def wire_output_len(cfg: FilterConfig) -> int:
+    return 5 * cfg.beams + cfg.grid * cfg.grid
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def compact_filter_step_wire(
+    state: FilterState, packed: jax.Array, count: jax.Array, cfg: FilterConfig
+) -> tuple[FilterState, jax.Array]:
+    """compact_filter_step returning the single-fetch flat output vector."""
+    state, out = _filter_step_impl(state, _unpack_compact(packed, count), cfg)
+    wire = jnp.concatenate(
+        [
+            out.ranges,
+            out.intensities,
+            out.points_xy.reshape(-1),
+            out.point_mask.astype(jnp.float32),
+            out.voxel.reshape(-1).astype(jnp.float32),  # exact to 2^24 counts
+        ]
+    )
+    return state, wire
+
+
+def unpack_output_wire(wire, cfg: FilterConfig) -> FilterOutput:
+    """Host-side inverse of the wire packing (numpy in, numpy out).
+
+    Slices are copied: a view would pin the whole ~300 KB wire vector for
+    as long as any published message (e.g. an 8 KB ranges array sitting in
+    a subscriber queue) stays alive.
+    """
+    import numpy as np
+
+    b, g = cfg.beams, cfg.grid
+    w = np.asarray(wire)
+    if w.size != wire_output_len(cfg):
+        raise ValueError(
+            f"wire vector of {w.size} floats does not match cfg "
+            f"(expected {wire_output_len(cfg)})"
+        )
+    return FilterOutput(
+        ranges=w[:b].copy(),
+        intensities=w[b : 2 * b].copy(),
+        points_xy=w[2 * b : 4 * b].reshape(b, 2).copy(),
+        point_mask=w[4 * b : 5 * b] != 0.0,
+        voxel=w[5 * b :].reshape(g, g).astype(np.int32),
+    )

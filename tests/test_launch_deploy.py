@@ -214,7 +214,7 @@ def test_viz_drops_nonfinite_points():
 def test_cli_view_subcommand():
     """Standalone main equivalent: `python -m ... view` runs end-to-end."""
     out = subprocess.run(
-        [sys.executable, "-m", "rplidar_ros2_driver_tpu", "view", "--scans", "1"],
+        [sys.executable, "-m", "rplidar_ros2_driver_tpu", "view", "--scans", "1", "--cpu"],
         capture_output=True,
         text=True,
         timeout=120,
@@ -233,6 +233,7 @@ def test_cli_run_duration():
             "--dummy",
             "--duration",
             "2",
+            "--cpu",
         ],
         capture_output=True,
         text=True,

@@ -135,3 +135,43 @@ class TestDriverWiring:
             drv.disconnect()
         finally:
             sim.stop()
+
+
+class TestFrequencyAndDiag:
+    def test_get_frequency_from_sim(self):
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, True)
+            assert drv.get_frequency(1000) is None  # not scanning yet
+            assert drv.start_motor("", 600)
+            f = drv.get_frequency(1000)
+            assert f is not None and f > 0
+            us = drv._scan_decoder.timing.sample_duration_us
+            assert f == pytest.approx(1e6 / (us * 1000))
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            sim.stop()
+
+    def test_diagnostics_carry_latency_p99(self):
+        import time as _time
+
+        from rplidar_ros2_driver_tpu.core.config import DriverParams
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+
+        node = RPlidarNode(DriverParams(dummy_mode=True))
+        assert node.configure() and node.activate()
+        t0 = _time.monotonic()
+        while node.publisher.scan_count < 2 and _time.monotonic() - t0 < 10:
+            _time.sleep(0.02)
+        node._update_diagnostics()
+        d = node.diagnostics.last
+        assert any(k.startswith("p99 ") for k in d.values), d.values
+        node.deactivate(); node.cleanup(); node.shutdown()
