@@ -108,6 +108,25 @@ def _cmd_view(args) -> int:
     return 0
 
 
+def _cmd_replay(args) -> int:
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.replay import decode_recording
+
+    dec = decode_recording(args.recording)
+    revs = dec.revolutions()
+    print(f"{args.recording}: {dec.num_nodes} nodes, {len(revs)} complete revolutions")
+    for ans_type, n_frames, n_nodes in dec.runs:
+        try:
+            name = Ans(ans_type).name
+        except ValueError:
+            name = f"0x{ans_type:02x}"
+        print(f"  run: {name:34s} {n_frames:6d} frames -> {n_nodes:7d} nodes")
+    if revs:
+        pts = [len(r["angle_q14"]) for r in revs]
+        print(f"  points/rev: min={min(pts)} median={sorted(pts)[len(pts)//2]} max={max(pts)}")
+    return 0
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
     ap = argparse.ArgumentParser(prog="rplidar_ros2_driver_tpu")
@@ -131,6 +150,10 @@ def main(argv=None) -> int:
     udev = sub.add_parser("udev", help="generate/install udev rules")
     udev.add_argument("--install", action="store_true")
 
+    replay = sub.add_parser("replay", help="batch-decode a frame recording")
+    replay.add_argument("recording", help="capture file (RealLidarDriver.start_recording)")
+    replay.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
+
     args = ap.parse_args(argv)
     if getattr(args, "cpu", False):
         # must run before the first jax backend init; the env var is not
@@ -142,6 +165,8 @@ def main(argv=None) -> int:
         return _cmd_run(args)
     if args.cmd == "view":
         return _cmd_view(args)
+    if args.cmd == "replay":
+        return _cmd_replay(args)
     if args.cmd == "udev":
         from rplidar_ros2_driver_tpu.tools import udev as udev_mod
 

@@ -94,6 +94,8 @@ class _ScanDecoder:
         # updated by the driver on scan start (the reference's
         # _updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554)
         self.timing = timingmod.TimingDesc()
+        # optional capture tee (replay.FrameRecorder)
+        self.recorder = None
 
     def reset(self) -> None:
         self._active_ans = None
@@ -111,6 +113,9 @@ class _ScanDecoder:
         return None  # normal nodes / HQ capsules handled inline
 
     def on_measurement(self, ans_type: int, payload: bytes) -> None:
+        rec = self.recorder
+        if rec is not None:
+            rec.write(ans_type, payload, time.monotonic())
         if ans_type != self._active_ans:
             # answer type changed: new scan mode — reset decode state
             self._active_ans = ans_type
@@ -580,6 +585,28 @@ class RealLidarDriver(LidarDriverInterface):
         if not self.is_connected() or not self._scanning:
             return None
         return self._assembler.wait_and_grab_host(timeout_s)
+
+    # ------------------------------------------------------------------
+    # capture (replay.py)
+    # ------------------------------------------------------------------
+
+    def start_recording(self, path: str) -> None:
+        """Tee every measurement frame into a capture file; decode it later
+        with replay.decode_recording (batched JAX kernels)."""
+        from rplidar_ros2_driver_tpu.replay import FrameRecorder
+
+        self.stop_recording()
+        self._scan_decoder.recorder = FrameRecorder(path)
+
+    def stop_recording(self) -> Optional[int]:
+        """Returns the number of frames captured, or None if not recording."""
+        rec = self._scan_decoder.recorder
+        if rec is None:
+            return None
+        self._scan_decoder.recorder = None
+        frames = rec.frames
+        rec.close()
+        return frames
 
     def grab_scan_data_with_interval(self, max_nodes: Optional[int] = None):
         """Raw nodes accumulated since the last interval grab, as a (k, 4)

@@ -56,13 +56,24 @@ class _Subscription:
             if self._draining:
                 return  # the draining thread will pick it up, in order
             self._draining = True
-        while True:
+        try:
+            while True:
+                with self.lock:
+                    if not self._cb_pending:
+                        # cleared atomically with the emptiness check, so a
+                        # racing publish either sees pending+draining or
+                        # empty+not-draining — never a stranded message
+                        self._draining = False
+                        return
+                    nxt = self._cb_pending.popleft()
+                self.callback(nxt)
+        except BaseException:
+            # a raising callback must not wedge the subscription: release
+            # the drain claim; whatever is still pending is delivered by
+            # the next publish
             with self.lock:
-                if not self._cb_pending:
-                    self._draining = False
-                    return
-                nxt = self._cb_pending.popleft()
-            self.callback(nxt)
+                self._draining = False
+            raise
 
     def drain(self) -> list:
         with self.lock:

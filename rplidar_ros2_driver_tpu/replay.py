@@ -1,0 +1,164 @@
+"""Record / replay: capture raw wire frames, batch-decode them on TPU.
+
+The reference has no capture tooling (its only offline artifact is the
+``_DEBUG_DUMP_PACKET`` printf path, sl_async_transceiver.cpp:336-359).
+Here recording is a first-class seam: the driver's decode tap can tee
+every measurement frame to disk, and a recording replays through the
+*vectorized* JAX unpackers (ops/unpack.py) — the whole capture decodes as
+a handful of ``(M, frame_bytes)`` batch kernels instead of a per-byte
+loop, then optionally streams through the filter chain scan-by-scan.
+
+File format (little-endian), append-only and tail-truncation safe:
+
+    magic  b"RPLR" | u16 version | u16 reserved
+    record u8 ans_type | u8 pad | u16 payload_len | f64 ts | payload
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import struct
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.ops import unpack
+from rplidar_ros2_driver_tpu.protocol.constants import ANS_PAYLOAD_BYTES, Ans
+
+MAGIC = b"RPLR"
+VERSION = 1
+_HEADER = struct.Struct("<4sHH")
+_REC = struct.Struct("<BBHd")
+
+
+class FrameRecorder:
+    """Appends measurement frames to a capture file (thread-safe enough for
+    the single decode thread that feeds it)."""
+
+    def __init__(self, path: str) -> None:
+        self._f: Optional[io.BufferedWriter] = open(path, "wb")
+        self._f.write(_HEADER.pack(MAGIC, VERSION, 0))
+        self.frames = 0
+        # serializes write vs close: stop_recording() can race the decode
+        # thread mid-write, and a ValueError there would abort the live
+        # decode of that frame
+        self._lock = threading.Lock()
+
+    def write(self, ans_type: int, payload: bytes, ts: float = 0.0) -> None:
+        with self._lock:
+            f = self._f
+            if f is None:
+                return  # closed concurrently: drop silently
+            f.write(_REC.pack(ans_type & 0xFF, 0, len(payload), ts))
+            f.write(payload)
+            self.frames += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __enter__(self) -> "FrameRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_frames(path: str) -> Iterator[tuple[int, float, bytes]]:
+    """Yield (ans_type, ts, payload); stops cleanly at a truncated tail."""
+    with open(path, "rb") as f:
+        head = f.read(_HEADER.size)
+        if len(head) < _HEADER.size:
+            return
+        magic, version, _ = _HEADER.unpack(head)
+        if magic != MAGIC or version != VERSION:
+            raise ValueError(f"{path}: not a frame recording (or wrong version)")
+        while True:
+            rec = f.read(_REC.size)
+            if len(rec) < _REC.size:
+                return
+            ans_type, _, length, ts = _REC.unpack(rec)
+            payload = f.read(length)
+            if len(payload) < length:
+                return  # torn tail: crash mid-write
+            yield ans_type, ts, payload
+
+
+# -- batched decode ----------------------------------------------------------
+
+# ans_type -> (kernel, needs_prev_frame_pairing)
+_BATCH_KERNELS = {
+    int(Ans.MEASUREMENT): unpack.unpack_normal_nodes,
+    int(Ans.MEASUREMENT_CAPSULED): unpack.unpack_capsules,
+    int(Ans.MEASUREMENT_CAPSULED_ULTRA): unpack.unpack_ultra_capsules,
+    int(Ans.MEASUREMENT_DENSE_CAPSULED): unpack.unpack_dense_capsules,
+    int(Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED): unpack.unpack_ultra_dense_capsules,
+    int(Ans.MEASUREMENT_HQ): unpack.unpack_hq_capsules,
+}
+
+
+@dataclasses.dataclass
+class DecodedRecording:
+    """Flat, time-ordered node stream (numpy) + per-run stats."""
+
+    angle_q14: np.ndarray
+    dist_q2: np.ndarray
+    quality: np.ndarray
+    flag: np.ndarray
+    runs: list  # [(ans_type, n_frames, n_valid_nodes)]
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.angle_q14.shape[0])
+
+    def revolutions(self) -> list[dict[str, np.ndarray]]:
+        """Split the node stream at sync flags into complete revolutions
+        (partial leading/trailing data dropped, like the live assembler)."""
+        sync = np.flatnonzero(self.flag & 1)
+        out = []
+        for a, b in zip(sync[:-1], sync[1:]):
+            out.append(
+                {
+                    "angle_q14": self.angle_q14[a:b],
+                    "dist_q2": self.dist_q2[a:b],
+                    "quality": self.quality[a:b],
+                    "flag": self.flag[a:b],
+                }
+            )
+        return out
+
+
+def decode_recording(path: str) -> DecodedRecording:
+    """Batch-decode a capture: consecutive same-type frames become ONE
+    kernel invocation over a (M, frame_bytes) uint8 array."""
+    runs: list[tuple[int, list[bytes]]] = []
+    for ans_type, _ts, payload in read_frames(path):
+        expect = ANS_PAYLOAD_BYTES.get(ans_type)
+        if expect is None or len(payload) != expect:
+            continue  # non-measurement or malformed record
+        if runs and runs[-1][0] == ans_type:
+            runs[-1][1].append(payload)
+        else:
+            runs.append((ans_type, [payload]))
+
+    parts = {k: [] for k in ("angle_q14", "dist_q2", "quality", "flag")}
+    stats = []
+    for ans_type, frames in runs:
+        kernel = _BATCH_KERNELS[ans_type]
+        arr = np.frombuffer(b"".join(frames), np.uint8).reshape(len(frames), -1)
+        dec = kernel(arr)
+        valid = np.asarray(dec.node_valid).reshape(-1)
+        n_valid = int(valid.sum())
+        for key in parts:
+            parts[key].append(np.asarray(getattr(dec, key)).reshape(-1)[valid])
+        stats.append((ans_type, len(frames), n_valid))
+
+    cat = {
+        k: (np.concatenate(v).astype(np.int32) if v else np.zeros(0, np.int32))
+        for k, v in parts.items()
+    }
+    return DecodedRecording(runs=stats, **cat)

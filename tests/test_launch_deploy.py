@@ -241,3 +241,22 @@ def test_cli_run_duration():
     )
     assert out.returncode == 0, out.stderr
     assert "scans=" in out.stdout
+
+
+def test_raising_callback_does_not_wedge_subscription():
+    """A callback exception must not permanently stop delivery."""
+    bus = IntraProcessBus()
+    got = []
+    calls = {"n": 0}
+
+    def flaky(msg):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        got.append(msg)
+
+    bus.subscribe("/t", flaky)
+    with pytest.raises(RuntimeError):
+        bus.publish("/t", "m1")
+    bus.publish("/t", "m2")  # must still be delivered
+    assert got == ["m2"]
