@@ -48,24 +48,31 @@ def _pick_device(backend: str):
 DEFAULT_BEAMS = 2048
 
 
+def config_from_params(params: DriverParams, beams: int = DEFAULT_BEAMS) -> FilterConfig:
+    """The one params -> FilterConfig mapping, shared by the single-stream
+    chain and the multi-stream sharded service so their filtering behavior
+    (and checkpoint layouts) cannot drift."""
+    chain = set(params.filter_chain)
+    return FilterConfig(
+        window=params.filter_window,
+        beams=beams,
+        grid=params.voxel_grid_size,
+        cell_m=params.voxel_cell_m,
+        range_min_m=params.range_clip_min_m,
+        range_max_m=params.range_clip_max_m,
+        intensity_min=params.intensity_min,
+        enable_clip="clip" in chain,
+        enable_median="median" in chain,
+        enable_voxel="voxel" in chain,
+        median_backend=params.median_backend,
+    )
+
+
 class ScanFilterChain:
     """Stateful host wrapper around the fused filter_step program."""
 
     def __init__(self, params: DriverParams, beams: int = DEFAULT_BEAMS) -> None:
-        chain = set(params.filter_chain)
-        self.cfg = FilterConfig(
-            window=params.filter_window,
-            beams=beams,
-            grid=params.voxel_grid_size,
-            cell_m=params.voxel_cell_m,
-            range_min_m=params.range_clip_min_m,
-            range_max_m=params.range_clip_max_m,
-            intensity_min=params.intensity_min,
-            enable_clip="clip" in chain,
-            enable_median="median" in chain,
-            enable_voxel="voxel" in chain,
-            median_backend=params.median_backend,
-        )
+        self.cfg = config_from_params(params, beams)
         self.device = _pick_device(params.filter_backend)
         self.backend = params.filter_backend
         self._state = jax.device_put(

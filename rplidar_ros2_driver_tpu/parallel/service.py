@@ -1,0 +1,159 @@
+"""Multi-stream filter service — the production face of the sharded step.
+
+One process, many lidars (a multi-sensor rig or a fleet gateway): each
+stream keeps its own rolling window/voxel state, all hosted on one
+``(stream, beam)`` device mesh (parallel/sharding.py).  Per tick the
+service stacks every stream's newest revolution into one stream-batched
+``ScanBatch``, runs the single sharded step (XLA inserts the one
+beam-axis psum), and hands back per-stream host outputs.
+
+Relation to single-stream: ``ScanFilterChain`` (filters/chain.py) is the
+one-lidar hot path; this service is its scale-out — same FilterConfig,
+same state layout (so checkpoints interoperate per stream), same output
+contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
+from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
+from rplidar_ros2_driver_tpu.ops.filters import FilterOutput, FilterState
+from rplidar_ros2_driver_tpu.parallel.sharding import (
+    build_sharded_step,
+    create_sharded_state,
+    make_mesh,
+    shard_batch,
+)
+
+
+class ShardedFilterService:
+    def __init__(
+        self,
+        params: DriverParams,
+        streams: int,
+        *,
+        mesh=None,
+        beams: int = DEFAULT_BEAMS,
+        capacity: int = MAX_SCAN_NODES,
+    ) -> None:
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.cfg = config_from_params(params, beams)
+        self.streams = streams
+        self.capacity = capacity
+        self._step = build_sharded_step(self.mesh, self.cfg)
+        self._state = create_sharded_state(self.mesh, self.cfg, streams)
+
+    # -- ingest -------------------------------------------------------------
+
+    def _stack(self, scans: Sequence[Optional[dict]]) -> ScanBatch:
+        n = self.capacity
+        s = self.streams
+        angle = np.zeros((s, n), np.int32)
+        dist = np.zeros((s, n), np.int32)
+        quality = np.zeros((s, n), np.int32)
+        flag = np.zeros((s, n), np.int32)
+        valid = np.zeros((s, n), bool)
+        count = np.zeros((s,), np.int32)
+        for i, scan in enumerate(scans):
+            if scan is None:
+                continue  # stream idle this tick: all-masked scan
+            c = int(len(scan["angle_q14"]))
+            if c > n:
+                raise ValueError(f"stream {i}: scan of {c} nodes exceeds capacity {n}")
+            angle[i, :c] = scan["angle_q14"]
+            dist[i, :c] = scan["dist_q2"]
+            quality[i, :c] = scan["quality"]
+            if scan.get("flag") is not None:
+                flag[i, :c] = scan["flag"]
+            valid[i, :c] = True
+            count[i] = c
+        import jax.numpy as jnp
+
+        return ScanBatch(
+            angle_q14=jnp.asarray(angle),
+            dist_q2=jnp.asarray(dist),
+            quality=jnp.asarray(quality),
+            flag=jnp.asarray(flag),
+            valid=jnp.asarray(valid),
+            count=jnp.asarray(count),
+        )
+
+    def submit(self, scans: Sequence[Optional[dict]]) -> list[Optional[FilterOutput]]:
+        """One tick: newest revolution per stream (None = no new data).
+
+        An idle stream still advances its window cursor with an all-masked
+        scan (its median sees an empty frame), keeping every stream's state
+        in lock-step — the property that makes the single stacked dispatch
+        possible.  Returns per-stream numpy FilterOutputs (None for idle
+        streams).
+        """
+        if len(scans) != self.streams:
+            raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
+        batch = shard_batch(self.mesh, self._stack(scans))
+        self._state, out = self._step(self._state, batch)
+        # one fetch per array (already stream-batched: 5 fetches per TICK,
+        # amortized over all streams)
+        ranges = np.asarray(out.ranges)
+        inten = np.asarray(out.intensities)
+        xy = np.asarray(out.points_xy)
+        mask = np.asarray(out.point_mask)
+        voxel = np.asarray(out.voxel)
+        results: list[Optional[FilterOutput]] = []
+        for i, scan in enumerate(scans):
+            if scan is None:
+                results.append(None)
+                continue
+            results.append(
+                FilterOutput(
+                    ranges=ranges[i],
+                    intensities=inten[i],
+                    points_xy=xy[i],
+                    point_mask=mask[i],
+                    voxel=voxel[i],
+                )
+            )
+        return results
+
+    # -- checkpoint surface (mirrors ScanFilterChain's) ---------------------
+
+    def snapshot(self) -> dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in vars(self._state).items()}
+
+    def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
+        if snap is not None:
+            # per-stream layout = FilterState.shapes with a leading stream
+            # axis (allocation-free, single source of truth)
+            expected = {
+                k: (self.streams, *v)
+                for k, v in FilterState.shapes(
+                    self.cfg.window, self.cfg.beams, self.cfg.grid
+                ).items()
+            }
+            got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+            if expected != got:
+                return False
+            self._state = self._place(FilterState(**snap))
+            return True
+        self._state = create_sharded_state(self.mesh, self.cfg, self.streams)
+        return False
+
+    def _place(self, state):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from rplidar_ros2_driver_tpu.parallel.sharding import STATE_SPEC
+
+        return jax.device_put(
+            state,
+            jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                STATE_SPEC,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )

@@ -1,0 +1,105 @@
+"""Multi-stream ShardedFilterService on the virtual 8-device CPU mesh.
+
+Key property: a stream processed through the sharded multi-stream service
+must produce bit-identical outputs to the same scans through the
+single-device ScanFilterChain.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+
+def _params(**kw) -> DriverParams:
+    base = dict(
+        dummy_mode=True,
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=32,
+    )
+    base.update(kw)
+    return DriverParams(**base)
+
+
+def _scan(k: int, points: int = 300) -> dict:
+    rng = np.random.default_rng(k)
+    return {
+        "angle_q14": ((np.arange(points) * 65536) // points).astype(np.int32),
+        "dist_q2": (rng.uniform(0.3, 8.0, points) * 4000).astype(np.int32),
+        "quality": np.full(points, 180, np.int32),
+        "flag": None,
+    }
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)  # conftest forces 8 virtual CPU devices
+
+
+class TestService:
+    def test_matches_single_stream_chain(self, mesh):
+        svc = ShardedFilterService(_params(), streams=4, mesh=mesh, beams=128)
+        chains = [ScanFilterChain(_params(), beams=128) for _ in range(4)]
+        for tick in range(6):
+            scans = [_scan(100 * s + tick) for s in range(4)]
+            outs = svc.submit(scans)
+            for s in range(4):
+                ref = chains[s].process_raw(
+                    scans[s]["angle_q14"], scans[s]["dist_q2"], scans[s]["quality"]
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(outs[s].ranges), np.asarray(ref.ranges)
+                )
+                np.testing.assert_array_equal(
+                    np.asarray(outs[s].voxel), np.asarray(ref.voxel)
+                )
+
+    def test_idle_stream_returns_none_but_advances(self, mesh):
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        outs = svc.submit([_scan(1), None])
+        assert outs[0] is not None and outs[1] is None
+        # idle stream advanced its cursor in lock-step
+        snap = svc.snapshot()
+        assert snap["cursor"][0] == snap["cursor"][1] == 1
+
+    def test_wrong_stream_count_rejected(self, mesh):
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        with pytest.raises(ValueError):
+            svc.submit([_scan(1)])
+
+    def test_capacity_overflow_rejected(self, mesh):
+        svc = ShardedFilterService(
+            _params(), streams=2, mesh=mesh, beams=128, capacity=256
+        )
+        with pytest.raises(ValueError):
+            svc.submit([_scan(1, points=300), None])
+
+    def test_snapshot_restore_roundtrip(self, mesh):
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit([_scan(1), _scan(2)])
+        snap = svc.snapshot()
+
+        svc2 = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        assert svc2.restore(snap)
+        for k, v in svc2.snapshot().items():
+            np.testing.assert_array_equal(v, snap[k])
+        # continued processing agrees
+        a = svc.submit([_scan(3), _scan(4)])
+        b = svc2.submit([_scan(3), _scan(4)])
+        np.testing.assert_array_equal(
+            np.asarray(a[0].voxel), np.asarray(b[0].voxel)
+        )
+
+    def test_restore_rejects_wrong_geometry(self, mesh):
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit([_scan(1), _scan(2)])
+        snap = svc.snapshot()
+        other = ShardedFilterService(_params(filter_window=8), streams=2, mesh=mesh, beams=128)
+        assert not other.restore(snap)
