@@ -138,13 +138,15 @@ class RPlidarDriver:
     def startScan(self, force: bool = False, use_typical: bool = True) -> bool:
         """Legacy auto-start: detect + start in the preferred mode.
 
-        ``force`` (FORCE_SCAN 0x21: scan despite failed health check) has
-        no equivalent here — the FSM health-gates starts by design — so it
-        warns loudly instead of silently differing from the legacy API.
+        ``force`` maps to FORCE_SCAN 0x21 (scan despite a failed health
+        check) on backends that support it (RealLidarDriver.force_scan);
+        elsewhere it warns and falls back to the health-gated path.
         """
         if force:
+            if self._impl.force_scan():
+                return True
             warnings.warn(
-                "startScan(force=True): FORCE_SCAN is not supported; "
+                "startScan(force=True): this backend has no FORCE_SCAN; "
                 "starting with the normal health-gated path",
                 RuntimeWarning,
                 stacklevel=2,

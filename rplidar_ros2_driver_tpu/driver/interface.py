@@ -64,6 +64,12 @@ class LidarDriverInterface(abc.ABC):
             return None
         return batch, time.monotonic(), 0.0
 
+    def force_scan(self, rpm: int = 0) -> bool:
+        """FORCE_SCAN (cmd 0x21): stream despite a failed device health
+        gate.  Default: unsupported — callers fall back to the normal
+        health-gated start (startScan force path, sl_lidar_driver.cpp:586)."""
+        return False
+
     def grab_scan_host(
         self, timeout_s: float = 2.0
     ) -> Optional[tuple[dict, float, float]]:

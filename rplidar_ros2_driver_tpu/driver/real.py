@@ -325,6 +325,25 @@ class RealLidarDriver(LidarDriverInterface):
         self.profile.hw_max_distance = mode.max_distance or NEW_TYPE_MAX_DISTANCE
         return True
 
+    def force_scan(self, rpm: int = 0) -> bool:
+        """FORCE_SCAN (cmd 0x21): start streaming regardless of the
+        device-side health gate (startScan(force=true),
+        sl_lidar_driver.cpp:586-616).  Legacy wire format."""
+        with self._lock:
+            if self._engine is None:
+                return False
+            target_rpm = rpm if rpm > 0 else DEFAULT_RPM
+            self.set_motor_speed(target_rpm)
+            time.sleep(self._legacy_warmup_s)
+            self._update_timing_desc(timingmod.LEGACY_SAMPLE_DURATION_US)
+            self._begin_streaming()
+            if not self._engine.send_only(Cmd.FORCE_SCAN):
+                return False
+            self._scanning = True
+            self.profile.active_mode = "Standard (forced)"
+            self.profile.active_rpm = target_rpm
+            return True
+
     def _start_old_type(self, rpm: int) -> bool:
         # legacy: fixed 600 RPM, brief spin-up, plain SCAN
         # (src/lidar_driver_wrapper.cpp:262-268)

@@ -271,7 +271,9 @@ class SimulatedDevice:
             self._handle_conf(payload)
         elif cmd == Cmd.SET_LIDAR_CONF:
             self._handle_set_conf(payload)
-        elif cmd == Cmd.SCAN:
+        elif cmd in (Cmd.SCAN, Cmd.FORCE_SCAN):
+            # FORCE_SCAN streams even when health-gated firmware would
+            # refuse SCAN (sl_lidar_driver.cpp startScan force path)
             self._start_stream(self.cfg.modes[0])
         elif cmd == Cmd.EXPRESS_SCAN:
             mode_id = payload[0] if payload else 0

@@ -15,20 +15,27 @@ contract.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
-from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
 from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
-from rplidar_ros2_driver_tpu.ops.filters import FilterOutput, FilterState
+from rplidar_ros2_driver_tpu.ops.filters import (
+    FilterOutput,
+    FilterState,
+    _unpack_compact,
+    pack_host_scan_compact,
+)
 from rplidar_ros2_driver_tpu.parallel.sharding import (
     build_sharded_step,
     create_sharded_state,
     make_mesh,
-    shard_batch,
+    place_state,
 )
 
 
@@ -46,43 +53,41 @@ class ShardedFilterService:
         self.cfg = config_from_params(params, beams)
         self.streams = streams
         self.capacity = capacity
-        self._step = build_sharded_step(self.mesh, self.cfg)
+        sharded_step = build_sharded_step(self.mesh, self.cfg)
+
+        # compact ingest, like the single-stream wire path: one bit-packed
+        # (streams, 2, N) uint32 upload (8 bytes/point), unpacked to a
+        # stream-batched ScanBatch inside the jitted program
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_packed(state, packed, count):
+            batch = jax.vmap(_unpack_compact)(packed, count)
+            return sharded_step(state, batch)
+
+        self._step = step_packed
+        self._packed_sharding = NamedSharding(self.mesh, P("stream", None, None))
+        self._count_sharding = NamedSharding(self.mesh, P("stream"))
         self._state = create_sharded_state(self.mesh, self.cfg, streams)
 
     # -- ingest -------------------------------------------------------------
 
-    def _stack(self, scans: Sequence[Optional[dict]]) -> ScanBatch:
+    def _stack(self, scans: Sequence[Optional[dict]]) -> tuple[np.ndarray, np.ndarray]:
         n = self.capacity
         s = self.streams
-        angle = np.zeros((s, n), np.int32)
-        dist = np.zeros((s, n), np.int32)
-        quality = np.zeros((s, n), np.int32)
-        flag = np.zeros((s, n), np.int32)
-        valid = np.zeros((s, n), bool)
+        packed = np.zeros((s, 2, n), np.uint32)
         count = np.zeros((s,), np.int32)
         for i, scan in enumerate(scans):
             if scan is None:
-                continue  # stream idle this tick: all-masked scan
-            c = int(len(scan["angle_q14"]))
-            if c > n:
-                raise ValueError(f"stream {i}: scan of {c} nodes exceeds capacity {n}")
-            angle[i, :c] = scan["angle_q14"]
-            dist[i, :c] = scan["dist_q2"]
-            quality[i, :c] = scan["quality"]
-            if scan.get("flag") is not None:
-                flag[i, :c] = scan["flag"]
-            valid[i, :c] = True
+                continue  # stream idle this tick: all-masked scan (count 0)
+            try:
+                buf, c = pack_host_scan_compact(
+                    scan["angle_q14"], scan["dist_q2"], scan["quality"],
+                    scan.get("flag"), n,
+                )
+            except ValueError as e:
+                raise ValueError(f"stream {i}: {e}") from None
+            packed[i] = buf
             count[i] = c
-        import jax.numpy as jnp
-
-        return ScanBatch(
-            angle_q14=jnp.asarray(angle),
-            dist_q2=jnp.asarray(dist),
-            quality=jnp.asarray(quality),
-            flag=jnp.asarray(flag),
-            valid=jnp.asarray(valid),
-            count=jnp.asarray(count),
-        )
+        return packed, count
 
     def submit(self, scans: Sequence[Optional[dict]]) -> list[Optional[FilterOutput]]:
         """One tick: newest revolution per stream (None = no new data).
@@ -95,8 +100,10 @@ class ShardedFilterService:
         """
         if len(scans) != self.streams:
             raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
-        batch = shard_batch(self.mesh, self._stack(scans))
-        self._state, out = self._step(self._state, batch)
+        packed_np, count_np = self._stack(scans)
+        packed = jax.device_put(packed_np, self._packed_sharding)
+        count = jax.device_put(count_np, self._count_sharding)
+        self._state, out = self._step(self._state, packed, count)
         # one fetch per array (already stream-batched: 5 fetches per TICK,
         # amortized over all streams)
         ranges = np.asarray(out.ranges)
@@ -137,23 +144,15 @@ class ShardedFilterService:
             }
             got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
             if expected != got:
+                import logging
+
+                logging.getLogger("rplidar_tpu.service").warning(
+                    "rejecting incompatible sharded snapshot (%s != %s)",
+                    got,
+                    expected,
+                )
                 return False
-            self._state = self._place(FilterState(**snap))
+            self._state = place_state(self.mesh, FilterState(**snap))
             return True
         self._state = create_sharded_state(self.mesh, self.cfg, self.streams)
         return False
-
-    def _place(self, state):
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        from rplidar_ros2_driver_tpu.parallel.sharding import STATE_SPEC
-
-        return jax.device_put(
-            state,
-            jax.tree_util.tree_map(
-                lambda spec: NamedSharding(self.mesh, spec),
-                STATE_SPEC,
-                is_leaf=lambda x: isinstance(x, P),
-            ),
-        )

@@ -232,6 +232,19 @@ def build_sharded_step(mesh: Mesh, cfg: FilterConfig) -> Callable:
     return jax.jit(sharded)
 
 
+def place_state(mesh: Mesh, state: FilterState) -> FilterState:
+    """Place a stream-batched FilterState according to STATE_SPEC — the one
+    placement point for fresh AND restored state."""
+    return jax.device_put(
+        state,
+        jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            STATE_SPEC,
+            is_leaf=lambda x: isinstance(x, P),
+        ),
+    )
+
+
 def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterState:
     """Batched FilterState with leading stream axis, placed per STATE_SPEC."""
     if streams % mesh.shape["stream"]:
@@ -246,14 +259,7 @@ def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterS
         cursor=jnp.zeros((streams,), jnp.int32),
         filled=jnp.zeros((streams,), jnp.int32),
     )
-    return jax.device_put(
-        base,
-        jax.tree_util.tree_map(
-            lambda spec: NamedSharding(mesh, spec),
-            STATE_SPEC,
-            is_leaf=lambda x: isinstance(x, P),
-        ),
-    )
+    return place_state(mesh, base)
 
 
 def shard_batch(mesh: Mesh, batch: ScanBatch) -> ScanBatch:

@@ -68,3 +68,17 @@ class StageTimer:
     def reset(self) -> None:
         with self._lock:
             self._samples.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a jax.profiler device trace (viewable in TensorBoard /
+    Perfetto) around a block — the real-tracing upgrade over the
+    reference's printf packet dump (sl_async_transceiver.cpp:336-359)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -56,3 +56,38 @@ def test_unsupported_legacy_args_warn():
         drv.startScan(force=True)
     with pytest.warns(RuntimeWarning, match="fixed_angle"):
         drv.startScanExpress(True, "Standard")
+
+
+def test_force_scan_against_sim(tmp_path):
+    """startScan(force=True) sends FORCE_SCAN 0x21 and streams."""
+    from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+    from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+    sim = SimulatedDevice().start()
+    try:
+        drv = RealLidarDriver(
+            channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+            motor_warmup_s=0.0, legacy_warmup_s=0.0,
+        )
+        facade = compat.RPlidarDriver(drv)
+        assert facade.connect("sim", 0)
+        assert facade.startScan(force=True)  # no warning on real backend
+        batch = facade.grabScanDataHq(5000)
+        assert batch is not None and int(batch.count) > 0
+        assert drv.profile.active_mode == "Standard (forced)"
+        facade.stop()
+        facade.disconnect()
+    finally:
+        sim.stop()
+
+
+def test_profile_trace_smoke(tmp_path):
+    import jax.numpy as jnp
+
+    from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
+
+    with profile_trace(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    import os
+
+    assert any(os.scandir(str(tmp_path)))  # trace files written
