@@ -136,3 +136,49 @@ def test_write_after_close_is_noop(tmp_path):
     rec.close()
     rec.write(0x81, b"\x02" * 5)  # racing decode thread: silently dropped
     assert rec.frames == 1
+
+
+@pytest.mark.parametrize("mode_name,expect_ans", [
+    ("DenseBoost", 0x85),     # dense capsules (40 pts/frame)
+    ("Sensitivity", 0x82),    # express capsules (16 cabins x 2)
+])
+def test_capture_capsule_formats(tmp_path, mode_name, expect_ans):
+    """Capture + batch-decode the capsule wire formats end-to-end: the
+    offline vectorized decode must reproduce the online scalar decode."""
+    from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+    from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+    path = str(tmp_path / f"{mode_name}.rplr")
+    sim = SimulatedDevice().start()
+    online = []
+    try:
+        drv = RealLidarDriver(
+            channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+            motor_warmup_s=0.0,
+        )
+        assert drv.connect("sim", 0, False)
+        drv.detect_and_init_strategy()
+        drv.start_recording(path)
+        assert drv.start_motor(mode_name, 600)
+        assert drv.profile.active_mode == mode_name
+        deadline = time.monotonic() + 15
+        while len(online) < 2 and time.monotonic() < deadline:
+            got = drv.grab_scan_host(2.0)
+            if got is not None:
+                online.append(got[0])
+        assert drv.stop_recording() > 0
+        drv.stop_motor()
+        drv.disconnect()
+    finally:
+        sim.stop()
+    assert online
+
+    dec = decode_recording(path)
+    assert any(a == expect_ans for a, _, _ in dec.runs), dec.runs
+    revs = dec.revolutions()
+    assert revs
+    # online nodes must appear node-exact inside the offline batch decode
+    on = np.concatenate([s["dist_q2"] for s in online])
+    off = np.concatenate([r["dist_q2"] for r in revs])
+    idx = off.tobytes().find(on.tobytes())
+    assert idx >= 0 and idx % 4 == 0, f"{mode_name}: online nodes not in offline decode"
