@@ -29,7 +29,7 @@ from typing import Optional
 import numpy as np
 
 from rplidar_ros2_driver_tpu.models.tables import DeviceInfo
-from rplidar_ros2_driver_tpu.ops import wire
+from rplidar_ros2_driver_tpu.ops import unpack_ref, wire
 from rplidar_ros2_driver_tpu.protocol.codec import AnsHeader
 from rplidar_ros2_driver_tpu.protocol.constants import (
     Ans,
@@ -39,7 +39,10 @@ from rplidar_ros2_driver_tpu.protocol.constants import (
     ConfKey,
     DENSE_CAPSULE_BYTES,
     CAPSULE_BYTES,
+    HQ_CAPSULE_BYTES,
     NORMAL_NODE_BYTES,
+    ULTRA_CAPSULE_BYTES,
+    ULTRA_DENSE_CAPSULE_BYTES,
 )
 
 log = logging.getLogger("rplidar_tpu.sim")
@@ -58,6 +61,9 @@ DEFAULT_MODES = [
     SimScanMode(0, "Standard", Ans.MEASUREMENT, 476.0, 12.0),
     SimScanMode(1, "DenseBoost", Ans.MEASUREMENT_DENSE_CAPSULED, 31.25, 40.0),
     SimScanMode(2, "Sensitivity", Ans.MEASUREMENT_CAPSULED, 63.0, 25.0),
+    SimScanMode(3, "UltraBoost", Ans.MEASUREMENT_CAPSULED_ULTRA, 42.0, 30.0),
+    SimScanMode(4, "UltraDense", Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED, 20.0, 40.0),
+    SimScanMode(5, "HQ", Ans.MEASUREMENT_HQ, 32.0, 40.0),
 ]
 
 
@@ -355,12 +361,14 @@ class SimulatedDevice:
             math.radians(theta_deg) + 0.1 * rev
         )
 
-    # wire formats the emulator can stream (the other answer types are
-    # covered by the offline golden tests against ops/wire.py encoders)
+    # all six measurement wire formats, (frame bytes, points per frame)
     STREAMABLE = {
         Ans.MEASUREMENT: (NORMAL_NODE_BYTES, 1),
         Ans.MEASUREMENT_DENSE_CAPSULED: (DENSE_CAPSULE_BYTES, 40),
         Ans.MEASUREMENT_CAPSULED: (CAPSULE_BYTES, 32),
+        Ans.MEASUREMENT_CAPSULED_ULTRA: (ULTRA_CAPSULE_BYTES, 96),
+        Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED: (ULTRA_DENSE_CAPSULE_BYTES, 64),
+        Ans.MEASUREMENT_HQ: (HQ_CAPSULE_BYTES, 96),
     }
 
     def _stream_loop(self, mode: SimScanMode) -> None:
@@ -399,7 +407,8 @@ class SimulatedDevice:
                     [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
                 )
                 frame = wire.encode_dense_capsule(start_q6, first, dists.astype(int))
-            else:  # express capsule: 16 cabins x 2 points
+            elif mode.ans_type == Ans.MEASUREMENT_CAPSULED:
+                # express capsule: 16 cabins x 2 points
                 thetas = 360.0 * ((np.arange(32) + idx) % ppr) / ppr
                 revs = (np.arange(32) + idx) // ppr
                 dists = np.array(
@@ -408,6 +417,63 @@ class SimulatedDevice:
                 dist_q2 = (dists.astype(int) * 4) & ~0x3
                 frame = wire.encode_capsule(
                     start_q6, first, dist_q2.reshape(16, 2), np.zeros((16, 2), int)
+                )
+            elif mode.ans_type == Ans.MEASUREMENT_CAPSULED_ULTRA:
+                # 32 cabins x 3 points.  The decoder's contract
+                # (unpack_ref.UltraCapsuleDecoder): major is the mm-domain
+                # varbitscale base of point 0; predict1 applies to THIS
+                # cabin's decoded base, predict2 to the NEXT cabin's,
+                # both shifted left by the base's scale level; -512/511
+                # are reserved invalid markers.  Encode quantization-aware
+                # against the decoded bases.
+                pts = np.arange(97) + idx  # + first point of the NEXT frame
+                thetas = 360.0 * (pts % ppr) / ppr
+                revs = pts // ppr
+                mm = np.array(
+                    [int(self._scene_dist_mm(t, r)) for t, r in zip(thetas, revs)]
+                )
+                bases_mm = mm[0::3]  # 33 cabin bases (incl. next frame's)
+                majors = np.array(
+                    [wire.varbitscale_encode(int(v)) for v in bases_mm]
+                )
+                dec = [unpack_ref.varbitscale_decode(int(m)) for m in majors]
+                p1 = np.empty(32, np.int64)
+                p2 = np.empty(32, np.int64)
+                for c in range(32):
+                    b1, l1 = dec[c]
+                    b2, l2 = dec[c + 1]
+                    p1[c] = np.clip((mm[3 * c + 1] - b1) >> l1, -511, 510)
+                    p2[c] = np.clip((mm[3 * c + 2] - b2) >> l2, -511, 510)
+                frame = wire.encode_ultra_capsule(
+                    start_q6, first, majors[:32], p1, p2
+                )
+            elif mode.ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+                # 32 cabins x 2 points, 20-bit piecewise-scaled samples
+                thetas = 360.0 * ((np.arange(64) + idx) % ppr) / ppr
+                revs = (np.arange(64) + idx) // ppr
+                words = np.array(
+                    [
+                        wire.ultra_dense_encode_sample(
+                            int(self._scene_dist_mm(t, r)), 0x2F
+                        )
+                        for t, r in zip(thetas, revs)
+                    ]
+                )
+                frame = wire.encode_ultra_dense_capsule(start_q6, first, words)
+            else:  # HQ capsule: 96 pre-formatted nodes + CRC32
+                pts = np.arange(96) + idx
+                thetas = 360.0 * (pts % ppr) / ppr
+                revs = pts // ppr
+                dq2 = np.array(
+                    [int(self._scene_dist_mm(t, r)) * 4 for t, r in zip(thetas, revs)]
+                )
+                flags = np.where(pts % ppr == 0, 1, 2)  # bit0 sync else !sync
+                frame = wire.encode_hq_capsule(
+                    (thetas * (65536.0 / 360.0)).astype(int),
+                    dq2,
+                    np.full(96, 0x2F, int),
+                    flags,
+                    timestamp=idx,
                 )
             self._send(frame)
             idx += pts_per_frame

@@ -141,6 +141,9 @@ def test_write_after_close_is_noop(tmp_path):
 @pytest.mark.parametrize("mode_name,expect_ans", [
     ("DenseBoost", 0x85),     # dense capsules (40 pts/frame)
     ("Sensitivity", 0x82),    # express capsules (16 cabins x 2)
+    ("UltraBoost", 0x84),     # ultra capsules (32 cabins x 3)
+    ("UltraDense", 0x86),     # ultra-dense capsules (32 cabins x 2)
+    ("HQ", 0x83),             # HQ capsules (96 nodes + CRC32)
 ])
 def test_capture_capsule_formats(tmp_path, mode_name, expect_ans):
     """Capture + batch-decode the capsule wire formats end-to-end: the
@@ -182,3 +185,38 @@ def test_capture_capsule_formats(tmp_path, mode_name, expect_ans):
     off = np.concatenate([r["dist_q2"] for r in revs])
     idx = off.tobytes().find(on.tobytes())
     assert idx >= 0 and idx % 4 == 0, f"{mode_name}: online nodes not in offline decode"
+
+
+def test_ultra_mode_geometry_matches_standard(tmp_path):
+    """The emulator's ultra mode must describe the SAME scene as Standard:
+    the varbitscale/predict encoding is mm-domain and quantized, so decoded
+    ranges agree within the coarsest scale step."""
+    from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+    from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+    def median_range_m(mode_name):
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, False)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor(mode_name, 600)
+            got = None
+            deadline = time.monotonic() + 15
+            while got is None and time.monotonic() < deadline:
+                got = drv.grab_scan_host(2.0)
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            sim.stop()
+        assert got is not None
+        d = got[0]["dist_q2"]
+        d = d[d > 0]
+        return float(np.median(d)) / 4000.0
+
+    std = median_range_m("Standard")
+    ultra = median_range_m("UltraBoost")
+    assert abs(ultra - std) / std < 0.05, (std, ultra)
