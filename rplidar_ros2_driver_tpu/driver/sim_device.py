@@ -19,10 +19,13 @@ from __future__ import annotations
 
 import logging
 import math
+import os
+import select
 import socket
 import struct
 import threading
 import time
+import tty
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -132,16 +135,20 @@ class SimulatedDevice:
         self._running.clear()
         self._streaming.clear()
         self.unplug()
+        self._close_listener()
+        for t in (self._accept_thread, self._stream_thread):
+            if t is not None:
+                t.join(3.0)
+        self._accept_thread = self._stream_thread = None
+
+    def _close_listener(self) -> None:
+        """Transport hook: tear down the accept endpoint."""
         if self._srv is not None:
             try:
                 self._srv.close()
             except OSError:
                 pass
             self._srv = None
-        for t in (self._accept_thread, self._stream_thread):
-            if t is not None:
-                t.join(3.0)
-        self._accept_thread = self._stream_thread = None
 
     def unplug(self) -> None:
         """Sever the client link abruptly (hot-unplug fault injection)."""
@@ -190,12 +197,16 @@ class SimulatedDevice:
                 return
             if not chunk:
                 return
-            buf += chunk
-            while True:
-                consumed = self._try_parse_request(bytes(buf))
-                if consumed == 0:
-                    break
-                del buf[:consumed]
+            self._feed(buf, chunk)
+
+    def _feed(self, buf: bytearray, chunk: bytes) -> None:
+        """Shared rx helper: append bytes, parse every complete request."""
+        buf += chunk
+        while True:
+            consumed = self._try_parse_request(bytes(buf))
+            if consumed == 0:
+                return
+            del buf[:consumed]
 
     def _try_parse_request(self, data: bytes) -> int:
         """Parse one request packet; returns bytes consumed (0 = need more)."""
@@ -482,3 +493,97 @@ class SimulatedDevice:
                 # tests run with frame_rate_hz unset -> tiny pacing sleep so
                 # the rx thread interleaves; realtime uses the mode's rate
                 time.sleep(min(period, 0.02) if self.cfg.frame_rate_hz == 0 else period)
+
+
+class SerialSimulatedDevice(SimulatedDevice):
+    """The same protocol emulator behind a pty: the driver opens the slave
+    end as a real serial device (termios2 path in native/src/channel.cc),
+    so the SERIAL transport — not just TCP — is exercisable end-to-end.
+
+    ``port_path`` is the /dev/pts/N to hand to ``connect()``.
+    ``unplug()`` closes the master, which surfaces as EIO on the slave —
+    the same failure a yanked USB adapter produces.  Unlike the TCP
+    emulator (whose listener keeps accepting, so the FSM can reconnect),
+    an unplugged pty CANNOT be re-plugged: the kernel owns /dev/pts
+    naming, so a fresh master would appear at a different path.  Use the
+    TCP emulator for reconnect/recovery scenarios.
+
+    fd discipline: the master is nonblocking and every os.read/os.write
+    happens under ``_conn_lock`` after re-checking ``self._master`` is
+    still the fd we selected on — a closed-and-reused fd number can never
+    be touched (unlike sockets, raw fds are silently recycled).
+    """
+
+    def __init__(self, config: Optional[SimConfig] = None) -> None:
+        super().__init__(config)
+        self._master: Optional[int] = None
+        self._slave: Optional[int] = None
+        self.port_path = ""
+
+    def start(self) -> "SerialSimulatedDevice":
+        self._master, self._slave = os.openpty()
+        tty.setraw(self._master)  # no echo/line discipline on the device side
+        os.set_blocking(self._master, False)
+        self.port_path = os.ttyname(self._slave)
+        self._running.set()
+        self.motor_rpm = 0
+        self.commands = []
+        self._accept_thread = threading.Thread(
+            target=self._serial_loop, name="sim_serial", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def _close_listener(self) -> None:
+        if self._slave is not None:
+            try:
+                os.close(self._slave)
+            except OSError:
+                pass
+            self._slave = None
+
+    def unplug(self) -> None:
+        self._streaming.clear()
+        with self._conn_lock:
+            if self._master is not None:
+                try:
+                    os.close(self._master)
+                except OSError:
+                    pass
+                self._master = None
+
+    def _serial_loop(self) -> None:
+        buf = bytearray()
+        while self._running.is_set():
+            with self._conn_lock:
+                fd = self._master
+            if fd is None:
+                return
+            try:
+                r, _, _ = select.select([fd], [], [], 0.2)
+            except OSError:
+                continue  # fd closed under us; loop re-checks _master
+            if not r:
+                continue
+            with self._conn_lock:
+                if self._master != fd:
+                    continue  # unplugged (and fd possibly recycled)
+                try:
+                    chunk = os.read(fd, 256)
+                except BlockingIOError:
+                    continue
+                except OSError:
+                    return
+            if not chunk:
+                return
+            self._feed(buf, chunk)
+
+    def _send(self, data: bytes) -> None:
+        with self._conn_lock:
+            fd = self._master
+            if fd is None:
+                return
+            try:
+                os.write(fd, data)
+            except (BlockingIOError, OSError):
+                pass  # pty buffer full or unplugged: frame dropped, like UART

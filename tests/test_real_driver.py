@@ -199,3 +199,37 @@ class TestHotUnplug:
         before = pub.scan_count
         assert _wait(lambda: pub.scan_count > before + 1, timeout=10.0)
         node.shutdown()
+
+
+class TestSerialTransportE2E:
+    """Full protocol over a pty: the driver's SERIAL channel (termios2)
+    against the emulator — devinfo, mode start, streaming, hot-unplug."""
+
+    def test_serial_connect_stream_unplug(self):
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SerialSimulatedDevice
+
+        sim = SerialSimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(channel_type="serial", motor_warmup_s=0.0)
+            assert drv.connect(sim.port_path, 115200, True)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 600)
+            got = None
+            deadline = time.monotonic() + 15
+            while got is None and time.monotonic() < deadline:
+                got = drv.grab_scan_host(2.0)
+            assert got is not None
+            scan, ts0, dur = got
+            assert len(scan["angle_q14"]) > 0
+            assert dur > 0
+            # serial link: timing desc carries the UART baud for back-dating
+            assert drv._scan_decoder.timing.is_serial
+            assert drv._scan_decoder.timing.baudrate == 115200
+            sim.unplug()  # EIO on the slave, like a yanked USB adapter
+            t0 = time.monotonic()
+            while drv.grab_scan_host(0.5) is not None:
+                assert time.monotonic() - t0 < 10
+            drv.disconnect()
+        finally:
+            sim.stop()
