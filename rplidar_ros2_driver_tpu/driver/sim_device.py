@@ -116,20 +116,29 @@ class SimulatedDevice:
     # lifecycle
     # ------------------------------------------------------------------
 
+    _RX_THREAD_NAME = "sim_accept"
+
     def start(self) -> "SimulatedDevice":
+        """Shared lifecycle: transports implement _open_listener/_rx_loop."""
+        self._open_listener()
+        self._running.set()
+        self.motor_rpm = 0
+        self.commands = []
+        self._accept_thread = threading.Thread(
+            target=self._rx_loop, name=self._RX_THREAD_NAME, daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def _open_listener(self) -> None:
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((self.TARGET, 0))
         self._srv.listen(1)
         self.port = self._srv.getsockname()[1]
-        self._running.set()
-        self.motor_rpm = 0
-        self.commands: list[int] = []
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="sim_accept", daemon=True
-        )
-        self._accept_thread.start()
-        return self
+
+    def _rx_loop(self) -> None:
+        self._accept_loop()
 
     def stop(self) -> None:
         self._running.clear()
@@ -520,19 +529,16 @@ class SerialSimulatedDevice(SimulatedDevice):
         self._slave: Optional[int] = None
         self.port_path = ""
 
-    def start(self) -> "SerialSimulatedDevice":
+    _RX_THREAD_NAME = "sim_serial"
+
+    def _open_listener(self) -> None:
         self._master, self._slave = os.openpty()
         tty.setraw(self._master)  # no echo/line discipline on the device side
         os.set_blocking(self._master, False)
         self.port_path = os.ttyname(self._slave)
-        self._running.set()
-        self.motor_rpm = 0
-        self.commands = []
-        self._accept_thread = threading.Thread(
-            target=self._serial_loop, name="sim_serial", daemon=True
-        )
-        self._accept_thread.start()
-        return self
+
+    def _rx_loop(self) -> None:
+        self._serial_loop()
 
     def _close_listener(self) -> None:
         if self._slave is not None:
@@ -579,11 +585,96 @@ class SerialSimulatedDevice(SimulatedDevice):
             self._feed(buf, chunk)
 
     def _send(self, data: bytes) -> None:
+        """Write the WHOLE frame or (on sustained backpressure) nothing
+        past what's already out: a short nonblocking write must not leave
+        a torn frame desyncing the byte stream, so the remainder is
+        retried with a writability wait until a deadline."""
+        view = memoryview(data)
+        deadline = time.monotonic() + 0.5
+        while len(view):
+            with self._conn_lock:
+                fd = self._master
+                if fd is None:
+                    return
+                try:
+                    n = os.write(fd, view)
+                except BlockingIOError:
+                    n = 0
+                except OSError:
+                    return
+            if n:
+                view = view[n:]
+                continue
+            if time.monotonic() > deadline:
+                return  # reader is gone; stream is torn either way
+            try:
+                select.select([], [fd], [], 0.05)
+            except OSError:
+                return
+
+
+class UdpSimulatedDevice(SimulatedDevice):
+    """The emulator over UDP with connected-pair semantics: the device
+    learns its peer from the first request datagram and streams answers
+    back to it (the reference's UDP channel connects to a fixed device
+    address the same way, sl_udp_channel.cpp:53-58).  ``unplug()`` goes
+    silent (drops the peer) — UDP has no connection to sever, so the
+    failure mode a dead radio link produces is timeouts, not errors.
+    """
+
+    def __init__(self, config: Optional[SimConfig] = None) -> None:
+        super().__init__(config)
+        self._sock: Optional[socket.socket] = None
+        self._peer = None
+
+    _RX_THREAD_NAME = "sim_udp"
+
+    def _open_listener(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((self.TARGET, 0))
+        self._sock.settimeout(0.2)
+        self.port = self._sock.getsockname()[1]
+
+    def _rx_loop(self) -> None:
+        self._udp_loop()
+
+    def _close_listener(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def unplug(self) -> None:
+        self._streaming.clear()
         with self._conn_lock:
-            fd = self._master
-            if fd is None:
+            self._peer = None
+
+    def _udp_loop(self) -> None:
+        buf = bytearray()
+        while self._running.is_set():
+            sock = self._sock
+            if sock is None:
                 return
             try:
-                os.write(fd, data)
-            except (BlockingIOError, OSError):
-                pass  # pty buffer full or unplugged: frame dropped, like UART
+                chunk, addr = sock.recvfrom(2048)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._conn_lock:
+                if self._peer != addr:
+                    self._peer = addr
+                    buf.clear()  # new client: drop any half-parsed request
+            self._feed(buf, chunk)
+
+    def _send(self, data: bytes) -> None:
+        with self._conn_lock:
+            sock, peer = self._sock, self._peer
+        if sock is None or peer is None:
+            return
+        try:
+            sock.sendto(data, peer)
+        except OSError:
+            pass

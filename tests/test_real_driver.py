@@ -233,3 +233,35 @@ class TestSerialTransportE2E:
             drv.disconnect()
         finally:
             sim.stop()
+
+
+class TestUdpTransportE2E:
+    """Full protocol over UDP datagrams through the native UDP channel."""
+
+    def test_udp_connect_stream_silence(self):
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import UdpSimulatedDevice
+
+        sim = UdpSimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="udp", udp_host="127.0.0.1", udp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("udp", 0, True)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 600)
+            got = None
+            deadline = time.monotonic() + 15
+            while got is None and time.monotonic() < deadline:
+                got = drv.grab_scan_host(2.0)
+            assert got is not None
+            assert len(got[0]["angle_q14"]) > 0
+            assert not drv._scan_decoder.timing.is_serial
+            sim.unplug()  # radio dies: silence, grabs must time out
+            t0 = time.monotonic()
+            while drv.grab_scan_host(0.5) is not None:
+                assert time.monotonic() - t0 < 10
+            drv.disconnect()
+        finally:
+            sim.stop()
