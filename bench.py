@@ -53,38 +53,91 @@ MEDIAN_BACKEND = "pallas"
 CAPACITY = 4096
 
 
-def _host_scans(n: int) -> list[dict[str, np.ndarray]]:
+def _host_scans(n: int, points: int = POINTS) -> list[dict[str, np.ndarray]]:
     """Pre-generate n raw host scans (numpy — as arriving from the unpacker)."""
     rng = np.random.default_rng(0)
     out = []
     for k in range(n):
-        angle = ((np.arange(POINTS) * 65536) // POINTS).astype(np.int32)
-        dist_m = 2.0 + 0.5 * np.sin(np.arange(POINTS) * (2 * np.pi / POINTS) + 0.1 * k)
-        dist_m += rng.normal(0, 0.01, POINTS)
+        angle = ((np.arange(points) * 65536) // points).astype(np.int32)
+        dist_m = 2.0 + 0.5 * np.sin(np.arange(points) * (2 * np.pi / points) + 0.1 * k)
+        dist_m += rng.normal(0, 0.01, points)
         out.append(
             {
                 "angle_q14": angle,
                 "dist_q2": (dist_m * 4000.0).astype(np.int32),
-                "quality": np.full(POINTS, 190, np.int32),
+                "quality": np.full(points, 190, np.int32),
             }
         )
     return out
 
 
-def main() -> None:
+# Graded configs (BASELINE.json "configs"): (points/rev, FilterConfig kwargs)
+# or "passthrough" for config 1 (raw LaserScan conversion, no chain).
+GRADED = {
+    1: ("passthrough", 360, {}),     # A1M8 Standard raw LaserScan
+    2: ("chain", 3200, dict(window=1, enable_median=False, enable_voxel=False)),
+    3: ("chain", 920, dict(window=1, enable_median=False, enable_voxel=False)),
+    4: ("chain", 800, dict(window=16, enable_voxel=False)),
+    5: ("chain", POINTS, dict(window=WINDOW)),  # the headline (default)
+}
+
+
+def bench_passthrough(points: int) -> dict:
+    """Config 1: raw ScanBatch -> LaserScan conversion kernel only."""
+    from rplidar_ros2_driver_tpu.core.types import ScanBatch
+    from rplidar_ros2_driver_tpu.ops.laserscan import to_laserscan
+
+    device = jax.devices()[0]
+    rng = np.random.default_rng(0)
+    batches = [
+        jax.device_put(
+            ScanBatch.from_numpy(
+                ((np.arange(points) * 65536) // points).astype(np.int32),
+                (rng.uniform(0.2, 11.0, points) * 4000).astype(np.int32),
+                np.full(points, 190, np.int32),
+            ),
+            device,
+        )
+        for _ in range(8)
+    ]
+    for b in batches:
+        out = to_laserscan(b, 0.1, 12.0, scan_processing=False, inverted=False, is_new_type=False)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for k in range(ITERS):
+        out = to_laserscan(
+            batches[k % len(batches)], 0.1, 12.0,
+            scan_processing=False, inverted=False, is_new_type=False,
+        )
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "a1m8_passthrough_scans_per_sec",
+        "value": round(ITERS / dt, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(ITERS / dt / BASELINE_SCANS_PER_SEC, 3),
+        "points_per_scan": points,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
+def main(config: int = 5) -> None:
+    kind, points, over = GRADED[config]
+    if kind == "passthrough":
+        print(json.dumps(bench_passthrough(points)))
+        return
     cfg = FilterConfig(
-        window=WINDOW, beams=BEAMS, grid=GRID, cell_m=0.25,
-        median_backend=MEDIAN_BACKEND,
+        beams=BEAMS, grid=GRID, cell_m=0.25, median_backend=MEDIAN_BACKEND, **over
     )
     device = jax.devices()[0]
     state = jax.device_put(FilterState.create(cfg.window, cfg.beams, cfg.grid), device)
-    scans = _host_scans(32)
+    scans = _host_scans(32, points)
     packed = [
         (
             pack_host_scan_compact(
                 s["angle_q14"], s["dist_q2"], s["quality"], None, CAPACITY
             )[0],
-            jax.device_put(jnp.asarray(POINTS, jnp.int32), device),
+            jax.device_put(jnp.asarray(points, jnp.int32), device),
         )
         for s in scans
     ]
@@ -116,17 +169,22 @@ def main() -> None:
         lat[k] = time.perf_counter() - t0
     sync_p99_ms = float(np.percentile(lat, 99) * 1e3)
 
+    metric = (
+        "denseboost64_filter_chain_scans_per_sec"
+        if config == 5
+        else f"graded_config{config}_scans_per_sec"
+    )
     print(
         json.dumps(
             {
-                "metric": "denseboost64_filter_chain_scans_per_sec",
+                "metric": metric,
                 "value": round(scans_per_sec, 2),
                 "unit": "scans/s",
                 "vs_baseline": round(scans_per_sec / BASELINE_SCANS_PER_SEC, 3),
                 "ms_per_scan_sustained": round(1e3 / scans_per_sec, 3),
                 "sync_p99_ms": round(sync_p99_ms, 3),
-                "points_per_scan": POINTS,
-                "window": WINDOW,
+                "points_per_scan": points,
+                "window": cfg.window,
                 "device": str(device.platform),
             }
         )
@@ -134,4 +192,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--config",
+        type=int,
+        default=5,
+        choices=sorted(GRADED),
+        help="graded BASELINE config (1=A1M8 passthrough .. 5=64-scan voxel; default 5 = headline)",
+    )
+    main(ap.parse_args().config)
