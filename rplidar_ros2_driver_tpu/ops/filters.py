@@ -28,7 +28,10 @@ import jax.numpy as jnp
 from rplidar_ros2_driver_tpu.core.types import ScanBatch
 
 TWO_PI = 2.0 * jnp.pi
-_INT_INF = jnp.int32(0x7FFFFFFF)
+# plain Python int (not jnp.int32): a module-scope jnp constant would
+# initialize a JAX backend at import time, defeating late platform selection
+# (tests/conftest.py, __graft_entry__.dryrun_multichip)
+_INT_INF = 0x7FFFFFFF
 
 
 @jax.tree_util.register_dataclass

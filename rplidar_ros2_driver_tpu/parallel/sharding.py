@@ -43,7 +43,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     temporal_median,
 )
 
-_INT_INF = jnp.int32(0x7FFFFFFF)
+_INT_INF = 0x7FFFFFFF  # plain int: no jnp constants at import (see ops/filters.py)
 TWO_PI = 2.0 * jnp.pi
 
 
