@@ -93,10 +93,21 @@ int rpl_transceiver_send(rpl_transceiver* t, const uint8_t* pkt, size_t len);
 int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
                                  uint8_t* ans_type, int* is_loop,
                                  uint8_t* payload, size_t cap);
+/* Same, plus the frame's arrival time (steady-clock seconds, captured in
+ * the rx thread at the read that completed the frame — immune to consumer
+ * queue-drain latency; feeds the per-node timestamp back-dating). */
+int rpl_transceiver_wait_message_ts(rpl_transceiver* t, int timeout_ms,
+                                    uint8_t* ans_type, int* is_loop,
+                                    double* rx_ts,
+                                    uint8_t* payload, size_t cap);
 /* Drop queued messages and reset decode state (scan-mode changes). */
 void rpl_transceiver_reset_decoder(rpl_transceiver* t);
 /* Nonzero once the rx thread observed a channel error (hot-unplug). */
 int rpl_transceiver_error(const rpl_transceiver* t);
+/* Scheduling class the rx thread achieved (best-effort PRIORITY_HIGH,
+ * ref arch/linux/thread.hpp:64-120): 2 = SCHED_RR, 1 = nice boost,
+ * 0 = default (unprivileged), -1 = rx thread not started yet. */
+int rpl_transceiver_rx_priority(const rpl_transceiver* t);
 
 #ifdef __cplusplus
 }

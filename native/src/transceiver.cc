@@ -11,7 +11,13 @@
 
 #include "rpl_native.h"
 
+#include <sched.h>
+#include <sys/resource.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
@@ -24,11 +30,40 @@ namespace {
 struct Message {
   uint8_t ans_type;
   bool is_loop;
+  double rx_ts;  // steady-clock seconds at the read that completed the frame
   std::vector<uint8_t> payload;
 };
 
+double SteadyNowSeconds() {
+  // CLOCK_MONOTONIC explicitly (not steady_clock) so the value is directly
+  // comparable with Python's time.monotonic() on the consumer side
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + 1e-9 * static_cast<double>(ts.tv_nsec);
+}
+
 constexpr size_t kReadChunk = 4096;
 constexpr size_t kMaxQueued = 8192;  // bound memory if the consumer stalls
+
+// Best-effort elevation of the calling thread to the reference's
+// PRIORITY_HIGH: SCHED_RR at the minimum RR priority, SCHED_RESET_ON_FORK
+// so children do not inherit it (Thread::SetSelfPriority,
+// arch/linux/thread.hpp:64-120).  Unprivileged processes get EPERM; fall
+// back silently to a negative nice (also usually EPERM) and finally to the
+// default policy — latency under host load degrades gracefully instead of
+// failing startup.  Returns 2 (SCHED_RR), 1 (nice boost) or 0 (default).
+int ElevateSelfToHighPriority() {
+  const pid_t tid = static_cast<pid_t>(syscall(SYS_gettid));
+  sched_param param{};
+  param.sched_priority = sched_get_priority_min(SCHED_RR);
+  if (sched_setscheduler(tid, SCHED_RR | SCHED_RESET_ON_FORK, &param) == 0) {
+    return 2;
+  }
+  if (setpriority(PRIO_PROCESS, tid, -10) == 0) {
+    return 1;
+  }
+  return 0;
+}
 
 }  // namespace
 
@@ -43,15 +78,21 @@ struct rpl_transceiver {
   std::condition_variable cv;
   std::deque<Message> queue;
   bool reset_requested = false;
+  std::atomic<int> rx_priority{-1};  // -1 until the rx thread reports
 
   void RxLoop();
 };
 
 void rpl_transceiver::RxLoop() {
+  rx_priority.store(ElevateSelfToHighPriority(), std::memory_order_relaxed);
   std::vector<uint8_t> buf(kReadChunk);
   std::vector<uint8_t> payload(64 * 1024);
   while (running.load(std::memory_order_relaxed)) {
     int n = rpl_channel_read(channel, buf.data(), buf.size(), 1000);
+    // arrival anchor for every frame completed by this read: taken HERE,
+    // in the rx thread, so consumer-side queue draining cannot compress
+    // inter-frame spacing (the timestamp back-dating models depend on it)
+    const double rx_ts = SteadyNowSeconds();
     if (n == RPL_TIMEOUT) continue;
     if (n <= 0) {
       if (!running.load(std::memory_order_relaxed)) break;
@@ -87,6 +128,7 @@ void rpl_transceiver::RxLoop() {
         Message m;
         m.ans_type = ans_type;
         m.is_loop = is_loop != 0;
+        m.rx_ts = rx_ts;
         m.payload.assign(payload.begin(), payload.begin() + plen);
         queue.push_back(std::move(m));
         pushed = true;
@@ -140,9 +182,10 @@ int rpl_transceiver_send(rpl_transceiver* t, const uint8_t* pkt, size_t len) {
   return rpl_channel_write(t->channel, pkt, len);
 }
 
-int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
-                                 uint8_t* ans_type, int* is_loop,
-                                 uint8_t* payload, size_t cap) {
+int rpl_transceiver_wait_message_ts(rpl_transceiver* t, int timeout_ms,
+                                    uint8_t* ans_type, int* is_loop,
+                                    double* rx_ts,
+                                    uint8_t* payload, size_t cap) {
   if (!t) return RPL_ERR;
   std::unique_lock<std::mutex> lk(t->mu);
   if (t->queue.empty()) {
@@ -160,10 +203,18 @@ int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
   if (m.payload.size() > cap) return RPL_TOOSMALL;
   *ans_type = m.ans_type;
   *is_loop = m.is_loop ? 1 : 0;
+  if (rx_ts) *rx_ts = m.rx_ts;
   if (!m.payload.empty()) std::memcpy(payload, m.payload.data(), m.payload.size());
   const int n = static_cast<int>(m.payload.size());
   t->queue.pop_front();
   return n;
+}
+
+int rpl_transceiver_wait_message(rpl_transceiver* t, int timeout_ms,
+                                 uint8_t* ans_type, int* is_loop,
+                                 uint8_t* payload, size_t cap) {
+  return rpl_transceiver_wait_message_ts(t, timeout_ms, ans_type, is_loop,
+                                         nullptr, payload, cap);
 }
 
 void rpl_transceiver_reset_decoder(rpl_transceiver* t) {
@@ -175,6 +226,10 @@ void rpl_transceiver_reset_decoder(rpl_transceiver* t) {
 
 int rpl_transceiver_error(const rpl_transceiver* t) {
   return (t && t->channel_error.load()) ? 1 : 0;
+}
+
+int rpl_transceiver_rx_priority(const rpl_transceiver* t) {
+  return t ? t->rx_priority.load(std::memory_order_relaxed) : -1;
 }
 
 }  // extern "C"

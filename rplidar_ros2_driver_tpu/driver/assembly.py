@@ -35,8 +35,8 @@ class ScanAssembler:
         self._event = threading.Event()
         self._pending: Optional[dict] = None      # newest complete scan
         self._partial: list[np.ndarray] = []      # [ (k,4) int32 chunks ]
+        self._partial_ts_chunks: list[np.ndarray] = []  # [ (k,) f64 per-node ts ]
         self._partial_len = 0
-        self._partial_ts = 0.0                    # revolution-begin timestamp
         self._seen_first_sync = False
         self.scans_completed = 0
         self.scans_dropped = 0                    # overwritten before grab
@@ -45,6 +45,7 @@ class ScanAssembler:
         with self._lock:
             self._pending = None
             self._partial = []
+            self._partial_ts_chunks = []
             self._partial_len = 0
             self._seen_first_sync = False
             self._event.clear()
@@ -57,7 +58,7 @@ class ScanAssembler:
         dist_q2: np.ndarray,
         quality: np.ndarray,
         flag: np.ndarray,
-        ts: Optional[float] = None,
+        ts=None,
     ) -> int:
         """Feed a flat, time-ordered batch of valid nodes.
 
@@ -65,10 +66,13 @@ class ScanAssembler:
         with flag bit0 set starts a new revolution (the reference swaps
         buffers on it, sl_lidar_driver.cpp:279-294).
 
-        ``ts`` is the (already back-dated, protocol/timing.py) measurement
-        time of the batch's first node; it stamps revolution boundaries the
-        way the reference records per-scan begin timestamps
-        (sl_lidar_driver.cpp:293).  Defaults to now.
+        ``ts`` carries the (already back-dated, protocol/timing.py)
+        measurement times: either a (n,) float64 array with one timestamp
+        PER NODE (the exact path — revolution boundaries inside the batch
+        each get their own begin time, correct during RPM transients), or a
+        scalar stamping the whole batch (per-frame approximation), or None
+        for "now".  The reference records per-scan begin timestamps the
+        same way (sl_lidar_driver.cpp:293) but from per-node stamps.
         """
         n = len(angle_q14)
         if n == 0:
@@ -82,29 +86,37 @@ class ScanAssembler:
             ],
             axis=1,
         )
-        sync_pos = np.flatnonzero(stacked[:, 3] & 1)
         if ts is None:
-            ts = time.monotonic()
+            ts_arr = np.full(n, time.monotonic(), np.float64)
+        elif np.ndim(ts) == 0:
+            ts_arr = np.full(n, float(ts), np.float64)
+        else:
+            ts_arr = np.asarray(ts, np.float64)
+            if ts_arr.shape != (n,):
+                raise ValueError(f"ts shape {ts_arr.shape} != ({n},)")
+        sync_pos = np.flatnonzero(stacked[:, 3] & 1)
         completed = 0
         with self._lock:
             start = 0
             for pos in sync_pos:
                 if self._seen_first_sync:
-                    self._append_partial(stacked[start:pos])
-                    self._close_partial(end_ts=ts)
+                    self._append_partial(stacked[start:pos], ts_arr[start:pos])
+                    # the sync node opening the NEXT revolution marks the
+                    # end of this one
+                    self._close_partial(end_ts=ts_arr[pos])
                     completed += 1
                 # data before the very first sync is dropped
                 self._partial = []
+                self._partial_ts_chunks = []
                 self._partial_len = 0
-                self._partial_ts = ts
                 self._seen_first_sync = True
                 start = pos
-            self._append_partial(stacked[start:])
+            self._append_partial(stacked[start:], ts_arr[start:])
             if completed:
                 self._event.set()
         return completed
 
-    def _append_partial(self, chunk: np.ndarray) -> None:
+    def _append_partial(self, chunk: np.ndarray, ts_chunk: np.ndarray) -> None:
         if not self._seen_first_sync or len(chunk) == 0:
             return
         room = self._max_nodes - self._partial_len
@@ -112,24 +124,29 @@ class ScanAssembler:
             return  # scan overflow: excess nodes dropped (cap 8192)
         chunk = chunk[:room]
         self._partial.append(chunk)
+        self._partial_ts_chunks.append(ts_chunk[:room])
         self._partial_len += len(chunk)
 
     def _close_partial(self, end_ts: float = 0.0) -> None:
         if self._partial_len == 0:
             return
         scan = np.concatenate(self._partial, axis=0)
+        node_ts = np.concatenate(self._partial_ts_chunks)
         if self._pending is not None:
             self.scans_dropped += 1  # consumer lagging: newest wins
+        ts0 = float(node_ts[0])
         self._pending = {
             "angle_q14": scan[:, 0],
             "dist_q2": scan[:, 1],
             "quality": scan[:, 2],
             "flag": scan[:, 3],
-            "ts0": self._partial_ts,
-            "duration": max(end_ts - self._partial_ts, 0.0),
+            "node_ts": node_ts,
+            "ts0": ts0,
+            "duration": max(end_ts - ts0, 0.0),
         }
         self.scans_completed += 1
         self._partial = []
+        self._partial_ts_chunks = []
         self._partial_len = 0
 
     # -- consumer side -----------------------------------------------------

@@ -9,9 +9,10 @@ sl_lidar_driver.cpp), re-composed for this framework:
     sl_lidar_driver.h:260-274)
   * request plane: CommandEngine (protocol/engine.py) + conf protocol
     (protocol/conf.py)
-  * scan plane: measurement payloads stream off the pump thread into the
-    per-format scalar decoders (ops/unpack_ref.py — golden-tested against
-    the vectorized JAX unpackers) and assemble into revolutions
+  * scan plane: measurement frames stream off the pump thread in natural
+    runs into the vectorized batch decoder (driver/decode.BatchScanDecoder
+    over ops/unpack.py, CPU-pinned jit; ops/unpack_ref.py is the scalar
+    golden oracle) and assemble into revolutions
     (driver/assembly.ScanAssembler, the ScanDataHolder equivalent)
   * strategy: model detection via models/tables.detect_profile; start_motor
     follows the reference's two strategies (src/lidar_driver_wrapper.cpp:
@@ -28,22 +29,23 @@ import threading
 import time
 from typing import Callable, Optional
 
-import numpy as np
-
 from rplidar_ros2_driver_tpu.core.results import DeviceHealth
 from rplidar_ros2_driver_tpu.core.types import ScanBatch
 from rplidar_ros2_driver_tpu.driver.assembly import RawNodeHolder, ScanAssembler
+from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
 from rplidar_ros2_driver_tpu.driver.interface import LidarDriverInterface
 from rplidar_ros2_driver_tpu.models.tables import (
     A2A3_MINUM_MAJOR_ID,
     DeviceInfo,
     DriverProfile,
+    MajorType,
     MotorCtrlSupport,
     ProtocolType,
     detect_profile,
     has_builtin_motor_ctrl,
+    major_type,
+    native_baudrate,
 )
-from rplidar_ros2_driver_tpu.ops import unpack_ref
 from rplidar_ros2_driver_tpu.protocol import conf as confproto
 from rplidar_ros2_driver_tpu.protocol.constants import (
     ACC_BOARD_FLAG_MOTOR_CTRL_SUPPORT_MASK,
@@ -78,77 +80,6 @@ def _default_transceiver_factory(
     return NativeTransceiver(ch)
 
 
-class _ScanDecoder:
-    """Routes measurement payloads to the right per-format scalar decoder
-    and pushes decoded nodes into the assembler (the role of the reference's
-    data-unpacker engine, dataunpacker.cpp:123-202, with auto-select on
-    answer-type change + reset)."""
-
-    def __init__(
-        self, assembler: ScanAssembler, raw_holder: Optional[RawNodeHolder] = None
-    ) -> None:
-        self._assembler = assembler
-        self._raw_holder = raw_holder
-        self._active_ans: Optional[int] = None
-        self._decoder = None
-        # updated by the driver on scan start (the reference's
-        # _updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554)
-        self.timing = timingmod.TimingDesc()
-        # optional capture tee (replay.FrameRecorder)
-        self.recorder = None
-
-    def reset(self) -> None:
-        self._active_ans = None
-        self._decoder = None
-
-    def _make(self, ans_type: int):
-        if ans_type == Ans.MEASUREMENT_CAPSULED:
-            return unpack_ref.CapsuleDecoder()
-        if ans_type == Ans.MEASUREMENT_CAPSULED_ULTRA:
-            return unpack_ref.UltraCapsuleDecoder()
-        if ans_type == Ans.MEASUREMENT_DENSE_CAPSULED:
-            return unpack_ref.DenseCapsuleDecoder()
-        if ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
-            return unpack_ref.UltraDenseCapsuleDecoder()
-        return None  # normal nodes / HQ capsules handled inline
-
-    def on_measurement(self, ans_type: int, payload: bytes) -> None:
-        rec = self.recorder
-        if rec is not None:
-            rec.write(ans_type, payload, time.monotonic())
-        if ans_type != self._active_ans:
-            # answer type changed: new scan mode — reset decode state
-            self._active_ans = ans_type
-            self._decoder = self._make(ans_type)
-            self._assembler.reset()
-        nodes: list[unpack_ref.HqNode] = []
-        if ans_type == Ans.MEASUREMENT:
-            node = unpack_ref.decode_normal_node(payload)
-            if node is not None:
-                nodes = [node]
-        elif ans_type == Ans.MEASUREMENT_HQ:
-            decoded, crc_ok = unpack_ref.decode_hq_capsule(payload)
-            if crc_ok:
-                nodes = decoded
-        elif self._decoder is not None:
-            nodes, _new_scan = self._decoder.decode(payload)
-        if not nodes:
-            return
-        angle = np.fromiter((n.angle_q14 for n in nodes), np.int32, len(nodes))
-        dist = np.fromiter((n.dist_q2 for n in nodes), np.int32, len(nodes))
-        quality = np.fromiter((n.quality for n in nodes), np.int32, len(nodes))
-        flag = np.fromiter((n.flag for n in nodes), np.int32, len(nodes))
-        # back-date to measurement time (protocol/timing.py delay models)
-        ts = time.monotonic() - 1e-6 * timingmod.frame_rx_delay_us(
-            ans_type, self.timing
-        )
-        self._assembler.push_nodes(angle, dist, quality, flag, ts=ts)
-        if self._raw_holder is not None:
-            # same feed, pre-assembly (ref pushes to both holders,
-            # sl_lidar_driver.cpp:1645-1648)
-            self._raw_holder.push(np.stack([angle, dist, quality, flag], axis=1))
-
-
 class RealLidarDriver(LidarDriverInterface):
     """Hardware driver: native transport + command engine + scan decode."""
 
@@ -174,7 +105,7 @@ class RealLidarDriver(LidarDriverInterface):
         self._engine: Optional[CommandEngine] = None
         self._assembler = ScanAssembler()
         self._raw_holder = RawNodeHolder()
-        self._scan_decoder = _ScanDecoder(self._assembler, self._raw_holder)
+        self._scan_decoder = BatchScanDecoder(self._assembler, self._raw_holder)
         self._lock = threading.RLock()
         self._connected = False
         self._scanning = False
@@ -202,7 +133,9 @@ class RealLidarDriver(LidarDriverInterface):
             except Exception as e:
                 log.error("channel creation failed: %s", e)
                 return False
-            engine = CommandEngine(tx, on_measurement=self._scan_decoder.on_measurement)
+            engine = CommandEngine(
+                tx, on_measurement_batch=self._scan_decoder.on_measurement_batch
+            )
             if not engine.start():
                 log.warning("could not open %s channel on %s", self._channel_type, port)
                 return False
@@ -315,6 +248,9 @@ class RealLidarDriver(LidarDriverInterface):
         # boost variants; setting EXPRESS_FLAG_BOOST here could make real
         # firmware stream a format that mismatches the enumerated ans_type.
         self._update_timing_desc(mode.us_per_sample)
+        # warm the decode-kernel jit cache for this mode's wire format before
+        # the stream starts, so the pump thread never stalls on a compile
+        self._scan_decoder.precompile(mode.ans_type)
         self._begin_streaming()
         payload = struct.pack("<BHH", mode.id, 0, 0)
         if not self._engine.send_only(Cmd.EXPRESS_SCAN, payload):
@@ -335,7 +271,8 @@ class RealLidarDriver(LidarDriverInterface):
             target_rpm = rpm if rpm > 0 else DEFAULT_RPM
             self.set_motor_speed(target_rpm)
             time.sleep(self._legacy_warmup_s)
-            self._update_timing_desc(timingmod.LEGACY_SAMPLE_DURATION_US)
+            self._update_timing_desc(self._legacy_sample_duration_us())
+            self._scan_decoder.precompile(Ans.MEASUREMENT)
             self._begin_streaming()
             if not self._engine.send_only(Cmd.FORCE_SCAN):
                 return False
@@ -346,10 +283,12 @@ class RealLidarDriver(LidarDriverInterface):
 
     def _start_old_type(self, rpm: int) -> bool:
         # legacy: fixed 600 RPM, brief spin-up, plain SCAN
-        # (src/lidar_driver_wrapper.cpp:262-268)
+        # (src/lidar_driver_wrapper.cpp:262-268); sample duration queried
+        # from the device (startScanNormal_commonpath, :620-661)
         self.set_motor_speed(DEFAULT_RPM)
         time.sleep(self._legacy_warmup_s)
-        self._update_timing_desc(timingmod.LEGACY_SAMPLE_DURATION_US)
+        self._update_timing_desc(self._legacy_sample_duration_us())
+        self._scan_decoder.precompile(Ans.MEASUREMENT)
         self._begin_streaming()
         if not self._engine.send_only(Cmd.SCAN):
             return False
@@ -360,12 +299,38 @@ class RealLidarDriver(LidarDriverInterface):
 
     def _update_timing_desc(self, us_per_sample: Optional[float]) -> None:
         """Push link+mode timing into the decoder for timestamp back-dating
-        (_updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554)."""
+        (_updateTimingDesc -> unpacker context, sl_lidar_driver.cpp:1538-1554):
+        the device model's NATIVE baud (sl_lidar_driver.cpp:1540) drives the
+        transmission-delay model, falling back to the link baud, then to the
+        per-format defaults; linkage delay is 0 like the reference (:1547)."""
+        native = 0
+        if self.device_info is not None:
+            native = native_baudrate(
+                self.device_info.model, self.device_info.hardware_version
+            )
         self._scan_decoder.timing = timingmod.TimingDesc(
             sample_duration_us=us_per_sample or timingmod.LEGACY_SAMPLE_DURATION_US,
-            baudrate=self._baudrate,
+            native_baudrate=native or self._baudrate,
             is_serial=self._channel_type == "serial",
         )
+
+    def _legacy_sample_duration_us(self) -> float:
+        """Sample duration for legacy (non-conf) scan startup, queried from
+        the device via GET_SAMPLERATE (cmd 0x59 -> ans 0x15, two u16 LE:
+        std/express µs) — _getLegacySampleDuration_uS,
+        sl_lidar_driver.cpp:1556-1599.  Very old A-series firmware
+        (< 1.17) predates the command and always gets the 476 µs default."""
+        if self.device_info is not None:
+            is_a_series = major_type(self.device_info.model) is MajorType.A_SERIES
+            if is_a_series and self.device_info.firmware_version < ((0x1 << 8) | 17):
+                return timingmod.LEGACY_SAMPLE_DURATION_US
+        ans = self._engine.request(
+            Cmd.GET_SAMPLERATE, Ans.SAMPLE_RATE, timeout_s=1.0
+        )
+        if ans is None or len(ans) < 4:
+            return timingmod.LEGACY_SAMPLE_DURATION_US
+        std_us, _express_us = struct.unpack_from("<HH", ans)
+        return float(std_us) or timingmod.LEGACY_SAMPLE_DURATION_US
 
     def _begin_streaming(self) -> None:
         self._engine.send_only(Cmd.STOP)

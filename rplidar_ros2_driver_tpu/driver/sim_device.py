@@ -65,7 +65,10 @@ DEFAULT_MODES = [
     SimScanMode(1, "DenseBoost", Ans.MEASUREMENT_DENSE_CAPSULED, 31.25, 40.0),
     SimScanMode(2, "Sensitivity", Ans.MEASUREMENT_CAPSULED, 63.0, 25.0),
     SimScanMode(3, "UltraBoost", Ans.MEASUREMENT_CAPSULED_ULTRA, 42.0, 30.0),
-    SimScanMode(4, "UltraDense", Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED, 20.0, 40.0),
+    # us_per_sample must keep the implied spin rate (1e6 / (us * points_per_rev))
+    # under the unpacker's 100 Hz angle-jump ceiling for a 32-cabin frame
+    # (handler_capsules.cpp:968): with 400 pts/rev, 60 us -> ~42 Hz.
+    SimScanMode(4, "UltraDense", Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED, 60.0, 40.0),
     SimScanMode(5, "HQ", Ans.MEASUREMENT_HQ, 32.0, 40.0),
 ]
 
@@ -88,6 +91,9 @@ class SimConfig:
     max_rpm: int = 1200
     desired_rpm: int = 600
     desired_pwm: int = 660
+    # legacy GET_SAMPLERATE answer (std/express µs)
+    std_sample_us: int = 476
+    express_sample_us: int = 238
     # network identity (MAC / static-IP conf keys)
     mac: bytes = b"\xaa\xbb\xcc\xdd\xee\xff"
     ip_conf: bytes = bytes([192, 168, 11, 2, 255, 255, 255, 0, 192, 168, 11, 1])
@@ -293,6 +299,17 @@ class SimulatedDevice:
         elif cmd == Cmd.GET_ACC_BOARD_FLAG:
             flag = 0x1 if self.cfg.acc_board_pwm else 0x0
             self._answer(Ans.ACC_BOARD_FLAG, struct.pack("<I", flag))
+        elif cmd == Cmd.GET_SAMPLERATE:
+            # legacy sample-rate query (cmd 0x59 -> ans 0x15): two u16 LE,
+            # std/express µs (sl_lidar_driver.cpp:1556-1599)
+            self._answer(
+                Ans.SAMPLE_RATE,
+                struct.pack(
+                    "<HH",
+                    int(self.cfg.std_sample_us),
+                    int(self.cfg.express_sample_us),
+                ),
+            )
         elif cmd == Cmd.GET_LIDAR_CONF:
             self._handle_conf(payload)
         elif cmd == Cmd.SET_LIDAR_CONF:
@@ -411,6 +428,11 @@ class SimulatedDevice:
         ppr = self.cfg.points_per_rev
         idx = 0  # global point index
         first = True
+        # absolute-deadline pacing: per-frame relative sleeps accumulate
+        # scheduler overhead (~0.1-1 ms each), which at 800 fps would run
+        # ~10-20% slow — pace against a running deadline instead
+        pace = min(period, 0.02) if self.cfg.frame_rate_hz == 0 else period
+        next_t = time.monotonic()
         while self._streaming.is_set() and self._running.is_set():
             rev, pos = divmod(idx, ppr)
             theta = 360.0 * pos / ppr
@@ -498,10 +520,13 @@ class SimulatedDevice:
             self._send(frame)
             idx += pts_per_frame
             first = False
-            if period > 0:
-                # tests run with frame_rate_hz unset -> tiny pacing sleep so
-                # the rx thread interleaves; realtime uses the mode's rate
-                time.sleep(min(period, 0.02) if self.cfg.frame_rate_hz == 0 else period)
+            if pace > 0:
+                next_t += pace
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                elif delay < -1.0:
+                    next_t = time.monotonic()  # fell far behind: resync
 
 
 class SerialSimulatedDevice(SimulatedDevice):

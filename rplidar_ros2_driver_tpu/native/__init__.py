@@ -86,9 +86,17 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_size_t,
     ]
+    lib.rpl_transceiver_wait_message_ts.restype = ctypes.c_int
+    lib.rpl_transceiver_wait_message_ts.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+        u8p, ctypes.c_size_t,
+    ]
     lib.rpl_transceiver_reset_decoder.argtypes = [ctypes.c_void_p]
     lib.rpl_transceiver_error.restype = ctypes.c_int
     lib.rpl_transceiver_error.argtypes = [ctypes.c_void_p]
+    lib.rpl_transceiver_rx_priority.restype = ctypes.c_int
+    lib.rpl_transceiver_rx_priority.argtypes = [ctypes.c_void_p]
     return lib
 
 

@@ -159,12 +159,23 @@ class NativeTransceiver:
 
     def wait_message(self, timeout_ms: int = 1000) -> Optional[tuple[int, bytes, bool]]:
         """None on timeout; raises ChannelError if the link died."""
+        got = self.wait_message_ts(timeout_ms)
+        return got[:3] if got is not None else None
+
+    def wait_message_ts(
+        self, timeout_ms: int = 1000
+    ) -> Optional[tuple[int, bytes, bool, float]]:
+        """Like wait_message plus the frame's rx-thread arrival time
+        (CLOCK_MONOTONIC seconds — comparable with time.monotonic()); the
+        anchor for per-node timestamp back-dating, immune to consumer
+        queue-drain latency."""
         ans_type = ctypes.c_uint8()
         is_loop = ctypes.c_int()
+        rx_ts = ctypes.c_double()
         payload = (ctypes.c_uint8 * _MAX_PAYLOAD)()
-        n = self._lib.rpl_transceiver_wait_message(
+        n = self._lib.rpl_transceiver_wait_message_ts(
             self._h, timeout_ms, ctypes.byref(ans_type), ctypes.byref(is_loop),
-            payload, _MAX_PAYLOAD,
+            ctypes.byref(rx_ts), payload, _MAX_PAYLOAD,
         )
         if n == RPL_TIMEOUT:
             return None
@@ -172,7 +183,10 @@ class NativeTransceiver:
             raise ChannelError("channel closed or errored")
         if n == RPL_TOOSMALL or n < 0:
             raise ChannelError(f"receive failed (rc={n})")
-        return int(ans_type.value), bytes(payload[:n]), bool(is_loop.value)
+        return (
+            int(ans_type.value), bytes(payload[:n]), bool(is_loop.value),
+            float(rx_ts.value),
+        )
 
     def reset_decoder(self) -> None:
         self._lib.rpl_transceiver_reset_decoder(self._h)
@@ -185,6 +199,13 @@ class NativeTransceiver:
     @property
     def had_error(self) -> bool:
         return bool(self._lib.rpl_transceiver_error(self._h))
+
+    @property
+    def rx_priority(self) -> int:
+        """Scheduling class the rx thread achieved (best-effort
+        PRIORITY_HIGH, ref arch/linux/thread.hpp:64-120): 2 = SCHED_RR,
+        1 = nice boost, 0 = default policy, -1 = not started yet."""
+        return int(self._lib.rpl_transceiver_rx_priority(self._h))
 
     def __del__(self) -> None:
         h = getattr(self, "_h", None)

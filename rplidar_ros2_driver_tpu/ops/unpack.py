@@ -414,7 +414,9 @@ def _ud_smooth(
         new_carry = jnp.where(sk, carry, out)
         return new_carry, out
 
-    _, out = jax.lax.scan(step, last_dist, (dist_raw, scale, skip), unroll=32)
+    # unroll=8 beats 32 on both compile time (~15x) and CPU runtime (~10x)
+    # for the 64-sample-per-frame stream shapes the live decoder feeds
+    _, out = jax.lax.scan(step, last_dist, (dist_raw, scale, skip), unroll=8)
     return out
 
 

@@ -38,8 +38,18 @@ class TransceiverLike(Protocol):
     def had_error(self) -> bool: ...
 
 
-# measurement callback: (ans_type, payload)
+# measurement callbacks: per-payload (ans_type, payload) or batched
+# (ans_type, [(payload, rx_monotonic_ts), ...]) — the batched form is the
+# production decode path: the pump drains every already-decoded message in
+# one go so the vectorized unpackers see whole frame runs (natural batching,
+# zero added latency — nothing ever *waits* for a batch to fill).
 MeasurementHandler = Callable[[int, bytes], None]
+MeasurementBatchHandler = Callable[[int, list], None]
+
+# Upper bound on one delivered measurement run: bounds decode-batch memory
+# and keeps request/response answers flowing between runs under sustained
+# streaming.
+_MAX_MEASUREMENT_BATCH = 64
 
 
 class CommandEngine:
@@ -47,9 +57,11 @@ class CommandEngine:
         self,
         transceiver: TransceiverLike,
         on_measurement: Optional[MeasurementHandler] = None,
+        on_measurement_batch: Optional[MeasurementBatchHandler] = None,
     ) -> None:
         self._tx = transceiver
         self._on_measurement = on_measurement
+        self._on_measurement_batch = on_measurement_batch
         self._op_lock = threading.RLock()
         self._pending_lock = threading.Lock()
         self._pending_ans: Optional[int] = None
@@ -136,32 +148,73 @@ class CommandEngine:
     def _pump_loop(self) -> None:
         from rplidar_ros2_driver_tpu.native.runtime import ChannelError
 
-        while self._running.is_set():
+        # prefer the rx-thread-stamped receive API: frame arrival times then
+        # come from the native rx thread (CLOCK_MONOTONIC), immune to the
+        # drain latency of this pump — a run of frames popped back-to-back
+        # keeps its true inter-frame spacing for timestamp back-dating
+        wait_ts = getattr(self._tx, "wait_message_ts", None)
+
+        def recv(timeout_ms: int):
+            if wait_ts is not None:
+                return wait_ts(timeout_ms=timeout_ms)
+            m = self._tx.wait_message(timeout_ms=timeout_ms)
+            return None if m is None else (*m, time.monotonic())
+
+        batch_type: Optional[int] = None
+        batch: list = []  # [(payload, rx_ts)] of consecutive same-type frames
+
+        def flush() -> None:
+            nonlocal batch_type, batch
+            if not batch:
+                return
             try:
-                m = self._tx.wait_message(timeout_ms=200)
-            except ChannelError:
-                if self._running.is_set():
-                    log.warning("channel error detected by pump (hot-unplug?)")
-                    self.link_error.set()
-                break
-            if m is None:
-                continue
-            ans_type, data, is_loop = m
-            if is_loop or ans_type in SCAN_ANS_TYPES:
-                if self._on_measurement is not None:
-                    try:
-                        self._on_measurement(ans_type, data)
-                    except Exception:
-                        log.exception("measurement handler failed")
-                continue
-            with self._pending_lock:
-                stale_until = self._stale.pop(ans_type, None)
-                if stale_until is not None and time.monotonic() < stale_until:
-                    log.debug("dropping stale ans %#x (%d bytes)", ans_type, len(data))
-                elif self._pending_ans == ans_type and self._pending_q is not None:
-                    try:
-                        self._pending_q.put_nowait(data)
-                    except queue.Full:
-                        pass
-                else:
-                    log.debug("dropping unexpected ans %#x (%d bytes)", ans_type, len(data))
+                if self._on_measurement_batch is not None:
+                    self._on_measurement_batch(batch_type, batch)
+                elif self._on_measurement is not None:
+                    for data, _ts in batch:
+                        self._on_measurement(batch_type, data)
+            except Exception:
+                log.exception("measurement handler failed")
+            batch_type = None
+            batch = []
+
+        while self._running.is_set():
+            # first message: block; then drain whatever else is already
+            # decoded (timeout 0) so sustained streams deliver in runs
+            timeout_ms = 200
+            while True:
+                try:
+                    m = recv(timeout_ms)
+                except ChannelError:
+                    flush()
+                    if self._running.is_set():
+                        log.warning("channel error detected by pump (hot-unplug?)")
+                        self.link_error.set()
+                    return
+                if m is None:
+                    break  # queue drained (or idle timeout): deliver the run
+                timeout_ms = 0
+                ans_type, data, is_loop, rx_ts = m
+                if is_loop or ans_type in SCAN_ANS_TYPES:
+                    if ans_type != batch_type:
+                        flush()
+                        batch_type = ans_type
+                    batch.append((data, rx_ts))
+                    if len(batch) >= _MAX_MEASUREMENT_BATCH:
+                        flush()
+                    continue
+                self._route_response(ans_type, data)
+            flush()
+
+    def _route_response(self, ans_type: int, data: bytes) -> None:
+        with self._pending_lock:
+            stale_until = self._stale.pop(ans_type, None)
+            if stale_until is not None and time.monotonic() < stale_until:
+                log.debug("dropping stale ans %#x (%d bytes)", ans_type, len(data))
+            elif self._pending_ans == ans_type and self._pending_q is not None:
+                try:
+                    self._pending_q.put_nowait(data)
+                except queue.Full:
+                    pass
+            else:
+                log.debug("dropping unexpected ans %#x (%d bytes)", ans_type, len(data))

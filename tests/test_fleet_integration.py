@@ -1,0 +1,144 @@
+"""Fleet-gateway integration: N concurrent SimulatedDevices end-to-end.
+
+The multi-stream story beyond unit tests (VERDICT r1 #8): each stream is a
+full production stack — protocol simulator → native TCP channel → batched
+decode (driver/decode.py) → assembler → fault-tolerant ScanLoopFsm — and
+the newest revolution of every stream feeds one ShardedFilterService tick
+on the virtual 8-device (stream, beam) mesh.  Also exercises one stream's
+hot-unplug mid-run: the fleet keeps ticking (idle stream = all-masked
+scan), the dead stream's FSM goes into recovery, and service output
+resumes for the healthy streams.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+from rplidar_ros2_driver_tpu.node.fsm import DriverState, FsmTimings, ScanLoopFsm
+from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+N_STREAMS = 4
+
+
+def _wait(cond, timeout=20.0, dt=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(dt)
+    return False
+
+
+class _Stream:
+    """One lidar stream: sim device + driver + FSM + newest-scan mailbox."""
+
+    def __init__(self, idx: int) -> None:
+        self.idx = idx
+        self.sim = SimulatedDevice().start()
+        self.lock = threading.Lock()
+        self.newest: dict | None = None
+        self.scan_count = 0
+        params = DriverParams(
+            serial_port=f"sim{idx}",
+            serial_baudrate=0,
+            scan_mode="DenseBoost",
+            max_retries=2,
+        )
+        self.fsm = ScanLoopFsm(
+            self._make_driver,
+            self._on_scan,
+            params=params,
+            timings=FsmTimings.fast(),
+        )
+
+    def _make_driver(self) -> RealLidarDriver:
+        return RealLidarDriver(
+            channel_type="tcp",
+            tcp_host="127.0.0.1",
+            tcp_port=self.sim.port,
+            motor_warmup_s=0.0,
+        )
+
+    def _on_scan(self, scan: dict, ts0: float, duration: float) -> None:
+        with self.lock:
+            self.newest = scan
+            self.scan_count += 1
+
+    def take(self) -> dict | None:
+        with self.lock:
+            scan, self.newest = self.newest, None
+        return scan
+
+    def stop(self) -> None:
+        self.fsm.stop()
+        self.sim.stop()
+
+
+def test_fleet_of_sims_through_sharded_service():
+    mesh = make_mesh(8)
+    assert mesh.shape["stream"] * mesh.shape["beam"] == 8
+    params = DriverParams(
+        dummy_mode=True,
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=32,
+    )
+    svc = ShardedFilterService(params, N_STREAMS, mesh=mesh, beams=512)
+    streams = [_Stream(i) for i in range(N_STREAMS)]
+    try:
+        for s in streams:
+            s.fsm.start()
+        # all four independent stacks must reach RUNNING and produce scans
+        assert _wait(lambda: all(s.scan_count >= 2 for s in streams)), [
+            (s.fsm.state, s.scan_count) for s in streams
+        ]
+
+        # tick the fleet: every stream's newest revolution in one dispatch
+        ticks_with_all = 0
+        outputs = None
+        for _ in range(30):
+            scans = [s.take() for s in streams]
+            outputs = svc.submit(scans)
+            if all(sc is not None for sc in scans):
+                ticks_with_all += 1
+                for i, out in enumerate(outputs):
+                    assert out is not None
+                    assert out.ranges.shape == (svc.cfg.beams,)
+                    assert np.isfinite(out.ranges).any(), f"stream {i} all-inf"
+                    assert out.voxel.shape == (svc.cfg.grid, svc.cfg.grid)
+            if ticks_with_all >= 3:
+                break
+            time.sleep(0.05)
+        assert ticks_with_all >= 3
+
+        # hot-unplug stream 0 mid-run: its FSM must leave RUNNING and the
+        # fleet must keep producing output for the healthy streams
+        streams[0].sim.unplug()
+        assert _wait(
+            lambda: streams[0].fsm.state is not DriverState.RUNNING, timeout=30.0
+        ), streams[0].fsm.state
+
+        healthy_seen = 0
+        for _ in range(30):
+            scans = [s.take() for s in streams]
+            outputs = svc.submit(scans)
+            got = [o is not None for o in outputs[1:]]
+            if all(sc is not None for sc in scans[1:]):
+                healthy_seen += 1
+                for out in outputs[1:]:
+                    assert np.isfinite(out.ranges).any()
+            if healthy_seen >= 2:
+                break
+            time.sleep(0.05)
+        assert healthy_seen >= 2, "healthy streams stopped producing after unplug"
+    finally:
+        for s in streams:
+            s.stop()

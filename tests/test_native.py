@@ -269,6 +269,26 @@ class TestTransceiver:
         t.join(3)
         assert received and received[0] == encode_command(0x20)
 
+    def test_rx_thread_priority_elevation_best_effort(self):
+        """The rx thread attempts the reference's PRIORITY_HIGH (SCHED_RR,
+        arch/linux/thread.hpp:64-120) and must FALL BACK silently when
+        unprivileged: after start the reported class is one of
+        {0 default, 1 nice, 2 SCHED_RR} — never a failure — and streaming
+        still works."""
+        from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
+
+        frames = _frame(0x81, [bytes(5)], is_loop=True)
+        port, t, _ = self._lidar_server(frames, close_after=0.8)
+        ch = NativeChannel("tcp", "127.0.0.1", port=port)
+        tx = NativeTransceiver(ch)
+        assert tx.rx_priority == -1  # not started yet
+        assert tx.start()
+        m = tx.wait_message(timeout_ms=2000)
+        assert m is not None
+        assert tx.rx_priority in (0, 1, 2), tx.rx_priority
+        tx.stop()
+        t.join(3)
+
     def test_reset_decoder_between_modes(self):
         from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
 

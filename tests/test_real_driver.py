@@ -223,9 +223,11 @@ class TestSerialTransportE2E:
             scan, ts0, dur = got
             assert len(scan["angle_q14"]) > 0
             assert dur > 0
-            # serial link: timing desc carries the UART baud for back-dating
+            # serial link: timing desc carries the device model's NATIVE
+            # baud for back-dating (sl_lidar_driver.cpp:1540 — not the
+            # negotiated link baud); the sim's S2 model id maps to 1 Mbaud
             assert drv._scan_decoder.timing.is_serial
-            assert drv._scan_decoder.timing.baudrate == 115200
+            assert drv._scan_decoder.timing.native_baudrate == 1_000_000
             sim.unplug()  # EIO on the slave, like a yanked USB adapter
             t0 = time.monotonic()
             while drv.grab_scan_host(0.5) is not None:

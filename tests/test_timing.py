@@ -19,40 +19,104 @@ from rplidar_ros2_driver_tpu.protocol.constants import (
     Ans,
 )
 from rplidar_ros2_driver_tpu.protocol.timing import (
+    ETHERNET_DUMMY_TRANSMISSION_US,
     LEGACY_SAMPLE_DURATION_US,
     SAMPLES_PER_FRAME,
     TimingDesc,
     frame_rx_delay_us,
+    frame_sample_times,
+    sample_delay_us,
 )
 
 
-class TestDelayModel:
-    def test_transmission_time_matches_8n1(self):
-        t = TimingDesc(sample_duration_us=65.0, baudrate=1_000_000, is_serial=True)
-        # 84-byte capsule at 1 Mbaud: 84*10 bits / 1e6 = 840 us
-        assert t.transmission_us(84) == pytest.approx(840.0)
+def _ref_delay_us(ans: Ans, timing: TimingDesc, idx: int) -> int:
+    """Independent scalar transcription of the reference's per-handler
+    delay functions (_getSampleDelayOffsetIn{LegacyMode,ExpressMode,
+    UltraBoostMode,DenseMode,UltraDenseMode,HQMode}; handler_normalnode.cpp:
+    51-68, handler_capsules.cpp:55-76,272-293,586-607,796-817,
+    handler_hqnode.cpp:54-73).  All-integer u64 math, per-format default
+    bauds, ethernet 100 µs dummy; grouping (N-1-idx)*dur for the capsule
+    formats only."""
+    defaults = {
+        Ans.MEASUREMENT: 115200,
+        Ans.MEASUREMENT_CAPSULED: 115200,
+        Ans.MEASUREMENT_CAPSULED_ULTRA: 256000,
+        Ans.MEASUREMENT_DENSE_CAPSULED: 256000,
+        Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED: 1000000,
+        Ans.MEASUREMENT_HQ: 1000000,
+    }
+    dur = int(timing.sample_duration_us + 0.5)
+    if not timing.is_serial:
+        trans = 100
+    else:
+        baud = timing.native_baudrate or defaults[ans]
+        trans = 1_000_000 * ANS_PAYLOAD_BYTES[ans] * 10 // baud
+    sample_delay = dur >> 1
+    sample_filter_delay = dur
+    grouping = {
+        Ans.MEASUREMENT: 0,
+        Ans.MEASUREMENT_HQ: 0,
+        Ans.MEASUREMENT_CAPSULED: (32 - 1 - idx) * dur,
+        Ans.MEASUREMENT_CAPSULED_ULTRA: (32 * 3 - 1 - idx) * dur,
+        Ans.MEASUREMENT_DENSE_CAPSULED: (40 - 1 - idx) * dur,
+        Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED: (32 * 2 - 1 - idx) * dur,
+    }[ans]
+    return sample_filter_delay + sample_delay + trans + timing.linkage_delay_us + grouping
 
-    def test_network_link_has_no_uart_delay(self):
-        t = TimingDesc(sample_duration_us=65.0, baudrate=0, is_serial=False)
-        assert t.transmission_us(84) == 0.0
+
+class TestDelayModel:
+    def test_transmission_time_matches_8n1_at_native_baud(self):
+        t = TimingDesc(sample_duration_us=65.0, native_baudrate=1_000_000)
+        # 84-byte capsule at 1 Mbaud: 84*10 bits / 1e6 = 840 us
+        assert t.transmission_us(Ans.MEASUREMENT_CAPSULED) == 840
+
+    def test_network_link_uses_ethernet_dummy(self):
+        """Non-serial links get the reference's fixed 100 µs stand-in
+        (the "dummy value" ethernet branch in every handler)."""
+        t = TimingDesc(sample_duration_us=65.0, is_serial=False)
+        assert t.transmission_us(Ans.MEASUREMENT_CAPSULED) == ETHERNET_DUMMY_TRANSMISSION_US
+
+    def test_unknown_native_baud_falls_back_per_format(self):
+        t = TimingDesc(sample_duration_us=65.0, native_baudrate=0)
+        # express guesses 115200, ultra-dense guesses 1 Mbaud (handlers)
+        assert t.transmission_us(Ans.MEASUREMENT_CAPSULED) == 84 * 10 * 1_000_000 // 115200
+        assert t.transmission_us(Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED) == (
+            ANS_PAYLOAD_BYTES[Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED] * 10
+        )
 
     def test_frame_delay_orders_by_density(self):
         """Denser frames carry older first samples (more grouping delay)."""
-        t = TimingDesc(sample_duration_us=65.0, baudrate=256000)
+        t = TimingDesc(sample_duration_us=65.0, native_baudrate=256000)
         d_norm = frame_rx_delay_us(Ans.MEASUREMENT, t)
         d_caps = frame_rx_delay_us(Ans.MEASUREMENT_CAPSULED, t)
         d_ultra = frame_rx_delay_us(Ans.MEASUREMENT_CAPSULED_ULTRA, t)
         assert d_norm < d_caps < d_ultra
 
-    def test_frame_delay_formula(self):
-        t = TimingDesc(sample_duration_us=100.0, baudrate=115200)
-        d = frame_rx_delay_us(Ans.MEASUREMENT_DENSE_CAPSULED, t)
-        expect = (
-            ANS_PAYLOAD_BYTES[Ans.MEASUREMENT_DENSE_CAPSULED] * 10.0 * 1e6 / 115200
-            + SAMPLES_PER_FRAME[Ans.MEASUREMENT_DENSE_CAPSULED] * 100.0
-            + 45
-        )
-        assert d == pytest.approx(expect)
+    @pytest.mark.parametrize("ans", sorted(SAMPLES_PER_FRAME, key=int))
+    @pytest.mark.parametrize("dur", [31.25, 65.0, 476.0])
+    def test_per_sample_delay_matches_reference_model(self, ans, dur):
+        """All 6 formats, every sample index: reference-exact parity."""
+        for timing in (
+            TimingDesc(sample_duration_us=dur, native_baudrate=0),
+            TimingDesc(sample_duration_us=dur, native_baudrate=256000),
+            TimingDesc(sample_duration_us=dur, is_serial=False),
+        ):
+            for idx in range(SAMPLES_PER_FRAME[ans]):
+                assert sample_delay_us(ans, timing, idx) == _ref_delay_us(
+                    ans, timing, idx
+                ), (ans, timing, idx)
+
+    @pytest.mark.parametrize("ans", sorted(SAMPLES_PER_FRAME, key=int))
+    def test_frame_sample_times_equal_per_index_evaluation(self, ans):
+        """The vectorized per-frame stamps are exactly rx − delay(idx)."""
+        timing = TimingDesc(sample_duration_us=65.0, native_baudrate=256000)
+        rx = 1234.5
+        times = frame_sample_times(ans, timing, rx)
+        assert times.shape == (SAMPLES_PER_FRAME[ans],)
+        for idx in range(SAMPLES_PER_FRAME[ans]):
+            assert times[idx] == pytest.approx(
+                rx - 1e-6 * sample_delay_us(ans, timing, idx), abs=1e-9
+            )
 
     def test_unknown_ans_type_is_zero(self):
         assert frame_rx_delay_us(0x42, TimingDesc()) == 0.0
