@@ -16,8 +16,8 @@ Layer map (top to bottom), mirroring SURVEY.md §1:
   models/    — device model tables & capability profiles
   protocol/  — command/response framing codec, CRC, conf protocol
   ops/       — JAX kernels: unpackers, resampler, filter math
-  channels/  — byte transports (serial / tcp / udp / loopback)
-  native/    — C++ runtime: raw serial, transceiver hot loop (ctypes)
+  native/    — C++ runtime: serial/tcp/udp channels, transceiver hot loop
+  launch/    — lifecycle launch, composition container, in-process bus
   parallel/  — device meshes, sharded multi-stream pipeline
 """
 

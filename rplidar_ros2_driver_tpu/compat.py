@@ -106,6 +106,18 @@ class RPlidarDriver:
 
     # -- connection ---------------------------------------------------------
     def connect(self, port: str, baudrate: int, flag: int = 0) -> bool:
+        if flag:
+            # the legacy flag argument was already unused by the reference
+            # shim (rplidar_driver.cpp connect forwards it nowhere); modern
+            # geometric compensation is always on here
+            import warnings
+
+            warnings.warn(
+                f"RPlidarDriver.connect flag={flag:#x} is ignored "
+                "(use RealLidarDriver.connect(use_geometric_compensation=...))",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return self._impl.connect(port, baudrate, True)
 
     def disconnect(self) -> None:

@@ -8,7 +8,7 @@ parameters that are this framework's north star (BASELINE.json).
 Three tiers, like the reference:
   * static params (read once at configure time),
   * runtime-mutable params (rpm / scan_processing / scan_mode,
-    src/rplidar_node.cpp:689-774) — see node/reconfigure.py,
+    src/rplidar_node.cpp:689-774) — see node/node.py set_parameters,
   * device-side config (the GET/SET_LIDAR_CONF key space) — see
     protocol/conf.py.
 """

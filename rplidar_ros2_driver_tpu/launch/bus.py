@@ -12,8 +12,11 @@ unbounded queue.
 from __future__ import annotations
 
 import collections
+import logging
 import threading
 from typing import Any, Callable, Optional
+
+log = logging.getLogger("rplidar_tpu.bus")
 
 from rplidar_ros2_driver_tpu.node.messages import (
     DiagnosticStatus,
@@ -66,9 +69,16 @@ class _Subscription:
                         self._draining = False
                         return
                     nxt = self._cb_pending.popleft()
-                self.callback(nxt)
+                try:
+                    self.callback(nxt)
+                except Exception:
+                    # a raising subscriber must not propagate into the
+                    # publisher's thread (in the node hot path that would
+                    # turn every publish into an FSM reset); rclcpp
+                    # intra-process delivery does not crash the publisher
+                    log.exception("subscriber callback raised; message dropped")
         except BaseException:
-            # a raising callback must not wedge the subscription: release
+            # non-Exception escape (KeyboardInterrupt/SystemExit): release
             # the drain claim; whatever is still pending is delivered by
             # the next publish
             with self.lock:

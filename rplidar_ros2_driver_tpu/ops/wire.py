@@ -3,7 +3,7 @@
 The reference only ever *decodes* these formats (the device firmware is the
 encoder).  We need encoders so the framework can (a) golden-test its
 decoders against hand-built byte fixtures and (b) run a simulated device
-(channels/loopback.py + driver/sim_device.py) that exercises the full
+(driver/sim_device.py) that exercises the full
 pipeline without hardware — the capability the reference's DummyLidarDriver
 only approximates at the node layer.
 

@@ -13,7 +13,7 @@ Re-implements the behavioral contract of the reference codec
 Unlike the reference's byte-at-a-time switch statement, this decoder works
 on whole buffers with ``bytes.find`` / slicing — the Python hot path hands
 off entire capsule streams at once, and the per-byte scan-sync hunting lives
-in the vectorized unpackers (ops/framing.py) or the C++ runtime (native/).
+in the vectorized unpackers (ops/unpack.py) or the C++ runtime (native/).
 """
 
 from __future__ import annotations

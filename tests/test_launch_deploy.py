@@ -243,8 +243,11 @@ def test_cli_run_duration():
     assert "scans=" in out.stdout
 
 
-def test_raising_callback_does_not_wedge_subscription():
-    """A callback exception must not permanently stop delivery."""
+def test_raising_callback_does_not_wedge_subscription_or_publisher():
+    """A raising subscriber must neither stop later delivery NOR propagate
+    into the publisher's thread (one bad consumer cannot degrade the node
+    hot path into an FSM reset loop — rclcpp intra-process delivery does
+    not crash the publisher either)."""
     bus = IntraProcessBus()
     got = []
     calls = {"n": 0}
@@ -256,7 +259,7 @@ def test_raising_callback_does_not_wedge_subscription():
         got.append(msg)
 
     bus.subscribe("/t", flaky)
-    with pytest.raises(RuntimeError):
-        bus.publish("/t", "m1")
+    bus.publish("/t", "m1")  # exception contained, logged
     bus.publish("/t", "m2")  # must still be delivered
+    assert calls["n"] == 2
     assert got == ["m2"]
