@@ -13,9 +13,16 @@ transfer with device compute the way the reference overlaps acquisition and
 consumption via its double-buffered ScanDataHolder
 (src/sdk/src/sl_lidar_driver.cpp:237-371).
 Throughput is measured over the sustained pipeline; per-scan device time is
-derived from it.  A fully synchronous per-scan sync would measure the
-host<->device link round-trip (~70 ms through the axon tunnel), not the
+derived from it.  A fully synchronous per-scan sync includes the
+host<->device link round-trip of the remote-attach tunnel, not just the
 framework, so it is reported separately as sync_p99_ms.
+
+MEASUREMENT CAVEAT (discovered r2): through a remote-attached device,
+``jax.block_until_ready`` can return BEFORE the device finishes — only a
+real data fetch is a completion barrier.  Every timed section here ends
+with ``_device_barrier`` (a 1-element dependent fetch); numbers taken with
+``block_until_ready`` on this rig can be inflated by the depth of the
+dispatch queue (observed up to ~300x on a short fused loop).
 
 Real-time budget is 10 scans/s; ``vs_baseline`` is measured scans/s over
 that 10 Hz requirement.  Prints ONE JSON line.
@@ -55,6 +62,14 @@ MEDIAN_BACKEND = "pallas"
 CAPACITY = 4096
 
 
+def _device_barrier(arr) -> None:
+    """True device-completion barrier: fetch ONE element that depends on
+    ``arr``.  jax.block_until_ready is NOT sufficient through the
+    remote-attach tunnel (see module docstring); the fetch adds one link
+    RTT, which timed sections amortize over many dispatches."""
+    np.asarray(jnp.ravel(arr)[:1])
+
+
 def _host_scans(n: int, points: int = POINTS) -> list[dict[str, np.ndarray]]:
     """Pre-generate n raw host scans (numpy — as arriving from the unpacker)."""
     rng = np.random.default_rng(0)
@@ -83,7 +98,55 @@ GRADED = {
     4: ("chain", 800, dict(window=16, enable_voxel=False)),
     5: ("chain", POINTS, dict(window=WINDOW)),  # the headline (default)
     6: ("e2e", POINTS, dict(window=WINDOW)),    # sim device -> decode -> chain
+    7: ("fused", POINTS, dict(window=WINDOW)),  # offline fused multi-scan replay
 }
+
+
+def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
+    """Config 7 — offline replay throughput: the fused multi-scan step
+    (ops/filters.compact_filter_scan) advances the 64-scan window over a
+    whole capture in K/chunk dispatches, amortizing the per-scan dispatch
+    and transfer overhead that bounds the streaming path (config 5)."""
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        compact_filter_scan,
+        pack_host_scans_compact,
+    )
+
+    device = jax.devices()[0]
+    cfg = FilterConfig(window=WINDOW, beams=BEAMS, grid=GRID, cell_m=0.25,
+                       median_backend=MEDIAN_BACKEND)
+    scans = _host_scans(32, POINTS)
+    seq_np, counts_np = pack_host_scans_compact(
+        [scans[i % len(scans)] for i in range(chunk)], CAPACITY
+    )
+    state = jax.device_put(FilterState.create(cfg.window, cfg.beams, cfg.grid), device)
+    seq = jax.device_put(seq_np, device)
+    counts = jax.device_put(counts_np, device)
+
+    # warm-up compile
+    state, ranges = compact_filter_scan(state, seq, counts, cfg)
+    _device_barrier(ranges)
+
+    n_chunks = k_scans // chunk
+    t0 = time.perf_counter()
+    for _ in range(n_chunks):
+        state, ranges = compact_filter_scan(state, seq, counts, cfg)
+    _device_barrier(ranges)
+    dt = time.perf_counter() - t0
+    sps = n_chunks * chunk / dt
+    return {
+        "metric": "fused_replay_scans_per_sec",
+        "value": round(sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(sps / BASELINE_SCANS_PER_SEC, 3),
+        "us_per_scan": round(1e6 / sps, 2),
+        "points_per_scan": POINTS,
+        "window": WINDOW,
+        "chunk": chunk,
+        "scans_total": n_chunks * chunk,
+        "median_backend": MEDIAN_BACKEND,
+        "device": str(device.platform),
+    }
 
 
 def bench_e2e(seconds: float = 15.0) -> dict:
@@ -137,7 +200,7 @@ def bench_e2e(seconds: float = 15.0) -> dict:
             state, jax.device_put(warm, device),
             jax.device_put(jnp.asarray(POINTS, jnp.int32), device), cfg,
         )
-        jax.block_until_ready(out)
+        _device_barrier(out.ranges)
 
         t_end = time.monotonic() + seconds
         pending = None
@@ -164,13 +227,13 @@ def bench_e2e(seconds: float = 15.0) -> dict:
             # fetch) so the pipeline stays bounded AND we sample the
             # RTT-inclusive number
             if published % 8 == 0:
-                jax.block_until_ready(out)
+                _device_barrier(out.ranges)
                 timer.record("publish_sync", time.monotonic() - rev_end)
             pending = out
         if published == 0:
             raise RuntimeError("e2e bench produced no scans (sim stream broken?)")
         if pending is not None:
-            jax.block_until_ready(pending)
+            _device_barrier(pending.ranges)
         dec = drv._scan_decoder
         frames_decoded, nodes_decoded = dec.frames_decoded, dec.nodes_decoded
         drv.stop_motor()
@@ -185,7 +248,7 @@ def bench_e2e(seconds: float = 15.0) -> dict:
         state, out = compact_filter_step(
             state, p, jax.device_put(jnp.asarray(count, jnp.int32), device), cfg
         )
-    jax.block_until_ready(out)
+    _device_barrier(out.ranges)
     device_ms = (time.perf_counter() - t0) / reps * 1e3
 
     rev_p99 = timer.percentile("rev_to_dispatch", 99) * 1e3
@@ -229,14 +292,14 @@ def bench_passthrough(points: int) -> dict:
     ]
     for b in batches:
         out = to_laserscan(b, 0.1, 12.0, scan_processing=False, inverted=False, is_new_type=False)
-    jax.block_until_ready(out)
+    _device_barrier(out.ranges)
     t0 = time.perf_counter()
     for k in range(ITERS):
         out = to_laserscan(
             batches[k % len(batches)], 0.1, 12.0,
             scan_processing=False, inverted=False, is_new_type=False,
         )
-    jax.block_until_ready(out)
+    _device_barrier(out.ranges)
     dt = time.perf_counter() - t0
     return {
         "metric": "a1m8_passthrough_scans_per_sec",
@@ -271,22 +334,22 @@ def _run_chain(cfg: FilterConfig, points: int) -> tuple[float, float]:
     # warm-up: compile + fill part of the window
     for k in range(WARMUP):
         state, out = submit(state, k)
-    jax.block_until_ready((state, out))
+    _device_barrier(out.ranges)
 
-    # sustained streaming throughput (single final sync)
+    # sustained streaming throughput (single final true barrier)
     t_all0 = time.perf_counter()
     for k in range(ITERS):
         state, out = submit(state, k)
-    jax.block_until_ready(out)
+    _device_barrier(out.ranges)
     t_all = time.perf_counter() - t_all0
     scans_per_sec = ITERS / t_all
 
-    # per-scan synchronous latency (dominated by link RTT when remote)
+    # per-scan synchronous latency (includes one link RTT when remote)
     lat = np.empty(SYNC_ITERS)
     for k in range(SYNC_ITERS):
         t0 = time.perf_counter()
         state, out = submit(state, k)
-        jax.block_until_ready(out)
+        _device_barrier(out.ranges)
         lat[k] = time.perf_counter() - t0
     sync_p99_ms = float(np.percentile(lat, 99) * 1e3)
     return scans_per_sec, sync_p99_ms
@@ -297,10 +360,10 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
     if kind == "passthrough":
         print(json.dumps(bench_passthrough(points)))
         return
-    if kind == "e2e":
+    if kind in ("e2e", "fused"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
-        print(json.dumps(bench_e2e()))
+        print(json.dumps(bench_e2e() if kind == "e2e" else bench_fused()))
         return
     cfg = FilterConfig(
         beams=BEAMS, grid=GRID, cell_m=0.25, median_backend=median, **over

@@ -124,6 +124,28 @@ def _cmd_replay(args) -> int:
     if revs:
         pts = [len(r["angle_q14"]) for r in revs]
         print(f"  points/rev: min={min(pts)} median={sorted(pts)[len(pts)//2]} max={max(pts)}")
+    if args.chain and revs:
+        import time as _time
+
+        import numpy as np
+
+        from rplidar_ros2_driver_tpu.core.config import DriverParams
+        from rplidar_ros2_driver_tpu.replay import replay_through_chain
+
+        params = DriverParams(
+            filter_backend="cpu" if args.cpu else "tpu",
+            filter_chain=("clip", "median", "voxel"),
+        )
+        t0 = _time.perf_counter()
+        ranges, state = replay_through_chain(revs, params)
+        dt = _time.perf_counter() - t0
+        finite = np.isfinite(ranges)
+        print(
+            f"  chain: {len(revs)} scans through the fused multi-scan step in "
+            f"{dt:.2f} s ({len(revs) / dt:.0f} scans/s); "
+            f"median range {np.median(ranges[finite]):.2f} m, "
+            f"voxel occupancy {int(np.asarray(state.voxel_acc).sum())}"
+        )
     return 0
 
 
@@ -153,6 +175,12 @@ def main(argv=None) -> int:
     replay = sub.add_parser("replay", help="batch-decode a frame recording")
     replay.add_argument("recording", help="capture file (RealLidarDriver.start_recording)")
     replay.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
+    replay.add_argument(
+        "--chain",
+        action="store_true",
+        help="also run the decoded revolutions through the filter chain "
+        "(fused multi-scan step)",
+    )
 
     args = ap.parse_args(argv)
     if getattr(args, "cpu", False):

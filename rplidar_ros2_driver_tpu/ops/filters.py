@@ -360,6 +360,55 @@ def _unpack_compact(packed: jax.Array, count: jax.Array) -> ScanBatch:
     )
 
 
+# -- fused multi-scan sequence step ------------------------------------------
+#
+# Offline/replay throughput path: K scans advance the rolling window in ONE
+# dispatch (lax.scan over the leading scans axis), amortizing the per-scan
+# dispatch + transfer overhead that bounds the streaming path.  Returns the
+# per-scan median-filtered range images and the final state (whose voxel_acc
+# is the window accumulation after the last scan); the full per-scan
+# FilterOutput is deliberately not materialized (K x ~300 KB would turn a
+# throughput path into an HBM bandwidth test).
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def compact_filter_scan(
+    state: FilterState, packed_seq: jax.Array, counts: jax.Array, cfg: FilterConfig
+) -> tuple[FilterState, jax.Array]:
+    """Run the chain over a (K, 2, N) uint32 packed scan sequence.
+
+    Semantically identical to K successive ``compact_filter_step`` calls
+    (same state trajectory — tests/test_filters.py asserts equality);
+    ``counts`` is (K,) int32.  Returns (final state, (K, beams) ranges).
+    """
+
+    def body(st, xs):
+        pk, ct = xs
+        st, out = _filter_step_impl(st, _unpack_compact(pk, ct), cfg)
+        return st, out.ranges
+
+    state, ranges = jax.lax.scan(body, state, (packed_seq, counts))
+    return state, ranges
+
+
+def pack_host_scans_compact(scans, n: int | None = None):
+    """Stack host scans into the (K, 2, n) sequence buffer + (K,) counts
+    (the multi-scan form of :func:`pack_host_scan_compact`)."""
+    import numpy as np
+
+    from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
+
+    n = n or MAX_SCAN_NODES
+    k = len(scans)
+    seq = np.zeros((k, 2, n), np.uint32)
+    counts = np.zeros((k,), np.int32)
+    for i, s in enumerate(scans):
+        seq[i], counts[i] = pack_host_scan_compact(
+            s["angle_q14"], s["dist_q2"], s["quality"], s.get("flag"), n
+        )
+    return seq, counts
+
+
 # -- fused single-fetch output -----------------------------------------------
 #
 # Pulling FilterOutput field-by-field costs one device->host round trip per

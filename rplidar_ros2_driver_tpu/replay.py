@@ -132,6 +132,46 @@ class DecodedRecording:
         return out
 
 
+def replay_through_chain(
+    revolutions: list[dict],
+    params,
+    *,
+    beams: int | None = None,
+    capacity: int = 4096,
+    chunk: int = 256,
+):
+    """Batch-process decoded revolutions through the filter chain with the
+    fused multi-scan step (ops/filters.compact_filter_scan): the whole
+    capture advances the rolling window in ``len(revs)/chunk`` dispatches
+    instead of one per scan — the offline-throughput twin of the streaming
+    ScanFilterChain (identical state trajectory).
+
+    Returns (per-scan (K, beams) float32 median range images, final
+    FilterState — whose ``voxel_acc`` is the window accumulation after the
+    last scan).
+    """
+    import jax
+
+    from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        FilterState,
+        compact_filter_scan,
+        pack_host_scans_compact,
+    )
+
+    cfg = config_from_params(params, beams or DEFAULT_BEAMS)
+    state = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    outs = []
+    for i in range(0, len(revolutions), chunk):
+        seq, counts = pack_host_scans_compact(revolutions[i : i + chunk], capacity)
+        state, ranges = compact_filter_scan(state, seq, counts, cfg)
+        outs.append(np.asarray(ranges))
+    return (
+        np.concatenate(outs) if outs else np.zeros((0, cfg.beams), np.float32),
+        jax.device_get(state),
+    )
+
+
 def decode_recording(path: str) -> DecodedRecording:
     """Batch-decode a capture: consecutive same-type frames become ONE
     kernel invocation over a (M, frame_bytes) uint8 array."""

@@ -9,10 +9,12 @@ from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
 from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterState,
+    compact_filter_scan,
     compact_filter_step,
     filter_step,
     pack_host_scan,
     pack_host_scan_compact,
+    pack_host_scans_compact,
     packed_filter_step,
 )
 
@@ -89,6 +91,59 @@ def test_compact_roundtrip_field_ranges():
     np.testing.assert_array_equal(row0 >> 24, flag.astype(np.uint32))
     np.testing.assert_array_equal(
         buf[1, :3].astype(np.int64), dist.astype(np.int64)
+    )
+
+
+def test_fused_scan_matches_sequential_steps():
+    """compact_filter_scan (K scans, one dispatch) must reproduce the exact
+    state trajectory and per-scan ranges of K compact_filter_step calls."""
+    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
+    scans = []
+    for k in range(10):
+        angle, dist, qual = _raw_scan(k, points=300 + 20 * k)
+        scans.append({"angle_q14": angle, "dist_q2": dist, "quality": qual})
+
+    s_seq = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    ranges_seq = []
+    for s in scans:
+        buf, count = pack_host_scan_compact(
+            s["angle_q14"], s["dist_q2"], s["quality"], None, 1024
+        )
+        s_seq, out = compact_filter_step(s_seq, buf, jnp.asarray(count, jnp.int32), cfg)
+        ranges_seq.append(np.asarray(out.ranges))
+
+    seq, counts = pack_host_scans_compact(scans, 1024)
+    s_fused = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    s_fused, ranges = compact_filter_scan(s_fused, seq, counts, cfg)
+    np.testing.assert_array_equal(np.asarray(ranges), np.stack(ranges_seq))
+    for name in ("range_window", "voxel_acc", "cursor", "filled"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_fused, name)), np.asarray(getattr(s_seq, name)), name
+        )
+
+
+def test_replay_through_chain_matches_streaming_chain():
+    from rplidar_ros2_driver_tpu.replay import replay_through_chain
+
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    scans = []
+    for k in range(9):
+        angle, dist, qual = _raw_scan(k + 7)
+        scans.append({"angle_q14": angle, "dist_q2": dist, "quality": qual})
+    chain = ScanFilterChain(params, beams=128)
+    stream_ranges = [
+        np.asarray(chain.process_raw(s["angle_q14"], s["dist_q2"], s["quality"]).ranges)
+        for s in scans
+    ]
+    ranges, final_state = replay_through_chain(scans, params, beams=128, chunk=4)
+    np.testing.assert_array_equal(ranges, np.stack(stream_ranges))
+    np.testing.assert_array_equal(
+        np.asarray(final_state.voxel_acc), np.asarray(chain.state.voxel_acc)
     )
 
 

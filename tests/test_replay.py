@@ -129,6 +129,19 @@ class TestEndToEnd:
         assert "complete revolutions" in out.stdout
         assert "run:" in out.stdout
 
+    def test_cli_replay_through_chain(self, tmp_path):
+        path, _ = _capture_from_sim(tmp_path, seconds=0.5)
+        out = subprocess.run(
+            [sys.executable, "-m", "rplidar_ros2_driver_tpu", "replay", path,
+             "--cpu", "--chain"],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "fused multi-scan step" in out.stdout
+        assert "voxel occupancy" in out.stdout
+
 
 def test_write_after_close_is_noop(tmp_path):
     rec = FrameRecorder(str(tmp_path / "c.rplr"))
