@@ -19,7 +19,6 @@ import logging
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
@@ -28,9 +27,9 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
     FilterState,
-    compact_filter_step_wire,
+    counted_filter_step_wire,
     filter_step,
-    pack_host_scan_compact,
+    pack_host_scan_counted,
     unpack_output_wire,
 )
 
@@ -89,15 +88,15 @@ class ScanFilterChain:
         """Streaming ingest of raw host arrays via the packed one-transfer path.
 
         This is the production hot path: per revolution, exactly one
-        host->device transfer (bit-packed (2, N) uint32, 8 bytes/point),
-        one donated step dispatch, and one device->host fetch (the fused
-        flat output vector).  Returns a numpy-backed FilterOutput.
+        host->device transfer (bit-packed (2, N) uint32 with the node
+        count folded into the reserved last slot — 8 bytes/point, no
+        separate count scalar), one donated step dispatch, and one
+        device->host fetch (the fused flat output vector).  Returns a
+        numpy-backed FilterOutput.
         """
-        buf, count = pack_host_scan_compact(angle_q14, dist_q2, quality, flag)
+        buf = pack_host_scan_counted(angle_q14, dist_q2, quality, flag)
         packed = jax.device_put(buf, self.device)
-        self._state, wire = compact_filter_step_wire(
-            self._state, packed, jnp.asarray(count, jnp.int32), self.cfg
-        )
+        self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
         return unpack_output_wire(wire, self.cfg)
 
     # -- checkpoint surface -------------------------------------------------

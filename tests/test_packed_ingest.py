@@ -11,9 +11,11 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterState,
     compact_filter_scan,
     compact_filter_step,
+    counted_filter_step,
     filter_step,
     pack_host_scan,
     pack_host_scan_compact,
+    pack_host_scan_counted,
     pack_host_scans_compact,
     packed_filter_step,
 )
@@ -76,6 +78,38 @@ def test_compact_step_matches_scanbatch_step():
         np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
         np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
     np.testing.assert_array_equal(np.asarray(s_a.voxel_acc), np.asarray(s_b.voxel_acc))
+
+
+def test_counted_step_matches_compact_step():
+    """The count-embedded one-transfer form must match buffer+scalar exactly."""
+    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
+    s_a = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    s_b = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    for k in range(6):
+        angle, dist, qual = _raw_scan(k, points=500 + 3 * k)
+        flag = np.zeros(len(angle), np.int32)
+        flag[0] = 1
+        buf, count = pack_host_scan_compact(angle, dist, qual, flag, n=1024)
+        s_a, out_a = compact_filter_step(s_a, buf, jnp.asarray(count, jnp.int32), cfg)
+        cbuf = pack_host_scan_counted(angle, dist, qual, flag, n=1024)
+        assert int(cbuf[0, -1]) == count
+        s_b, out_b = counted_filter_step(s_b, cbuf, cfg)
+        np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
+        np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
+    np.testing.assert_array_equal(np.asarray(s_a.voxel_acc), np.asarray(s_b.voxel_acc))
+
+
+def test_counted_pack_truncates_full_capacity():
+    """The reserved count slot never holds a real node: a revolution
+    filling the buffer exactly (the assembler's MAX_SCAN_NODES truncation
+    case) drops its final node instead of raising in the hot path."""
+    angle = np.arange(1024, dtype=np.int32)
+    buf = pack_host_scan_counted(angle, angle, angle, n=1024)
+    assert int(buf[0, -1]) == 1023  # truncated to capacity - 1
+    # one below capacity keeps every node
+    buf = pack_host_scan_counted(angle[:1023], angle[:1023], angle[:1023], n=1024)
+    assert int(buf[0, -1]) == 1023
+    np.testing.assert_array_equal(buf[1, :1023].astype(np.int64), angle[:1023])
 
 
 def test_compact_roundtrip_field_ranges():
