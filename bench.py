@@ -439,7 +439,7 @@ if __name__ == "__main__":
         default=5,
         choices=sorted(GRADED),
         help="graded BASELINE config (1=A1M8 passthrough .. 5=64-scan voxel "
-        "headline (default), 6=e2e with wire decode)",
+        "headline (default), 6=e2e with wire decode, 7=fused offline replay)",
     )
     ap.add_argument(
         "--median",

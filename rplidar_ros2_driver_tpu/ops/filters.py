@@ -347,23 +347,26 @@ def compact_filter_step(
 
 
 def pack_host_scan_counted(angle_q14, dist_q2, quality, flag=None, n: int | None = None):
-    """Count-embedded wire form: :func:`pack_host_scan_compact` with the
-    node count folded into the buffer's last angle-row slot, so the hot
-    path ships ONE array per revolution instead of buffer + count scalar.
+    """Count-embedded wire form: :func:`pack_host_scan_compact` plus one
+    extra column whose angle-row slot holds the node count, so the hot
+    path ships ONE ``(2, n + 1)`` array per revolution instead of buffer
+    + count scalar.
 
     Through a remote-attached device every host->device transfer is a
     separate RPC enqueue; measured on the axon tunnel the second (scalar)
     put roughly doubles the paced per-scan dispatch latency (p99 ~2.2 ms
-    -> ~1.3 ms with the count folded in).  The last slot is reserved for
-    the count, so capacity is ``n - 1`` nodes: a revolution filling the
-    buffer to exactly ``n`` (the assembler truncates overflow at
-    MAX_SCAN_NODES, matching the reference's 8192-node cap) drops its
-    final node rather than failing the hot path.
+    -> ~1.3 ms with the count folded in).  The count slot is an *extra*
+    column (8 wire bytes), not a reservation out of ``n``, so capacity-
+    filling revolutions (the assembler truncates at MAX_SCAN_NODES,
+    matching the reference's 8192-node cap) keep every node.
     """
+    import numpy as np
+
     buf, count = pack_host_scan_compact(angle_q14, dist_q2, quality, flag, n)
-    count = min(count, buf.shape[1] - 1)
-    buf[0, -1] = count
-    return buf
+    out = np.zeros((2, buf.shape[1] + 1), np.uint32)
+    out[:, :-1] = buf
+    out[0, -1] = count
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
@@ -372,8 +375,9 @@ def counted_filter_step(
 ) -> tuple[FilterState, FilterOutput]:
     """filter_step over the count-embedded wire form (one transfer/scan).
 
-    The count read back from ``packed[0, -1]`` is always < n, so the
-    reserved slot itself can never enter the live mask.
+    The count slot sits at index ``n`` of a ``(2, n + 1)`` buffer and the
+    count is at most ``n``, so the slot itself can never enter the
+    ``i < count`` live mask.
     """
     count = packed[0, -1].astype(jnp.int32)
     return _filter_step_impl(state, _unpack_compact(packed, count), cfg)

@@ -19,6 +19,7 @@ import functools
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -29,7 +30,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterOutput,
     FilterState,
     _unpack_compact,
-    pack_host_scan_compact,
+    pack_host_scan_counted,
 )
 from rplidar_ros2_driver_tpu.parallel.sharding import (
     build_sharded_step,
@@ -55,39 +56,38 @@ class ShardedFilterService:
         self.capacity = capacity
         sharded_step = build_sharded_step(self.mesh, self.cfg)
 
-        # compact ingest, like the single-stream wire path: one bit-packed
-        # (streams, 2, N) uint32 upload (8 bytes/point), unpacked to a
-        # stream-batched ScanBatch inside the jitted program
+        # counted compact ingest, like the single-stream wire path: one
+        # bit-packed (streams, 2, N) uint32 upload (8 bytes/point, per-stream
+        # node count embedded in each buffer's reserved last slot — no
+        # separate count vector transfer), unpacked to a stream-batched
+        # ScanBatch inside the jitted program
         @functools.partial(jax.jit, donate_argnums=(0,))
-        def step_packed(state, packed, count):
+        def step_packed(state, packed):
+            count = packed[:, 0, -1].astype(jnp.int32)
             batch = jax.vmap(_unpack_compact)(packed, count)
             return sharded_step(state, batch)
 
         self._step = step_packed
         self._packed_sharding = NamedSharding(self.mesh, P("stream", None, None))
-        self._count_sharding = NamedSharding(self.mesh, P("stream"))
         self._state = create_sharded_state(self.mesh, self.cfg, streams)
 
     # -- ingest -------------------------------------------------------------
 
-    def _stack(self, scans: Sequence[Optional[dict]]) -> tuple[np.ndarray, np.ndarray]:
+    def _stack(self, scans: Sequence[Optional[dict]]) -> np.ndarray:
         n = self.capacity
         s = self.streams
-        packed = np.zeros((s, 2, n), np.uint32)
-        count = np.zeros((s,), np.int32)
+        packed = np.zeros((s, 2, n + 1), np.uint32)  # +1: embedded count slot
         for i, scan in enumerate(scans):
             if scan is None:
                 continue  # stream idle this tick: all-masked scan (count 0)
             try:
-                buf, c = pack_host_scan_compact(
+                packed[i] = pack_host_scan_counted(
                     scan["angle_q14"], scan["dist_q2"], scan["quality"],
                     scan.get("flag"), n,
                 )
             except ValueError as e:
                 raise ValueError(f"stream {i}: {e}") from None
-            packed[i] = buf
-            count[i] = c
-        return packed, count
+        return packed
 
     def submit(self, scans: Sequence[Optional[dict]]) -> list[Optional[FilterOutput]]:
         """One tick: newest revolution per stream (None = no new data).
@@ -100,10 +100,9 @@ class ShardedFilterService:
         """
         if len(scans) != self.streams:
             raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
-        packed_np, count_np = self._stack(scans)
+        packed_np = self._stack(scans)
         packed = jax.device_put(packed_np, self._packed_sharding)
-        count = jax.device_put(count_np, self._count_sharding)
-        self._state, out = self._step(self._state, packed, count)
+        self._state, out = self._step(self._state, packed)
         # one fetch per array (already stream-batched: 5 fetches per TICK,
         # amortized over all streams)
         ranges = np.asarray(out.ranges)

@@ -99,17 +99,21 @@ def test_counted_step_matches_compact_step():
     np.testing.assert_array_equal(np.asarray(s_a.voxel_acc), np.asarray(s_b.voxel_acc))
 
 
-def test_counted_pack_truncates_full_capacity():
-    """The reserved count slot never holds a real node: a revolution
-    filling the buffer exactly (the assembler's MAX_SCAN_NODES truncation
-    case) drops its final node instead of raising in the hot path."""
+def test_counted_pack_keeps_full_capacity():
+    """The count rides in an extra column, so a revolution filling the
+    buffer exactly (the assembler's MAX_SCAN_NODES truncation case)
+    keeps every node — no silent drop vs the compact form."""
     angle = np.arange(1024, dtype=np.int32)
     buf = pack_host_scan_counted(angle, angle, angle, n=1024)
-    assert int(buf[0, -1]) == 1023  # truncated to capacity - 1
-    # one below capacity keeps every node
-    buf = pack_host_scan_counted(angle[:1023], angle[:1023], angle[:1023], n=1024)
-    assert int(buf[0, -1]) == 1023
-    np.testing.assert_array_equal(buf[1, :1023].astype(np.int64), angle[:1023])
+    assert buf.shape == (2, 1025)
+    assert int(buf[0, -1]) == 1024
+    np.testing.assert_array_equal(buf[1, :1024].astype(np.int64), angle)
+    # over capacity still rejects (same contract as the compact form)
+    import pytest
+
+    big = np.zeros(2048, np.int32)
+    with pytest.raises(ValueError):
+        pack_host_scan_counted(big, big, big, n=1024)
 
 
 def test_compact_roundtrip_field_ranges():
