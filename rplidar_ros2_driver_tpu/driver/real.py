@@ -67,17 +67,32 @@ NEW_TYPE_MAX_DISTANCE = 40.0
 def _default_transceiver_factory(
     channel_type: str, port: str, baudrate: int, host: str, net_port: int
 ) -> TransceiverLike:
-    from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
-
-    if channel_type == "serial":
-        ch = NativeChannel("serial", port, baud=baudrate)
-    elif channel_type == "tcp":
-        ch = NativeChannel("tcp", host, port=net_port)
-    elif channel_type == "udp":
-        ch = NativeChannel("udp", host, port=net_port)
-    else:
+    """Native C++ transport when the library builds/loads; otherwise the
+    pure-Python twin (protocol/pytransport.py) with a one-time notice —
+    same contracts, no SCHED_RR rx elevation."""
+    if channel_type not in ("serial", "tcp", "udp"):
         raise ValueError(f"unsupported channel_type {channel_type!r}")
-    return NativeTransceiver(ch)
+
+    def make_channel(channel_cls):
+        # NativeChannel and PyChannel are deliberate duck-type twins
+        if channel_type == "serial":
+            return channel_cls("serial", port, baud=baudrate)
+        return channel_cls(channel_type, host, port=net_port)
+
+    try:
+        from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
+
+        return NativeTransceiver(make_channel(NativeChannel))
+    except Exception as e:
+        from rplidar_ros2_driver_tpu.native import NativeUnavailable
+
+        if not isinstance(e, NativeUnavailable):
+            raise
+        log.warning("native I/O library unavailable (%s); using the "
+                    "pure-Python transport fallback", e)
+        from rplidar_ros2_driver_tpu.protocol.pytransport import PyChannel, PyTransceiver
+
+        return PyTransceiver(make_channel(PyChannel))
 
 
 class RealLidarDriver(LidarDriverInterface):

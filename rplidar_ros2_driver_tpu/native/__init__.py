@@ -100,12 +100,23 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+_load_error: NativeUnavailable | None = None
+
+
 def load(rebuild: bool = False) -> ctypes.CDLL:
-    """Load (building if necessary) the native library."""
-    global _lib
+    """Load (building if necessary) the native library.
+
+    Failure is cached: one failed build costs one compiler invocation per
+    process, not one per connect attempt (the driver's factory and every
+    FSM reconnect call this; re-running ``make`` each time would add
+    seconds to every retry).  ``rebuild=True`` clears the cache.
+    """
+    global _lib, _load_error
     with _lock:
         if _lib is not None and not rebuild:
             return _lib
+        if _load_error is not None and not rebuild:
+            raise _load_error
         if rebuild or not os.path.exists(_LIB_PATH):
             try:
                 subprocess.run(
@@ -116,11 +127,14 @@ def load(rebuild: bool = False) -> ctypes.CDLL:
                 )
             except (subprocess.CalledProcessError, FileNotFoundError) as e:
                 detail = getattr(e, "stderr", "") or str(e)
-                raise NativeUnavailable(f"native build failed: {detail}") from e
+                _load_error = NativeUnavailable(f"native build failed: {detail}")
+                raise _load_error from e
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
         except OSError as e:
-            raise NativeUnavailable(f"cannot load {_LIB_PATH}: {e}") from e
+            _load_error = NativeUnavailable(f"cannot load {_LIB_PATH}: {e}")
+            raise _load_error from e
+        _load_error = None
         return _lib
 
 

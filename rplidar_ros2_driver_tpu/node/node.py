@@ -88,15 +88,16 @@ class RPlidarNode(LifecycleNode):
     def on_configure(self) -> bool:
         log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
         if self._driver_factory is None and not self.params.dummy_mode:
-            # fail fast here, not inside the scan thread: the real backend
-            # needs the native I/O library (built/loaded lazily), and a
-            # factory error in the FSM thread would surface as silence
+            # probe the native I/O library here, not inside the scan thread:
+            # when it cannot be built/loaded the driver falls back to the
+            # pure-Python transport (protocol/pytransport.py), which works
+            # but loses the SCHED_RR rx elevation — worth one loud notice
             from rplidar_ros2_driver_tpu import native
 
             if not native.available():
-                log.error("real driver backend unavailable: native I/O library "
-                          "could not be built/loaded (see native/Makefile)")
-                return False
+                log.warning("native I/O library unavailable (see "
+                            "native/Makefile); real driver will use the "
+                            "pure-Python transport fallback")
         factory = self._driver_factory or self._default_factory
         self.fsm = ScanLoopFsm(
             factory,
