@@ -447,5 +447,18 @@ if __name__ == "__main__":
         default=MEDIAN_BACKEND,
         help="temporal-median kernel backend (config 5 records an A/B of both)",
     )
+    ap.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="capture a jax.profiler device trace of the benchmarked section "
+        "into DIR (TensorBoard / Perfetto viewable)",
+    )
     args = ap.parse_args()
-    main(args.config, args.median)
+    if args.profile:
+        from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
+
+        with profile_trace(args.profile):
+            main(args.config, args.median)
+    else:
+        main(args.config, args.median)

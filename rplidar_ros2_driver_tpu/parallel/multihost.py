@@ -1,0 +1,135 @@
+"""Multi-host (DCN-era) bring-up for the sharded service.
+
+The reference's distributed backend is its byte channel + DDS pub/sub
+(SURVEY.md §2.3); the single-host analog here is the ``(stream, beam)``
+ICI mesh (parallel/sharding.py).  This module is the multi-host rung of
+the same ladder: N processes, each owning its local TPU chips, joined
+into ONE global mesh by `jax.distributed` — the framework's equivalent
+of the reference scaling from one serial port to a fleet of network
+lidars, except the "network" is the XLA runtime's DCN/ICI fabric and
+the collectives are compiler-inserted.
+
+Usage (one call per process, before any other JAX API):
+
+    from rplidar_ros2_driver_tpu.parallel import multihost
+    multihost.initialize()            # no-op when single-process
+    mesh = multihost.make_global_mesh(stream=...)
+
+Process topology comes from the standard coordinator variables
+(``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``)
+or explicit arguments.  Every array placed with the meshes built here
+uses ``NamedSharding``, so the same ``ShardedFilterService`` program
+runs unmodified: XLA routes the beam-axis ``psum`` over ICI within a
+host and DCN across hosts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+log = logging.getLogger("rplidar_tpu.multihost")
+
+_COORD_ENV = "JAX_COORDINATOR_ADDRESS"
+_NPROC_ENV = "JAX_NUM_PROCESSES"
+_PID_ENV = "JAX_PROCESS_ID"
+
+_initialized = False
+
+
+def is_configured() -> bool:
+    """True when the environment declares a multi-process topology."""
+    return bool(os.environ.get(_COORD_ENV))
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the process group when a topology is configured.
+
+    Returns True when `jax.distributed` was initialized (or already
+    was), False for the single-process case — callers never need to
+    branch: everything downstream works identically either way.
+    Idempotent; safe to call from every entry point.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(_COORD_ENV)
+    if not coordinator_address:
+        return False
+    if num_processes is None:
+        env = os.environ.get(_NPROC_ENV)
+        if env is None:
+            # a coordinator with no topology is a misconfiguration, not a
+            # 1-process job: defaulting would make every host coordinator
+            # of its own disjoint mesh with no error pointing at the cause
+            raise ValueError(
+                f"{_COORD_ENV} is set but {_NPROC_ENV} is not; "
+                "a multi-process topology needs all three variables"
+            )
+        num_processes = int(env)
+    if process_id is None:
+        env = os.environ.get(_PID_ENV)
+        if env is None:
+            raise ValueError(
+                f"{_COORD_ENV} is set but {_PID_ENV} is not; "
+                "a multi-process topology needs all three variables"
+            )
+        process_id = int(env)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "joined process group: %d/%d via %s (%d global devices)",
+        process_id, num_processes, coordinator_address, jax.device_count(),
+    )
+    return True
+
+
+def make_global_mesh(stream: Optional[int] = None) -> Mesh:
+    """The ``(stream, beam)`` mesh over every device in the job.
+
+    Single-process: identical to ``make_mesh()``.  Multi-process: built
+    from ``jax.devices()`` (the *global* device list once initialize()
+    has run), so mesh axes span hosts; keep the stream axis aligned
+    with process boundaries when each host physically owns its lidars
+    (host-local streams avoid cross-DCN ingest transfers — the analog
+    of keeping collectives on ICI).
+    """
+    import jax
+
+    return make_mesh(devices=jax.devices(), stream=stream)
+
+
+def local_stream_slice(streams: int) -> slice:
+    """Which of the service's ``streams`` this process should feed.
+
+    With S streams spread over P processes (stream-major, matching the
+    mesh's stream axis when built by :func:`make_global_mesh`), process
+    p owns the contiguous block [p*S/P, (p+1)*S/P) — ingest stays
+    host-local, matching the sharding of the stacked upload.
+    Single-process: the full range.
+    """
+    import jax
+
+    p, n = jax.process_index(), jax.process_count()
+    if n <= 1:
+        return slice(0, streams)
+    if streams % n:
+        raise ValueError(f"{streams} streams do not divide over {n} processes")
+    per = streams // n
+    return slice(p * per, (p + 1) * per)

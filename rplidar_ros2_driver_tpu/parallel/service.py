@@ -50,7 +50,15 @@ class ShardedFilterService:
         beams: int = DEFAULT_BEAMS,
         capacity: int = MAX_SCAN_NODES,
     ) -> None:
-        self.mesh = mesh if mesh is not None else make_mesh()
+        if mesh is None:
+            # multi-process topology (coordinator env vars) joins the
+            # process group first, so the default mesh spans the GLOBAL
+            # device set; single-process this is a no-op
+            from rplidar_ros2_driver_tpu.parallel import multihost
+
+            multihost.initialize()
+            mesh = make_mesh()
+        self.mesh = mesh
         self.cfg = config_from_params(params, beams)
         self.streams = streams
         self.capacity = capacity

@@ -1,0 +1,86 @@
+"""Multi-host bring-up (parallel/multihost.py) — single-process paths.
+
+True multi-process needs N coordinated interpreters; what CAN be pinned
+down hermetically: topology detection, the no-op single-process path,
+global-mesh construction over the virtual device set, and the
+stream-ownership arithmetic every process uses to pick its lidars.
+"""
+
+import os
+from unittest import mock
+
+import jax
+import pytest
+
+from rplidar_ros2_driver_tpu.parallel import multihost
+
+
+def test_not_configured_without_env():
+    with mock.patch.dict(os.environ, {}, clear=False):
+        os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
+        assert not multihost.is_configured()
+        assert multihost.initialize() is False  # single-process: no-op
+
+
+def test_configured_detection():
+    with mock.patch.dict(
+        os.environ, {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234"}
+    ):
+        assert multihost.is_configured()
+
+
+def test_initialize_passes_topology_through():
+    """The env topology must reach jax.distributed.initialize verbatim."""
+    try:
+        with mock.patch.dict(
+            os.environ,
+            {
+                "JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234",
+                "JAX_NUM_PROCESSES": "4",
+                "JAX_PROCESS_ID": "2",
+            },
+        ), mock.patch.object(jax.distributed, "initialize") as init:
+            assert multihost.initialize() is True
+            init.assert_called_once_with(
+                coordinator_address="10.0.0.1:1234", num_processes=4, process_id=2
+            )
+    finally:
+        multihost._initialized = False  # undo the module latch regardless
+
+
+def test_partial_topology_is_an_error():
+    """A coordinator address without process count/id must fail loudly,
+    not default every host to its own 1-process job."""
+    env = {"JAX_COORDINATOR_ADDRESS": "10.0.0.1:1234"}
+    with mock.patch.dict(os.environ, env):
+        os.environ.pop("JAX_NUM_PROCESSES", None)
+        os.environ.pop("JAX_PROCESS_ID", None)
+        with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
+            multihost.initialize()
+    with mock.patch.dict(
+        os.environ, {**env, "JAX_NUM_PROCESSES": "4"}
+    ):
+        os.environ.pop("JAX_PROCESS_ID", None)
+        with pytest.raises(ValueError, match="JAX_PROCESS_ID"):
+            multihost.initialize()
+
+
+def test_global_mesh_single_process():
+    """Single process: the global mesh is just the local (stream, beam)
+    mesh over every visible device (8 virtual CPUs under conftest)."""
+    mesh = multihost.make_global_mesh()
+    assert set(mesh.axis_names) == {"stream", "beam"}
+    assert mesh.devices.size == len(jax.devices())
+
+
+def test_local_stream_slice_single_process():
+    assert multihost.local_stream_slice(6) == slice(0, 6)
+
+
+def test_local_stream_slice_multi_process_arithmetic():
+    with mock.patch.object(jax, "process_index", return_value=1), mock.patch.object(
+        jax, "process_count", return_value=4
+    ):
+        assert multihost.local_stream_slice(8) == slice(2, 4)
+        with pytest.raises(ValueError):
+            multihost.local_stream_slice(6)  # 6 streams / 4 processes

@@ -193,6 +193,23 @@ def test_pack_host_scan_overflow():
         pack_host_scan(angle, angle, angle, n=1024)
 
 
+def test_chain_warmup_is_invisible():
+    """Eager precompile (warmup=True, the default) must not change any
+    output: state after warmup is exactly a fresh state."""
+    params = DriverParams(
+        filter_backend="cpu", filter_window=4,
+        filter_chain=("clip", "median", "voxel"), voxel_grid_size=32,
+    )
+    warm = ScanFilterChain(params, beams=128, warmup=True)
+    cold = ScanFilterChain(params, beams=128, warmup=False)
+    for k in range(6):
+        angle, dist, qual = _raw_scan(k + 40)
+        out_w = warm.process_raw(angle, dist, qual)
+        out_c = cold.process_raw(angle, dist, qual)
+        np.testing.assert_array_equal(np.asarray(out_w.ranges), np.asarray(out_c.ranges))
+        np.testing.assert_array_equal(np.asarray(out_w.voxel), np.asarray(out_c.voxel))
+
+
 def test_incompatible_snapshot_discarded():
     """Restoring a snapshot taken under different chain geometry must fall
     back to a cold start, not crash the hot path."""

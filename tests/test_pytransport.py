@@ -106,6 +106,37 @@ class TestPySerial:
             sim.stop()
 
 
+class TestPyUdp:
+    def test_udp_connect_stream_silence(self):
+        """Connected-pair UDP datagrams through the Python channel; an
+        unplugged radio is silence (datagrams just stop), not an error."""
+        from rplidar_ros2_driver_tpu.driver.sim_device import UdpSimulatedDevice
+
+        sim = UdpSimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="udp", udp_host="127.0.0.1", udp_port=sim.port,
+                motor_warmup_s=0.0, transceiver_factory=_py_factory,
+            )
+            assert drv.connect("udp", 0, True)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 600)
+            got = None
+            deadline = time.monotonic() + 15
+            while got is None and time.monotonic() < deadline:
+                got = drv.grab_scan_host(2.0)
+            assert got is not None
+            assert len(got[0]["angle_q14"]) > 0
+            assert not drv._scan_decoder.timing.is_serial
+            sim.unplug()
+            t0 = time.monotonic()
+            while drv.grab_scan_host(0.5) is not None:
+                assert time.monotonic() - t0 < 10
+            drv.disconnect()
+        finally:
+            sim.stop()
+
+
 class TestFallbackSelection:
     def test_factory_falls_back_when_native_unavailable(self):
         """_default_transceiver_factory must hand out the Python transport
