@@ -139,6 +139,31 @@ class ShardedFilterService:
     def snapshot(self) -> dict[str, np.ndarray]:
         return {k: np.asarray(v) for k, v in vars(self._state).items()}
 
+    def save_sharded(self, path: str) -> None:
+        """Persist the sharded state with Orbax — no host gather: each
+        process writes its own shards (utils/checkpoint_orbax.py).  Use
+        this instead of snapshot()+npz once the fleet state stops fitting
+        comfortably in one host buffer."""
+        from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
+
+        checkpoint_orbax.save_sharded(path, self._state)
+
+    def load_sharded(self, path: str) -> bool:
+        """Restore an Orbax checkpoint directly onto this service's mesh.
+        Geometry mismatch (or absence) is rejected with the current state
+        left untouched; returns whether the restore happened.  The
+        restore template is abstract (ShapeDtypeStructs) — no throwaway
+        device state is allocated."""
+        from rplidar_ros2_driver_tpu.parallel.sharding import abstract_sharded_state
+        from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
+
+        template = abstract_sharded_state(self.mesh, self.cfg, self.streams)
+        got = checkpoint_orbax.restore_sharded(path, template)
+        if got is None:
+            return False
+        self._state = got
+        return True
+
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
         if snap is not None:
             # per-stream layout = FilterState.shapes with a leading stream

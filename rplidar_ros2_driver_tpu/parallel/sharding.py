@@ -262,6 +262,30 @@ def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterS
     return place_state(mesh, base)
 
 
+def abstract_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterState:
+    """ShapeDtypeStruct pytree matching :func:`create_sharded_state` —
+    same shapes, dtypes, and shardings, but NO device allocation.  The
+    checkpoint-restore template: restoring through this places shards
+    straight onto the mesh without first materializing a throwaway state."""
+    dtypes = {
+        "range_window": jnp.float32,
+        "inten_window": jnp.float32,
+        "hit_window": jnp.int32,
+        "voxel_acc": jnp.int32,
+        "cursor": jnp.int32,
+        "filled": jnp.int32,
+    }
+    shapes = FilterState.shapes(cfg.window, cfg.beams, cfg.grid)
+    return FilterState(**{
+        k: jax.ShapeDtypeStruct(
+            (streams, *shapes[k]),
+            dtypes[k],
+            sharding=NamedSharding(mesh, getattr(STATE_SPEC, k)),
+        )
+        for k in shapes
+    })
+
+
 def shard_batch(mesh: Mesh, batch: ScanBatch) -> ScanBatch:
     """Place a stream-batched ScanBatch according to BATCH_SPEC."""
     return jax.device_put(

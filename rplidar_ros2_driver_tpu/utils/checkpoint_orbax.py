@@ -1,0 +1,104 @@
+"""Orbax backend for sharded checkpoint/resume.
+
+The npz checkpointer (utils/checkpoint.py) is the single-stream default:
+host snapshot, one atomic file, no dependencies.  At fleet scale the
+service's state is a *sharded* pytree over the ``(stream, beam)`` mesh,
+and gathering it to one host buffer defeats the sharding; this backend
+saves/restores the device arrays directly with Orbax (the JAX
+ecosystem's standard checkpointer): each process writes exactly its
+addressable shards, restore places shards straight onto the restoring
+mesh — which may be a different mesh shape than the one that saved, as
+long as the global array shapes match.
+
+Durability matches the npz path's old-or-new contract: Orbax's own
+``force=True`` overwrite deletes the previous checkpoint *before*
+writing the new one, so a crash mid-save would lose both; instead the
+save lands in a sibling ``.saving`` directory and is rotated in with
+two renames (previous → ``.old``, new → final).  A crash between the
+renames leaves the previous checkpoint at ``.old``, which
+:func:`restore_sharded` falls back to.
+
+Geometry safety matches the npz path too: restore goes through an
+abstract template built from the target state, so a checkpoint of
+incompatible window/beams/grid fails cleanly instead of corrupting the
+compiled step.  Orbax is an *optional* dependency (``pip install
+rplidar-ros2-driver-tpu[orbax]``); nothing imports it until these
+functions run.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import shutil
+from typing import Optional
+
+import jax
+
+from rplidar_ros2_driver_tpu.ops.filters import FilterState
+
+log = logging.getLogger("rplidar_tpu.checkpoint")
+
+_SAVING_SUFFIX = ".saving"
+_OLD_SUFFIX = ".old"
+
+
+@functools.lru_cache(maxsize=1)
+def _checkpointer():
+    """One process-wide checkpointer: constructing one per call tears
+    down Orbax's async executor on GC, which breaks any later call with
+    'cannot schedule new futures after shutdown'."""
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_sharded(path: str, state: FilterState) -> None:
+    """Write the (possibly sharded) FilterState pytree under ``path``.
+
+    Blocks until the write is finalized and rotated in, so on return the
+    checkpoint at ``path`` is durable and a reader always finds either
+    the previous checkpoint or the new one (see module docstring for the
+    crash-window analysis).
+    """
+    path = os.path.abspath(path)
+    tmp, old = path + _SAVING_SUFFIX, path + _OLD_SUFFIX
+    shutil.rmtree(tmp, ignore_errors=True)
+    ck = _checkpointer()
+    ck.save(tmp, state, force=True)  # force only ever clears a dead .saving
+    ck.wait_until_finished()
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.isdir(path):
+        os.replace(path, old)
+    os.replace(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def restore_sharded(path: str, like: FilterState) -> Optional[FilterState]:
+    """Restore a FilterState shaped-and-sharded like ``like``.
+
+    ``like`` supplies the target geometry AND target shardings — pass
+    :func:`~rplidar_ros2_driver_tpu.parallel.sharding.abstract_sharded_state`
+    (allocation-free) or a concrete state: shards land directly on its
+    mesh.  Returns None when the checkpoint is absent or its geometry
+    does not match — the caller keeps its current state, mirroring
+    ScanFilterChain.restore's reject-don't-crash contract.  When ``path``
+    is missing but a rotation crash left ``path.old``, that previous
+    checkpoint is restored instead.
+    """
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        old = path + _OLD_SUFFIX
+        if not os.path.isdir(old):
+            return None
+        log.warning("checkpoint %s missing; recovering previous from %s", path, old)
+        path = old
+    template = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), like
+    )
+    try:
+        return _checkpointer().restore(path, template)
+    except (ValueError, KeyError, FileNotFoundError) as e:
+        log.warning("rejecting orbax checkpoint %s: %s", path, e)
+        return None
