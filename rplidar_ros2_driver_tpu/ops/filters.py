@@ -88,6 +88,10 @@ class FilterConfig:
     # "xla" = jnp.sort path; "pallas" = VMEM bitonic-network kernel
     # (ops/pallas_kernels.temporal_median_pallas)
     median_backend: str = "xla"
+    # sharded-step voxel all-reduce over the beam axis: "psum" (XLA's
+    # tuned all-reduce, default) or "ring" (explicit ppermute
+    # rotate-accumulate) — parallel/sharding.py; ignored single-device
+    voxel_reduce: str = "psum"
 
 
 @dataclasses.dataclass(frozen=True)

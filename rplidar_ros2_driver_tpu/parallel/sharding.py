@@ -12,8 +12,10 @@ DP/TP/SP are absent there).  The TPU framework makes scale-out first-class:
     accumulation is a partial-sum per shard reduced with ``psum`` over
     the beam axis — a single ICI all-reduce per revolution.
 
-Everything is expressed with ``jax.sharding.Mesh`` + ``shard_map`` so XLA
-inserts the collectives; there is no hand-written communication.  The
+Everything is expressed with ``jax.sharding.Mesh`` + ``shard_map``; the
+one collective is the voxel all-reduce, ``psum`` by default (XLA's tuned
+lowering) with an explicit ``ppermute`` ring formulation selectable via
+``FilterConfig.voxel_reduce`` (bit-identical, tested).  The
 reference's analog of the interconnect is its serial/TCP byte channel
 (SURVEY.md §2.3 note 1); here the interconnect is ICI and the "bytes" are
 sharded device arrays.
@@ -129,12 +131,43 @@ def _voxel_hits_partial(xy: jax.Array, mask: jax.Array, cfg: FilterConfig) -> ja
     return counts.reshape(grid, grid)
 
 
+def _ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
+    """All-reduce(+) via a ``ppermute`` ring: N-1 rotate-accumulate hops.
+
+    Semantically identical to ``psum`` (integer adds commute exactly);
+    exists as the explicit neighbor-exchange formulation of the same
+    collective — each hop moves one constant-size payload to the next
+    device around the axis, the pattern that rides ICI neighbor links.
+    ``psum`` remains the default: XLA lowers it to the platform's tuned
+    all-reduce, and on a (G, G) grid the latency-optimal choice is the
+    compiler's to make.
+    """
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc, rot = x, x
+    for _ in range(n - 1):
+        rot = jax.lax.ppermute(rot, axis_name, perm)
+        acc = acc + rot
+    return acc
+
+
+def _all_reduce(x: jax.Array, axis_name: str, mode: str) -> jax.Array:
+    if mode == "ring":
+        return _ring_all_reduce(x, axis_name)
+    if mode != "psum":
+        raise ValueError(f"unknown voxel_reduce mode {mode!r} (psum|ring)")
+    return jax.lax.psum(x, axis_name)
+
+
 def _filter_step_shard(
     state: FilterState, batch: ScanBatch, cfg: FilterConfig, b_local: int
 ) -> tuple[FilterState, FilterOutput]:
     """One stream's chain step on one (stream, beam) shard.
 
-    Beam-local throughout except the voxel partial-sum psum at the end.
+    Beam-local throughout except the voxel partial-sum all-reduce at the
+    end (``cfg.voxel_reduce``: compiler ``psum`` or explicit ``ring``).
     """
     if cfg.enable_clip:
         batch = clip_filter(batch, cfg)
@@ -149,7 +182,7 @@ def _filter_step_shard(
 
     if cfg.enable_voxel:
         # partial hits per beam shard -> one all-reduce over the beam axis
-        new_hits = jax.lax.psum(_voxel_hits_partial(xy, mask, cfg), "beam")
+        new_hits = _all_reduce(_voxel_hits_partial(xy, mask, cfg), "beam", cfg.voxel_reduce)
         old_hits = jax.lax.dynamic_index_in_dim(
             state.hit_window, state.cursor, 0, keepdims=False
         )
@@ -264,25 +297,23 @@ def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterS
 
 def abstract_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterState:
     """ShapeDtypeStruct pytree matching :func:`create_sharded_state` —
-    same shapes, dtypes, and shardings, but NO device allocation.  The
-    checkpoint-restore template: restoring through this places shards
-    straight onto the mesh without first materializing a throwaway state."""
-    dtypes = {
-        "range_window": jnp.float32,
-        "inten_window": jnp.float32,
-        "hit_window": jnp.int32,
-        "voxel_acc": jnp.int32,
-        "cursor": jnp.int32,
-        "filled": jnp.int32,
-    }
-    shapes = FilterState.shapes(cfg.window, cfg.beams, cfg.grid)
-    return FilterState(**{
-        k: jax.ShapeDtypeStruct(
-            (streams, *shapes[k]),
-            dtypes[k],
-            sharding=NamedSharding(mesh, getattr(STATE_SPEC, k)),
+    same shapes, dtypes, shardings, and validation, but NO device
+    allocation.  The checkpoint-restore template: restoring through this
+    places shards straight onto the mesh without first materializing a
+    throwaway state.  Shapes/dtypes are derived from the single-stream
+    constructor via ``jax.eval_shape`` so they cannot drift from it."""
+    if streams % mesh.shape["stream"]:
+        raise ValueError(
+            f"streams={streams} not divisible by stream axis {mesh.shape['stream']}"
         )
-        for k in shapes
+    per = jax.eval_shape(lambda: FilterState.create(cfg.window, cfg.beams, cfg.grid))
+    return FilterState(**{
+        f.name: jax.ShapeDtypeStruct(
+            (streams, *getattr(per, f.name).shape),
+            getattr(per, f.name).dtype,
+            sharding=NamedSharding(mesh, getattr(STATE_SPEC, f.name)),
+        )
+        for f in dataclasses.fields(FilterState)
     })
 
 

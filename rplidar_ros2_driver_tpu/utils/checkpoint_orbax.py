@@ -54,25 +54,42 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _barrier(tag: str) -> None:
+    """Cross-process sync point; free when single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"rpl_ckpt:{tag}")
+
+
 def save_sharded(path: str, state: FilterState) -> None:
     """Write the (possibly sharded) FilterState pytree under ``path``.
 
     Blocks until the write is finalized and rotated in, so on return the
     checkpoint at ``path`` is durable and a reader always finds either
     the previous checkpoint or the new one (see module docstring for the
-    crash-window analysis).
+    crash-window analysis).  Multi-process: every process calls this
+    (Orbax's save is collective — each writes its shards); the
+    filesystem rotation is performed by process 0 only, bracketed by
+    barriers, mirroring how Orbax itself finalizes on the primary host.
     """
     path = os.path.abspath(path)
     tmp, old = path + _SAVING_SUFFIX, path + _OLD_SUFFIX
-    shutil.rmtree(tmp, ignore_errors=True)
+    primary = jax.process_index() == 0
+    if primary:
+        shutil.rmtree(tmp, ignore_errors=True)
+    _barrier("pre-save")
     ck = _checkpointer()
     ck.save(tmp, state, force=True)  # force only ever clears a dead .saving
     ck.wait_until_finished()
-    shutil.rmtree(old, ignore_errors=True)
-    if os.path.isdir(path):
-        os.replace(path, old)
-    os.replace(tmp, path)
-    shutil.rmtree(old, ignore_errors=True)
+    _barrier("post-save")
+    if primary:
+        shutil.rmtree(old, ignore_errors=True)
+        if os.path.isdir(path):
+            os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    _barrier("post-rotate")
 
 
 def restore_sharded(path: str, like: FilterState) -> Optional[FilterState]:

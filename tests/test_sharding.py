@@ -63,6 +63,26 @@ def test_sharded_matches_single_device():
     )
 
 
+def test_ring_reduce_matches_psum():
+    """The explicit ppermute ring all-reduce is semantically psum: the
+    sharded step under voxel_reduce='ring' must be bit-identical to the
+    default, across a beam axis wide enough for multiple hops."""
+    mesh = make_mesh(8, stream=2)  # beam axis = 4 -> 3 ring hops
+    streams = 2
+    batch = _make_batch(streams)
+    outs = {}
+    for mode in ("psum", "ring"):
+        cfg = FilterConfig(window=4, beams=64, grid=16, cell_m=0.5, voxel_reduce=mode)
+        step = build_sharded_step(mesh, cfg)
+        state = create_sharded_state(mesh, cfg, streams)
+        sbatch = shard_batch(mesh, batch)
+        for _ in range(3):
+            state, out = step(state, sbatch)
+        outs[mode] = (np.asarray(out.voxel), np.asarray(state.voxel_acc))
+    np.testing.assert_array_equal(outs["ring"][0], outs["psum"][0])
+    np.testing.assert_array_equal(outs["ring"][1], outs["psum"][1])
+
+
 @pytest.mark.parametrize("n", [2, 4, 8])
 def test_dryrun_multichip(n):
     import __graft_entry__ as ge
