@@ -137,7 +137,7 @@ def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
     dt = time.perf_counter() - t0
     sps = n_chunks * chunk / dt
     return {
-        "metric": "fused_replay_scans_per_sec",
+        "metric": metric_name(7),
         "value": round(sps, 2),
         "unit": "scans/s",
         "vs_baseline": round(sps / BASELINE_SCANS_PER_SEC, 3),
@@ -248,7 +248,7 @@ def bench_e2e(seconds: float = 15.0) -> dict:
 
     rev_p99 = timer.percentile("rev_to_dispatch", 99) * 1e3
     return {
-        "metric": "e2e_decode_chain_scans_per_sec",
+        "metric": metric_name(6),
         "value": round(published / seconds, 2),
         "unit": "scans/s",
         "vs_baseline": round(published / seconds / BASELINE_SCANS_PER_SEC, 3),
@@ -297,7 +297,7 @@ def bench_passthrough(points: int) -> dict:
     _device_barrier(out.ranges)
     dt = time.perf_counter() - t0
     return {
-        "metric": "a1m8_passthrough_scans_per_sec",
+        "metric": metric_name(1),
         "value": round(ITERS / dt, 2),
         "unit": "scans/s",
         "vs_baseline": round(ITERS / dt / BASELINE_SCANS_PER_SEC, 3),
@@ -364,6 +364,17 @@ class _ChainRunner:
         return float(np.percentile(lat, 99) * 1e3)
 
 
+def metric_name(config: int) -> str:
+    """The one config -> metric-name mapping (success AND failure records
+    of a config must share a name to land in the same series)."""
+    return {
+        1: "a1m8_passthrough_scans_per_sec",
+        5: "denseboost64_filter_chain_scans_per_sec",
+        6: "e2e_decode_chain_scans_per_sec",
+        7: "fused_replay_scans_per_sec",
+    }.get(config, f"graded_config{config}_scans_per_sec")
+
+
 def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
     kind, points, over = GRADED[config]
     if kind == "passthrough":
@@ -409,11 +420,7 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
         ab = None
 
     result = {
-        "metric": (
-            "denseboost64_filter_chain_scans_per_sec"
-            if config == 5
-            else f"graded_config{config}_scans_per_sec"
-        ),
+        "metric": metric_name(config),
         "value": round(scans_per_sec, 2),
         "unit": "scans/s",
         "vs_baseline": round(scans_per_sec / BASELINE_SCANS_PER_SEC, 3),
@@ -455,6 +462,42 @@ if __name__ == "__main__":
         "into DIR (TensorBoard / Perfetto viewable)",
     )
     args = ap.parse_args()
+
+    # Backend-init watchdog: a dead remote-attach tunnel makes
+    # jax.devices() block forever (observed: the relay process died and
+    # every backend init hung until killed).  Probe it from a daemon
+    # thread with a generous budget so a broken link yields ONE honest
+    # JSON line instead of a silent hang.
+    import threading
+
+    _probe_done = threading.Event()
+    _probe_err: list = []
+
+    def _probe() -> None:
+        try:
+            jax.devices()
+        except BaseException as e:  # report the real failure, not a timeout
+            _probe_err.append(f"{type(e).__name__}: {e}")
+        finally:
+            _probe_done.set()
+
+    threading.Thread(target=_probe, daemon=True).start()
+    if not _probe_done.wait(timeout=240.0) or _probe_err:
+        err = (
+            _probe_err[0]
+            if _probe_err
+            else "jax backend init timed out after 240 s "
+                 "(remote-attach tunnel unreachable)"
+        )
+        print(json.dumps({
+            "metric": metric_name(args.config),
+            "value": 0.0,
+            "unit": "scans/s",
+            "vs_baseline": 0.0,
+            "error": err,
+        }))
+        raise SystemExit(3)
+
     if args.profile:
         from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
 

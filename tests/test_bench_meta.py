@@ -1,0 +1,28 @@
+"""bench.py metadata invariants (no device work — safe on CPU CI).
+
+The driver keys benchmark series by metric name; success and failure
+records of one config must share a name, and no two configs may collide.
+"""
+
+import bench
+
+
+def test_metric_names_unique_across_configs():
+    names = {c: bench.metric_name(c) for c in bench.GRADED}
+    assert len(set(names.values())) == len(names), names
+
+
+def test_metric_names_stable():
+    # the driver's recorded series — renames would orphan history
+    assert bench.metric_name(5) == "denseboost64_filter_chain_scans_per_sec"
+    assert bench.metric_name(6) == "e2e_decode_chain_scans_per_sec"
+    assert bench.metric_name(1) == "a1m8_passthrough_scans_per_sec"
+    assert bench.metric_name(7) == "fused_replay_scans_per_sec"
+    assert bench.metric_name(4) == "graded_config4_scans_per_sec"
+
+
+def test_graded_table_well_formed():
+    for c, (kind, points, over) in bench.GRADED.items():
+        assert kind in ("passthrough", "chain", "e2e", "fused")
+        assert points > 0
+        assert isinstance(over, dict)
