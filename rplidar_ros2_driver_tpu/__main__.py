@@ -45,6 +45,10 @@ def _cmd_run(args) -> int:
         if node.lifecycle_state is LifecycleState.INACTIVE:
             node.cleanup()
         node.shutdown()
+    if args.stats:
+        import json
+
+        print(json.dumps(node.tracer.summary(), indent=2))
     return 0
 
 
@@ -159,6 +163,8 @@ def main(argv=None) -> int:
     run.add_argument("--dummy", action="store_true", help="force the synthetic backend")
     run.add_argument("--duration", type=float, default=0.0, help="seconds to run (0 = forever)")
     run.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
+    run.add_argument("--stats", action="store_true",
+                     help="print per-stage latency percentiles (JSON) at exit")
 
     view = sub.add_parser("view", help="capture dummy scans and render a top-down view")
     view.add_argument("--scans", type=int, default=3)

@@ -36,6 +36,9 @@ RUNTIME_MUTABLE = ("rpm", "scan_processing", "scan_mode")
 VALID_QOS = ("reliable", "best_effort")
 VALID_BACKENDS = ("cpu", "tpu")
 VALID_CHANNELS = ("serial", "tcp", "udp", "dummy")
+# "polar" is accepted for symmetry with the BASELINE graded configs but
+# the Cartesian projection is always computed inside the fused step (its
+# output feeds voxelization); the other three stages toggle real work.
 VALID_FILTER_STAGES = ("clip", "polar", "median", "voxel")
 
 

@@ -234,6 +234,7 @@ def test_cli_run_duration():
             "--duration",
             "2",
             "--cpu",
+            "--stats",
         ],
         capture_output=True,
         text=True,
@@ -241,6 +242,18 @@ def test_cli_run_duration():
     )
     assert out.returncode == 0, out.stderr
     assert "scans=" in out.stdout
+    # --stats appends a JSON per-stage latency summary after shutdown
+    import json
+    import re
+
+    brace = out.stdout.find("{")
+    assert brace != -1, f"no stats JSON in output: {out.stdout!r}"
+    summary = json.loads(out.stdout[brace:])
+    # stage entries exist only for scans that actually published; on a
+    # loaded host the whole duration can go to the first jit compile
+    scan_counts = [int(m) for m in re.findall(r"scans=(\d+)", out.stdout)]
+    if scan_counts and scan_counts[-1] > 0:
+        assert "publish" in summary and "p99_ms" in summary["publish"]
 
 
 def test_raising_callback_does_not_wedge_subscription_or_publisher():
