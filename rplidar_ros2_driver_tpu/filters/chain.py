@@ -16,9 +16,11 @@ RESETTING recovery — either preserve or deterministically reset the window
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
@@ -68,7 +70,17 @@ def config_from_params(params: DriverParams, beams: int = DEFAULT_BEAMS) -> Filt
 
 
 class ScanFilterChain:
-    """Stateful host wrapper around the fused filter_step program."""
+    """Stateful host wrapper around the fused filter_step program.
+
+    Thread-safety: the hot-path step DONATES the state buffers (they are
+    deleted the moment a step is dispatched), so a concurrent
+    ``snapshot()`` — e.g. a checkpoint requested while the scan thread
+    streams — would read deleted arrays and raise.  Every method that
+    reads or swaps the state (process/process_raw/snapshot/restore)
+    serializes on one lock, uncontended in steady state (one scan
+    thread).  The ``state`` property is the one unsynchronized accessor
+    (debug/tests); see its docstring.
+    """
 
     def __init__(
         self,
@@ -80,6 +92,7 @@ class ScanFilterChain:
         self.cfg = config_from_params(params, beams)
         self.device = _pick_device(params.filter_backend)
         self.backend = params.filter_backend
+        self._lock = threading.Lock()
         self._state = jax.device_put(
             FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
             self.device,
@@ -98,26 +111,28 @@ class ScanFilterChain:
         this never ran.  On a state that has already absorbed scans the
         warmup step would overwrite the current ring row, so it is
         skipped — the program is necessarily compiled by then anyway."""
-        if int(np.asarray(self._state.filled)) != 0:
-            return
-        zeros = np.zeros(0, np.int32)
-        buf = pack_host_scan_counted(zeros, zeros, zeros)
-        packed = jax.device_put(buf, self.device)
-        state, _ = counted_filter_step_wire(self._state, packed, self.cfg)
-        # the step donates its state argument: rebuild from the stepped
-        # arrays with the cursor/filled advance undone
-        self._state = FilterState(
-            range_window=state.range_window,
-            inten_window=state.inten_window,
-            hit_window=state.hit_window,
-            voxel_acc=state.voxel_acc,
-            cursor=state.cursor * 0,
-            filled=state.filled * 0,
-        )
+        with self._lock:
+            if int(np.asarray(self._state.filled)) != 0:
+                return
+            zeros = np.zeros(0, np.int32)
+            buf = pack_host_scan_counted(zeros, zeros, zeros)
+            packed = jax.device_put(buf, self.device)
+            state, _ = counted_filter_step_wire(self._state, packed, self.cfg)
+            # the step donates its state argument: rebuild from the stepped
+            # arrays with the cursor/filled advance undone
+            self._state = FilterState(
+                range_window=state.range_window,
+                inten_window=state.inten_window,
+                hit_window=state.hit_window,
+                voxel_acc=state.voxel_acc,
+                cursor=state.cursor * 0,
+                filled=state.filled * 0,
+            )
 
     def process(self, batch: ScanBatch) -> FilterOutput:
         batch = jax.device_put(batch, self.device)
-        self._state, out = filter_step(self._state, batch, self.cfg)
+        with self._lock:
+            self._state, out = filter_step(self._state, batch, self.cfg)
         return out
 
     def process_raw(self, angle_q14, dist_q2, quality, flag=None) -> FilterOutput:
@@ -132,14 +147,22 @@ class ScanFilterChain:
         """
         buf = pack_host_scan_counted(angle_q14, dist_q2, quality, flag)
         packed = jax.device_put(buf, self.device)
-        self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
+        with self._lock:
+            self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
         return unpack_output_wire(wire, self.cfg)
 
     # -- checkpoint surface -------------------------------------------------
 
     def snapshot(self) -> dict[str, np.ndarray]:
-        """Host copy of the rolling window + accumulator."""
-        return {k: np.asarray(v) for k, v in vars(self._state).items()}
+        """Host copy of the rolling window + accumulator.
+
+        Safe against the streaming thread: a device-side copy is taken
+        under the lock (cheap — on-device), then the lock is released
+        before the host gather, so a checkpoint never stalls the hot
+        path for the duration of a device->host fetch."""
+        with self._lock:
+            state = jax.tree_util.tree_map(jnp.copy, self._state)
+        return {k: np.asarray(v) for k, v in vars(state).items()}
 
     @staticmethod
     def _shape_mismatch(
@@ -194,12 +217,14 @@ class ScanFilterChain:
                 )
                 return False
         if snap is None:
-            self._state = jax.device_put(
-                FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
-                self.device,
-            )
+            with self._lock:
+                self._state = jax.device_put(
+                    FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
+                    self.device,
+                )
             return False
-        self._state = jax.device_put(FilterState(**snap), self.device)
+        with self._lock:
+            self._state = jax.device_put(FilterState(**snap), self.device)
         return True
 
     def reset(self) -> None:
@@ -207,4 +232,10 @@ class ScanFilterChain:
 
     @property
     def state(self) -> FilterState:
+        """The live device state — UNSYNCHRONIZED debug/test accessor.
+
+        The arrays returned are the ones the next (donating) step will
+        consume; reading them concurrently with streaming can observe
+        deleted buffers.  Use :meth:`snapshot` from any thread that does
+        not own the streaming loop."""
         return self._state

@@ -16,6 +16,7 @@ contract.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Optional, Sequence
 
 import jax
@@ -77,6 +78,14 @@ class ShardedFilterService:
 
         self._step = step_packed
         self._packed_sharding = NamedSharding(self.mesh, P("stream", None, None))
+        # step_packed donates the state (deleted at dispatch); snapshots/
+        # restores racing a concurrent tick in THIS process serialize on
+        # this lock (same hazard and remedy as ScanFilterChain).  The lock
+        # is per-process: in multi-process mode collective operations
+        # (submit ticks, save_sharded) must additionally be issued in the
+        # same order by every process — a local mutex cannot order
+        # collectives across hosts (see save_sharded's docstring).
+        self._lock = threading.Lock()
         self._state = create_sharded_state(self.mesh, self.cfg, streams)
 
     # -- ingest -------------------------------------------------------------
@@ -110,7 +119,8 @@ class ShardedFilterService:
             raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
         packed_np = self._stack(scans)
         packed = jax.device_put(packed_np, self._packed_sharding)
-        self._state, out = self._step(self._state, packed)
+        with self._lock:
+            self._state, out = self._step(self._state, packed)
         # one fetch per array (already stream-batched: 5 fetches per TICK,
         # amortized over all streams)
         ranges = np.asarray(out.ranges)
@@ -136,17 +146,33 @@ class ShardedFilterService:
 
     # -- checkpoint surface (mirrors ScanFilterChain's) ---------------------
 
+    def _copy_state(self) -> FilterState:
+        """Device-side copy of the live state under the lock — the lock is
+        held only for the (cheap, on-device) copy dispatch, never across a
+        host gather or disk write, so checkpoints don't stall ticks."""
+        with self._lock:
+            return jax.tree_util.tree_map(jnp.copy, self._state)
+
     def snapshot(self) -> dict[str, np.ndarray]:
-        return {k: np.asarray(v) for k, v in vars(self._state).items()}
+        state = self._copy_state()
+        return {k: np.asarray(v) for k, v in vars(state).items()}
 
     def save_sharded(self, path: str) -> None:
         """Persist the sharded state with Orbax — no host gather: each
         process writes its own shards (utils/checkpoint_orbax.py).  Use
         this instead of snapshot()+npz once the fleet state stops fitting
-        comfortably in one host buffer."""
+        comfortably in one host buffer.
+
+        Collective: in multi-process mode EVERY process must call this,
+        and every process must sequence its submit()/save_sharded() calls
+        in the same global order (e.g. checkpoint between ticks from the
+        same control loop) — the internal lock only orders threads within
+        one process, and interleaving mismatched collectives across
+        processes deadlocks the mesh.
+        """
         from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
 
-        checkpoint_orbax.save_sharded(path, self._state)
+        checkpoint_orbax.save_sharded(path, self._copy_state())
 
     def load_sharded(self, path: str) -> bool:
         """Restore an Orbax checkpoint directly onto this service's mesh.
@@ -161,7 +187,8 @@ class ShardedFilterService:
         got = checkpoint_orbax.restore_sharded(path, template)
         if got is None:
             return False
-        self._state = got
+        with self._lock:
+            self._state = got
         return True
 
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
@@ -184,7 +211,9 @@ class ShardedFilterService:
                     expected,
                 )
                 return False
-            self._state = place_state(self.mesh, FilterState(**snap))
+            with self._lock:
+                self._state = place_state(self.mesh, FilterState(**snap))
             return True
-        self._state = create_sharded_state(self.mesh, self.cfg, self.streams)
+        with self._lock:
+            self._state = create_sharded_state(self.mesh, self.cfg, self.streams)
         return False
