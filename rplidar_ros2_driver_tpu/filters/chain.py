@@ -216,15 +216,20 @@ class ScanFilterChain:
                     "rejecting incompatible filter snapshot (%s != %s)", got, expected
                 )
                 return False
+        # build the new device state OUTSIDE the lock (the H2D upload is
+        # several MB at default geometry); only the reference swap — O(1)
+        # — holds the streaming lock
         if snap is None:
+            fresh = jax.device_put(
+                FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
+                self.device,
+            )
             with self._lock:
-                self._state = jax.device_put(
-                    FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
-                    self.device,
-                )
+                self._state = fresh
             return False
+        restored = jax.device_put(FilterState(**snap), self.device)
         with self._lock:
-            self._state = jax.device_put(FilterState(**snap), self.device)
+            self._state = restored
         return True
 
     def reset(self) -> None:

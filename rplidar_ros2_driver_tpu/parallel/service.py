@@ -211,9 +211,12 @@ class ShardedFilterService:
                     expected,
                 )
                 return False
+            # H2D placement outside the lock; only the O(1) swap inside
+            restored = place_state(self.mesh, FilterState(**snap))
             with self._lock:
-                self._state = place_state(self.mesh, FilterState(**snap))
+                self._state = restored
             return True
+        fresh = create_sharded_state(self.mesh, self.cfg, self.streams)
         with self._lock:
-            self._state = create_sharded_state(self.mesh, self.cfg, self.streams)
+            self._state = fresh
         return False

@@ -85,6 +85,41 @@ def test_reconfigure_diagnostics_checkpoint_under_streaming(tmp_path):
         sim.stop()
 
 
+def test_service_snapshot_races_submit():
+    """Sharded-service analog of the chain race: snapshots hammered from
+    another thread while ticks stream must never observe donated-deleted
+    buffers."""
+    from test_sharded_service import _params, _scan  # shared fixtures
+
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+
+    svc = ShardedFilterService(_params(), streams=2, beams=128, capacity=512)
+    scan = _scan
+
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def snapshotter():
+        while not stop.is_set():
+            try:
+                snap = svc.snapshot()
+                assert snap["voxel_acc"].shape == (2, 32, 32)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=snapshotter)
+    t.start()
+    try:
+        for k in range(200):
+            svc.submit([scan(k), scan(k + 1000)])
+    finally:
+        stop.set()
+        t.join(5.0)
+    assert not t.is_alive()
+    assert not errors, errors
+
+
 def test_two_nodes_two_devices_are_isolated():
     """Per-instance decoder state (vs the reference's process-global
     `static lastNodeSyncBit`): two concurrent driver stacks must not
