@@ -116,6 +116,11 @@ class SimulatedDevice:
         self.port = 0
         # observability for tests
         self.motor_rpm = 0
+        # wire format of the most recently started stream — NOT reset on
+        # stop/unplug (observability for tests asserting what the last
+        # scan start selected, not a liveness signal; use _streaming for
+        # that)
+        self.active_ans_type = 0
         self.commands: list[int] = []
 
     # ------------------------------------------------------------------
@@ -387,6 +392,7 @@ class SimulatedDevice:
         self._streaming.clear()
         if self._stream_thread is not None:
             self._stream_thread.join(2.0)
+        self.active_ans_type = int(mode.ans_type)  # test observability
         self._streaming.set()
         self._stream_thread = threading.Thread(
             target=self._stream_loop, args=(mode,), name="sim_stream", daemon=True
