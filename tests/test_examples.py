@@ -1,0 +1,28 @@
+"""The examples/ workflows must keep running end-to-end (CPU)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize(
+    "script,extra",
+    [
+        ("single_lidar.py", ["--seconds", "3"]),
+        ("fleet_gateway.py", ["--ticks", "3"]),
+        ("record_replay.py", ["--seconds", "2"]),
+    ],
+)
+def test_example_runs(script, extra):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script), "--cpu", *extra],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        cwd=_ROOT,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
