@@ -11,11 +11,11 @@ gather) and a restore into a fresh service.
 """
 
 import argparse
+import os
 import shutil
 import sys
-import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
@@ -64,19 +64,29 @@ def main() -> int:
             occ = [int(np.asarray(o.voxel).sum()) if o else 0 for o in outs]
             print(f"tick {tick}: {live}/{args.streams} streams, voxel occ {occ}")
 
-        shutil.rmtree("/tmp/fleet_ckpt", ignore_errors=True)
-        svc.save_sharded("/tmp/fleet_ckpt")
-        svc2 = ShardedFilterService(params, streams=args.streams,
-                                    beams=256, capacity=4096)
-        ok = svc2.load_sharded("/tmp/fleet_ckpt")
-        print(f"orbax restore into a fresh service: {'ok' if ok else 'FAILED'}")
+        import tempfile
+
+        ckpt_dir = os.path.join(tempfile.mkdtemp(prefix="fleet_ckpt_"), "ckpt")
+        try:
+            svc.save_sharded(ckpt_dir)
+            svc2 = ShardedFilterService(params, streams=args.streams,
+                                        beams=256, capacity=4096)
+            ok = svc2.load_sharded(ckpt_dir)
+            print(f"orbax restore into a fresh service: {'ok' if ok else 'FAILED'}")
+        finally:
+            shutil.rmtree(os.path.dirname(ckpt_dir), ignore_errors=True)
     finally:
         for d in drvs:
-            d.stop_motor()
-            d.disconnect()
+            try:
+                d.stop_motor()
+                d.disconnect()
+            except Exception:
+                pass
         for s in sims:
-            s.stop()
-        shutil.rmtree("/tmp/fleet_ckpt", ignore_errors=True)
+            try:
+                s.stop()
+            except Exception:
+                pass
     return 0 if ok else 1
 
 

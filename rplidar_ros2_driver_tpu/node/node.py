@@ -31,7 +31,7 @@ from rplidar_ros2_driver_tpu.core.types import ScanBatch
 from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
 from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
 from rplidar_ros2_driver_tpu.node.diagnostics import DiagnosticsUpdater
-from rplidar_ros2_driver_tpu.node.fsm import DriverState, FsmTimings, ScanLoopFsm
+from rplidar_ros2_driver_tpu.node.fsm import FsmTimings, ScanLoopFsm
 from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleNode, LifecycleState
 from rplidar_ros2_driver_tpu.node.messages import (
     LaserScanHost,

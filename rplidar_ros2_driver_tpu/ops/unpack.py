@@ -31,7 +31,6 @@ from rplidar_ros2_driver_tpu.protocol.constants import (
     EXP_SYNC_2,
     HQ_CAPSULE_BYTES,
     HQ_NODES_PER_CAPSULE,
-    NORMAL_NODE_BYTES,
     ULTRA_CAPSULE_BYTES,
     ULTRA_DENSE_CAPSULE_BYTES,
     VARBITSCALE_X2_DEST_VAL,

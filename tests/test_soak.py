@@ -19,10 +19,20 @@ from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
 from rplidar_ros2_driver_tpu.driver.sim_device import SimConfig, SimulatedDevice
 
 
-@pytest.mark.parametrize("rate_mult", [1.0, 3.0])
-def test_sustained_stream_keeps_up(rate_mult):
+def _py_factory(channel_type, port, baudrate, host, net_port):
+    from rplidar_ros2_driver_tpu.protocol.pytransport import PyChannel, PyTransceiver
+
+    return PyTransceiver(PyChannel("tcp", host, port=net_port))
+
+
+@pytest.mark.parametrize(
+    "rate_mult,transport",
+    [(1.0, "native"), (3.0, "native"), (1.0, "python")],
+)
+def test_sustained_stream_keeps_up(rate_mult, transport):
     """At device pace and at 3x device pace the grab loop must see
-    (nearly) every revolution: decode + assembly are not the bottleneck."""
+    (nearly) every revolution: decode + assembly are not the bottleneck.
+    The pure-Python transport fallback must also hold device pace."""
     # DenseBoost cadence: 3200 pts/rev @ 10 rev/s = 800 frames/s (64
     # nodes/ultra-dense pair frame -> 50 frames/rev)
     frame_rate = 800.0 * rate_mult
@@ -34,6 +44,7 @@ def test_sustained_stream_keeps_up(rate_mult):
         drv = RealLidarDriver(
             channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
             motor_warmup_s=0.0,
+            transceiver_factory=_py_factory if transport == "python" else None,
         )
         assert drv.connect("sim", 0, False)
         drv.detect_and_init_strategy()
