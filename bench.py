@@ -464,37 +464,18 @@ if __name__ == "__main__":
     args = ap.parse_args()
 
     # Backend-init watchdog: a dead remote-attach tunnel makes
-    # jax.devices() block forever (observed: the relay process died and
-    # every backend init hung until killed).  Probe it from a daemon
-    # thread with a generous budget so a broken link yields ONE honest
-    # JSON line instead of a silent hang.
-    import threading
+    # jax.devices() block forever — probe with the shared hang guard so a
+    # broken link yields ONE honest JSON line instead of a silent hang.
+    from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
 
-    _probe_done = threading.Event()
-    _probe_err: list = []
-
-    def _probe() -> None:
-        try:
-            jax.devices()
-        except BaseException as e:  # report the real failure, not a timeout
-            _probe_err.append(f"{type(e).__name__}: {e}")
-        finally:
-            _probe_done.set()
-
-    threading.Thread(target=_probe, daemon=True).start()
-    if not _probe_done.wait(timeout=240.0) or _probe_err:
-        err = (
-            _probe_err[0]
-            if _probe_err
-            else "jax backend init timed out after 240 s "
-                 "(remote-attach tunnel unreachable)"
-        )
+    _ok, _detail, _devices = probe_jax_backend(240.0)
+    if not _ok:
         print(json.dumps({
             "metric": metric_name(args.config),
             "value": 0.0,
             "unit": "scans/s",
             "vs_baseline": 0.0,
-            "error": err,
+            "error": _detail,
         }))
         raise SystemExit(3)
 

@@ -21,7 +21,7 @@ Layer map (top to bottom), mirroring SURVEY.md §1:
   parallel/  — device meshes, sharded multi-stream pipeline
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # keep in lockstep with pyproject.toml
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, LaserScanMsg, ScanBatch

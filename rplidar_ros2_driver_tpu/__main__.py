@@ -153,6 +153,102 @@ def _cmd_replay(args) -> int:
     return 0
 
 
+def _cmd_doctor(args) -> int:
+    """Environment self-check: every row prints PASS/WARN/FAIL + detail.
+
+    Exit code is 1 only on FAIL (WARN covers degraded-but-working
+    states like the pure-Python transport fallback)."""
+    results: list[tuple[str, str, str]] = []
+
+    def check(name: str, fn) -> None:
+        try:
+            level, detail = fn()
+        except Exception as e:  # noqa: BLE001 - a crashed probe IS the finding
+            level, detail = "FAIL", f"{type(e).__name__}: {e}"
+        results.append((name, level, detail))
+
+    def deps():
+        # informational: a genuinely MISSING jax/numpy fails at package
+        # import, before this subcommand runs — this row reports what is
+        # installed, it cannot catch absence
+        import jax
+
+        import numpy
+
+        return "PASS", f"jax {jax.__version__}, numpy {numpy.__version__}"
+
+    def native_lib():
+        from rplidar_ros2_driver_tpu import native
+
+        if native.available():
+            return "PASS", "librpl_native.so loaded (C++ I/O plane active)"
+        return "WARN", ("native library unavailable — pure-Python transport "
+                        "fallback will be used (no SCHED_RR rx elevation)")
+
+    def jax_backend():
+        from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
+
+        ok, detail, _devices = probe_jax_backend(args.device_timeout)
+        return ("PASS" if ok else "FAIL"), detail
+
+    def sim_roundtrip():
+        import time as _time
+
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(channel_type="tcp", tcp_host="127.0.0.1",
+                                  tcp_port=sim.port, motor_warmup_s=0.0)
+            if not drv.connect("sim", 0, False):
+                return "FAIL", "connect to loopback simulator failed"
+            drv.detect_and_init_strategy()
+            if not drv.start_motor("", 600):
+                return "FAIL", "scan start failed"
+            t0 = _time.monotonic()
+            got = None
+            while got is None and _time.monotonic() - t0 < 10:
+                got = drv.grab_scan_host(2.0)
+            drv.stop_motor()
+            drv.disconnect()
+            if got is None:
+                return "FAIL", "no revolution within 10 s"
+            return "PASS", (f"full protocol round-trip: {len(got[0]['angle_q14'])} "
+                            f"nodes/rev through channel->codec->decode->assembly")
+        finally:
+            sim.stop()
+
+    def serial_port():
+        import os
+
+        port = args.port
+        if os.path.exists(port):
+            ok = os.access(port, os.R_OK | os.W_OK)
+            return ("PASS" if ok else "WARN",
+                    f"{port} present{'' if ok else ' but not read/writable (udev rules? dialout group?)'}")
+        return "WARN", f"{port} not present (no device attached, or udev rule missing — see `udev` subcommand)"
+
+    check("python deps", deps)
+    check("native I/O library", native_lib)
+    check("jax backend", jax_backend)
+    if results[-1][1] == "PASS":
+        check("loopback protocol round-trip", sim_roundtrip)
+    else:
+        # ANY first jax use (even CPU-pinned decode) initializes every
+        # backend, so with the device link down the round-trip would hang
+        results.append(("loopback protocol round-trip", "SKIP",
+                        "skipped: jax backend unavailable (decode needs it); "
+                        "re-run with --cpu to test the rest of the stack"))
+    check("serial port", serial_port)
+
+    worst = 0
+    for name, level, detail in results:
+        print(f"[{level:4s}] {name}: {detail}")
+        worst = max(worst, {"PASS": 0, "WARN": 0, "SKIP": 0, "FAIL": 1}[level])
+    return worst
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
     ap = argparse.ArgumentParser(prog="rplidar_ros2_driver_tpu")
@@ -178,6 +274,13 @@ def main(argv=None) -> int:
     udev = sub.add_parser("udev", help="generate/install udev rules")
     udev.add_argument("--install", action="store_true")
 
+    doctor = sub.add_parser("doctor", help="environment self-check (deps, "
+                            "native lib, jax backend, protocol round-trip, port)")
+    doctor.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
+    doctor.add_argument("--port", default="/dev/rplidar", help="serial port to probe")
+    doctor.add_argument("--device-timeout", type=float, default=60.0,
+                        help="seconds to wait for jax backend init before declaring it down")
+
     replay = sub.add_parser("replay", help="batch-decode a frame recording")
     replay.add_argument("recording", help="capture file (RealLidarDriver.start_recording)")
     replay.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
@@ -201,6 +304,8 @@ def main(argv=None) -> int:
         return _cmd_view(args)
     if args.cmd == "replay":
         return _cmd_replay(args)
+    if args.cmd == "doctor":
+        return _cmd_doctor(args)
     if args.cmd == "udev":
         from rplidar_ros2_driver_tpu.tools import udev as udev_mod
 

@@ -1,0 +1,41 @@
+"""JAX backend-init probe with a hang guard.
+
+Through a remote-attached device a dead link makes the first
+``jax.devices()`` block forever (observed: the relay died and every
+backend init hung until killed).  Probing from a daemon thread with a
+bounded wait turns that failure mode into a reportable result; bench.py
+and the ``doctor`` CLI both use this single implementation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+def probe_jax_backend(timeout_s: float) -> tuple[bool, str, Optional[list]]:
+    """(ok, detail, devices-or-None).
+
+    ok=False detail distinguishes a hang (link down) from an init error;
+    a daemon probe thread means a hung init never blocks process exit.
+    """
+    import jax
+
+    out: dict = {}
+    done = threading.Event()
+
+    def _probe() -> None:
+        try:
+            out["devices"] = list(jax.devices())
+        except BaseException as e:  # report the real failure, not a timeout
+            out["err"] = f"{type(e).__name__}: {e}"
+        finally:
+            done.set()
+
+    threading.Thread(target=_probe, daemon=True).start()
+    if not done.wait(timeout_s):
+        return False, (f"jax backend init timed out after {timeout_s:.0f} s "
+                       "(remote-attach tunnel unreachable)"), None
+    if "err" in out:
+        return False, out["err"], None
+    return True, ", ".join(str(d) for d in out["devices"]), out["devices"]

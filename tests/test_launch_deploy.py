@@ -223,6 +223,21 @@ def test_cli_view_subcommand():
     assert "#" in out.stdout
 
 
+def test_cli_doctor():
+    """Self-check subcommand: all probes run, loopback round-trip passes,
+    missing hardware port is a WARN (not FAIL) so exit code is 0."""
+    out = subprocess.run(
+        [sys.executable, "-m", "rplidar_ros2_driver_tpu", "doctor", "--cpu",
+         "--port", "/dev/definitely_not_a_lidar"],
+        capture_output=True,
+        text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "[PASS] loopback protocol round-trip" in out.stdout
+    assert "[WARN] serial port" in out.stdout
+
+
 def test_cli_run_duration():
     out = subprocess.run(
         [
