@@ -23,3 +23,17 @@ import jax  # noqa: E402  (sitecustomize has already imported it anyway)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def wait_for(predicate, timeout=20.0, interval=0.02):
+    """Poll ``predicate`` until truthy or ``timeout`` elapses; returns
+    whether it became true.  The one wait helper for all suites (was
+    duplicated per test module)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False

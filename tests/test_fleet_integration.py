@@ -27,13 +27,11 @@ from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
 N_STREAMS = 4
 
 
+from conftest import wait_for
+
+
 def _wait(cond, timeout=20.0, dt=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(dt)
-    return False
+    return wait_for(cond, timeout, dt)
 
 
 class _Stream:

@@ -19,13 +19,11 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+from conftest import wait_for
+
+
 def _wait(predicate, timeout=10.0, interval=0.01):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return False
+    return wait_for(predicate, timeout, interval)
 
 
 def make_driver(sim: SimulatedDevice, **kw) -> RealLidarDriver:

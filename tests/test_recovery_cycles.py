@@ -1,0 +1,65 @@
+"""Repeated hot-unplug recovery — the flagship feature, cycled.
+
+The reference's community protocol is one manual cable pull
+(README.md:27-38); single-unplug recovery is covered in
+test_real_driver.py / test_fleet_integration.py.  This cycles it: the
+node must survive SEVERAL unplug->reconnect rounds in one session, each
+time re-detecting the device, re-selecting the scan mode, and resuming
+publishing — no cumulative state corruption across driver recreations.
+"""
+
+import time
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+from rplidar_ros2_driver_tpu.node.fsm import FsmTimings
+from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+
+CYCLES = 3
+
+
+def test_repeated_unplug_recovery():
+    sim = SimulatedDevice().start()
+    node = None
+    try:
+        params = DriverParams(
+            dummy_mode=False, channel_type="tcp", scan_mode="DenseBoost",
+            filter_backend="cpu", filter_chain=("clip", "median"),
+            filter_window=4, max_retries=2,
+        )
+        node = RPlidarNode(
+            params,
+            driver_factory=lambda: RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0),
+            fsm_timings=FsmTimings(
+                connect_retry_s=0.1, reset_backoff_s=0.2, idle_tick_s=0.01,
+                grab_retry_s=0.01,
+            ),
+        )
+        assert node.configure()
+        assert node.activate()
+
+        from conftest import wait_for
+
+        def wait_streaming(n, timeout=25.0):
+            base = node.publisher.scan_count
+            assert wait_for(
+                lambda: node.publisher.scan_count >= base + n, timeout
+            ), "stream did not resume"
+
+        wait_streaming(3)
+        for cycle in range(1, CYCLES + 1):
+            resets_before = node.fsm.reset_count
+            sim.unplug()
+            assert wait_for(
+                lambda: node.fsm.reset_count > resets_before, 30
+            ), f"cycle {cycle}: no reset"
+            wait_streaming(3)  # recovered and publishing again
+            assert node.fsm.driver.profile.active_mode == "DenseBoost"
+        assert node.fsm.reset_count >= CYCLES
+    finally:
+        if node is not None:
+            node.shutdown()
+        sim.stop()
