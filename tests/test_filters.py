@@ -207,6 +207,27 @@ class TestLaserScanKernel:
         msg = to_laserscan(b, 0.1, 12.0)
         assert int(msg.beam_count) == 0
 
+    @pytest.mark.parametrize("scan_processing", [False, True])
+    def test_header_fields(self, scan_processing):
+        """The ROS header contract (src/rplidar_node.cpp:614-631): full
+        circle, increments derived from the valid point count, duration
+        carried through, REP-117 bounds."""
+        n_valid = 360
+        angles_deg = np.linspace(0, 359, n_valid)
+        b = make_batch(angles_deg, np.full(n_valid, 2.0), n=512)
+        duration = 0.125
+        msg = to_laserscan(b, duration, 12.0, scan_processing=scan_processing)
+        count = int(msg.beam_count)
+        assert count == n_valid
+        assert float(msg.angle_min) == 0.0
+        assert float(msg.angle_max) == pytest.approx(2 * np.pi)
+        denom = count if scan_processing else count - 1
+        assert float(msg.angle_increment) == pytest.approx(2 * np.pi / denom, rel=1e-6)
+        assert float(msg.time_increment) == pytest.approx(duration / denom, rel=1e-6)
+        assert float(msg.scan_time) == pytest.approx(duration)
+        assert float(msg.range_min) == pytest.approx(0.15)
+        assert float(msg.range_max) == pytest.approx(12.0)
+
 
 class TestAscend:
     def test_invalid_angles_interpolated_and_sorted(self):
