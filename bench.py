@@ -468,7 +468,7 @@ if __name__ == "__main__":
     # broken link yields ONE honest JSON line instead of a silent hang.
     from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
 
-    _ok, _detail, _devices = probe_jax_backend(240.0)
+    _ok, _detail = probe_jax_backend(240.0)
     if not _ok:
         print(json.dumps({
             "metric": metric_name(args.config),

@@ -188,7 +188,7 @@ def _cmd_doctor(args) -> int:
     def jax_backend():
         from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
 
-        ok, detail, _devices = probe_jax_backend(args.device_timeout)
+        ok, detail = probe_jax_backend(args.device_timeout)
         return ("PASS" if ok else "FAIL"), detail
 
     def sim_roundtrip():

@@ -315,6 +315,24 @@ def packed_filter_step(
     return _filter_step_impl(state, batch, cfg)
 
 
+def _pack_compact_rows(buf, capacity: int, angle_q14, dist_q2, quality, flag) -> int:
+    """Fill the leading columns of a (2, >=capacity) uint32 buffer with the
+    bit-packed node stream; the one definition of the row layout shared by
+    the compact and counted wire forms.  Returns the node count."""
+    import numpy as np
+
+    count = int(len(angle_q14))
+    if count > capacity:
+        raise ValueError(f"scan of {count} nodes exceeds capacity {capacity}")
+    a = np.asarray(angle_q14, np.uint32) & 0xFFFF
+    q = (np.asarray(quality, np.uint32) & 0xFF) << 16
+    buf[0, :count] = a | q
+    if flag is not None:
+        buf[0, :count] |= (np.asarray(flag, np.uint32) & 0xFF) << 24
+    buf[1, :count] = np.asarray(dist_q2, np.int64).astype(np.uint32)
+    return count
+
+
 def pack_host_scan_compact(angle_q14, dist_q2, quality, flag=None, n: int | None = None):
     """Bit-packed wire form: (2, n) uint32, 8 bytes/point (half the (4, n)
     int32 form) — row0 = angle_q14 | quality<<16 | flag<<24, row1 = dist_q2.
@@ -329,16 +347,8 @@ def pack_host_scan_compact(angle_q14, dist_q2, quality, flag=None, n: int | None
     from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
 
     n = n or MAX_SCAN_NODES
-    count = int(len(angle_q14))
-    if count > n:
-        raise ValueError(f"scan of {count} nodes exceeds capacity {n}")
     buf = np.zeros((2, n), np.uint32)
-    a = np.asarray(angle_q14, np.uint32) & 0xFFFF
-    q = (np.asarray(quality, np.uint32) & 0xFF) << 16
-    buf[0, :count] = a | q
-    if flag is not None:
-        buf[0, :count] |= (np.asarray(flag, np.uint32) & 0xFF) << 24
-    buf[1, :count] = np.asarray(dist_q2, np.int64).astype(np.uint32)
+    count = _pack_compact_rows(buf, n, angle_q14, dist_q2, quality, flag)
     return buf, count
 
 
@@ -366,11 +376,13 @@ def pack_host_scan_counted(angle_q14, dist_q2, quality, flag=None, n: int | None
     """
     import numpy as np
 
-    buf, count = pack_host_scan_compact(angle_q14, dist_q2, quality, flag, n)
-    out = np.zeros((2, buf.shape[1] + 1), np.uint32)
-    out[:, :-1] = buf
-    out[0, -1] = count
-    return out
+    from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
+
+    n = n or MAX_SCAN_NODES
+    buf = np.zeros((2, n + 1), np.uint32)
+    count = _pack_compact_rows(buf, n, angle_q14, dist_q2, quality, flag)
+    buf[0, -1] = count
+    return buf
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))

@@ -10,14 +10,12 @@ and the ``doctor`` CLI both use this single implementation.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 
-def probe_jax_backend(timeout_s: float) -> tuple[bool, str, Optional[list]]:
-    """(ok, detail, devices-or-None).
-
-    ok=False detail distinguishes a hang (link down) from an init error;
-    a daemon probe thread means a hung init never blocks process exit.
+def probe_jax_backend(timeout_s: float) -> tuple[bool, str]:
+    """(ok, detail) — detail is the device list on success, and on
+    failure distinguishes a hang (link down) from an init error; a
+    daemon probe thread means a hung init never blocks process exit.
     """
     import jax
 
@@ -35,7 +33,7 @@ def probe_jax_backend(timeout_s: float) -> tuple[bool, str, Optional[list]]:
     threading.Thread(target=_probe, daemon=True).start()
     if not done.wait(timeout_s):
         return False, (f"jax backend init timed out after {timeout_s:.0f} s "
-                       "(remote-attach tunnel unreachable)"), None
+                       "(remote-attach tunnel unreachable)")
     if "err" in out:
-        return False, out["err"], None
-    return True, ", ".join(str(d) for d in out["devices"]), out["devices"]
+        return False, out["err"]
+    return True, ", ".join(str(d) for d in out["devices"])

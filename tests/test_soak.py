@@ -19,10 +19,7 @@ from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
 from rplidar_ros2_driver_tpu.driver.sim_device import SimConfig, SimulatedDevice
 
 
-def _py_factory(channel_type, port, baudrate, host, net_port):
-    from rplidar_ros2_driver_tpu.protocol.pytransport import PyChannel, PyTransceiver
-
-    return PyTransceiver(PyChannel("tcp", host, port=net_port))
+from test_pytransport import _py_factory  # shared TCP fallback factory
 
 
 @pytest.mark.parametrize(
