@@ -82,6 +82,50 @@ class TestConnect:
         finally:
             dev.stop()
 
+    def test_legacy_samplerate_queried_from_device(self):
+        """OLD_TYPE startup on firmware >= 1.17 must ask the device for its
+        sample duration (GET_SAMPLERATE, sl_lidar_driver.cpp:1556-1599)
+        instead of assuming the 476 us legacy default."""
+        from rplidar_ros2_driver_tpu.protocol.constants import Cmd
+
+        dev = SimulatedDevice(SimConfig(
+            model_id=0x18, firmware=0x0118, std_sample_us=500,
+        )).start()
+        try:
+            drv = make_driver(dev)
+            assert drv.connect("ignored", 0, True)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 600)
+            assert Cmd.GET_SAMPLERATE in dev.commands
+            assert drv._scan_decoder.timing.sample_duration_us == 500.0
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            dev.stop()
+
+    def test_legacy_samplerate_default_on_old_firmware(self):
+        """Firmware < 1.17 predates GET_SAMPLERATE: the command must not be
+        sent and timing falls back to the 476 us table value."""
+        from rplidar_ros2_driver_tpu.protocol.constants import Cmd
+        from rplidar_ros2_driver_tpu.protocol.timing import LEGACY_SAMPLE_DURATION_US
+
+        dev = SimulatedDevice(SimConfig(
+            model_id=0x18, firmware=0x0105, std_sample_us=500,
+        )).start()
+        try:
+            drv = make_driver(dev)
+            assert drv.connect("ignored", 0, True)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("", 600)
+            assert Cmd.GET_SAMPLERATE not in dev.commands
+            assert drv._scan_decoder.timing.sample_duration_us == (
+                LEGACY_SAMPLE_DURATION_US
+            )
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            dev.stop()
+
 
 class TestScanStreaming:
     def _grab_scans(self, drv, n=2, timeout=3.0):
