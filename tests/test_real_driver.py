@@ -88,8 +88,10 @@ class TestConnect:
         instead of assuming the 476 us legacy default."""
         from rplidar_ros2_driver_tpu.protocol.constants import Cmd
 
+        # firmware exactly 1.17 (0x0111): the boundary itself must query —
+        # pins the `< 1.17` comparison direction in real.py
         dev = SimulatedDevice(SimConfig(
-            model_id=0x18, firmware=0x0118, std_sample_us=500,
+            model_id=0x18, firmware=0x0111, std_sample_us=500,
         )).start()
         try:
             drv = make_driver(dev)
