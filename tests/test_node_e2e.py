@@ -248,3 +248,19 @@ class TestDiagnostics:
         assert pub.diagnostics[-1].message == "Scanning"
         assert pub.diagnostics[-1].hardware_id.startswith("rplidar-")
         node.shutdown()
+
+    def test_kv_details_surface(self):
+        """REP-107 detail parity (src/rplidar_node.cpp:521-544): port,
+        target RPM, device info, plus the per-stage p99 latencies this
+        framework adds once scans have flowed."""
+        node, pub = make_node()
+        launch(node)
+        assert _wait(lambda: pub.scan_count >= 2)
+        node._update_diagnostics()
+        values = pub.diagnostics[-1].values
+        for key in ("Serial Port", "Target RPM", "Device Info",
+                    "FSM State", "Lifecycle"):
+            assert key in values, values
+        assert values["FSM State"] == DriverState.RUNNING.value
+        assert any(k.startswith("p99 ") for k in values), values
+        node.shutdown()
