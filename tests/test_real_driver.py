@@ -78,6 +78,7 @@ class TestConnect:
             drv.detect_and_init_strategy()
             assert not drv.is_new_type()
             assert drv.get_hw_max_distance() == 12.0
+            drv.print_summary()  # smoke: the SDK summary table renders
             drv.disconnect()
         finally:
             dev.stop()
