@@ -73,6 +73,13 @@ class AnsHeader:
 # message callback: (ans_type, payload bytes, is_loop)
 MessageListener = Callable[[int, bytes, bool], None]
 
+# Largest real payload is the HQ capsule (777 bytes); anything near the
+# 30-bit field limit is a corrupted header (e.g. wrong-baud noise that
+# happened to contain A5 5A) and must trigger a resync instead of
+# swallowing the stream into a giant pending payload.  Matches the native
+# codec's kMaxSanePayload (native/src/codec.cc).
+MAX_SANE_PAYLOAD = 8192
+
 
 class ResponseDecoder:
     """Streaming response decoder with loop-mode support.
@@ -126,9 +133,20 @@ class ResponseDecoder:
                     del self._buf[:idx]
                     return
                 word = int.from_bytes(self._buf[idx + 2 : idx + 6], "little")
+                payload_len = word & ANS_HEADER_SIZE_MASK
+                if payload_len > MAX_SANE_PAYLOAD:
+                    # corrupted header: skip the false sync byte and rescan.
+                    # Both codecs REJECT such frames (codec.cc resyncs on
+                    # implausible sizes too); recovery differs benignly —
+                    # the byte-at-a-time native decoder has already consumed
+                    # the 7 header bytes, while this buffered decoder can
+                    # rescan from sync+1 and so recovers a real packet that
+                    # starts inside the corrupt header.
+                    del self._buf[: idx + 1]
+                    continue
                 self._header = AnsHeader(
                     ans_type=self._buf[idx + 6],
-                    payload_len=word & ANS_HEADER_SIZE_MASK,
+                    payload_len=payload_len,
                     is_loop=bool((word >> ANS_HEADER_SUBTYPE_SHIFT) & ANS_PKTFLAG_LOOP),
                 )
                 del self._buf[: idx + ANS_HEADER_LEN]

@@ -84,6 +84,31 @@ class TestResponseDecoder:
         dec.feed(self._header(Ans.SET_LIDAR_CONF, 0))
         assert dec.messages == [(int(Ans.SET_LIDAR_CONF), b"", False)]
 
+    def test_corrupt_size_resyncs(self):
+        """A header claiming an implausibly large payload (wrong-baud noise
+        containing A5 5A) must trigger a resync, not swallow the stream.
+        Same rejection rule as the native codec (codec.cc kMaxSanePayload);
+        this buffered decoder additionally recovers packets that begin
+        inside the corrupt header (rescan from sync+1)."""
+        import struct
+
+        from rplidar_ros2_driver_tpu.protocol.codec import MAX_SANE_PAYLOAD
+
+        dec = ResponseDecoder()
+        corrupt = b"\xa5\x5a" + struct.pack("<I", MAX_SANE_PAYLOAD + 1) + b"\x04"
+        good_payload = bytes(range(20))
+        dec.feed(corrupt + self._header(Ans.DEVINFO, 20) + good_payload)
+        assert dec.messages == [(int(Ans.DEVINFO), good_payload, False)]
+
+    def test_max_sane_payload_accepted(self):
+        """The cap itself is a legal size (boundary pins the > comparison)."""
+        from rplidar_ros2_driver_tpu.protocol.codec import MAX_SANE_PAYLOAD
+
+        dec = ResponseDecoder()
+        payload = bytes(MAX_SANE_PAYLOAD)
+        dec.feed(self._header(Ans.DEVINFO, MAX_SANE_PAYLOAD) + payload)
+        assert dec.messages == [(int(Ans.DEVINFO), payload, False)]
+
 
 class TestCrc:
     def test_matches_zlib_with_device_padding(self):
