@@ -37,3 +37,47 @@ def wait_for(predicate, timeout=20.0, interval=0.02):
             return True
         time.sleep(interval)
     return False
+
+
+class ScriptedTransceiver:
+    """Queue-backed TransceiverLike fake shared by engine-level suites.
+
+    ``q.put((ans_type, payload, is_loop))`` scripts answers; an empty
+    queue behaves as a silent device (wait_message times out).  The
+    optional ``channel`` exposes a raw-channel object for tests of the
+    DTR/autobaud escape hatch.
+    """
+
+    def __init__(self, channel=None):
+        import queue
+
+        self.q = queue.Queue()
+        self.sent = []
+        self.channel = channel
+        self.running = False
+
+    def start(self):
+        self.running = True
+        return True
+
+    def stop(self):
+        self.running = False
+
+    def send(self, packet):
+        self.sent.append(bytes(packet))
+        return True
+
+    def wait_message(self, timeout_ms=1000):
+        import queue
+
+        try:
+            return self.q.get(timeout=timeout_ms / 1000.0)
+        except queue.Empty:
+            return None
+
+    def reset_decoder(self):
+        pass
+
+    @property
+    def had_error(self):
+        return False

@@ -168,35 +168,7 @@ class FakeSerialChannel:
         return True
 
 
-class FakeTransceiver:
-    """TransceiverLike fake that exposes the raw channel."""
-
-    def __init__(self, channel):
-        self.channel = channel
-        self.sent = []
-        self.running = False
-
-    def start(self):
-        self.running = True
-        return True
-
-    def stop(self):
-        self.running = False
-
-    def send(self, packet: bytes) -> bool:
-        self.sent.append(bytes(packet))
-        return True
-
-    def wait_message(self, timeout_ms: int = 1000):
-        time.sleep(timeout_ms / 1000)
-        return None
-
-    def reset_decoder(self):
-        pass
-
-    @property
-    def had_error(self):
-        return False
+from conftest import ScriptedTransceiver as FakeTransceiver  # noqa: E402
 
 
 def test_autobaud_negotiation_flow():

@@ -110,39 +110,7 @@ class TestResponseDecoder:
         assert dec.messages == [(int(Ans.DEVINFO), payload, False)]
 
 
-class _ScriptedTransceiver:
-    """Feed the engine's pump a scripted message sequence on demand."""
-
-    def __init__(self):
-        import queue
-
-        self.q = queue.Queue()
-        self.sent = []
-
-    def start(self):
-        return True
-
-    def stop(self):
-        pass
-
-    def send(self, packet):
-        self.sent.append(bytes(packet))
-        return True
-
-    def wait_message(self, timeout_ms=1000):
-        import queue
-
-        try:
-            return self.q.get(timeout=timeout_ms / 1000.0)
-        except queue.Empty:
-            return None
-
-    def reset_decoder(self):
-        pass
-
-    @property
-    def had_error(self):
-        return False
+from conftest import ScriptedTransceiver as _ScriptedTransceiver, wait_for
 
 
 class TestStaleAnswerGuard:
@@ -160,30 +128,39 @@ class TestStaleAnswerGuard:
         assert eng.start()
         return eng, tx
 
-    def test_late_answer_dropped_once(self):
+    def _background_request(self, eng, tx, timeout_s=5.0):
+        """Start a request on a thread and wait (via the send the engine
+        performs AFTER registering its pending slot) until it is in flight
+        — deterministic sequencing, no bare sleeps."""
         import threading
-        import time
 
+        sends_before = len(tx.sent)
+        result = {}
+
+        def req():
+            result["ans"] = eng.request(
+                Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF, timeout_s=timeout_s
+            )
+
+        t = threading.Thread(target=req)
+        t.start()
+        assert wait_for(lambda: len(tx.sent) > sends_before, 5.0)
+        return t, result
+
+    def test_late_answer_dropped_once(self):
         eng, tx = self._engine()
         try:
-            # request 1: device stays silent -> timeout marks the type stale
+            # request 1: device stays silent -> timeout marks the type
+            # stale for a window equal to the timeout (generous: 2 s, so
+            # CI scheduling jitter cannot expire it mid-test)
             assert eng.request(Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF,
-                               timeout_s=0.1) is None
+                               timeout_s=2.0) is None
             # request 2 in flight; the LATE answer to request 1 lands first,
             # then the real answer — the engine must hand back the second
-            result = {}
-
-            def req():
-                result["ans"] = eng.request(
-                    Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF, timeout_s=2.0
-                )
-
-            t = threading.Thread(target=req)
-            t.start()
-            time.sleep(0.05)
+            t, result = self._background_request(eng, tx)
             tx.q.put((int(Ans.GET_LIDAR_CONF), b"LATE", False))   # dropped
             tx.q.put((int(Ans.GET_LIDAR_CONF), b"FRESH", False))  # completes
-            t.join(3.0)
+            t.join(10.0)
             assert result["ans"] == b"FRESH"
         finally:
             eng.stop()
@@ -195,22 +172,11 @@ class TestStaleAnswerGuard:
         try:
             assert eng.request(Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF,
                                timeout_s=0.05) is None
-            time.sleep(0.1)  # stale window (== timeout) elapses
+            time.sleep(0.2)  # stale window (== timeout, 50 ms) elapses
             # an answer arriving after expiry flows normally
-            import threading
-
-            result = {}
-
-            def req():
-                result["ans"] = eng.request(
-                    Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF, timeout_s=2.0
-                )
-
-            t = threading.Thread(target=req)
-            t.start()
-            time.sleep(0.05)
+            t, result = self._background_request(eng, tx)
             tx.q.put((int(Ans.GET_LIDAR_CONF), b"OK", False))
-            t.join(3.0)
+            t.join(10.0)
             assert result["ans"] == b"OK"
         finally:
             eng.stop()
