@@ -26,10 +26,45 @@ __version__ = "0.2.0"  # keep in lockstep with pyproject.toml
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, LaserScanMsg, ScanBatch
 
+# The main user-facing classes resolve lazily: eagerly importing the node/
+# driver/service stack here would pull the whole framework (and trigger
+# side work like the native-library probe) on `import rplidar_ros2_driver_tpu`.
+_LAZY = {
+    "RPlidarNode": ("rplidar_ros2_driver_tpu.node.node", "RPlidarNode"),
+    "launch_lifecycle": ("rplidar_ros2_driver_tpu.launch", "launch_lifecycle"),
+    "ScanFilterChain": ("rplidar_ros2_driver_tpu.filters.chain", "ScanFilterChain"),
+    "RealLidarDriver": ("rplidar_ros2_driver_tpu.driver.real", "RealLidarDriver"),
+    "DummyLidarDriver": ("rplidar_ros2_driver_tpu.driver.dummy", "DummyLidarDriver"),
+    "ShardedFilterService": ("rplidar_ros2_driver_tpu.parallel.service", "ShardedFilterService"),
+}
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    obj = getattr(importlib.import_module(module), attr)
+    globals()[name] = obj  # cache: later accesses are plain attribute hits
+    return obj
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
+
+
 __all__ = [
     "DriverParams",
+    "DummyLidarDriver",
     "LaserScanMsg",
     "MAX_SCAN_NODES",
+    "RPlidarNode",
+    "RealLidarDriver",
     "ScanBatch",
+    "ScanFilterChain",
+    "ShardedFilterService",
+    "launch_lifecycle",
     "__version__",
 ]
