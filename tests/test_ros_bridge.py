@@ -1,0 +1,36 @@
+"""rclpy bridge gating: importable everywhere, constructible only with ROS.
+
+Full topic behavior can only run on a ROS 2 host (rclpy is not in this
+CI image); what must hold here is that the module imports cleanly
+without rclpy, reports availability honestly, and fails construction
+with ImportError (the documented contract) rather than something
+surprising.
+"""
+
+import pytest
+
+from rplidar_ros2_driver_tpu.tools import ros_bridge
+
+
+def test_importable_and_reports_availability():
+    assert isinstance(ros_bridge.rclpy_available(), bool)
+
+
+def test_constructor_requires_rclpy():
+    if ros_bridge.rclpy_available():  # pragma: no cover - ROS host
+        pytest.skip("rclpy present: constructor would succeed")
+    with pytest.raises(ImportError):
+        ros_bridge.RclpyPublisher()
+
+
+def test_invalid_qos_rejected_before_any_ros_import():
+    """The QoS vocabulary check precedes the rclpy imports, so a typo'd
+    reliability fails loudly (ValueError) even without ROS installed."""
+    with pytest.raises(ValueError, match="qos_reliability"):
+        ros_bridge.RclpyPublisher(qos_reliability="RELIABLE")
+
+
+def test_is_a_publisher_base():
+    from rplidar_ros2_driver_tpu.node.publisher import PublisherBase
+
+    assert issubclass(ros_bridge.RclpyPublisher, PublisherBase)
