@@ -122,6 +122,20 @@ class SimulatedDevice:
         # that)
         self.active_ans_type = 0
         self.commands: list[int] = []
+        # points actually delivered by the stream loop (frames _send
+        # confirmed written; resets at each scan start) — under host load
+        # the absolute-deadline pacer can fall behind nominal rate, so
+        # tests that check "did the consumer keep up" compare against
+        # this, not wall-clock * nominal rate
+        self.points_emitted = 0
+        # when the current stream session began, and how many frame sends
+        # blocked hard (>100 ms inside _send): a consumer that stops
+        # draining the socket fills the kernel buffer and parks sendall
+        # for hundreds of ms, while host-load/GIL scheduling delays stay
+        # in the single-ms range — tests use this to tell "consumer
+        # can't keep up" apart from "CI host is slow"
+        self.stream_t0 = 0.0
+        self.stream_send_stalls = 0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -262,15 +276,51 @@ class SimulatedDevice:
     # request handlers
     # ------------------------------------------------------------------
 
-    def _send(self, data: bytes) -> None:
+    def _send(self, data: bytes) -> bool:
+        """Write the WHOLE frame or report failure.  The conn socket
+        carries the 0.2 s rx timeout set in _serve, which also applies
+        to sends — a backpressured sendall would abort mid-frame after
+        0.2 s and tear the byte stream, so partial progress is tracked
+        explicitly and timeouts retry until a deadline (same contract as
+        the serial transport's _send)."""
         with self._conn_lock:
             conn = self._conn
         if conn is None:
-            return
+            return False
+        view = memoryview(data)
+        deadline = time.monotonic() + 0.5
+        while len(view):
+            try:
+                n = conn.send(view)
+            except socket.timeout:
+                n = 0
+            except OSError:
+                return False
+            if n:
+                view = view[n:]
+            elif time.monotonic() > deadline:
+                return False  # reader is gone; stream is torn either way
+        return True
+
+    def tx_backlog_bytes(self) -> int:
+        """Bytes queued in the kernel TX buffer, not yet drained by the
+        consumer (Linux SIOCOUTQ).  This is the timing-insensitive
+        "is the consumer keeping up" signal: a drain-limited consumer
+        pins this near the socket buffer size, while host-load slowness
+        (sim thread starved, GIL contention) leaves it near zero.
+        Returns 0 when no client is connected or the query fails."""
+        import fcntl
+        import termios
+
+        with self._conn_lock:
+            conn = self._conn
+        if conn is None:
+            return 0
         try:
-            conn.sendall(data)
+            buf = fcntl.ioctl(conn.fileno(), termios.TIOCOUTQ, b"\x00" * 4)
+            return struct.unpack("i", buf)[0]
         except OSError:
-            pass
+            return 0
 
     def _answer(self, ans_type: int, payload: bytes, is_loop: bool = False) -> None:
         hdr = AnsHeader(ans_type=ans_type, payload_len=len(payload), is_loop=is_loop)
@@ -433,6 +483,9 @@ class SimulatedDevice:
         )
         ppr = self.cfg.points_per_rev
         idx = 0  # global point index
+        self.points_emitted = 0
+        self.stream_send_stalls = 0
+        self.stream_t0 = time.monotonic()
         first = True
         # absolute-deadline pacing: per-frame relative sleeps accumulate
         # scheduler overhead (~0.1-1 ms each), which at 800 fps would run
@@ -523,8 +576,13 @@ class SimulatedDevice:
                     flags,
                     timestamp=idx,
                 )
-            self._send(frame)
+            t_send = time.monotonic()
+            sent = self._send(frame)
+            if time.monotonic() - t_send > 0.1:
+                self.stream_send_stalls += 1
             idx += pts_per_frame
+            if sent:
+                self.points_emitted += pts_per_frame
             first = False
             if pace > 0:
                 next_t += pace
@@ -615,7 +673,7 @@ class SerialSimulatedDevice(SimulatedDevice):
                 return
             self._feed(buf, chunk)
 
-    def _send(self, data: bytes) -> None:
+    def _send(self, data: bytes) -> bool:
         """Write the WHOLE frame or (on sustained backpressure) nothing
         past what's already out: a short nonblocking write must not leave
         a torn frame desyncing the byte stream, so the remainder is
@@ -626,22 +684,23 @@ class SerialSimulatedDevice(SimulatedDevice):
             with self._conn_lock:
                 fd = self._master
                 if fd is None:
-                    return
+                    return False
                 try:
                     n = os.write(fd, view)
                 except BlockingIOError:
                     n = 0
                 except OSError:
-                    return
+                    return False
             if n:
                 view = view[n:]
                 continue
             if time.monotonic() > deadline:
-                return  # reader is gone; stream is torn either way
+                return False  # reader is gone; stream is torn either way
             try:
                 select.select([], [fd], [], 0.05)
             except OSError:
-                return
+                return False
+        return True
 
 
 class UdpSimulatedDevice(SimulatedDevice):
@@ -651,6 +710,12 @@ class UdpSimulatedDevice(SimulatedDevice):
     address the same way, sl_udp_channel.cpp:53-58).  ``unplug()`` goes
     silent (drops the peer) — UDP has no connection to sever, so the
     failure mode a dead radio link produces is timeouts, not errors.
+
+    Keep-up counters are weaker here than over TCP/serial: ``sendto``
+    never backpressures, so ``points_emitted`` counts datagrams *fired*
+    (not delivered), ``stream_send_stalls`` cannot trigger, and
+    ``tx_backlog_bytes`` reads 0.  Consumer keep-up tests should drive
+    the TCP or serial emulator instead.
     """
 
     def __init__(self, config: Optional[SimConfig] = None) -> None:
@@ -700,12 +765,13 @@ class UdpSimulatedDevice(SimulatedDevice):
                     buf.clear()  # new client: drop any half-parsed request
             self._feed(buf, chunk)
 
-    def _send(self, data: bytes) -> None:
+    def _send(self, data: bytes) -> bool:
         with self._conn_lock:
             sock, peer = self._sock, self._peer
         if sock is None or peer is None:
-            return
+            return False
         try:
             sock.sendto(data, peer)
+            return True
         except OSError:
-            pass
+            return False

@@ -79,6 +79,12 @@ class RclpyPublisher(PublisherBase):
                 f"qos_reliability must be 'reliable' or 'best_effort', "
                 f"got {qos_reliability!r}"
             )
+        # import EVERYTHING the publish methods will touch, so a
+        # partially-sourced ROS overlay fails loudly here (matching the
+        # rclpy_available() gate) instead of on the scan thread at the
+        # first publish
+        import builtin_interfaces.msg  # noqa: F401
+        import geometry_msgs.msg  # noqa: F401
         import rclpy.node
         from diagnostic_msgs.msg import DiagnosticArray
         from rclpy.qos import (
