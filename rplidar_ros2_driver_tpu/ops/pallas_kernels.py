@@ -68,16 +68,46 @@ def _bitonic_sort_rows(x: jax.Array) -> jax.Array:
     return x
 
 
-def _median_kernel(win_ref, out_ref):
-    """One (W, TB) tile: sort rows, pick the lower median of finite values."""
-    win = win_ref[:]
-    w = win.shape[0]
-    nvalid = jnp.sum(jnp.isfinite(win), axis=0)                 # (TB,)
+def _pad_beam_tiles(x: jax.Array, block_beams: int, interpret: bool):
+    """Shared beam-axis tiling rule of the median entry points: pick the
+    tile width (>= one lane group on hardware, clamped to the data in
+    interpret mode) and +inf-pad the minor axis to a tile multiple.
+    Returns (padded array, tile width)."""
+    b = x.shape[-1]
+    tb = min(block_beams, _next_pow2(max(b, _LANES)))
+    tb = max(tb, _LANES) if not interpret else min(tb, max(b, 1))
+    b_pad = ((b + tb - 1) // tb) * tb
+    if b_pad != b:
+        x = jnp.pad(
+            x, ((0, 0),) * (x.ndim - 1) + ((0, b_pad - b),), constant_values=jnp.inf
+        )
+    return x, tb
+
+
+def _median_select(win: jax.Array, w: int) -> jax.Array:
+    """(>=W, TB) window -> (TB,) lower median of the finite values.
+
+    The one definition of the median rule shared by the streaming
+    (_median_kernel) and fused (_sliding_median_kernel) kernels: rows
+    beyond ``w`` must be +inf padding (they sort to the tail and cannot
+    shift the lower median); all-inf lanes stay +inf."""
+    w_pad = _next_pow2(max(w, 2))
+    nvalid = jnp.sum(jnp.isfinite(win[:w]), axis=0)             # (TB,)
+    if win.shape[0] != w_pad:
+        win = jnp.concatenate(
+            [win, jnp.full((w_pad - win.shape[0], win.shape[1]), jnp.inf, win.dtype)]
+        )
     s = _bitonic_sort_rows(win)                                 # inf sorts last
     pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)                # (TB,)
     rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     med = jnp.sum(jnp.where(rows == pick[None, :], s, 0.0), axis=0)
-    out_ref[:] = jnp.where(nvalid > 0, med, jnp.inf)[None, :]
+    return jnp.where(nvalid > 0, med, jnp.inf)
+
+
+def _median_kernel(win_ref, out_ref):
+    """One (W, TB) tile: sort rows, pick the lower median of finite values."""
+    win = win_ref[:]
+    out_ref[:] = _median_select(win, win.shape[0])[None, :]
 
 
 @functools.partial(jax.jit, static_argnames=("block_beams", "interpret"))
@@ -96,6 +126,86 @@ def _median_call(window: jax.Array, block_beams: int, interpret: bool) -> jax.Ar
         out_shape=jax.ShapeDtypeStruct((1, b), jnp.float32),
         interpret=interpret,
     )(window)[0]
+
+
+def _sliding_median_kernel(w: int, k: int, ext_ref, out_ref):
+    """One (W+K, TB) history stripe -> (K, TB) sliding medians.
+
+    Step i's window is rows [i+1, i+1+W) of the stripe (the W most
+    recent rows after appending scan i — ops/filters.compact_filter_scan
+    builds the stripe as [previous ring in age order] ++ [new rows]).
+    Each stripe is read into VMEM once; the K windows are overlapping
+    VMEM slices, so nothing is re-fetched from HBM and the (K, W, B)
+    gather the XLA path materializes never exists.
+
+    Mosaic only accepts sublane-aligned dynamic slice starts (multiples
+    of 8 in dim 0), so steps are processed in groups of 8: one aligned
+    (W+8, TB) load per group, the 8 windows inside it are static slices
+    of the loaded value.  Requires k % 8 == 0 (caller pads)."""
+
+    def body(g, _):
+        blk = ext_ref[pl.ds(8 * g, w + 8), :]
+        meds = [
+            _median_select(blk[j + 1 : j + 1 + w], w)[None, :] for j in range(8)
+        ]
+        out_ref[pl.ds(8 * g, 8), :] = jnp.concatenate(meds, axis=0)
+        return 0
+
+    jax.lax.fori_loop(0, k // 8, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "block_beams", "interpret"))
+def _sliding_median_call(
+    ext: jax.Array, w: int, block_beams: int, interpret: bool
+) -> jax.Array:
+    wk, b = ext.shape
+    k = wk - w
+    grid = (b // block_beams,)
+    return pl.pallas_call(
+        functools.partial(_sliding_median_kernel, w, k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((wk, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (k, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((k, b), jnp.float32),
+        interpret=interpret,
+    )(ext)
+
+
+def sliding_median_pallas(
+    ext: jax.Array,
+    window: int,
+    *,
+    block_beams: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """K sliding per-beam medians over an extended history — Pallas backend.
+
+    ``ext`` is (window + K, B): the previous ring in age order followed by
+    K new rows; returns (K, B) where row i is the per-beam lower median
+    over ``ext[i+1 : i+1+window]`` (exactly what K successive
+    :func:`ops.filters.temporal_median` calls on the advancing ring would
+    produce).  Non-power-of-two windows are padded with +inf rows inside
+    the kernel (they sort to the tail without shifting the lower median)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    wk, b = ext.shape
+    w = window
+    k = wk - w
+    ext = ext.astype(jnp.float32)
+
+    # group-of-8 alignment (see _sliding_median_kernel): pad the stripe
+    # with trailing +inf rows; the extra outputs are sliced off
+    k_pad = ((k + 7) // 8) * 8
+    if k_pad != k:
+        ext = jnp.pad(ext, ((0, k_pad - k), (0, 0)), constant_values=jnp.inf)
+
+    ext, tb = _pad_beam_tiles(ext, block_beams, interpret)
+    out = _sliding_median_call(ext, w, tb, interpret)
+    return out[:k, :b]
 
 
 def temporal_median_pallas(
@@ -120,11 +230,6 @@ def temporal_median_pallas(
     if w_pad != w:
         window = jnp.pad(window, ((0, w_pad - w), (0, 0)), constant_values=jnp.inf)
 
-    tb = min(block_beams, _next_pow2(max(b, _LANES)))
-    tb = max(tb, _LANES) if not interpret else min(tb, max(b, 1))
-    b_pad = ((b + tb - 1) // tb) * tb
-    if b_pad != b:
-        window = jnp.pad(window, ((0, 0), (0, b_pad - b)), constant_values=jnp.inf)
-
+    window, tb = _pad_beam_tiles(window, block_beams, interpret)
     out = _median_call(window, tb, interpret)
     return out[:b]

@@ -2,6 +2,7 @@
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.core.types import ScanBatch
@@ -132,12 +133,18 @@ def test_compact_roundtrip_field_ranges():
     )
 
 
-def test_fused_scan_matches_sequential_steps():
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_fused_scan_matches_sequential_steps(backend):
     """compact_filter_scan (K scans, one dispatch) must reproduce the exact
-    state trajectory and per-scan ranges of K compact_filter_step calls."""
-    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
+    state trajectory and per-scan ranges of K compact_filter_step calls.
+
+    Split across two fused calls so both chunk regimes of the parallel
+    implementation are exercised: K=3 < W (old window rows survive into
+    the final state, entry cursor 0) and K=10 > W (final window is all
+    new rows, nonzero entry cursor with ring wrap-around)."""
+    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5, median_backend=backend)
     scans = []
-    for k in range(10):
+    for k in range(13):
         angle, dist, qual = _raw_scan(k, points=300 + 20 * k)
         scans.append({"angle_q14": angle, "dist_q2": dist, "quality": qual})
 
@@ -150,14 +157,27 @@ def test_fused_scan_matches_sequential_steps():
         s_seq, out = compact_filter_step(s_seq, buf, jnp.asarray(count, jnp.int32), cfg)
         ranges_seq.append(np.asarray(out.ranges))
 
-    seq, counts = pack_host_scans_compact(scans, 1024)
-    s_fused = FilterState.create(cfg.window, cfg.beams, cfg.grid)
-    s_fused, ranges = compact_filter_scan(s_fused, seq, counts, cfg)
-    np.testing.assert_array_equal(np.asarray(ranges), np.stack(ranges_seq))
-    for name in ("range_window", "voxel_acc", "cursor", "filled"):
+    # the parallel production path AND the lax.scan reference form must
+    # both reproduce the per-step trajectory
+    from rplidar_ros2_driver_tpu.ops.filters import _compact_filter_scan_sequential
+
+    for scan_fn in (compact_filter_scan, _compact_filter_scan_sequential):
+        s_fused = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+        fused_ranges = []
+        for lo, hi in ((0, 3), (3, 13)):  # K < W, then K > W
+            seq, counts = pack_host_scans_compact(scans[lo:hi], 1024)
+            s_fused, ranges = scan_fn(s_fused, seq, counts, cfg)
+            fused_ranges.append(np.asarray(ranges))
         np.testing.assert_array_equal(
-            np.asarray(getattr(s_fused, name)), np.asarray(getattr(s_seq, name)), name
+            np.concatenate(fused_ranges), np.stack(ranges_seq)
         )
+        for name in ("range_window", "inten_window", "hit_window", "voxel_acc",
+                     "cursor", "filled"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s_fused, name)),
+                np.asarray(getattr(s_seq, name)),
+                name,
+            )
 
 
 def test_replay_through_chain_matches_streaming_chain():
