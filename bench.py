@@ -363,6 +363,33 @@ class _ChainRunner:
             lat[k] = time.perf_counter() - t0
         return float(np.percentile(lat, 99) * 1e3)
 
+    def measure_device_only(self, iters: int) -> float:
+        """Sustained scans/s with a device-resident input (no per-scan
+        host->device transfer): what a locally-attached chip sustains.
+        Reported alongside the streaming number so artifacts separate
+        framework compute from the remote-attach link's condition."""
+        p = jax.device_put(self.packed[0], self.device)
+        self.state, out = counted_filter_step(self.state, p, self.cfg)
+        _device_barrier(out.ranges)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            self.state, out = counted_filter_step(self.state, p, self.cfg)
+        _device_barrier(out.ranges)
+        return iters / (time.perf_counter() - t0)
+
+    def measure_link_put_ms(self, iters: int = 60) -> float:
+        """Amortized host->device transfer cost of one packed scan (the
+        streaming regime's per-scan link tax).  The tunnel's throughput
+        drifts ~2x over seconds, so this calibration lets artifact
+        readers normalize streaming numbers across runs/rounds."""
+        p = jax.device_put(self.packed[0], self.device)
+        _device_barrier(p)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p = jax.device_put(self.packed[0], self.device)
+        _device_barrier(p)
+        return (time.perf_counter() - t0) / iters * 1e3
+
 
 def metric_name(config: int) -> str:
     """The one config -> metric-name mapping (success AND failure records
@@ -415,9 +442,16 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
             "speedup": round(med["pallas"] / med["xla"], 3),
             "rounds": {k: [round(x, 1) for x in v] for k, v in rounds.items()},
         }
+        # link-condition calibration: the streaming number above is
+        # bounded by the remote-attach tunnel's per-scan transfer cost,
+        # which drifts run to run; record it plus the device-resident
+        # compute throughput so the artifact separates framework from
+        # link (a local chip sees device_only, not value)
+        link_put_ms = runners[median].measure_link_put_ms()
+        device_only = runners[median].measure_device_only(ITERS)
     else:
         scans_per_sec, sync_p99_ms = _run_chain(cfg, points)
-        ab = None
+        ab = link_put_ms = device_only = None
 
     result = {
         "metric": metric_name(config),
@@ -433,6 +467,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
     }
     if ab is not None:
         result["median_ab"] = ab
+        result["link_put_ms"] = round(link_put_ms, 3)
+        result["device_only_scans_per_sec"] = round(device_only, 2)
     print(json.dumps(result))
 
 
