@@ -486,16 +486,29 @@ def _compact_filter_scan_sequential(
     return state, ranges
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
-def compact_filter_scan(
-    state: FilterState, packed_seq: jax.Array, counts: jax.Array, cfg: FilterConfig
+def fused_scan_core(
+    state: FilterState,
+    packed_seq: jax.Array,
+    counts: jax.Array,
+    cfg: FilterConfig,
+    *,
+    keys_fn,
+    polar_fn,
+    hits_fn,
 ) -> tuple[FilterState, jax.Array]:
-    """Run the chain over a (K, 2, N) uint32 packed scan sequence.
+    """The one fused K-scan formulation, shared by the single-device path
+    (:func:`compact_filter_scan`) and the sharded path
+    (parallel/sharding._filter_scan_shard).  The callers inject the three
+    partition-dependent primitives; every piece of boundary arithmetic —
+    history stripe, sliding-median indexing, ring restore, telescoped
+    hit-window merge — lives only here.
 
-    Semantically identical to K successive ``compact_filter_step`` calls
-    (same state trajectory — tests/test_packed_ingest.py asserts equality
-    against both the per-step calls and _compact_filter_scan_sequential);
-    ``counts`` is (K,) int32.  Returns (final state, (K, beams) ranges).
+    * ``keys_fn(batch) -> (beam, packed)`` — resample keys (global beam
+      indices, or shard-local with out-of-slice points carrying INF);
+    * ``polar_fn(med_row) -> (xy, mask)`` — Cartesian projection for one
+      range row (global or shard-offset beam angles);
+    * ``hits_fn(xy, mask) -> (m, G, G)`` — per-scan occupancy grids for
+      the batch, including any cross-shard reduction.
     """
     k = packed_seq.shape[0]
     w = state.range_window.shape[0]
@@ -506,10 +519,11 @@ def compact_filter_scan(
         batch = _unpack_compact(pk, ct)
         if cfg.enable_clip:
             batch = clip_filter(batch, cfg)
-        return _resample_keys(batch, cfg.beams)
+        return keys_fn(batch)
 
     beam_k, packed_k = jax.vmap(keys_one)(packed_seq, counts)  # (K, P) each
-    new_r, new_i = grid_resample_batch(beam_k, packed_k, cfg.beams)  # (K, B)
+    b_local = state.range_window.shape[1]
+    new_r, new_i = grid_resample_batch(beam_k, packed_k, b_local)  # (K, B)
 
     # 2. extended history: previous ring in age order (oldest first), then
     # the new rows.  After step i the live window is ext[i+1 : i+1+W].
@@ -521,7 +535,6 @@ def compact_filter_scan(
     # no gather, nothing re-fetched from HBM.  XLA: materialize the K
     # windows in (W, K, B) order and flatten, one (W, K*B) lane median.
     if cfg.enable_median:
-        beams = new_r.shape[1]
         if cfg.median_backend == "pallas":
             from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
                 sliding_median_pallas,
@@ -530,8 +543,8 @@ def compact_filter_scan(
             med = sliding_median_pallas(ext_r, w)
         else:
             win_idx = jnp.arange(w)[:, None] + jnp.arange(1, k + 1)[None, :]  # (W, K)
-            windows = ext_r[win_idx].reshape(w, k * beams)
-            med = temporal_median(windows).reshape(k, beams)
+            windows = ext_r[win_idx].reshape(w, k * b_local)
+            med = temporal_median(windows).reshape(k, b_local)
     else:
         med = new_r
 
@@ -546,18 +559,12 @@ def compact_filter_scan(
 
     # 5. voxel: the accumulator after the last step is the sum of the
     # final window's hit grids (incremental add/retire telescopes); only
-    # the last min(K, W) scans' grids need computing
+    # the last min(K, W) scans' grids survive, so the Cartesian
+    # projection is restricted to those scans
     if cfg.enable_voxel:
-        # only the last min(K, W) scans' hit grids survive into the final
-        # window, so the Cartesian projection (1M-point trig at K=512) is
-        # restricted to those scans
         m = min(k, w)
-        xy, mask = jax.vmap(polar_to_cartesian, in_axes=(0, None))(
-            med[k - m :], cfg.beams
-        )
-        new_hits = jax.vmap(voxel_hits, in_axes=(0, 0, None, None))(
-            xy, mask, cfg.grid, cfg.cell_m
-        )  # (m, G, G)
+        xy, mask = jax.vmap(polar_fn)(med[k - m :])
+        new_hits = hits_fn(xy, mask)  # (m, G, G)
         if m < w:
             prev_h = jnp.roll(state.hit_window, -state.cursor, axis=0)
             ext_h = jnp.concatenate([prev_h[k:], new_hits], axis=0)  # (W,)
@@ -578,6 +585,30 @@ def compact_filter_scan(
         filled=filled,
     )
     return final, med
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def compact_filter_scan(
+    state: FilterState, packed_seq: jax.Array, counts: jax.Array, cfg: FilterConfig
+) -> tuple[FilterState, jax.Array]:
+    """Run the chain over a (K, 2, N) uint32 packed scan sequence.
+
+    Semantically identical to K successive ``compact_filter_step`` calls
+    (same state trajectory — tests/test_packed_ingest.py asserts equality
+    against both the per-step calls and _compact_filter_scan_sequential);
+    ``counts`` is (K,) int32.  Returns (final state, (K, beams) ranges).
+    """
+    return fused_scan_core(
+        state,
+        packed_seq,
+        counts,
+        cfg,
+        keys_fn=lambda batch: _resample_keys(batch, cfg.beams),
+        polar_fn=lambda row: polar_to_cartesian(row, cfg.beams),
+        hits_fn=lambda xy, mask: jax.vmap(
+            voxel_hits, in_axes=(0, 0, None, None)
+        )(xy, mask, cfg.grid, cfg.cell_m),
+    )
 
 
 def pack_host_scans_compact(scans, n: int | None = None):

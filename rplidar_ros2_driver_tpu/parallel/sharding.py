@@ -41,7 +41,9 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
     FilterState,
+    _grid_decode,
     clip_filter,
+    fused_scan_core,
     temporal_median,
 )
 
@@ -84,13 +86,13 @@ def make_mesh(
 # ---------------------------------------------------------------------------
 
 
-def _grid_resample_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
-    """Scatter-min the (replicated) point set into this shard's beam slice.
+def _resample_keys_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
+    """Shard-local (beam_local, packed) keys for this beam slice.
 
     Each beam shard sees every point of its stream's scan but keeps only
     those whose global beam index lands in its [offset, offset+b_local)
-    slice — out-of-slice points scatter with ``mode="drop"``.  No
-    communication: the drop IS the partition.
+    slice — out-of-slice points carry _INT_INF.  No communication: the
+    mask IS the partition.
     """
     offset = jax.lax.axis_index("beam") * b_local
     ok = batch.valid & (batch.dist_q2 != 0)
@@ -101,12 +103,15 @@ def _grid_resample_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
     in_slice = ok & (beam_local >= 0) & (beam_local < b_local)
     packed = (batch.dist_q2 << 8) | jnp.clip(batch.quality, 0, 255)
     packed = jnp.where(in_slice, packed, _INT_INF)
+    return beam_local, packed, in_slice
+
+
+def _grid_resample_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
+    """Scatter-min the (replicated) point set into this shard's beam slice."""
+    beam_local, packed, in_slice = _resample_keys_shard(batch, cfg, b_local)
     idx = jnp.where(in_slice, beam_local, b_local)  # b_local scatters to drop
     grid = jnp.full((b_local,), _INT_INF, jnp.int32).at[idx].min(packed, mode="drop")
-    hit = grid != _INT_INF
-    ranges = jnp.where(hit, (grid >> 8).astype(jnp.float32) * (1.0 / 4000.0), jnp.inf)
-    inten = jnp.where(hit, (grid & 0xFF).astype(jnp.float32), 0.0)
-    return ranges, inten
+    return _grid_decode(grid)
 
 
 def _polar_to_cartesian_shard(ranges: jax.Array, cfg: FilterConfig, b_local: int):
@@ -238,6 +243,23 @@ OUT_SPEC = FilterOutput(
 )
 
 
+def _beams_per_shard(mesh: Mesh, cfg: FilterConfig) -> int:
+    n_beam = mesh.shape["beam"]
+    if cfg.beams % n_beam:
+        raise ValueError(f"beams={cfg.beams} not divisible by beam axis {n_beam}")
+    return cfg.beams // n_beam
+
+
+def _shard_mapped(per_shard: Callable, mesh: Mesh, in_specs, out_specs) -> Callable:
+    """jit(shard_map(...)) with the jax-version compat shim in ONE place."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        sharded = shard_map(per_shard, **kwargs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        sharded = shard_map(per_shard, **kwargs, check_rep=False)
+    return jax.jit(sharded)
+
+
 def build_sharded_step(mesh: Mesh, cfg: FilterConfig) -> Callable:
     """Jit-compiled multi-stream filter step over ``mesh``.
 
@@ -245,24 +267,77 @@ def build_sharded_step(mesh: Mesh, cfg: FilterConfig) -> Callable:
     ``state``/``batch`` has a leading stream axis divisible by the mesh's
     stream extent and ``cfg.beams`` is divisible by its beam extent.
     """
-    n_beam = mesh.shape["beam"]
-    if cfg.beams % n_beam:
-        raise ValueError(f"beams={cfg.beams} not divisible by beam axis {n_beam}")
-    b_local = cfg.beams // n_beam
+    b_local = _beams_per_shard(mesh, cfg)
 
     def per_shard(state: FilterState, batch: ScanBatch):
         # leading local-stream axis: vmap the per-stream shard step
         step = functools.partial(_filter_step_shard, cfg=cfg, b_local=b_local)
         return jax.vmap(step)(state, batch)
 
-    kwargs = dict(
-        mesh=mesh, in_specs=(STATE_SPEC, BATCH_SPEC), out_specs=(STATE_SPEC, OUT_SPEC)
+    return _shard_mapped(
+        per_shard, mesh, (STATE_SPEC, BATCH_SPEC), (STATE_SPEC, OUT_SPEC)
     )
-    try:  # jax >= 0.8 renamed check_rep -> check_vma
-        sharded = shard_map(per_shard, **kwargs, check_vma=False)
-    except TypeError:  # pragma: no cover - older jax
-        sharded = shard_map(per_shard, **kwargs, check_rep=False)
-    return jax.jit(sharded)
+
+
+def _filter_scan_shard(
+    state: FilterState,
+    packed_seq: jax.Array,
+    counts: jax.Array,
+    cfg: FilterConfig,
+    b_local: int,
+) -> tuple[FilterState, jax.Array]:
+    """One stream's fused K-scan chain on one (stream, beam) shard.
+
+    ops.filters.fused_scan_core with the shard primitives injected:
+    beam-local resample keys, shard-offset Cartesian projection, and ONE
+    batched voxel all-reduce for the min(K, W) surviving hit grids (vs K
+    per-step collectives in a step loop).  Bit-identical to K successive
+    _filter_step_shard calls (tests/test_sharding.py asserts it).
+    """
+
+    def keys_fn(batch):
+        beam_local, packed, _ = _resample_keys_shard(batch, cfg, b_local)
+        return beam_local, packed
+
+    def hits_fn(xy, mask):
+        partial = jax.vmap(_voxel_hits_partial, in_axes=(0, 0, None))(xy, mask, cfg)
+        return _all_reduce(partial, "beam", cfg.voxel_reduce)
+
+    return fused_scan_core(
+        state,
+        packed_seq,
+        counts,
+        cfg,
+        keys_fn=keys_fn,
+        polar_fn=lambda row: _polar_to_cartesian_shard(row, cfg, b_local),
+        hits_fn=hits_fn,
+    )
+
+
+# specs for the fused scan's (streams, K, 2, N) sequence inputs/outputs
+SEQ_SPEC = P("stream", None, None, None)
+COUNTS_SPEC = P("stream", None)
+RANGES_SEQ_SPEC = P("stream", None, "beam")
+
+
+def build_sharded_scan(mesh: Mesh, cfg: FilterConfig) -> Callable:
+    """Jit-compiled fused multi-scan replay over ``mesh`` (the fleet
+    analog of ops.filters.compact_filter_scan).
+
+    Signature: ``scan(state, packed_seq, counts) -> (state, ranges)``
+    where ``packed_seq`` is (streams, K, 2, N) uint32, ``counts`` is
+    (streams, K) int32, and ``ranges`` comes back (streams, K, beams).
+    Semantically identical to K successive ``build_sharded_step`` calls.
+    """
+    b_local = _beams_per_shard(mesh, cfg)
+
+    def per_shard(state: FilterState, packed_seq: jax.Array, counts: jax.Array):
+        scan = functools.partial(_filter_scan_shard, cfg=cfg, b_local=b_local)
+        return jax.vmap(scan)(state, packed_seq, counts)
+
+    return _shard_mapped(
+        per_shard, mesh, (STATE_SPEC, SEQ_SPEC, COUNTS_SPEC), (STATE_SPEC, RANGES_SEQ_SPEC)
+    )
 
 
 def place_state(mesh: Mesh, state: FilterState) -> FilterState:

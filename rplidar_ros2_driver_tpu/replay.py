@@ -172,6 +172,64 @@ def replay_through_chain(
     )
 
 
+def replay_fleet(
+    stream_revolutions: list[list[dict]],
+    params,
+    *,
+    mesh=None,
+    beams: int | None = None,
+    capacity: int = 4096,
+    chunk: int = 256,
+):
+    """Fleet-scale :func:`replay_through_chain`: N streams' captures
+    through the fused K-scan chain sharded over a ``(stream, beam)``
+    mesh (parallel/sharding.build_sharded_scan — one batched voxel
+    all-reduce per chunk instead of one per scan).
+
+    Streams are truncated to the shortest capture (the fused step needs
+    one rectangular (S, K, 2, N) sequence per dispatch).  Returns
+    ((S, K, beams) float32 range images, final sharded FilterState);
+    an empty fleet returns ((0, 0, beams), None) without touching the
+    mesh.
+    """
+    import jax
+
+    from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
+    from rplidar_ros2_driver_tpu.ops.filters import pack_host_scans_compact
+    from rplidar_ros2_driver_tpu.parallel.sharding import (
+        build_sharded_scan,
+        create_sharded_state,
+        make_mesh,
+    )
+
+    if mesh is None:
+        mesh = make_mesh()
+    cfg = config_from_params(params, beams or DEFAULT_BEAMS)
+    streams = len(stream_revolutions)
+    if streams == 0:
+        return np.zeros((0, 0, cfg.beams), np.float32), None
+    k_total = min(len(r) for r in stream_revolutions)
+    scan_fn = build_sharded_scan(mesh, cfg)
+    state = create_sharded_state(mesh, cfg, streams)
+    outs = []
+    for i in range(0, k_total, chunk):
+        hi = min(i + chunk, k_total)
+        seqs, counts = zip(*[
+            pack_host_scans_compact(revs[i:hi], capacity)
+            for revs in stream_revolutions
+        ])
+        state, ranges = scan_fn(
+            state, np.stack(seqs), np.stack(counts).astype(np.int32)
+        )
+        outs.append(np.asarray(ranges))
+    return (
+        np.concatenate(outs, axis=1)
+        if outs
+        else np.zeros((streams, 0, cfg.beams), np.float32),
+        jax.device_get(state),
+    )
+
+
 def decode_recording(path: str) -> DecodedRecording:
     """Batch-decode a capture: consecutive same-type frames become ONE
     kernel invocation over a (M, frame_bytes) uint8 array."""

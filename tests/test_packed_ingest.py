@@ -180,6 +180,50 @@ def test_fused_scan_matches_sequential_steps(backend):
             )
 
 
+@pytest.mark.parametrize("k_chunk", [1, 4, 7])
+def test_fused_scan_edge_regimes(k_chunk):
+    """K == W, K == 1, and K coprime-to-W chunks must all reproduce the
+    per-step trajectory — these hit the parallel implementation's
+    boundary arithmetic (full-window replacement, single-step stripe,
+    cursor positions that never revisit 0)."""
+    cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
+    scans = []
+    for k in range(14):
+        angle, dist, qual = _raw_scan(k + 40, points=260)
+        # adversarial ordering: the resampler must not assume the
+        # rotation-sorted layout real revolutions have
+        rng = np.random.default_rng(1000 + k)
+        perm = rng.permutation(len(angle))
+        scans.append(
+            {"angle_q14": angle[perm], "dist_q2": dist[perm], "quality": qual[perm]}
+        )
+
+    s_seq = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    ranges_seq = []
+    for s in scans:
+        buf, count = pack_host_scan_compact(
+            s["angle_q14"], s["dist_q2"], s["quality"], None, 512
+        )
+        s_seq, out = compact_filter_step(s_seq, buf, jnp.asarray(count, jnp.int32), cfg)
+        ranges_seq.append(np.asarray(out.ranges))
+
+    s_fused = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    got = []
+    for lo in range(0, 14, k_chunk):
+        chunk = scans[lo : lo + k_chunk]
+        if not chunk:
+            break
+        seq, counts = pack_host_scans_compact(chunk, 512)
+        s_fused, ranges = compact_filter_scan(s_fused, seq, counts, cfg)
+        got.append(np.asarray(ranges))
+    np.testing.assert_array_equal(np.concatenate(got), np.stack(ranges_seq))
+    for name in ("range_window", "inten_window", "hit_window", "voxel_acc",
+                 "cursor", "filled"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_fused, name)), np.asarray(getattr(s_seq, name)), name
+        )
+
+
 def test_replay_through_chain_matches_streaming_chain():
     from rplidar_ros2_driver_tpu.replay import replay_through_chain
 

@@ -233,3 +233,42 @@ def test_ultra_mode_geometry_matches_standard(tmp_path):
     std = median_range_m("Standard")
     ultra = median_range_m("UltraBoost")
     assert abs(ultra - std) / std < 0.05, (std, ultra)
+
+
+def test_replay_fleet_matches_per_stream_replay():
+    """Fleet replay over the (stream, beam) mesh must reproduce each
+    stream's single-device replay bit-for-bit: the beam partition is
+    exact and the voxel all-reduce sums integers."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+    from rplidar_ros2_driver_tpu.replay import replay_fleet, replay_through_chain
+
+    params = DriverParams(
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=16,
+    )
+    rng = np.random.default_rng(11)
+    streams = []
+    for s in range(4):
+        revs = []
+        for k in range(10):
+            n = 60 + 4 * k + s
+            revs.append({
+                "angle_q14": ((np.arange(n) * 65536) // n).astype(np.int32),
+                "dist_q2": (rng.uniform(0.3, 8.0, n) * 4000).astype(np.int32),
+                "quality": np.full(n, 180, np.int32),
+            })
+        streams.append(revs)
+
+    mesh = make_mesh(8, stream=2)
+    ranges, state = replay_fleet(
+        streams, params, mesh=mesh, beams=64, capacity=128, chunk=6
+    )
+    assert ranges.shape == (4, 10, 64)
+    for s, revs in enumerate(streams):
+        ref, ref_state = replay_through_chain(revs, params, beams=64, capacity=128, chunk=6)
+        np.testing.assert_array_equal(ranges[s], ref)
+        np.testing.assert_array_equal(
+            np.asarray(state.voxel_acc[s]), np.asarray(ref_state.voxel_acc)
+        )

@@ -63,6 +63,80 @@ def test_sharded_matches_single_device():
     )
 
 
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_sharded_scan_matches_sharded_steps(backend):
+    """build_sharded_scan (fused K-scan fleet replay) must reproduce the
+    exact trajectory of K successive build_sharded_step calls — across a
+    K < W chunk (surviving old window rows) and a K > W chunk (ring
+    wrap), on both median backends."""
+    from rplidar_ros2_driver_tpu.ops.filters import pack_host_scans_compact
+    from rplidar_ros2_driver_tpu.parallel.sharding import build_sharded_scan
+
+    mesh = make_mesh(8, stream=2)
+    cfg = FilterConfig(window=4, beams=64, grid=16, cell_m=0.5,
+                       median_backend=backend)
+    streams, capacity = 4, 128
+    rng = np.random.default_rng(7)
+    per_stream = []
+    for s in range(streams):
+        scans = []
+        for k in range(9):
+            n = 50 + 3 * k + s
+            scans.append({
+                "angle_q14": ((np.arange(n) * 65536) // n).astype(np.int32),
+                "dist_q2": (rng.uniform(0.3, 8.0, n) * 4000).astype(np.int32),
+                "quality": np.full(n, 180, np.int32),
+            })
+        per_stream.append(scans)
+
+    def batch_at(k):
+        from rplidar_ros2_driver_tpu.core.types import ScanBatch
+        from rplidar_ros2_driver_tpu.ops.filters import pack_host_scan_compact
+
+        bufs, counts = zip(*[
+            pack_host_scan_compact(
+                s[k]["angle_q14"], s[k]["dist_q2"], s[k]["quality"], None, capacity
+            )
+            for s in per_stream
+        ])
+        from rplidar_ros2_driver_tpu.ops.filters import _unpack_compact
+
+        return jax.vmap(_unpack_compact)(
+            jnp.asarray(np.stack(bufs)), jnp.asarray(counts, jnp.int32)
+        )
+
+    # reference: 9 sharded per-step calls
+    step = build_sharded_step(mesh, cfg)
+    s_ref = create_sharded_state(mesh, cfg, streams)
+    ranges_ref = []
+    for k in range(9):
+        s_ref, out = step(s_ref, shard_batch(mesh, batch_at(k)))
+        ranges_ref.append(np.asarray(out.ranges))
+
+    # fused: K=3 (< W) then K=6 (> W) chunks
+    scan_fn = build_sharded_scan(mesh, cfg)
+    s_fused = create_sharded_state(mesh, cfg, streams)
+    got = []
+    for lo, hi in ((0, 3), (3, 9)):
+        seqs, counts = zip(*[
+            pack_host_scans_compact(s[lo:hi], capacity) for s in per_stream
+        ])
+        s_fused, ranges = scan_fn(
+            s_fused, jnp.asarray(np.stack(seqs)), jnp.asarray(np.stack(counts))
+        )
+        got.append(np.asarray(ranges))
+    got = np.concatenate(got, axis=1)  # (streams, 9, beams)
+
+    np.testing.assert_array_equal(
+        got.transpose(1, 0, 2), np.stack(ranges_ref)
+    )
+    for name in ("range_window", "inten_window", "hit_window", "voxel_acc",
+                 "cursor", "filled"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(s_fused, name)), np.asarray(getattr(s_ref, name)), name
+        )
+
+
 def test_ring_reduce_matches_psum():
     """The explicit ppermute ring all-reduce is semantically psum: the
     sharded step under voxel_reduce='ring' must be bit-identical to the
