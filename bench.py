@@ -108,7 +108,15 @@ def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
     """Config 7 — offline replay throughput: the fused multi-scan step
     (ops/filters.compact_filter_scan) advances the 64-scan window over a
     whole capture in K/chunk dispatches, amortizing the per-scan dispatch
-    and transfer overhead that bounds the streaming path (config 5)."""
+    and transfer overhead that bounds the streaming path (config 5).
+
+    The headline number comes from an in-jit fori_loop over the chunks —
+    ONE dispatch for the whole capture — because the remote-attach
+    tunnel's per-dispatch RPC cost drifts between ~1 and ~18 ms
+    (measured r2), which at chunk granularity swamps the device time a
+    local chip would see.  The per-dispatch chunk time is reported
+    alongside so the artifact still records what THIS rig pays when
+    dispatching chunk by chunk."""
     from rplidar_ros2_driver_tpu.ops.filters import (
         compact_filter_scan,
         pack_host_scans_compact,
@@ -125,17 +133,43 @@ def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
     seq = jax.device_put(seq_np, device)
     counts = jax.device_put(counts_np, device)
 
-    # warm-up compile
+    n_chunks = k_scans // chunk
+
+    @jax.jit
+    def run_capture(state, seq, counts):
+        def body(_, carry):
+            st, acc = carry
+            st, ranges = compact_filter_scan(st, seq, counts, cfg)
+            # fold every scan's median into the carry — without this
+            # dependency XLA would DCE the median work for all but the
+            # window-surviving scans and the number would be a lie
+            return st, jnp.minimum(acc, ranges)
+
+        st, acc = jax.lax.fori_loop(
+            0, n_chunks, body,
+            (state, jnp.full((chunk, cfg.beams), jnp.inf, jnp.float32)),
+        )
+        return st, acc[0, :1]
+
+    # warm-up compiles (single-chunk form first: reused for dispatch timing)
     state, ranges = compact_filter_scan(state, seq, counts, cfg)
     _device_barrier(ranges)
+    st2, tail = run_capture(state, seq, counts)
+    _device_barrier(tail)
 
-    n_chunks = k_scans // chunk
     t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        state, ranges = compact_filter_scan(state, seq, counts, cfg)
-    _device_barrier(ranges)
+    st2, tail = run_capture(st2, seq, counts)
+    _device_barrier(tail)
     dt = time.perf_counter() - t0
     sps = n_chunks * chunk / dt
+
+    # per-dispatch chunk cost on this rig (link + device), for context
+    t0 = time.perf_counter()
+    for _ in range(4):
+        st2, ranges = compact_filter_scan(st2, seq, counts, cfg)
+    _device_barrier(ranges)
+    per_dispatch_ms = (time.perf_counter() - t0) / 4 * 1e3
+
     return {
         "metric": metric_name(7),
         "value": round(sps, 2),
@@ -146,6 +180,7 @@ def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
         "window": WINDOW,
         "chunk": chunk,
         "scans_total": n_chunks * chunk,
+        "per_dispatch_chunk_ms": round(per_dispatch_ms, 3),
         "median_backend": MEDIAN_BACKEND,
         "device": str(device.platform),
     }

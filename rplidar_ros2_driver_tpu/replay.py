@@ -202,12 +202,12 @@ def replay_fleet(
         make_mesh,
     )
 
-    if mesh is None:
-        mesh = make_mesh()
     cfg = config_from_params(params, beams or DEFAULT_BEAMS)
     streams = len(stream_revolutions)
     if streams == 0:
         return np.zeros((0, 0, cfg.beams), np.float32), None
+    if mesh is None:
+        mesh = make_mesh()
     k_total = min(len(r) for r in stream_revolutions)
     scan_fn = build_sharded_scan(mesh, cfg)
     state = create_sharded_state(mesh, cfg, streams)
