@@ -32,6 +32,7 @@ that 10 Hz requirement.  Prints ONE JSON line.
 
 from __future__ import annotations
 
+import functools
 import json
 import time
 
@@ -408,7 +409,7 @@ class _ChainRunner:
         carry so XLA cannot dead-code-eliminate the median work."""
         cfg = self.cfg
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def run(state, p):
             def body(_, carry):
                 st, acc = carry

@@ -54,15 +54,32 @@ def main() -> int:
                               voxel_grid_size=64)
         svc = ShardedFilterService(params, streams=args.streams,
                                    beams=256, capacity=4096)
+        captures = [[] for _ in drvs]
         for tick in range(args.ticks):
             scans = []
-            for d in drvs:
+            for s, d in enumerate(drvs):
                 got = d.grab_scan_host(2.0)
                 scans.append(got[0] if got else None)
+                if got:
+                    captures[s].append(got[0])
             outs = svc.submit(scans)
             live = sum(o is not None for o in outs)
             occ = [int(np.asarray(o.voxel).sum()) if o else 0 for o in outs]
             print(f"tick {tick}: {live}/{args.streams} streams, voxel occ {occ}")
+
+        # the same revolutions again, offline: fused fleet replay over the
+        # service's mesh — one dispatch per chunk for the whole fleet
+        if all(len(c) >= 1 for c in captures):
+            from rplidar_ros2_driver_tpu.replay import replay_fleet
+
+            ranges, _ = replay_fleet(
+                captures, params, mesh=svc.mesh, beams=256,
+                capacity=4096, chunk=8,
+            )
+            print(
+                f"fleet replay: {ranges.shape[1]} revs/stream re-filtered "
+                f"offline -> ranges {ranges.shape}"
+            )
 
         import tempfile
 

@@ -55,3 +55,23 @@ def test_filter_step_pallas_backend_matches_xla():
         sp, op = filter_step(sp, batch, cfg_p)
     np.testing.assert_array_equal(np.asarray(ox.ranges), np.asarray(op.ranges))
     np.testing.assert_array_equal(np.asarray(ox.voxel), np.asarray(op.voxel))
+
+
+@pytest.mark.parametrize(
+    "w,k,b",
+    [(4, 8, 64), (6, 8, 100), (7, 16, 257), (8, 3, 128), (16, 24, 640), (1, 8, 32)],
+)
+def test_sliding_median_matches_successive_windows(w, k, b):
+    """sliding_median_pallas over a (W+K, B) stripe must equal K separate
+    temporal_median calls on the advancing windows — including non-power-
+    of-two W (in-kernel +inf pad rows) and k not a multiple of 8 (stripe
+    pad + output slice)."""
+    from rplidar_ros2_driver_tpu.ops.pallas_kernels import sliding_median_pallas
+
+    rng = np.random.default_rng(w * 100 + k * 10 + b)
+    ext = rand_window(rng, w + k, b)
+    got = np.asarray(sliding_median_pallas(jnp.asarray(ext), w))
+    want = np.stack(
+        [np.asarray(temporal_median(jnp.asarray(ext[i + 1 : i + 1 + w]))) for i in range(k)]
+    )
+    np.testing.assert_array_equal(got, want)
