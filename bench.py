@@ -274,12 +274,30 @@ def bench_e2e(seconds: float = 15.0) -> dict:
     finally:
         sim.stop()
 
-    # sustained device compute per scan: saturated re-dispatch of one scan
-    t0 = time.perf_counter()
+    # sustained device compute per scan, measured inside ONE dispatch so
+    # the tunnel's per-dispatch RPC (drifts ~1-18 ms on this rig) does
+    # not masquerade as framework time; the median output folds into the
+    # carry so the work cannot be dead-code-eliminated
     reps = 100
-    for _ in range(reps):
-        state, out = counted_filter_step(state, p, cfg)
-    _device_barrier(out.ranges)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_steps(state, p):
+        def body(_, carry):
+            st, acc = carry
+            st, out = counted_filter_step(st, p, cfg)
+            return st, jnp.minimum(acc, out.ranges)
+
+        st, acc = jax.lax.fori_loop(
+            0, reps, body,
+            (state, jnp.full((cfg.beams,), jnp.inf, jnp.float32)),
+        )
+        return st, acc[:1]
+
+    state, tail = run_steps(state, p)
+    _device_barrier(tail)
+    t0 = time.perf_counter()
+    state, tail = run_steps(state, p)
+    _device_barrier(tail)
     device_ms = (time.perf_counter() - t0) / reps * 1e3
 
     rev_p99 = timer.percentile("rev_to_dispatch", 99) * 1e3
