@@ -116,39 +116,68 @@ def _cmd_replay(args) -> int:
     from rplidar_ros2_driver_tpu.protocol.constants import Ans
     from rplidar_ros2_driver_tpu.replay import decode_recording
 
-    dec = decode_recording(args.recording)
-    revs = dec.revolutions()
-    print(f"{args.recording}: {dec.num_nodes} nodes, {len(revs)} complete revolutions")
-    for ans_type, n_frames, n_nodes in dec.runs:
-        try:
-            name = Ans(ans_type).name
-        except ValueError:
-            name = f"0x{ans_type:02x}"
-        print(f"  run: {name:34s} {n_frames:6d} frames -> {n_nodes:7d} nodes")
-    if revs:
-        pts = [len(r["angle_q14"]) for r in revs]
-        print(f"  points/rev: min={min(pts)} median={sorted(pts)[len(pts)//2]} max={max(pts)}")
-    if args.chain and revs:
+    per_stream = []
+    for path in args.recordings:
+        dec = decode_recording(path)
+        revs = dec.revolutions()
+        per_stream.append(revs)
+        print(f"{path}: {dec.num_nodes} nodes, {len(revs)} complete revolutions")
+        for ans_type, n_frames, n_nodes in dec.runs:
+            try:
+                name = Ans(ans_type).name
+            except ValueError:
+                name = f"0x{ans_type:02x}"
+            print(f"  run: {name:34s} {n_frames:6d} frames -> {n_nodes:7d} nodes")
+        if revs:
+            pts = [len(r["angle_q14"]) for r in revs]
+            print(f"  points/rev: min={min(pts)} median={sorted(pts)[len(pts)//2]} max={max(pts)}")
+    if args.chain and all(per_stream):
         import time as _time
 
         import numpy as np
 
         from rplidar_ros2_driver_tpu.core.config import DriverParams
-        from rplidar_ros2_driver_tpu.replay import replay_through_chain
 
         params = DriverParams(
             filter_backend="cpu" if args.cpu else "tpu",
             filter_chain=("clip", "median", "voxel"),
         )
         t0 = _time.perf_counter()
-        ranges, state = replay_through_chain(revs, params)
+        if len(per_stream) == 1:
+            from rplidar_ros2_driver_tpu.replay import replay_through_chain
+
+            ranges, state = replay_through_chain(per_stream[0], params)
+            what = "fused multi-scan step"
+        else:
+            # N recordings = N streams through the (stream, beam) mesh;
+            # size the stream axis to divide the recording count (the
+            # default squarest mesh split need not)
+            import math
+
+            import jax
+
+            from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+            from rplidar_ros2_driver_tpu.replay import replay_fleet
+
+            n_streams = len(per_stream)
+            mesh = make_mesh(stream=math.gcd(n_streams, len(jax.devices())))
+            k_min = min(len(r) for r in per_stream)
+            if any(len(r) != k_min for r in per_stream):
+                print(
+                    f"  note: recordings differ in length — fleet replay "
+                    f"truncates every stream to {k_min} revolutions"
+                )
+            ranges, state = replay_fleet(per_stream, params, mesh=mesh)
+            what = f"sharded fleet replay ({n_streams} streams)"
         dt = _time.perf_counter() - t0
+        occupancy = int(np.asarray(state.voxel_acc).sum())
+        n_scans = int(np.prod(ranges.shape[:-1]))
         finite = np.isfinite(ranges)
         print(
-            f"  chain: {len(revs)} scans through the fused multi-scan step in "
-            f"{dt:.2f} s ({len(revs) / dt:.0f} scans/s); "
+            f"  chain: {n_scans} scans through the {what} in "
+            f"{dt:.2f} s ({n_scans / dt:.0f} scans/s); "
             f"median range {np.median(ranges[finite]):.2f} m, "
-            f"voxel occupancy {int(np.asarray(state.voxel_acc).sum())}"
+            f"voxel occupancy {occupancy}"
         )
     return 0
 
@@ -281,8 +310,13 @@ def main(argv=None) -> int:
     doctor.add_argument("--device-timeout", type=float, default=60.0,
                         help="seconds to wait for jax backend init before declaring it down")
 
-    replay = sub.add_parser("replay", help="batch-decode a frame recording")
-    replay.add_argument("recording", help="capture file (RealLidarDriver.start_recording)")
+    replay = sub.add_parser("replay", help="batch-decode frame recording(s)")
+    replay.add_argument(
+        "recordings",
+        nargs="+",
+        help="capture file(s) (RealLidarDriver.start_recording); several "
+        "recordings replay as one fleet over the (stream, beam) mesh",
+    )
     replay.add_argument("--cpu", action="store_true", help="force the CPU JAX backend")
     replay.add_argument(
         "--chain",

@@ -55,11 +55,11 @@ class TestFormat:
         assert list(read_frames(str(p))) == []
 
 
-def _capture_from_sim(tmp_path, seconds=1.2):
+def _capture_from_sim(tmp_path, seconds=1.2, name="sim.rplr"):
     from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
     from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
 
-    path = str(tmp_path / "sim.rplr")
+    path = str(tmp_path / name)
     sim = SimulatedDevice().start()
     online_scans = []
     try:
@@ -140,6 +140,21 @@ class TestEndToEnd:
         )
         assert out.returncode == 0, out.stderr
         assert "fused multi-scan step" in out.stdout
+        assert "voxel occupancy" in out.stdout
+
+    def test_cli_replay_fleet(self, tmp_path):
+        """Two recordings replay as one fleet over the mesh."""
+        p1, _ = _capture_from_sim(tmp_path, seconds=0.5, name="a.rplr")
+        p2, _ = _capture_from_sim(tmp_path, seconds=0.5, name="b.rplr")
+        out = subprocess.run(
+            [sys.executable, "-m", "rplidar_ros2_driver_tpu", "replay", p1, p2,
+             "--cpu", "--chain"],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "sharded fleet replay (2 streams)" in out.stdout
         assert "voxel occupancy" in out.stdout
 
 
