@@ -19,10 +19,11 @@ def test_metric_names_stable():
     assert bench.metric_name(1) == "a1m8_passthrough_scans_per_sec"
     assert bench.metric_name(7) == "fused_replay_scans_per_sec"
     assert bench.metric_name(4) == "graded_config4_scans_per_sec"
+    assert bench.metric_name(8) == "fleet4_fused_replay_scans_per_sec"
 
 
 def test_graded_table_well_formed():
     for c, (kind, points, over) in bench.GRADED.items():
-        assert kind in ("passthrough", "chain", "e2e", "fused")
+        assert kind in ("passthrough", "chain", "e2e", "fused", "fleet")
         assert points > 0
         assert isinstance(over, dict)
