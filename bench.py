@@ -106,7 +106,7 @@ GRADED = {
 }
 
 
-def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
+def bench_fused(k_scans: int = 32768, chunk: int = 512) -> dict:
     """Config 7 — offline replay throughput: the fused multi-scan step
     (ops/filters.compact_filter_scan) advances the 64-scan window over a
     whole capture in K/chunk dispatches, amortizing the per-scan dispatch
@@ -188,7 +188,7 @@ def bench_fused(k_scans: int = 8192, chunk: int = 512) -> dict:
     }
 
 
-def bench_fleet(streams: int | None = None, k_scans: int = 2048, chunk: int = 256) -> dict:
+def bench_fleet(streams: int | None = None, k_scans: int = 8192, chunk: int = 256) -> dict:
     """Config 8 — N-stream fused fleet replay (parallel/sharding.
     build_sharded_scan) over the available mesh, chunks looped inside one
     jit dispatch (same discipline as config 7).  On one chip the streams
