@@ -1,0 +1,143 @@
+"""REAL multi-process distributed run: 2 jax.distributed processes.
+
+The other multihost tests exercise topology arithmetic in-process; this
+one actually launches two controller processes (2 virtual CPU devices
+each), joins them via `parallel.multihost.initialize`, builds the global
+(2, 2) `(stream, beam)` mesh spanning both, and runs the fused sharded
+fleet replay with the voxel all-reduce crossing the process boundary
+(gloo-backed CPU collectives — the stand-in for ICI/DCN).  Each process
+verifies the gathered result against a locally computed single-device
+reference, so the test proves the cross-host program is bit-identical
+to the single-chip math — the framework's analog of validating an
+NCCL/MPI backend against the serial implementation.
+"""
+
+import socket
+import subprocess
+import sys
+import textwrap
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    from rplidar_ros2_driver_tpu.parallel import multihost
+    assert multihost.is_configured()
+    assert multihost.initialize()
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        FilterConfig, FilterState, compact_filter_scan, pack_host_scans_compact,
+    )
+    from rplidar_ros2_driver_tpu.parallel import sharding as sh
+
+    # stream=1 deliberately: the BEAM axis must span both processes so
+    # the voxel all-reduce genuinely crosses the process boundary (a
+    # stream-major (2, 2) mesh would keep each stream's psum inside one
+    # process and the test would pass with zero inter-process bytes)
+    mesh = multihost.make_global_mesh(stream=1)
+    assert dict(mesh.shape) == {"stream": 1, "beam": 4}
+
+    cfg = FilterConfig(window=4, beams=64, grid=16, cell_m=0.5)
+    streams, k, cap = 2, 6, 128
+
+    # identical data on both controllers (SPMD contract)
+    rng = np.random.default_rng(0)
+    per_stream = []
+    for s in range(streams):
+        revs = []
+        for j in range(k):
+            n = 40 + 3 * j + s
+            revs.append({
+                "angle_q14": ((np.arange(n) * 65536) // n).astype(np.int32),
+                "dist_q2": (rng.uniform(0.3, 6.0, n) * 4000).astype(np.int32),
+                "quality": np.full(n, 180, np.int32),
+            })
+        per_stream.append(revs)
+    seqs, counts = zip(*[pack_host_scans_compact(r, cap) for r in per_stream])
+    seq_np = np.stack(seqs); counts_np = np.stack(counts).astype(np.int32)
+
+    scan_fn = sh.build_sharded_scan(mesh, cfg)
+    state = sh.create_sharded_state(mesh, cfg, streams)
+    seq = jax.device_put(seq_np, NamedSharding(mesh, sh.SEQ_SPEC))
+    cts = jax.device_put(counts_np, NamedSharding(mesh, sh.COUNTS_SPEC))
+    state, ranges = scan_fn(state, seq, cts)
+
+    # reassemble this process's addressable beam columns (half the beam
+    # axis lives here; the other half only on the peer)
+    got = np.full((streams, k, cfg.beams), np.nan, np.float32)
+    cols = np.zeros(cfg.beams, bool)
+    for shard in ranges.addressable_shards:
+        idx = shard.index  # (stream slice, scan slice, beam slice)
+        got[:, :, idx[2]] = np.asarray(shard.data)
+        cols[idx[2]] = True
+    assert cols.sum() == cfg.beams // 2, cols.sum()  # strictly half
+    # voxel_acc is replicated over beam, and its VALUE depends on hit
+    # grids from beams this process does NOT hold — equality with the
+    # local reference proves the cross-process all-reduce delivered
+    vox = np.asarray(state.voxel_acc.addressable_shards[0].data)
+
+    for s in range(streams):
+        st = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+        st, ref = compact_filter_scan(
+            st, jnp.asarray(seq_np[s]), jnp.asarray(counts_np[s]), cfg
+        )
+        np.testing.assert_array_equal(
+            got[s][:, cols], np.asarray(ref)[:, cols]
+        )
+        np.testing.assert_array_equal(vox[s], np.asarray(st.voxel_acc))
+    print(f"proc {pid}: cross-process fleet replay bit-exact", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_once(port: int):
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(i)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return procs, outs
+
+
+def test_two_process_distributed_fleet_replay():
+    # the free-port probe races against other processes binding it; one
+    # retry with a fresh port covers the TOCTOU window on busy CI hosts
+    for attempt in range(2):
+        procs, outs = _launch_once(_free_port())
+        if all(p.returncode == 0 for p in procs) or attempt == 1:
+            break
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "bit-exact" in out, out[-1000:]
