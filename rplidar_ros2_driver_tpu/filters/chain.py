@@ -37,13 +37,20 @@ from rplidar_ros2_driver_tpu.ops.filters import (
 
 
 def _pick_device(backend: str):
+    # local_devices, not devices: in a multi-controller job the global
+    # list starts with process 0's devices, and device_put to another
+    # process's device raises "Cannot copy array to non-addressable
+    # device" — the single-stream chain is a per-host object
     if backend == "cpu":
-        return jax.devices("cpu")[0]
-    # "tpu": first accelerator if present, else fall back to host
-    for d in jax.devices():
+        for d in jax.local_devices():
+            if d.platform == "cpu":
+                return d
+        return jax.local_devices(backend="cpu")[0]
+    # "tpu": first local accelerator if present, else fall back to host
+    for d in jax.local_devices():
         if d.platform != "cpu":
             return d
-    return jax.devices()[0]
+    return jax.local_devices()[0]
 
 
 DEFAULT_BEAMS = 2048

@@ -90,10 +90,11 @@ class ShardedFilterService:
 
     # -- ingest -------------------------------------------------------------
 
-    def _stack(self, scans: Sequence[Optional[dict]]) -> np.ndarray:
+    def _stack(self, scans: Sequence[Optional[dict]], offset: int = 0) -> np.ndarray:
+        """Pack a block of streams' newest revolutions; ``offset`` is the
+        block's first global stream index (error attribution only)."""
         n = self.capacity
-        s = self.streams
-        packed = np.zeros((s, 2, n + 1), np.uint32)  # +1: embedded count slot
+        packed = np.zeros((len(scans), 2, n + 1), np.uint32)  # +1: count slot
         for i, scan in enumerate(scans):
             if scan is None:
                 continue  # stream idle this tick: all-masked scan (count 0)
@@ -103,7 +104,7 @@ class ShardedFilterService:
                     scan.get("flag"), n,
                 )
             except ValueError as e:
-                raise ValueError(f"stream {i}: {e}") from None
+                raise ValueError(f"stream {offset + i}: {e}") from None
         return packed
 
     def submit(self, scans: Sequence[Optional[dict]]) -> list[Optional[FilterOutput]]:
@@ -130,6 +131,89 @@ class ShardedFilterService:
         voxel = np.asarray(out.voxel)
         results: list[Optional[FilterOutput]] = []
         for i, scan in enumerate(scans):
+            if scan is None:
+                results.append(None)
+                continue
+            results.append(
+                FilterOutput(
+                    ranges=ranges[i],
+                    intensities=inten[i],
+                    points_xy=xy[i],
+                    point_mask=mask[i],
+                    voxel=voxel[i],
+                )
+            )
+        return results
+
+    def submit_local(
+        self, local_scans: Sequence[Optional[dict]]
+    ) -> list[Optional[FilterOutput]]:
+        """Multi-controller tick: each process feeds ONLY its own stream
+        block (multihost.local_stream_slice) and gets back only its own
+        streams' outputs.
+
+        :meth:`submit` assumes one controller that can address every
+        shard — its ``np.asarray`` output fetches throw on a mesh that
+        spans processes.  This variant builds the global upload from
+        per-process local data (``jax.make_array_from_process_local_data``
+        — ingest never crosses hosts) and reassembles outputs from the
+        locally addressable shards.  Collective: every process must call
+        it each tick, in the same order relative to other collectives
+        (same contract as save_sharded).  Requires the stream-major mesh
+        layout of ``multihost.make_global_mesh`` so each process's stream
+        rows live entirely on its own devices; single-process it behaves
+        like :meth:`submit`.
+        """
+        from rplidar_ros2_driver_tpu.parallel import multihost
+
+        slc = multihost.local_stream_slice(self.streams)
+        n_local = slc.stop - slc.start
+        if len(local_scans) != n_local:
+            raise ValueError(
+                f"expected {n_local} local scans (streams {slc.start}:{slc.stop} "
+                f"of {self.streams}), got {len(local_scans)}"
+            )
+        packed_local = self._stack(local_scans, offset=slc.start)
+        packed = jax.make_array_from_process_local_data(
+            self._packed_sharding, packed_local
+        )
+        with self._lock:
+            self._state, out = self._step(self._state, packed)
+
+        def local_rows(arr):
+            """Reassemble this process's stream rows from addressable
+            shards (beam-sharded axes are split across local devices)."""
+            shape = (n_local,) + arr.shape[1:]
+            buf = np.zeros(shape, arr.dtype)
+            seen = np.zeros(shape, bool)
+            for shard in arr.addressable_shards:
+                idx = shard.index
+                # an unsharded stream dim yields slice(None): the global
+                # stream count is the stop fallback, clipped to our block
+                s0 = max(idx[0].start or 0, slc.start)
+                s1 = min(idx[0].stop or self.streams, slc.stop)
+                if s1 <= s0:
+                    continue
+                data = np.asarray(shard.data)
+                d0 = s0 - (idx[0].start or 0)
+                local_idx = (slice(s0 - slc.start, s1 - slc.start),) + idx[1:]
+                buf[local_idx] = data[d0 : d0 + (s1 - s0)]
+                seen[local_idx] = True
+            if not seen.all():
+                raise RuntimeError(
+                    "submit_local needs each process's stream rows fully "
+                    "addressable — use the stream-major mesh from "
+                    "multihost.make_global_mesh"
+                )
+            return buf
+
+        ranges = local_rows(out.ranges)
+        inten = local_rows(out.intensities)
+        xy = local_rows(out.points_xy)
+        mask = local_rows(out.point_mask)
+        voxel = local_rows(out.voxel)
+        results: list[Optional[FilterOutput]] = []
+        for i, scan in enumerate(local_scans):
             if scan is None:
                 results.append(None)
                 continue

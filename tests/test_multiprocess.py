@@ -99,6 +99,32 @@ _WORKER = textwrap.dedent(
         )
         np.testing.assert_array_equal(vox[s], np.asarray(st.voxel_acc))
     print(f"proc {pid}: cross-process fleet replay bit-exact", flush=True)
+
+    # --- streaming service, multi-controller: each process feeds ONLY
+    # its own stream over the production stream-major mesh --------------
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+
+    params = DriverParams(
+        filter_backend="cpu", filter_window=4,
+        filter_chain=("clip", "median", "voxel"), voxel_grid_size=16,
+    )
+    mesh2 = multihost.make_global_mesh(stream=2)  # rows align to processes
+    svc = ShardedFilterService(params, streams=2, mesh=mesh2, beams=64,
+                               capacity=cap)
+    ref_chain = ScanFilterChain(params, beams=64)
+    for j in range(k):
+        scan = per_stream[pid][j]  # this process's OWN stream only
+        outs = svc.submit_local([scan])
+        want = ref_chain.process_raw(
+            scan["angle_q14"], scan["dist_q2"], scan["quality"]
+        )
+        np.testing.assert_array_equal(
+            outs[0].ranges, np.asarray(want.ranges)
+        )
+        np.testing.assert_array_equal(outs[0].voxel, np.asarray(want.voxel))
+    print(f"proc {pid}: multi-controller service ticks bit-exact", flush=True)
     """
 )
 
@@ -122,7 +148,13 @@ def _launch_once(port: int):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            try:
+                out, _ = p.communicate(timeout=240)
+            except subprocess.TimeoutExpired:
+                # a stolen coordinator port leaves the non-coordinator
+                # blocked in initialize(): kill and let the caller retry
+                p.kill()
+                out, _ = p.communicate()
             outs.append(out)
     finally:
         for p in procs:
@@ -140,4 +172,5 @@ def test_two_process_distributed_fleet_replay():
             break
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
-        assert "bit-exact" in out, out[-1000:]
+        assert "fleet replay bit-exact" in out, out[-1000:]
+        assert "service ticks bit-exact" in out, out[-1000:]
