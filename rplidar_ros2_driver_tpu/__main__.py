@@ -149,25 +149,18 @@ def _cmd_replay(args) -> int:
             ranges, state = replay_through_chain(per_stream[0], params)
             what = "fused multi-scan step"
         else:
-            # N recordings = N streams through the (stream, beam) mesh;
-            # size the stream axis to divide the recording count (the
-            # default squarest mesh split need not)
-            import math
-
-            import jax
-
-            from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+            # N recordings = N streams through the (stream, beam) mesh
+            # (replay_fleet's default mesh divides any stream count)
             from rplidar_ros2_driver_tpu.replay import replay_fleet
 
             n_streams = len(per_stream)
-            mesh = make_mesh(stream=math.gcd(n_streams, len(jax.devices())))
             k_min = min(len(r) for r in per_stream)
             if any(len(r) != k_min for r in per_stream):
                 print(
                     f"  note: recordings differ in length — fleet replay "
                     f"truncates every stream to {k_min} revolutions"
                 )
-            ranges, state = replay_fleet(per_stream, params, mesh=mesh)
+            ranges, state = replay_fleet(per_stream, params)
             what = f"sharded fleet replay ({n_streams} streams)"
         dt = _time.perf_counter() - t0
         occupancy = int(np.asarray(state.voxel_acc).sum())

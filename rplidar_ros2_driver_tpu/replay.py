@@ -187,11 +187,15 @@ def replay_fleet(
     all-reduce per chunk instead of one per scan).
 
     Streams are truncated to the shortest capture (the fused step needs
-    one rectangular (S, K, 2, N) sequence per dispatch).  Returns
+    one rectangular (S, K, 2, N) sequence per dispatch).  The default
+    mesh sizes its stream axis to gcd(streams, devices) so any fleet
+    size divides it (the squarest split need not).  Returns
     ((S, K, beams) float32 range images, final sharded FilterState);
     an empty fleet returns ((0, 0, beams), None) without touching the
     mesh.
     """
+    import math
+
     import jax
 
     from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
@@ -207,7 +211,7 @@ def replay_fleet(
     if streams == 0:
         return np.zeros((0, 0, cfg.beams), np.float32), None
     if mesh is None:
-        mesh = make_mesh()
+        mesh = make_mesh(stream=math.gcd(streams, len(jax.devices())))
     k_total = min(len(r) for r in stream_revolutions)
     scan_fn = build_sharded_scan(mesh, cfg)
     state = create_sharded_state(mesh, cfg, streams)
