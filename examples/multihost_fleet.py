@@ -1,0 +1,117 @@
+"""Multi-controller fleet: two jax.distributed processes, one mesh.
+
+Self-launches two worker processes (the parent is only a launcher), each
+owning one lidar stream.  The workers join via
+``parallel.multihost.initialize`` (standard coordinator env vars), build
+the global stream-major ``(stream, beam)`` mesh, and tick
+``ShardedFilterService.submit_local`` — each process uploads ONLY its
+own stream's revolutions (`jax.make_array_from_process_local_data`, so
+ingest never crosses hosts) and reads back only its own output shards.
+On a real pod the same code spans hosts; here the two processes share
+one machine with 2 virtual CPU devices each (gloo collectives standing
+in for ICI/DCN).
+
+    python examples/multihost_fleet.py [--ticks 5]
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    port, pid, ticks = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    sys.path.insert(0, os.getcwd())  # launcher sets cwd to the repo root
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
+    from rplidar_ros2_driver_tpu.parallel import multihost
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+
+    assert multihost.initialize()
+    mesh = multihost.make_global_mesh(stream=2)  # rows align to processes
+    print(f"proc {pid}: joined, mesh {dict(mesh.shape)} over "
+          f"{jax.process_count()} processes", flush=True)
+
+    params = DriverParams(filter_backend="cpu", filter_window=4,
+                          filter_chain=("clip", "median", "voxel"),
+                          voxel_grid_size=32)
+    svc = ShardedFilterService(params, streams=2, mesh=mesh, beams=256,
+                               capacity=1024)
+    lidar = DummyLidarDriver()         # this host's OWN sensor
+    lidar.connect("dummy", 0, False)
+    lidar.start_motor("", 600)
+    for tick in range(ticks):
+        scan, _ts0, _dur = lidar.grab_scan_host(2.0)
+        outs = svc.submit_local([scan])   # collective: both procs tick
+        occ = int(outs[0].voxel.sum())
+        print(f"proc {pid} tick {tick}: voxel occ {occ}", flush=True)
+    lidar.stop_motor()
+    lidar.disconnect()
+    print(f"proc {pid}: done", flush=True)
+    """
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ticks", type=int, default=3)
+    # accepted for symmetry with the other examples; the workers force
+    # the CPU backend themselves (virtual 2-device processes)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(i), str(args.ticks)],
+            cwd=os.path.dirname(here),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    # timeout well under any harness timeout, and a hung worker takes
+    # its sibling down with it (a lone survivor would orphan holding
+    # the coordinator port)
+    ok = True
+    outs = ["", ""]
+    try:
+        for i, p in enumerate(procs):
+            try:
+                outs[i], _ = p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                outs[i], _ = p.communicate()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, p in enumerate(procs):
+        print(f"--- worker {i} (rc={p.returncode}) ---")
+        print(outs[i].strip())
+        ok = ok and p.returncode == 0 and "done" in outs[i]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

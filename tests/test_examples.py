@@ -15,6 +15,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         ("single_lidar.py", ["--seconds", "3"]),
         ("fleet_gateway.py", ["--ticks", "3"]),
         ("record_replay.py", ["--seconds", "2"]),
+        ("multihost_fleet.py", ["--ticks", "2"]),
     ],
 )
 def test_example_runs(script, extra):
