@@ -190,3 +190,27 @@ class TestOrbaxCheckpoint:
         assert svc2.load_sharded(path)
         for k, v in svc2.snapshot().items():
             np.testing.assert_array_equal(v, svc.snapshot()[k], k)
+
+    def test_submit_local_single_process_matches_submit(self, mesh):
+        """Single-process, submit_local covers the full stream range and
+        must be tick-for-tick identical to submit (same state trajectory,
+        same outputs) — the degenerate case of the multi-controller path
+        (the real 2-process case lives in test_multiprocess.py)."""
+        svc_a = ShardedFilterService(_params(), streams=4, mesh=mesh, beams=128)
+        svc_b = ShardedFilterService(_params(), streams=4, mesh=mesh, beams=128)
+        for tick in range(3):
+            scans = [
+                _scan(10 * tick + s) if (tick + s) % 3 else None
+                for s in range(4)
+            ]
+            out_a = svc_a.submit(scans)
+            out_b = svc_b.submit_local(scans)
+            for a, b in zip(out_a, out_b):
+                assert (a is None) == (b is None)
+                if a is None:
+                    continue
+                np.testing.assert_array_equal(a.ranges, b.ranges)
+                np.testing.assert_array_equal(a.voxel, b.voxel)
+                np.testing.assert_array_equal(a.points_xy, b.points_xy)
+        for k, v in svc_b.snapshot().items():
+            np.testing.assert_array_equal(v, svc_a.snapshot()[k], k)
