@@ -106,6 +106,31 @@ GRADED = {
 }
 
 
+def _min_fold_loop(step_fn, acc_shape: tuple, iters: int):
+    """The ONE in-jit measurement harness (see module caveat): run
+    ``iters`` steps of ``step_fn(state, *operands) -> (state, out)``
+    inside a single dispatch, folding every step's output into a
+    min-carry so XLA cannot dead-code-eliminate the work.  Callers time
+    two invocations (warm-up compile, then the measured one) and MUST
+    barrier on a value depending on the WHOLE acc (e.g.
+    ``_device_barrier(jnp.min(acc))``) so sharded runs cannot report
+    before every device finishes.  State is donated."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run(state, *operands):
+        def body(_, carry):
+            st, acc = carry
+            st, out = step_fn(st, *operands)
+            return st, jnp.minimum(acc, out)
+
+        return jax.lax.fori_loop(
+            0, iters, body,
+            (state, jnp.full(acc_shape, jnp.inf, jnp.float32)),
+        )
+
+    return run
+
+
 def bench_fused(k_scans: int = 32768, chunk: int = 512) -> dict:
     """Config 7 — offline replay throughput: the fused multi-scan step
     (ops/filters.compact_filter_scan) advances the 64-scan window over a
@@ -136,32 +161,21 @@ def bench_fused(k_scans: int = 32768, chunk: int = 512) -> dict:
     counts = jax.device_put(counts_np, device)
 
     n_chunks = k_scans // chunk
-
-    @jax.jit
-    def run_capture(state, seq, counts):
-        def body(_, carry):
-            st, acc = carry
-            st, ranges = compact_filter_scan(st, seq, counts, cfg)
-            # fold every scan's median into the carry — without this
-            # dependency XLA would DCE the median work for all but the
-            # window-surviving scans and the number would be a lie
-            return st, jnp.minimum(acc, ranges)
-
-        st, acc = jax.lax.fori_loop(
-            0, n_chunks, body,
-            (state, jnp.full((chunk, cfg.beams), jnp.inf, jnp.float32)),
-        )
-        return st, acc[0, :1]
+    run_capture = _min_fold_loop(
+        lambda st, seq, counts: compact_filter_scan(st, seq, counts, cfg),
+        (chunk, cfg.beams),
+        n_chunks,
+    )
 
     # warm-up compiles (single-chunk form first: reused for dispatch timing)
     state, ranges = compact_filter_scan(state, seq, counts, cfg)
     _device_barrier(ranges)
-    st2, tail = run_capture(state, seq, counts)
-    _device_barrier(tail)
+    st2, acc = run_capture(state, seq, counts)
+    _device_barrier(jnp.min(acc))
 
     t0 = time.perf_counter()
-    st2, tail = run_capture(st2, seq, counts)
-    _device_barrier(tail)
+    st2, acc = run_capture(st2, seq, counts)
+    _device_barrier(jnp.min(acc))
     dt = time.perf_counter() - t0
     sps = n_chunks * chunk / dt
 
@@ -222,28 +236,17 @@ def bench_fleet(streams: int | None = None, k_scans: int = 8192, chunk: int = 25
     counts = jnp.asarray(np.stack(counts))     # (S, chunk)
 
     n_chunks = k_scans // chunk
+    run_capture = _min_fold_loop(
+        lambda st, seq, counts: scan_fn(st, seq, counts),
+        (streams, chunk, cfg.beams),
+        n_chunks,
+    )
 
-    @jax.jit
-    def run_capture(state, seq, counts):
-        def body(_, carry):
-            st, acc = carry
-            st, ranges = scan_fn(st, seq, counts)
-            return st, jnp.minimum(acc, ranges)
-
-        st, acc = jax.lax.fori_loop(
-            0, n_chunks, body,
-            (state, jnp.full((streams, chunk, cfg.beams), jnp.inf, jnp.float32)),
-        )
-        # fold across the STREAM axis too: on a stream-sharded mesh the
-        # rows live on different devices with no coupling collective, so
-        # a stream-0-only fetch could return before the rest finish
-        return st, jnp.min(acc[:, 0, :1], axis=0)
-
-    st2, tail = run_capture(state, seq, counts)
-    _device_barrier(tail)
+    st2, acc = run_capture(state, seq, counts)
+    _device_barrier(jnp.min(acc))  # full reduce: depends on EVERY shard
     t0 = time.perf_counter()
-    st2, tail = run_capture(st2, seq, counts)
-    _device_barrier(tail)
+    st2, acc = run_capture(st2, seq, counts)
+    _device_barrier(jnp.min(acc))
     dt = time.perf_counter() - t0
     total = streams * n_chunks * chunk
     sps = total / dt
@@ -278,8 +281,12 @@ def bench_e2e(seconds: float = 15.0) -> dict:
       * rev_to_dispatch_p99_ms — revolution measurement-end to chain
         dispatch handed to the device (decode + assembly wake + pack +
         upload enqueue): pure host framework overhead.
-      * device_ms_per_scan — sustained device compute per scan (pipelined).
-      * added_p99_est_ms — rev_to_dispatch_p99 + device time: what a
+      * device_compute_ms_per_scan — sustained device compute per scan
+        (in-jit step loop; renamed from device_ms_per_scan when the
+        measurement stopped including per-dispatch RPC — series are not
+        comparable).
+      * added_p99_local_est_ms — rev_to_dispatch_p99 + device compute:
+        what a
         locally-attached chip would add end-to-end (<10 ms north star).
       * publish_sync_p99_ms — full output fetch included; through the axon
         tunnel this is link-RTT-dominated and reported for honesty.
@@ -353,28 +360,19 @@ def bench_e2e(seconds: float = 15.0) -> dict:
 
     # sustained device compute per scan, measured inside ONE dispatch so
     # the tunnel's per-dispatch RPC (drifts ~1-18 ms on this rig) does
-    # not masquerade as framework time; the median output folds into the
-    # carry so the work cannot be dead-code-eliminated
+    # not masquerade as framework time
     reps = 100
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def run_steps(state, p):
-        def body(_, carry):
-            st, acc = carry
-            st, out = counted_filter_step(st, p, cfg)
-            return st, jnp.minimum(acc, out.ranges)
+    def step_ranges(st, p):
+        st, out = counted_filter_step(st, p, cfg)
+        return st, out.ranges
 
-        st, acc = jax.lax.fori_loop(
-            0, reps, body,
-            (state, jnp.full((cfg.beams,), jnp.inf, jnp.float32)),
-        )
-        return st, acc[:1]
-
-    state, tail = run_steps(state, p)
-    _device_barrier(tail)
+    run_steps = _min_fold_loop(step_ranges, (cfg.beams,), reps)
+    state, acc = run_steps(state, p)
+    _device_barrier(jnp.min(acc))
     t0 = time.perf_counter()
-    state, tail = run_steps(state, p)
-    _device_barrier(tail)
+    state, acc = run_steps(state, p)
+    _device_barrier(jnp.min(acc))
     device_ms = (time.perf_counter() - t0) / reps * 1e3
 
     rev_p99 = timer.percentile("rev_to_dispatch", 99) * 1e3
@@ -390,8 +388,8 @@ def bench_e2e(seconds: float = 15.0) -> dict:
         "decode_nodes_per_sec": round(nodes_decoded / seconds),
         "rev_to_dispatch_p99_ms": round(rev_p99, 3),
         "grab_to_dispatch_p99_ms": round(timer.percentile("grab_to_dispatch", 99) * 1e3, 3),
-        "device_ms_per_scan": round(device_ms, 3),
-        "added_p99_est_ms": round(rev_p99 + device_ms, 3),
+        "device_compute_ms_per_scan": round(device_ms, 3),
+        "added_p99_local_est_ms": round(rev_p99 + device_ms, 3),
         "publish_sync_p99_ms": round(timer.percentile("publish_sync", 99) * 1e3, 3),
         "median_backend": MEDIAN_BACKEND,
         "device": str(device.platform),
@@ -504,25 +502,17 @@ class _ChainRunner:
         carry so XLA cannot dead-code-eliminate the median work."""
         cfg = self.cfg
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def run(state, p):
-            def body(_, carry):
-                st, acc = carry
-                st, out = counted_filter_step(st, p, cfg)
-                return st, jnp.minimum(acc, out.ranges)
+        def step_ranges(st, p):
+            st, out = counted_filter_step(st, p, cfg)
+            return st, out.ranges
 
-            st, acc = jax.lax.fori_loop(
-                0, iters, body,
-                (state, jnp.full((cfg.beams,), jnp.inf, jnp.float32)),
-            )
-            return st, acc[:1]
-
+        run = _min_fold_loop(step_ranges, (cfg.beams,), iters)
         p = jax.device_put(self.packed[0], self.device)
-        self.state, tail = run(self.state, p)
-        _device_barrier(tail)
+        self.state, acc = run(self.state, p)
+        _device_barrier(jnp.min(acc))
         t0 = time.perf_counter()
-        self.state, tail = run(self.state, p)
-        _device_barrier(tail)
+        self.state, acc = run(self.state, p)
+        _device_barrier(jnp.min(acc))
         return iters / (time.perf_counter() - t0)
 
     def measure_link_put_ms(self, iters: int = 60) -> float:
@@ -547,7 +537,7 @@ def metric_name(config: int) -> str:
         5: "denseboost64_filter_chain_scans_per_sec",
         6: "e2e_decode_chain_scans_per_sec",
         7: "fused_replay_scans_per_sec",
-        8: "fleet4_fused_replay_scans_per_sec",
+        8: "fleet_fused_replay_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -635,7 +625,7 @@ if __name__ == "__main__":
         choices=sorted(GRADED),
         help="graded BASELINE config (1=A1M8 passthrough .. 5=64-scan voxel "
         "headline (default), 6=e2e with wire decode, 7=fused offline replay, "
-        "8=4-stream fleet replay on the mesh)",
+        "8=fleet replay on the mesh, 4 streams per stream-shard)",
     )
     ap.add_argument(
         "--median",

@@ -73,39 +73,49 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(port), str(i), str(args.ticks)],
-            cwd=os.path.dirname(here),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for i in range(2)
-    ]
-    # timeout well under any harness timeout, and a hung worker takes
-    # its sibling down with it (a lone survivor would orphan holding
-    # the coordinator port)
+    def launch_once(port: int):
+        here = os.path.dirname(os.path.abspath(__file__))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER, str(port), str(i), str(args.ticks)],
+                cwd=os.path.dirname(here),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        # timeout well under any harness timeout, and a hung worker takes
+        # its sibling down with it (a lone survivor would orphan holding
+        # the coordinator port)
+        outs = ["", ""]
+        try:
+            for i, p in enumerate(procs):
+                try:
+                    outs[i], _ = p.communicate(timeout=120)
+                except subprocess.TimeoutExpired:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.kill()
+                    outs[i], _ = p.communicate()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return procs, outs
+
+    # the free-port probe races other processes binding it (TOCTOU):
+    # one retry with a fresh port covers the window
+    for attempt in range(2):
+        procs, outs = launch_once(free_port())
+        if all(p.returncode == 0 for p in procs) or attempt == 1:
+            break
     ok = True
-    outs = ["", ""]
-    try:
-        for i, p in enumerate(procs):
-            try:
-                outs[i], _ = p.communicate(timeout=120)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                outs[i], _ = p.communicate()
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
     for i, p in enumerate(procs):
         print(f"--- worker {i} (rc={p.returncode}) ---")
         print(outs[i].strip())

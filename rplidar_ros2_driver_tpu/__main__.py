@@ -131,6 +131,11 @@ def _cmd_replay(args) -> int:
         if revs:
             pts = [len(r["angle_q14"]) for r in revs]
             print(f"  points/rev: min={min(pts)} median={sorted(pts)[len(pts)//2]} max={max(pts)}")
+    if args.chain and not all(per_stream):
+        empty = [p for p, revs in zip(args.recordings, per_stream) if not revs]
+        print(
+            f"  --chain skipped: no complete revolutions in {', '.join(empty)}"
+        )
     if args.chain and all(per_stream):
         import time as _time
 

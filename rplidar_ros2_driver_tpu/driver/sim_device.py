@@ -210,6 +210,10 @@ class SimulatedDevice:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # timeout BEFORE publishing: _send's whole-frame retry loop
+            # relies on send() timing out at 0.2 s — a send grabbing the
+            # conn in the publish-to-_serve window must not block forever
+            conn.settimeout(0.2)
             with self._conn_lock:
                 self._conn = conn
             try:
@@ -221,8 +225,7 @@ class SimulatedDevice:
 
     def _serve(self, conn: socket.socket) -> None:
         buf = bytearray()
-        conn.settimeout(0.2)
-        while self._running.is_set():
+        while self._running.is_set():  # timeout set before conn was published
             try:
                 chunk = conn.recv(256)
             except socket.timeout:

@@ -42,9 +42,6 @@ def _pick_device(backend: str):
     # process's device raises "Cannot copy array to non-addressable
     # device" — the single-stream chain is a per-host object
     if backend == "cpu":
-        for d in jax.local_devices():
-            if d.platform == "cpu":
-                return d
         return jax.local_devices(backend="cpu")[0]
     # "tpu": first local accelerator if present, else fall back to host
     for d in jax.local_devices():

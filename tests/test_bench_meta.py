@@ -19,7 +19,7 @@ def test_metric_names_stable():
     assert bench.metric_name(1) == "a1m8_passthrough_scans_per_sec"
     assert bench.metric_name(7) == "fused_replay_scans_per_sec"
     assert bench.metric_name(4) == "graded_config4_scans_per_sec"
-    assert bench.metric_name(8) == "fleet4_fused_replay_scans_per_sec"
+    assert bench.metric_name(8) == "fleet_fused_replay_scans_per_sec"
 
 
 def test_graded_table_well_formed():

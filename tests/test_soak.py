@@ -84,7 +84,11 @@ def test_sustained_stream_keeps_up(rate_mult, transport):
     # a fully parked consumer), (2) kernel TX queue occupancy sampled
     # every grab (a merely-slow consumer pins the socket buffer full;
     # a starved sim thread leaves it near empty).
-    assert stalls <= 3, (stalls, span)
+    # coarse secondary signal only: a >100 ms _send can also be the sim
+    # thread descheduled under extreme CI load, so the bound sits above
+    # anything scheduling jitter produces; a fully parked consumer hits
+    # ~duration/0.5 s stalls AND pins the TX queue (the primary signal)
+    assert stalls <= 8, (stalls, span)
     if backlogs:
         med_backlog = float(np.median(backlogs))
         assert med_backlog <= 64 * 1024, (med_backlog, max(backlogs))
