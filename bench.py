@@ -7,7 +7,7 @@ resample -> 64-scan rolling temporal median -> polar->Cartesian -> incremental
 voxel occupancy).
 
 The harness streams scans through the bit-packed one-transfer ingest path
-(ops.filters.counted_filter_step: one (2, N) uint32 device_put — 8
+(ops.filters.counted_filter_step: one (3, N) uint16 device_put — 6
 bytes/point, node count folded into the buffer's reserved last slot so
 there is no separate count-scalar transfer — + one donated step dispatch
 per revolution), overlapping host
@@ -61,7 +61,7 @@ BASELINE_SCANS_PER_SEC = 10.0  # real-time requirement at 600 RPM
 # interpret mode on CPU.
 MEDIAN_BACKEND = "pallas"
 # wire capacity: smallest power of two holding a DenseBoost revolution —
-# halves the per-scan transfer vs the 8192-node default
+# halves the per-scan transfer vs the 8192-node default (24 KB at 6 B/pt)
 CAPACITY = 4096
 
 
@@ -232,7 +232,7 @@ def bench_fleet(streams: int | None = None, k_scans: int = 8192, chunk: int = 25
         )
         for s in range(streams)
     ])
-    seq = jnp.asarray(np.stack(seqs))          # (S, chunk, 2, N)
+    seq = jnp.asarray(np.stack(seqs))          # (S, chunk, 3, N) uint16
     counts = jnp.asarray(np.stack(counts))     # (S, chunk)
 
     n_chunks = k_scans // chunk

@@ -110,10 +110,18 @@ def main() -> int:
         return procs, outs
 
     # the free-port probe races other processes binding it (TOCTOU):
-    # one retry with a fresh port covers the window
+    # one retry with a fresh port covers the window — but only when the
+    # failure looks like a bind/coordinator problem, so genuine worker
+    # failures stay fast and keep their first-attempt diagnostics
+    port_errors = ("Address already in use", "Failed to bind", "UNAVAILABLE",
+                   "coordination service")
     for attempt in range(2):
         procs, outs = launch_once(free_port())
-        if all(p.returncode == 0 for p in procs) or attempt == 1:
+        if all(p.returncode == 0 for p in procs):
+            break
+        if attempt == 0 and not any(
+            e in out for e in port_errors for out in outs
+        ):
             break
     ok = True
     for i, p in enumerate(procs):

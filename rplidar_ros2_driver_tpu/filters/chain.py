@@ -143,8 +143,8 @@ class ScanFilterChain:
         """Streaming ingest of raw host arrays via the packed one-transfer path.
 
         This is the production hot path: per revolution, exactly one
-        host->device transfer (bit-packed (2, N) uint32 with the node
-        count folded into the reserved last slot — 8 bytes/point, no
+        host->device transfer (bit-packed (3, N) uint16 with the node
+        count folded into the reserved last slot — 6 bytes/point, no
         separate count scalar), one donated step dispatch, and one
         device->host fetch (the fused flat output vector).  Returns a
         numpy-backed FilterOutput.

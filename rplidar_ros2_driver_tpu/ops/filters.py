@@ -348,38 +348,50 @@ def packed_filter_step(
 
 
 def _pack_compact_rows(buf, capacity: int, angle_q14, dist_q2, quality, flag) -> int:
-    """Fill the leading columns of a (2, >=capacity) uint32 buffer with the
+    """Fill the leading columns of a (3, >=capacity) uint16 buffer with the
     bit-packed node stream; the one definition of the row layout shared by
-    the compact and counted wire forms.  Returns the node count."""
+    the compact and counted wire forms.  Returns the node count.
+
+    Layout (6 bytes/point): row0 = angle_q14; row1 = dist_q2 low 16;
+    row2 = dist_q2 bits 17:16 | quality<<2 | flag<<10.  Distance is
+    clamped to 18 bits (2^18 q2 = 65.5 m — beyond any supported lidar;
+    the reference's own max is 40 m) and flag to 6 bits (the wire flag
+    uses 2: sync + inverse-sync), mirroring how malformed angles clamp
+    into the edge beams rather than being dropped."""
     import numpy as np
 
     count = int(len(angle_q14))
     if count > capacity:
         raise ValueError(f"scan of {count} nodes exceeds capacity {capacity}")
-    a = np.asarray(angle_q14, np.uint32) & 0xFFFF
-    q = (np.asarray(quality, np.uint32) & 0xFF) << 16
-    buf[0, :count] = a | q
+    d = np.minimum(
+        np.asarray(dist_q2, np.int64).astype(np.uint32), np.uint32(0x3FFFF)
+    )
+    buf[0, :count] = np.asarray(angle_q14, np.uint32).astype(np.uint16)
+    buf[1, :count] = (d & 0xFFFF).astype(np.uint16)
+    hi = (d >> 16).astype(np.uint16)
+    hi |= ((np.asarray(quality, np.uint32) & 0xFF) << 2).astype(np.uint16)
     if flag is not None:
-        buf[0, :count] |= (np.asarray(flag, np.uint32) & 0xFF) << 24
-    buf[1, :count] = np.asarray(dist_q2, np.int64).astype(np.uint32)
+        hi |= ((np.asarray(flag, np.uint32) & 0x3F) << 10).astype(np.uint16)
+    buf[2, :count] = hi
     return count
 
 
 def pack_host_scan_compact(angle_q14, dist_q2, quality, flag=None, n: int | None = None):
-    """Bit-packed wire form: (2, n) uint32, 8 bytes/point (half the (4, n)
-    int32 form) — row0 = angle_q14 | quality<<16 | flag<<24, row1 = dist_q2.
+    """Bit-packed wire form: (3, n) uint16, 6 bytes/point (see
+    :func:`_pack_compact_rows` for the row layout and clamps).
 
-    Lossless for the HQ node value ranges: angle_q14 is u16, quality u8,
-    flag u8, dist_mm_q2 u32 (sl_lidar_cmd.h:272-278).  Over a
-    remote-attached TPU the per-scan transfer is the pipeline bottleneck,
-    so wire bytes matter more than device-side unpack arithmetic.
+    Over a remote-attached TPU the per-scan transfer is the pipeline
+    bottleneck and its cost is size-dependent (~36 µs/KB marginal on the
+    axon tunnel), so wire bytes matter more than device-side unpack
+    arithmetic; 6 bytes/point cuts a DenseBoost revolution from 32 KB
+    (the earlier (2, n) uint32 form) to 24 KB.
     """
     import numpy as np
 
     from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
 
     n = n or MAX_SCAN_NODES
-    buf = np.zeros((2, n), np.uint32)
+    buf = np.zeros((3, n), np.uint16)
     count = _pack_compact_rows(buf, n, angle_q14, dist_q2, quality, flag)
     return buf, count
 
@@ -395,23 +407,28 @@ def compact_filter_step(
 def pack_host_scan_counted(angle_q14, dist_q2, quality, flag=None, n: int | None = None):
     """Count-embedded wire form: :func:`pack_host_scan_compact` plus one
     extra column whose angle-row slot holds the node count, so the hot
-    path ships ONE ``(2, n + 1)`` array per revolution instead of buffer
+    path ships ONE ``(3, n + 1)`` array per revolution instead of buffer
     + count scalar.
 
     Through a remote-attached device every host->device transfer is a
     separate RPC enqueue; measured on the axon tunnel the second (scalar)
     put roughly doubles the paced per-scan dispatch latency (p99 ~2.2 ms
     -> ~1.3 ms with the count folded in).  The count slot is an *extra*
-    column (8 wire bytes), not a reservation out of ``n``, so capacity-
+    column (6 wire bytes), not a reservation out of ``n``, so capacity-
     filling revolutions (the assembler truncates at MAX_SCAN_NODES,
-    matching the reference's 8192-node cap) keep every node.
+    matching the reference's 8192-node cap) keep every node; the count
+    (<= 8192) fits the u16 slot.
     """
     import numpy as np
 
     from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
 
     n = n or MAX_SCAN_NODES
-    buf = np.zeros((2, n + 1), np.uint32)
+    if n >= 0x10000:
+        # the count slot is u16: a larger capacity would silently wrap
+        # the count and mask out most of the scan
+        raise ValueError(f"counted wire form supports capacity < 65536, got {n}")
+    buf = np.zeros((3, n + 1), np.uint16)
     count = _pack_compact_rows(buf, n, angle_q14, dist_q2, quality, flag)
     buf[0, -1] = count
     return buf
@@ -423,7 +440,7 @@ def counted_filter_step(
 ) -> tuple[FilterState, FilterOutput]:
     """filter_step over the count-embedded wire form (one transfer/scan).
 
-    The count slot sits at index ``n`` of a ``(2, n + 1)`` buffer and the
+    The count slot sits at index ``n`` of a ``(3, n + 1)`` buffer and the
     count is at most ``n``, so the slot itself can never enter the
     ``i < count`` live mask.
     """
@@ -434,12 +451,12 @@ def counted_filter_step(
 def _unpack_compact(packed: jax.Array, count: jax.Array) -> ScanBatch:
     i = jnp.arange(packed.shape[1], dtype=jnp.int32)
     live = i < count
-    row0 = packed[0]
+    hi = packed[2].astype(jnp.int32)
     return ScanBatch(
-        angle_q14=(row0 & 0xFFFF).astype(jnp.int32),
-        dist_q2=packed[1].astype(jnp.int32),
-        quality=((row0 >> 16) & 0xFF).astype(jnp.int32),
-        flag=(row0 >> 24).astype(jnp.int32),
+        angle_q14=packed[0].astype(jnp.int32),
+        dist_q2=packed[1].astype(jnp.int32) | ((hi & 0x3) << 16),
+        quality=(hi >> 2) & 0xFF,
+        flag=(hi >> 10) & 0x3F,
         valid=live,
         count=count,
     )
@@ -591,7 +608,7 @@ def fused_scan_core(
 def compact_filter_scan(
     state: FilterState, packed_seq: jax.Array, counts: jax.Array, cfg: FilterConfig
 ) -> tuple[FilterState, jax.Array]:
-    """Run the chain over a (K, 2, N) uint32 packed scan sequence.
+    """Run the chain over a (K, 3, N) uint16 packed scan sequence.
 
     Semantically identical to K successive ``compact_filter_step`` calls
     (same state trajectory — tests/test_packed_ingest.py asserts equality
@@ -612,7 +629,7 @@ def compact_filter_scan(
 
 
 def pack_host_scans_compact(scans, n: int | None = None):
-    """Stack host scans into the (K, 2, n) sequence buffer + (K,) counts
+    """Stack host scans into the (K, 3, n) sequence buffer + (K,) counts
     (the multi-scan form of :func:`pack_host_scan_compact`)."""
     import numpy as np
 
@@ -620,7 +637,7 @@ def pack_host_scans_compact(scans, n: int | None = None):
 
     n = n or MAX_SCAN_NODES
     k = len(scans)
-    seq = np.zeros((k, 2, n), np.uint32)
+    seq = np.zeros((k, 3, n), np.uint16)
     counts = np.zeros((k,), np.int32)
     for i, s in enumerate(scans):
         seq[i], counts[i] = pack_host_scan_compact(

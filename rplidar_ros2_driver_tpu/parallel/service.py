@@ -66,7 +66,7 @@ class ShardedFilterService:
         sharded_step = build_sharded_step(self.mesh, self.cfg)
 
         # counted compact ingest, like the single-stream wire path: one
-        # bit-packed (streams, 2, N) uint32 upload (8 bytes/point, per-stream
+        # bit-packed (streams, 3, N) uint16 upload (6 bytes/point, per-stream
         # node count embedded in each buffer's reserved last slot — no
         # separate count vector transfer), unpacked to a stream-batched
         # ScanBatch inside the jitted program
@@ -94,7 +94,7 @@ class ShardedFilterService:
         """Pack a block of streams' newest revolutions; ``offset`` is the
         block's first global stream index (error attribution only)."""
         n = self.capacity
-        packed = np.zeros((len(scans), 2, n + 1), np.uint32)  # +1: count slot
+        packed = np.zeros((len(scans), 3, n + 1), np.uint16)  # +1: count slot
         for i, scan in enumerate(scans):
             if scan is None:
                 continue  # stream idle this tick: all-masked scan (count 0)

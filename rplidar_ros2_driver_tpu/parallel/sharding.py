@@ -325,7 +325,7 @@ def build_sharded_scan(mesh: Mesh, cfg: FilterConfig) -> Callable:
     analog of ops.filters.compact_filter_scan).
 
     Signature: ``scan(state, packed_seq, counts) -> (state, ranges)``
-    where ``packed_seq`` is (streams, K, 2, N) uint32, ``counts`` is
+    where ``packed_seq`` is (streams, K, 3, N) uint16, ``counts`` is
     (streams, K) int32, and ``ranges`` comes back (streams, K, beams).
     Semantically identical to K successive ``build_sharded_step`` calls.
     """

@@ -187,7 +187,7 @@ def replay_fleet(
     all-reduce per chunk instead of one per scan).
 
     Streams are truncated to the shortest capture (the fused step needs
-    one rectangular (S, K, 2, N) sequence per dispatch).  The default
+    one rectangular (S, K, 3, N) sequence per dispatch).  The default
     mesh sizes its stream axis to gcd(streams, devices) so any fleet
     size divides it (the squarest split need not).  Returns
     ((S, K, beams) float32 range images, final sharded FilterState);

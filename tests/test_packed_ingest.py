@@ -63,7 +63,8 @@ def test_chain_process_raw_matches_process():
 
 
 def test_compact_step_matches_scanbatch_step():
-    """The 8-byte/point bit-packed wire form must be lossless."""
+    """The 6-byte/point bit-packed wire form must be lossless for
+        in-range values (18-bit distances, 6-bit flags)."""
     cfg = FilterConfig(window=4, beams=128, grid=32, cell_m=0.5)
     s_a = FilterState.create(cfg.window, cfg.beams, cfg.grid)
     s_b = FilterState.create(cfg.window, cfg.beams, cfg.grid)
@@ -74,7 +75,7 @@ def test_compact_step_matches_scanbatch_step():
         batch = ScanBatch.from_numpy(angle, dist, qual, flag, n=1024)
         s_a, out_a = filter_step(s_a, batch, cfg)
         buf, count = pack_host_scan_compact(angle, dist, qual, flag, n=1024)
-        assert buf.dtype == np.uint32 and buf.shape == (2, 1024)
+        assert buf.dtype == np.uint16 and buf.shape == (3, 1024)
         s_b, out_b = compact_filter_step(s_b, buf, jnp.asarray(count, jnp.int32), cfg)
         np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
         np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
@@ -106,7 +107,7 @@ def test_counted_pack_keeps_full_capacity():
     keeps every node — no silent drop vs the compact form."""
     angle = np.arange(1024, dtype=np.int32)
     buf = pack_host_scan_counted(angle, angle, angle, n=1024)
-    assert buf.shape == (2, 1025)
+    assert buf.shape == (3, 1025)
     assert int(buf[0, -1]) == 1024
     np.testing.assert_array_equal(buf[1, :1024].astype(np.int64), angle)
     # over capacity still rejects (same contract as the compact form)
@@ -118,18 +119,24 @@ def test_counted_pack_keeps_full_capacity():
 
 
 def test_compact_roundtrip_field_ranges():
-    """Boundary values of every field survive the bit packing."""
-    angle = np.array([0, 1, 65535], np.int32)
-    dist = np.array([0, 123456, 0x7FFFFFFF], np.int32)
-    qual = np.array([0, 128, 255], np.int32)
-    flag = np.array([1, 0, 255], np.int32)
+    """Boundary values of every field survive the 6-byte bit packing
+    (distance clamps at 18 bits = 65.5 m, flag at 6 bits — documented
+    in _pack_compact_rows; both beyond any real device's range)."""
+    from rplidar_ros2_driver_tpu.ops.filters import _unpack_compact
+
+    angle = np.array([0, 1, 65535, 7], np.int32)
+    dist = np.array([0, 123456, 0x3FFFF, 0x7FFFFFFF], np.int32)
+    qual = np.array([0, 128, 255, 9], np.int32)
+    flag = np.array([1, 0, 63, 2], np.int32)
     buf, count = pack_host_scan_compact(angle, dist, qual, flag, n=8)
-    row0 = buf[0, :3]
-    np.testing.assert_array_equal(row0 & 0xFFFF, angle.astype(np.uint32))
-    np.testing.assert_array_equal((row0 >> 16) & 0xFF, qual.astype(np.uint32))
-    np.testing.assert_array_equal(row0 >> 24, flag.astype(np.uint32))
+    assert buf.shape == (3, 8) and buf.dtype == np.uint16
+    batch = _unpack_compact(jnp.asarray(buf), jnp.asarray(count, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(batch.angle_q14)[:4], angle)
+    np.testing.assert_array_equal(np.asarray(batch.quality)[:4], qual)
+    np.testing.assert_array_equal(np.asarray(batch.flag)[:4], flag)
+    # 18-bit distances round-trip exactly; larger clamp to the max
     np.testing.assert_array_equal(
-        buf[1, :3].astype(np.int64), dist.astype(np.int64)
+        np.asarray(batch.dist_q2)[:4], np.minimum(dist, 0x3FFFF)
     )
 
 
