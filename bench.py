@@ -323,6 +323,7 @@ def bench_e2e(seconds: float = 15.0) -> dict:
 
         t_end = time.monotonic() + seconds
         pending = None
+        after_sync = False
         while time.monotonic() < t_end:
             got = drv.grab_scan_host(2.0)
             if got is None:
@@ -339,13 +340,20 @@ def bench_e2e(seconds: float = 15.0) -> dict:
             t_disp = time.monotonic()
             published += 1
             timer.record("grab_to_dispatch", t_disp - t_grab)
-            timer.record("rev_to_dispatch", t_disp - rev_end)
+            if not after_sync:
+                # the revolution grabbed right after a deliberate sync
+                # sample sat waiting while the loop paid the fetch RTT —
+                # a self-inflicted stall (hundreds of ms when the tunnel
+                # is sick) that would masquerade as framework latency
+                timer.record("rev_to_dispatch", t_disp - rev_end)
+            after_sync = False
             # every 8th scan, pay the full output sync (publish seam with
             # fetch) so the pipeline stays bounded AND we sample the
             # RTT-inclusive number
             if published % 8 == 0:
                 _device_barrier(out.ranges)
                 timer.record("publish_sync", time.monotonic() - rev_end)
+                after_sync = True
             pending = out
         if published == 0:
             raise RuntimeError("e2e bench produced no scans (sim stream broken?)")
