@@ -36,7 +36,13 @@ def _cmd_run(args) -> int:
             diag = pub.diagnostics[-1] if getattr(pub, "diagnostics", None) else None
             scans = getattr(pub, "scan_count", 0)
             state = diag.message if diag else "?"
-            print(f"[{node.name}] scans={scans} state={state}")
+            note = ""
+            if scans == 0 and state == "Scanning":
+                # healthy but nothing out yet: first revolutions pay the
+                # device compile and (on remote-attached rigs) output
+                # fetch round-trips
+                note = " (first publish pending: device compile/fetch)"
+            print(f"[{node.name}] scans={scans} state={state}{note}")
     except KeyboardInterrupt:
         pass
     finally:
