@@ -322,7 +322,9 @@ class SimulatedDevice:
         try:
             buf = fcntl.ioctl(conn.fileno(), termios.TIOCOUTQ, b"\x00" * 4)
             return struct.unpack("i", buf)[0]
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: termios lacks TIOCOUTQ on non-Linux hosts —
+            # same "returns 0 on failure" contract as a failed ioctl.
             return 0
 
     def _answer(self, ans_type: int, payload: bytes, is_loop: bool = False) -> None:

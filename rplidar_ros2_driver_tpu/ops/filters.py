@@ -400,7 +400,7 @@ def pack_host_scan_compact(angle_q14, dist_q2, quality, flag=None, n: int | None
 def compact_filter_step(
     state: FilterState, packed: jax.Array, count: jax.Array, cfg: FilterConfig
 ) -> tuple[FilterState, FilterOutput]:
-    """filter_step over the bit-packed (2, n) uint32 wire form."""
+    """filter_step over the compact (3, n) uint16 wire form."""
     return _filter_step_impl(state, _unpack_compact(packed, count), cfg)
 
 

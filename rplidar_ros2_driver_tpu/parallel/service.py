@@ -16,6 +16,7 @@ contract.
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import Optional, Sequence
 
@@ -39,6 +40,8 @@ from rplidar_ros2_driver_tpu.parallel.sharding import (
     make_mesh,
     place_state,
 )
+
+logger = logging.getLogger("rplidar_tpu.service")
 
 
 class ShardedFilterService:
@@ -90,9 +93,20 @@ class ShardedFilterService:
 
     # -- ingest -------------------------------------------------------------
 
-    def _stack(self, scans: Sequence[Optional[dict]], offset: int = 0) -> np.ndarray:
+    def _stack(
+        self,
+        scans: Sequence[Optional[dict]],
+        offset: int = 0,
+        malformed: str = "raise",
+    ) -> np.ndarray:
         """Pack a block of streams' newest revolutions; ``offset`` is the
-        block's first global stream index (error attribution only)."""
+        block's first global stream index (error attribution only).
+
+        ``malformed="idle"`` turns a scan that fails to pack (oversized,
+        mismatched field lengths, ...) into an all-masked idle row plus a
+        warning instead of raising — submit_local uses this because a
+        per-process exception ahead of the collective hangs every peer
+        inside theirs (see its docstring)."""
         n = self.capacity
         packed = np.zeros((len(scans), 3, n + 1), np.uint16)  # +1: count slot
         for i, scan in enumerate(scans):
@@ -103,9 +117,45 @@ class ShardedFilterService:
                     scan["angle_q14"], scan["dist_q2"], scan["quality"],
                     scan.get("flag"), n,
                 )
-            except ValueError as e:
-                raise ValueError(f"stream {offset + i}: {e}") from None
+            except (ValueError, KeyError, TypeError) as e:
+                # KeyError/TypeError: missing wire field / None where an
+                # array is required — same per-tick-data class as oversize.
+                if malformed == "idle":
+                    # packed[i] is untouched (pack_host_scan_counted
+                    # builds its own buffer), so the row stays the
+                    # all-zero = all-masked idle frame.
+                    logger.warning(
+                        "stream %d: dropping malformed scan this tick: %s",
+                        offset + i, e,
+                    )
+                    continue
+                raise type(e)(f"stream {offset + i}: {e}") from None
         return packed
+
+    def _clip_to_capacity(self, scan: Optional[dict]) -> Optional[dict]:
+        """Truncate an oversized scan to ``capacity`` nodes, keeping the
+        head — the same head-keep policy as ScanAssembler's 8192-node
+        overflow cap (excess nodes dropped)."""
+        wire_keys = ("angle_q14", "dist_q2", "quality", "flag")
+        try:
+            lens = {
+                len(scan[k]) for k in wire_keys[:3]
+            } | ({len(scan["flag"])} if scan.get("flag") is not None else set())
+            if len(lens) != 1 or lens.pop() <= self.capacity:
+                # mismatched field lengths are the malformed-scan signal:
+                # pass through UNclipped so _stack's malformed="idle"
+                # handler reports and drops it (clipping first could mask
+                # the mismatch and let desynchronized data through)
+                return scan
+        except (KeyError, TypeError):
+            # missing/None wire field: likewise _stack's problem — this
+            # helper must never raise ahead of the collective.
+            return scan
+        n = self.capacity
+        return {
+            k: (v[:n] if k in wire_keys and v is not None else v)
+            for k, v in scan.items()
+        }
 
     def submit(self, scans: Sequence[Optional[dict]]) -> list[Optional[FilterOutput]]:
         """One tick: newest revolution per stream (None = no new data).
@@ -163,6 +213,16 @@ class ShardedFilterService:
         layout of ``multihost.make_global_mesh`` so each process's stream
         rows live entirely on its own devices; single-process it behaves
         like :meth:`submit`.
+
+        Oversized scans are truncated to ``capacity`` here (head-keep,
+        like the assembler's MAX_SCAN_NODES overflow cap) rather than
+        raised: a
+        per-process ValueError would abort this process before it enters
+        the collective while every peer blocks inside theirs, turning one
+        malformed scan on one host into a fleet-wide hang.  The
+        stream-count mismatch check below is deliberately still an error —
+        it is a deployment bug, not per-tick data, and fails on every
+        process identically.
         """
         from rplidar_ros2_driver_tpu.parallel import multihost
 
@@ -173,7 +233,8 @@ class ShardedFilterService:
                 f"expected {n_local} local scans (streams {slc.start}:{slc.stop} "
                 f"of {self.streams}), got {len(local_scans)}"
             )
-        packed_local = self._stack(local_scans, offset=slc.start)
+        local_scans = [self._clip_to_capacity(s) for s in local_scans]
+        packed_local = self._stack(local_scans, offset=slc.start, malformed="idle")
         packed = jax.make_array_from_process_local_data(
             self._packed_sharding, packed_local
         )
@@ -287,9 +348,7 @@ class ShardedFilterService:
             }
             got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
             if expected != got:
-                import logging
-
-                logging.getLogger("rplidar_tpu.service").warning(
+                logger.warning(
                     "rejecting incompatible sharded snapshot (%s != %s)",
                     got,
                     expected,

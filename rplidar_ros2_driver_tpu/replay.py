@@ -188,8 +188,10 @@ def replay_fleet(
 
     Streams are truncated to the shortest capture (the fused step needs
     one rectangular (S, K, 3, N) sequence per dispatch).  The default
-    mesh sizes its stream axis to gcd(streams, devices) so any fleet
-    size divides it (the squarest split need not).  Returns
+    mesh picks the largest device count whose (stream, beam) split
+    divides both the fleet size and ``beams`` — usually all devices with
+    stream = gcd(streams, devices), but it shrinks when no full-device
+    beam extent divides ``beams``.  Returns
     ((S, K, beams) float32 range images, final sharded FilterState);
     an empty fleet returns ((0, 0, beams), None) without touching the
     mesh.
@@ -211,7 +213,20 @@ def replay_fleet(
     if streams == 0:
         return np.zeros((0, 0, cfg.beams), np.float32), None
     if mesh is None:
-        mesh = make_mesh(stream=math.gcd(streams, len(jax.devices())))
+        # Largest stream extent that (a) divides the device count, (b)
+        # divides the stream count, and (c) leaves a beam extent that
+        # divides cfg.beams — (c) is what plain gcd misses (e.g. 6
+        # devices x 4 streams -> beam=3 vs beams=2048).  If no full-
+        # device split satisfies all three, shrink the device count;
+        # (1, 1) always qualifies.
+        n_dev, stream = 1, 1
+        for n in range(len(jax.devices()), 0, -1):
+            g = math.gcd(streams, n)
+            ok = [d for d in range(g, 0, -1) if g % d == 0 and cfg.beams % (n // d) == 0]
+            if ok:
+                n_dev, stream = n, ok[0]
+                break
+        mesh = make_mesh(n_devices=n_dev, stream=stream)
     k_total = min(len(r) for r in stream_revolutions)
     scan_fn = build_sharded_scan(mesh, cfg)
     state = create_sharded_state(mesh, cfg, streams)

@@ -287,3 +287,36 @@ def test_replay_fleet_matches_per_stream_replay():
         np.testing.assert_array_equal(
             np.asarray(state.voxel_acc[s]), np.asarray(ref_state.voxel_acc)
         )
+
+
+def test_replay_fleet_default_mesh_awkward_beam_count():
+    """The default mesh must shrink itself when no full-device split has
+    a beam extent dividing cfg.beams (2 streams x 8 devices x 6 beams:
+    gcd would pick beam=4, which does not divide 6 — the workable split
+    is 6 devices as (stream=2, beam=3))."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.replay import replay_fleet, replay_through_chain
+
+    params = DriverParams(
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=16,
+    )
+    rng = np.random.default_rng(23)
+    streams = []
+    for s in range(2):
+        revs = []
+        for k in range(6):
+            n = 40 + 3 * k + s
+            revs.append({
+                "angle_q14": ((np.arange(n) * 65536) // n).astype(np.int32),
+                "dist_q2": (rng.uniform(0.3, 8.0, n) * 4000).astype(np.int32),
+                "quality": np.full(n, 180, np.int32),
+            })
+        streams.append(revs)
+
+    ranges, _ = replay_fleet(streams, params, beams=6, capacity=64, chunk=3)
+    assert ranges.shape == (2, 6, 6)
+    for s, revs in enumerate(streams):
+        ref, _ = replay_through_chain(revs, params, beams=6, capacity=64, chunk=3)
+        np.testing.assert_array_equal(ranges[s], ref)

@@ -104,6 +104,70 @@ class TestService:
         other = ShardedFilterService(_params(filter_window=8), streams=2, mesh=mesh, beams=128)
         assert not other.restore(snap)
 
+    def test_submit_local_truncates_oversized_scan(self, mesh):
+        """An oversized scan must not raise out of submit_local — a
+        per-process ValueError before the collective would hang every
+        peer process inside theirs.  It is truncated to capacity
+        (head-keep, the assembler's overflow policy) and the tick
+        proceeds; submit with the pre-truncated scan is the oracle."""
+        cap = 256
+        svc = ShardedFilterService(
+            _params(), streams=4, mesh=mesh, beams=128, capacity=cap
+        )
+        ref = ShardedFilterService(
+            _params(), streams=4, mesh=mesh, beams=128, capacity=cap
+        )
+        big = _scan(7, points=cap + 50)
+        big["ts0"] = 1.5  # scalar metadata (assembler-shaped dicts carry it)
+        clipped = {
+            k: (v[:cap] if k != "ts0" and v is not None else v)
+            for k, v in big.items()
+        }
+        small_1, small_3 = _scan(1, points=200), _scan(3, points=200)
+        out = svc.submit_local([big, small_1, None, small_3])
+        out_ref = ref.submit([clipped, small_1, None, small_3])
+        for a, b in zip(out, out_ref):
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_array_equal(a.ranges, b.ranges)
+
+    def test_submit_local_degrades_malformed_scan_to_idle(self, mesh, caplog):
+        """Any packing failure beyond oversize (e.g. mismatched field
+        lengths) must also not raise out of submit_local pre-collective:
+        the malformed scan becomes an all-masked idle row with a warning
+        and the other streams' tick proceeds normally."""
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        ref = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        bad = _scan(5)
+        bad["dist_q2"] = bad["dist_q2"][:-7]  # truncated capture
+        good = _scan(9)
+        with caplog.at_level("WARNING", logger="rplidar_tpu.service"):
+            out = svc.submit_local([bad, good])
+        assert any("malformed" in r.message for r in caplog.records)
+        # the peer stream is unaffected; oracle = submit with bad idle.
+        # (submit_local still returns an output object for the bad slot —
+        # it carries the all-masked frame's result, matching a None tick.)
+        out_ref = ref.submit([None, good])
+        np.testing.assert_array_equal(out[1].ranges, out_ref[1].ranges)
+        snap, snap_ref = svc.snapshot(), ref.snapshot()
+        np.testing.assert_array_equal(snap["range_window"], snap_ref["range_window"])
+        # a scan missing a wire field entirely (KeyError class) likewise
+        # degrades to idle instead of escaping pre-collective
+        no_quality = {k: v for k, v in _scan(6).items() if k != "quality"}
+        out2 = svc.submit_local([no_quality, _scan(8)])
+        out2_ref = ref.submit([None, _scan(8)])
+        np.testing.assert_array_equal(out2[1].ranges, out2_ref[1].ranges)
+        # oversize + mismatched lengths = still malformed, NOT clipped
+        # into accidental agreement (clipping would mask the mismatch)
+        over_bad = _scan(4, points=svc.capacity + 50)
+        over_bad["dist_q2"] = over_bad["dist_q2"][:-6]
+        out3 = svc.submit_local([over_bad, _scan(10)])
+        out3_ref = ref.submit([None, _scan(10)])
+        np.testing.assert_array_equal(out3[1].ranges, out3_ref[1].ranges)
+        np.testing.assert_array_equal(
+            svc.snapshot()["range_window"], ref.snapshot()["range_window"]
+        )
+
 
 class TestOrbaxCheckpoint:
     @pytest.fixture(autouse=True)
