@@ -18,7 +18,11 @@ sl_lidar_driver.cpp), re-composed for this framework:
     follows the reference's two strategies (src/lidar_driver_wrapper.cpp:
     193-268): NEW_TYPE = RPM control + mode enumeration with
     user-pref → DenseBoost → Sensitivity fallback + express scan;
-    OLD_TYPE = 600 RPM default + legacy startScan.
+    OLD_TYPE = 600 RPM default + startScan(0, 1)'s typical-mode path —
+    conf-resolved typical mode when the firmware speaks the conf
+    protocol, hardwired EXPRESS fallback when it predates it
+    (sl_lidar_driver.cpp:577-580).  Every conf query is gated on
+    checkSupportConfigCommands semantics (:1176-1196).
 """
 
 from __future__ import annotations
@@ -41,10 +45,12 @@ from rplidar_ros2_driver_tpu.models.tables import (
     MajorType,
     MotorCtrlSupport,
     ProtocolType,
+    ScanMode,
     detect_profile,
     has_builtin_motor_ctrl,
     major_type,
     native_baudrate,
+    supports_conf_commands,
 )
 from rplidar_ros2_driver_tpu.protocol import conf as confproto
 from rplidar_ros2_driver_tpu.protocol.constants import (
@@ -53,6 +59,8 @@ from rplidar_ros2_driver_tpu.protocol.constants import (
     AUTOBAUD_CONFIRM_FLAG,
     AUTOBAUD_MAGICBYTE,
     Cmd,
+    SCAN_COMMAND_EXPRESS,
+    SCAN_COMMAND_STD,
 )
 from rplidar_ros2_driver_tpu.protocol import timing as timingmod
 from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine, TransceiverLike
@@ -130,6 +138,10 @@ class RealLidarDriver(LidarDriverInterface):
         self.profile = DriverProfile()
         self.scan_modes: list = []
         self.motor_ctrl = MotorCtrlSupport.NONE
+        # conf-protocol gate (checkSupportConfigCommands): set on connect;
+        # every GET/SET_LIDAR_CONF path checks it so a pre-conf device is
+        # never sent a query it would silently time out on
+        self.conf_supported = False
 
     # ------------------------------------------------------------------
     # connection
@@ -166,6 +178,7 @@ class RealLidarDriver(LidarDriverInterface):
                 engine.stop()
                 return False
             self.device_info = DeviceInfo.from_payload(info_payload)
+            self.conf_supported = supports_conf_commands(self.device_info)
             self._engine = engine
             self._connected = True
             self.motor_ctrl = self._check_motor_ctrl_support()
@@ -228,6 +241,13 @@ class RealLidarDriver(LidarDriverInterface):
         if not self.set_motor_speed(target_rpm):
             return False
         time.sleep(self._motor_warmup_s)
+        if not self.conf_supported:
+            # cannot happen for a genuine NEW_TYPE unit (ND magic implies
+            # conf support) — but if a device misreports, degrade the way
+            # a pre-conf triangle would rather than fire doomed queries
+            log.warning("device reports no conf support; using the legacy "
+                        "Express fallback")
+            return self._start_legacy_express(target_rpm)
         self.scan_modes = confproto.enumerate_scan_modes(self._engine)
         mode = self._select_mode(scan_mode)
         if mode is None:
@@ -255,25 +275,34 @@ class RealLidarDriver(LidarDriverInterface):
                     return m
         return self.scan_modes[0]
 
-    def _start_express(self, mode, target_rpm: int) -> bool:
+    def _start_express(
+        self, mode, target_rpm: int, *, wire_mode: Optional[int] = None,
+        update_hw_max: bool = True,
+    ) -> bool:
         # EXPRESS_SCAN payload: u8 mode, u16 flags, u16 reserved
         # (startScanExpress, sl_lidar_driver.cpp:745-758).  working_flags
         # stays 0 like the reference wrapper's startScanExpress(false, id, 0)
         # call (src/lidar_driver_wrapper.cpp:249): the mode id alone selects
         # boost variants; setting EXPRESS_FLAG_BOOST here could make real
         # firmware stream a format that mismatches the enumerated ans_type.
+        # ``wire_mode`` overrides the payload mode byte — pre-conf firmware
+        # expects 0 there (startScanExpress :748-750) while the metadata
+        # mode id stays SCAN_COMMAND_EXPRESS.
         self._update_timing_desc(mode.us_per_sample)
         # warm the decode-kernel jit cache for this mode's wire format before
         # the stream starts, so the pump thread never stalls on a compile
         self._scan_decoder.precompile(mode.ans_type)
         self._begin_streaming()
-        payload = struct.pack("<BHH", mode.id, 0, 0)
+        payload = struct.pack(
+            "<BHH", mode.id if wire_mode is None else wire_mode, 0, 0
+        )
         if not self._engine.send_only(Cmd.EXPRESS_SCAN, payload):
             return False
         self._scanning = True
         self.profile.active_mode = mode.name
         self.profile.active_rpm = target_rpm
-        self.profile.hw_max_distance = mode.max_distance or NEW_TYPE_MAX_DISTANCE
+        if update_hw_max:
+            self.profile.hw_max_distance = mode.max_distance or NEW_TYPE_MAX_DISTANCE
         return True
 
     def force_scan(self, rpm: int = 0) -> bool:
@@ -286,7 +315,7 @@ class RealLidarDriver(LidarDriverInterface):
             target_rpm = rpm if rpm > 0 else DEFAULT_RPM
             self.set_motor_speed(target_rpm)
             time.sleep(self._legacy_warmup_s)
-            self._update_timing_desc(self._legacy_sample_duration_us())
+            self._update_timing_desc(self._legacy_sample_durations()[0])
             self._scan_decoder.precompile(Ans.MEASUREMENT)
             self._begin_streaming()
             if not self._engine.send_only(Cmd.FORCE_SCAN):
@@ -297,12 +326,31 @@ class RealLidarDriver(LidarDriverInterface):
             return True
 
     def _start_old_type(self, rpm: int) -> bool:
-        # legacy: fixed 600 RPM, brief spin-up, plain SCAN
-        # (src/lidar_driver_wrapper.cpp:262-268); sample duration queried
-        # from the device (startScanNormal_commonpath, :620-661)
+        # legacy strategy: fixed 600 RPM, brief spin-up, then the
+        # reference wrapper's startScan(0, 1) — useTypicalScan
+        # (src/lidar_driver_wrapper.cpp:262-268 -> sl_lidar_driver.cpp:
+        # 586-616): the typical mode comes from the conf protocol when the
+        # firmware speaks it, and is hardwired to the EXPRESS scan command
+        # on pre-conf triangle units (getTypicalScanMode :577-580) — those
+        # must never be sent a conf query at all.
         self.set_motor_speed(DEFAULT_RPM)
         time.sleep(self._legacy_warmup_s)
-        self._update_timing_desc(self._legacy_sample_duration_us())
+        if not self.conf_supported:
+            return self._start_legacy_express(DEFAULT_RPM)
+        typical = confproto.get_typical_mode(self._engine)
+        if typical is not None and typical != SCAN_COMMAND_STD:
+            mode = confproto.get_mode_metadata(self._engine, typical)
+            if mode is not None and mode.ans_type != Ans.MEASUREMENT:
+                return self._start_express(mode, DEFAULT_RPM, update_hw_max=False)
+        # typical resolved to Standard (or its metadata didn't): plain scan
+        # (startScanNormal_commonpath redirect, sl_lidar_driver.cpp:732-735)
+        return self._start_standard_scan()
+
+    def _start_standard_scan(self) -> bool:
+        """Plain SCAN startup with device-queried sample duration
+        (startScanNormal_commonpath, sl_lidar_driver.cpp:620-661)."""
+        std_us, _ = self._legacy_sample_durations()
+        self._update_timing_desc(std_us)
         self._scan_decoder.precompile(Ans.MEASUREMENT)
         self._begin_streaming()
         if not self._engine.send_only(Cmd.SCAN):
@@ -311,6 +359,27 @@ class RealLidarDriver(LidarDriverInterface):
         self.profile.active_mode = "Standard"
         self.profile.active_rpm = DEFAULT_RPM
         return True
+
+    def _start_legacy_express(self, target_rpm: int) -> bool:
+        """Express startup for pre-conf firmware (startScanExpress legacy
+        branch, sl_lidar_driver.cpp:716-729): no conf queries — metadata is
+        fixed to the GET_SAMPLERATE express duration, 16 m, the capsule
+        stream format, name "Express" — and the EXPRESS_SCAN payload's
+        working_mode byte stays 0 (:748-750).  hw_max_distance keeps the
+        wrapper's 12 m A-series profile value (the 16 m here is SDK mode
+        metadata, not the wrapper profile)."""
+        _, express_us = self._legacy_sample_durations()
+        mode = ScanMode(
+            id=SCAN_COMMAND_EXPRESS,
+            us_per_sample=express_us,
+            max_distance=16.0,
+            ans_type=Ans.MEASUREMENT_CAPSULED,
+            name="Express",
+        )
+        return self._start_express(
+            mode, target_rpm, wire_mode=0, update_hw_max=False
+        )
+
 
     def _update_timing_desc(self, us_per_sample: Optional[float]) -> None:
         """Push link+mode timing into the decoder for timestamp back-dating
@@ -329,23 +398,25 @@ class RealLidarDriver(LidarDriverInterface):
             is_serial=self._channel_type == "serial",
         )
 
-    def _legacy_sample_duration_us(self) -> float:
-        """Sample duration for legacy (non-conf) scan startup, queried from
-        the device via GET_SAMPLERATE (cmd 0x59 -> ans 0x15, two u16 LE:
-        std/express µs) — _getLegacySampleDuration_uS,
+    def _legacy_sample_durations(self) -> tuple[float, float]:
+        """(std, express) sample durations for legacy (non-conf) scan
+        startup, queried from the device via GET_SAMPLERATE (cmd 0x59 ->
+        ans 0x15, two u16 LE: std/express µs) — _getLegacySampleDuration_uS,
         sl_lidar_driver.cpp:1556-1599.  Very old A-series firmware
-        (< 1.17) predates the command and always gets the 476 µs default."""
+        (< 1.17) predates the command and always gets the 476 µs default
+        for both (:1559-1567)."""
+        default = timingmod.LEGACY_SAMPLE_DURATION_US
         if self.device_info is not None:
             is_a_series = major_type(self.device_info.model) is MajorType.A_SERIES
             if is_a_series and self.device_info.firmware_version < ((0x1 << 8) | 17):
-                return timingmod.LEGACY_SAMPLE_DURATION_US
+                return default, default
         ans = self._engine.request(
             Cmd.GET_SAMPLERATE, Ans.SAMPLE_RATE, timeout_s=1.0
         )
         if ans is None or len(ans) < 4:
-            return timingmod.LEGACY_SAMPLE_DURATION_US
-        std_us, _express_us = struct.unpack_from("<HH", ans)
-        return float(std_us) or timingmod.LEGACY_SAMPLE_DURATION_US
+            return default, default
+        std_us, express_us = struct.unpack_from("<HH", ans)
+        return float(std_us) or default, float(express_us) or default
 
     def _begin_streaming(self) -> None:
         self._engine.send_only(Cmd.STOP)
@@ -401,10 +472,11 @@ class RealLidarDriver(LidarDriverInterface):
             if rpm is None:
                 # DTR-driven legacy units can't use a fetched speed (the DTR
                 # path only distinguishes stop/run) — skip the blocking conf
-                # query there.
+                # query there, and on any pre-conf device (the gate).
                 desired = (
                     confproto.get_desired_speed(self._engine)
-                    if self.motor_ctrl is not MotorCtrlSupport.NONE
+                    if self.conf_supported
+                    and self.motor_ctrl is not MotorCtrlSupport.NONE
                     else None
                 )
                 if desired is not None:
@@ -426,26 +498,35 @@ class RealLidarDriver(LidarDriverInterface):
                 return bool(channel.set_dtr(rpm == 0))
             return True  # network units have no host-driven motor line
 
+    def _conf_engine(self) -> Optional[CommandEngine]:
+        """The engine iff conf queries are allowed — None keeps every
+        conf getter a clean miss on pre-conf firmware (the gate)."""
+        return self._engine if self.conf_supported else None
+
     def get_motor_info(self) -> Optional[confproto.MotorInfo]:
         """min/max/desired rotation speed (getMotorInfo :1023-1056)."""
         with self._lock:
-            if self._engine is None:
+            engine = self._conf_engine()
+            if engine is None:
                 return None
             return confproto.get_motor_info(
-                self._engine, pwm_ctrl=self.motor_ctrl is MotorCtrlSupport.PWM
+                engine, pwm_ctrl=self.motor_ctrl is MotorCtrlSupport.PWM
             )
 
     def get_mac_addr(self) -> Optional[bytes]:
         with self._lock:
-            return confproto.get_mac_addr(self._engine) if self._engine else None
+            engine = self._conf_engine()
+            return confproto.get_mac_addr(engine) if engine else None
 
     def get_ip_conf(self) -> Optional[confproto.IpConf]:
         with self._lock:
-            return confproto.get_ip_conf(self._engine) if self._engine else None
+            engine = self._conf_engine()
+            return confproto.get_ip_conf(engine) if engine else None
 
     def set_ip_conf(self, conf: confproto.IpConf) -> bool:
         with self._lock:
-            return confproto.set_ip_conf(self._engine, conf) if self._engine else False
+            engine = self._conf_engine()
+            return confproto.set_ip_conf(engine, conf) if engine else False
 
     # ------------------------------------------------------------------
     # serial autobaud negotiation (sl_lidar_driver.cpp:1058-1155)

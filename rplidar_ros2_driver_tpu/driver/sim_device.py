@@ -371,19 +371,52 @@ class SimulatedDevice:
                 ),
             )
         elif cmd == Cmd.GET_LIDAR_CONF:
-            self._handle_conf(payload)
+            # pre-conf firmware (old triangle, fw < 1.24) does not know the
+            # command at all: no answer, the requester times out — the
+            # behavior checkSupportConfigCommands exists to avoid
+            # (sl_lidar_driver.cpp:1176-1196)
+            if self._conf_capable():
+                self._handle_conf(payload)
         elif cmd == Cmd.SET_LIDAR_CONF:
-            self._handle_set_conf(payload)
+            if self._conf_capable():
+                self._handle_set_conf(payload)
         elif cmd in (Cmd.SCAN, Cmd.FORCE_SCAN):
             # FORCE_SCAN streams even when health-gated firmware would
             # refuse SCAN (sl_lidar_driver.cpp startScan force path)
             self._start_stream(self.cfg.modes[0])
         elif cmd == Cmd.EXPRESS_SCAN:
+            if not self._conf_capable():
+                # pre-conf express: working_mode byte is 0 on the wire and
+                # the device streams the classic capsule format
+                # (startScanExpress legacy branch, sl_lidar_driver.cpp:
+                # 716-729, 748-750)
+                self._start_stream(SimScanMode(
+                    1, "Express", Ans.MEASUREMENT_CAPSULED,
+                    float(self.cfg.express_sample_us), 16.0,
+                ))
+                return
             mode_id = payload[0] if payload else 0
             mode = next((m for m in self.cfg.modes if m.id == mode_id), None)
             if mode is not None:
                 self._start_stream(mode)
         # unknown commands are ignored, like real firmware
+
+    def _conf_capable(self) -> bool:
+        """Whether the emulated firmware speaks GET/SET_LIDAR_CONF — the
+        device-side truth the host's supports_conf_commands gate predicts
+        (ND-magic major id >= 4, or triangle firmware >= 1.24).  The
+        comparison logic is deliberately written out rather than calling
+        supports_conf_commands: the emulator is the independent oracle the
+        gate is tested against."""
+        from rplidar_ros2_driver_tpu.models.tables import (
+            CONF_MIN_FIRMWARE_VERSION,
+            NEWDESIGN_MINUM_MAJOR_ID,
+        )
+
+        return (
+            (self.cfg.model_id >> 4) >= NEWDESIGN_MINUM_MAJOR_ID
+            or self.cfg.firmware >= CONF_MIN_FIRMWARE_VERSION
+        )
 
     def _handle_conf(self, payload: bytes) -> None:
         if len(payload) < 4:

@@ -120,6 +120,22 @@ def has_builtin_motor_ctrl(model_id: int) -> bool:
     return (model_id >> 4) >= BUILTIN_MOTORCTL_MINUM_MAJOR_ID
 
 
+# conf protocol appears on triangle lidars at firmware 1.24
+# (checkSupportConfigCommands, sl_lidar_driver.cpp:1176-1196)
+CONF_MIN_FIRMWARE_VERSION = (0x1 << 8) | 24
+
+
+def supports_conf_commands(info: "DeviceInfo") -> bool:
+    """checkSupportConfigCommands (sl_lidar_driver.cpp:1176-1196):
+    new-design models (ND magic: major id >= 4, _checkNDMagicNumber
+    :1467-1470) always speak GET/SET_LIDAR_CONF; old triangle units only
+    from firmware 1.24.  A gated device must never be sent a conf query —
+    it would silently time out per query."""
+    if (info.model >> 4) >= NEWDESIGN_MINUM_MAJOR_ID:
+        return True
+    return info.firmware_version >= CONF_MIN_FIRMWARE_VERSION
+
+
 class MotorCtrlSupport(enum.Enum):
     """How the motor is driven (checkMotorCtrlSupport,
     sl_lidar_driver.cpp:833-878): built-in RPM control for major id >= 6,

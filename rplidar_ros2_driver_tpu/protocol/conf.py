@@ -156,6 +156,22 @@ def set_ip_conf(engine: CommandEngine, conf: IpConf) -> bool:
     return set_conf(engine, ConfKey.LIDAR_STATIC_IP_ADDR, conf.to_payload())
 
 
+def get_mode_metadata(engine: CommandEngine, mode_id: int) -> Optional[ScanMode]:
+    """Full metadata for ONE mode id — the four-getter query block shared
+    by getAllSupportedScanModes (sl_lidar_driver.cpp:529-549) and
+    startScanExpress's single-mode lookup (:702-715).  None when any
+    field is missing."""
+    us = get_mode_us_per_sample(engine, mode_id)
+    dist = get_mode_max_distance(engine, mode_id)
+    ans = get_mode_ans_type(engine, mode_id)
+    name = get_mode_name(engine, mode_id)
+    if None in (us, dist, ans, name):
+        return None
+    return ScanMode(
+        id=mode_id, us_per_sample=us, max_distance=dist, ans_type=ans, name=name
+    )
+
+
 def enumerate_scan_modes(engine: CommandEngine) -> list[ScanMode]:
     """All supported modes with metadata (ref getAllSupportedScanModes
     sl_lidar_driver.cpp:518-554)."""
@@ -164,13 +180,7 @@ def enumerate_scan_modes(engine: CommandEngine) -> list[ScanMode]:
         return []
     modes: list[ScanMode] = []
     for mode_id in range(count):
-        us = get_mode_us_per_sample(engine, mode_id)
-        dist = get_mode_max_distance(engine, mode_id)
-        ans = get_mode_ans_type(engine, mode_id)
-        name = get_mode_name(engine, mode_id)
-        if None in (us, dist, ans, name):
-            continue
-        modes.append(
-            ScanMode(id=mode_id, us_per_sample=us, max_distance=dist, ans_type=ans, name=name)
-        )
+        mode = get_mode_metadata(engine, mode_id)
+        if mode is not None:
+            modes.append(mode)
     return modes

@@ -145,6 +145,15 @@ class ConfKey(enum.IntEnum):
     LIDAR_STATIC_IP_ADDR = 0x0001CCC0
 
 
+# Scan-command mode ids shared by the conf protocol and the EXPRESS_SCAN
+# request (SL_LIDAR_CONF_SCAN_COMMAND_STD/EXPRESS, sl_lidar_cmd.h:289-290).
+# EXPRESS is also the hardwired typical-mode fallback for old triangle
+# lidars whose firmware predates the conf protocol (getTypicalScanMode,
+# sl_lidar_driver.cpp:577-580).
+SCAN_COMMAND_STD = 0
+SCAN_COMMAND_EXPRESS = 1
+
+
 class HealthStatus(enum.IntEnum):
     """Device-side health byte (sl_lidar_cmd.h:171-173)."""
 

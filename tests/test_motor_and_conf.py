@@ -87,6 +87,46 @@ class TestMotorCtrlSupport:
             drv.disconnect(); dev.stop()
 
 
+class TestConfSupportGate:
+    """checkSupportConfigCommands semantics (sl_lidar_driver.cpp:1176-1196):
+    a device whose firmware predates the conf protocol must never be sent
+    a GET/SET_LIDAR_CONF query — each one would silently time out."""
+
+    def test_pre_conf_device_never_queried(self):
+        # A2 with acc-board PWM: the PWM motor path would otherwise fetch
+        # DESIRED_ROT_FREQ on set_motor_speed(None)
+        dev, drv = connected(SimConfig(
+            model_id=0x28, firmware=0x0117, acc_board_pwm=True,
+        ))
+        try:
+            assert not drv.conf_supported
+            assert drv.get_motor_info() is None
+            assert drv.get_mac_addr() is None
+            assert drv.get_ip_conf() is None
+            assert not drv.set_ip_conf(IpConf(
+                (192, 168, 0, 7), (255, 255, 255, 0), (192, 168, 0, 1)
+            ))
+            assert drv.set_motor_speed(None)  # falls back to 600 default
+            assert _wait(lambda: dev.motor_rpm == 600)
+            assert Cmd.GET_LIDAR_CONF not in dev.commands
+            assert Cmd.SET_LIDAR_CONF not in dev.commands
+        finally:
+            drv.disconnect(); dev.stop()
+
+    def test_firmware_1_24_boundary_enables_conf(self):
+        # exactly 1.24 on a triangle unit: the boundary itself qualifies —
+        # pins the `>=` comparison direction
+        dev, drv = connected(SimConfig(
+            model_id=0x28, firmware=(0x1 << 8) | 24, acc_board_pwm=True,
+        ))
+        try:
+            assert drv.conf_supported
+            info = drv.get_motor_info()
+            assert info is not None and info.max_speed == 1200
+        finally:
+            drv.disconnect(); dev.stop()
+
+
 def _wait(pred, timeout=5.0):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:

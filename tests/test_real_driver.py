@@ -86,13 +86,17 @@ class TestConnect:
     def test_legacy_samplerate_queried_from_device(self):
         """OLD_TYPE startup on firmware >= 1.17 must ask the device for its
         sample duration (GET_SAMPLERATE, sl_lidar_driver.cpp:1556-1599)
-        instead of assuming the 476 us legacy default."""
+        instead of assuming the 476 us legacy default.  Pre-conf firmware
+        takes the Express fallback, so the EXPRESS duration is the one
+        that lands in the timing model (startScanExpress legacy branch,
+        :722)."""
         from rplidar_ros2_driver_tpu.protocol.constants import Cmd
 
         # firmware exactly 1.17 (0x0111): the boundary itself must query —
         # pins the `< 1.17` comparison direction in real.py
         dev = SimulatedDevice(SimConfig(
-            model_id=0x18, firmware=0x0111, std_sample_us=500,
+            model_id=0x18, firmware=0x0111,
+            std_sample_us=500, express_sample_us=250,
         )).start()
         try:
             drv = make_driver(dev)
@@ -100,7 +104,7 @@ class TestConnect:
             drv.detect_and_init_strategy()
             assert drv.start_motor("", 600)
             assert Cmd.GET_SAMPLERATE in dev.commands
-            assert drv._scan_decoder.timing.sample_duration_us == 500.0
+            assert drv._scan_decoder.timing.sample_duration_us == 250.0
             drv.stop_motor()
             drv.disconnect()
         finally:
@@ -179,15 +183,52 @@ class TestScanStreaming:
         drv.disconnect()
 
     def test_legacy_scan_path(self):
+        """A pre-conf A1M8 starts via the typical-mode EXPRESS fallback:
+        capsule stream, working_mode 0 on the wire, zero conf queries
+        (the reference wrapper's startScan(0, 1) through getTypicalScanMode
+        sl_lidar_driver.cpp:577-580)."""
+        from rplidar_ros2_driver_tpu.protocol.constants import Ans, Cmd
+
         dev = SimulatedDevice(SimConfig(model_id=0x18, points_per_rev=80)).start()
         try:
             drv = make_driver(dev)
             assert drv.connect("ignored", 0, False)
             drv.detect_and_init_strategy()
+            assert not drv.conf_supported
             assert drv.start_motor("", 0)
-            assert drv.profile.active_mode == "Standard"
+            assert drv.profile.active_mode == "Express"
+            assert dev.active_ans_type == Ans.MEASUREMENT_CAPSULED
+            # the wrapper profile keeps the A-series 12 m limit; 16 m is
+            # SDK mode metadata only
+            assert drv.get_hw_max_distance() == 12.0
             scans = self._grab_scans(drv, 1)
             assert scans and 40 <= int(scans[0].count) <= 90
+            assert Cmd.GET_LIDAR_CONF not in dev.commands
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            dev.stop()
+
+    def test_conf_capable_old_triangle_uses_typical_mode(self):
+        """An A-series unit with firmware >= 1.24 speaks the conf protocol:
+        OLD_TYPE startup resolves the typical mode via conf and starts the
+        express stream for it (startScan(0,1) -> getTypicalScanMode conf
+        branch, sl_lidar_driver.cpp:562-575)."""
+        from rplidar_ros2_driver_tpu.protocol.constants import Cmd
+
+        dev = SimulatedDevice(SimConfig(
+            model_id=0x18, firmware=(0x1 << 8) | 24,
+        )).start()
+        try:
+            drv = make_driver(dev)
+            assert drv.connect("ignored", 0, True)
+            drv.detect_and_init_strategy()
+            assert not drv.is_new_type()
+            assert drv.conf_supported
+            assert drv.start_motor("", 0)
+            # the sim's typical mode is DenseBoost
+            assert drv.profile.active_mode == "DenseBoost"
+            assert Cmd.GET_LIDAR_CONF in dev.commands
             drv.stop_motor()
             drv.disconnect()
         finally:
