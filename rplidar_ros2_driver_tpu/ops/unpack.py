@@ -359,7 +359,9 @@ def unpack_dense_capsules(frames, last_sync_out=0, sample_duration_us: int = 476
     s_raw = (((raw + inc_q16[:, None]) % FULL_TURN_Q16) < (inc_q16[:, None] << 1)).astype(jnp.int32)
     # samples of discarded pairs never reach the reference's edge filter;
     # zeroing them keeps the carry chain aligned (runs crossing a dropped
-    # capsule — sync fires ~once/rev — may differ by one flag).
+    # capsule — sync fires ~once/rev — may differ by one flag; the <= 1
+    # flag/dropped-frame bound is pinned by
+    # tests/test_unpack_golden.py::TestSyncEdgeDivergenceBound).
     s_raw = s_raw * pair_valid[:, None].astype(jnp.int32)
     sync = _sync_edge(s_raw.reshape(-1), jnp.asarray(last_sync_out)).reshape(s_raw.shape)
     angle_q14, quality, flag = _finish_nodes(angle_q6, dist, sync)

@@ -236,3 +236,132 @@ class TestHqCapsules:
         fr[100] ^= 1
         nodes, _ = unpack_ref.decode_hq_capsule(bytes(fr))
         assert nodes == []
+
+
+class TestSyncEdgeDivergenceBound:
+    """Pin the documented dense/ultra-dense carry-chain divergence window
+    (ops/unpack.py dense sync note): the vectorized decoders zero a
+    discarded pair's sync inputs to keep the batch carry aligned, while
+    the scalar model (like the reference's per-sample filter,
+    handler_capsules.cpp:738,766) simply never sees dropped samples.  A
+    sync region straddling a dropped capsule can therefore re-fire the
+    edge once on the far side — at most ONE extra flag per dropped
+    frame, and zero drift anywhere else.  These streams are engineered
+    so the revolution wrap lands exactly across the dropped frames (the
+    only geometry where the decoders can disagree)."""
+
+    M, J = 12, 6  # stream length, corrupted frame index
+
+    def _starts(self):
+        """900-q6 steps, except frames J-1..J+1 stall just past the 0
+        wrap: the last samples of pair J-2 sit inside the sync window
+        below 0, and pair J+1's first sample sits inside it above 0."""
+        j = self.J
+        starts = []
+        for i in range(self.M):
+            if i < j - 1:
+                starts.append(22145 - 900 * (j - 2) + 900 * i)
+            elif i <= j + 1:
+                starts.append(5 + 2 * (i - (j - 1)))
+            else:
+                starts.append(909 + 900 * (i - j - 1))
+        return [s % (360 << 6) for s in starts]
+
+    def _flag_drift(self, dec, per_pair_ref, npts):
+        """(flag mismatches, any-other-field mismatches) between the JAX
+        decode and the scalar model, over pairs both emitted."""
+        angle = np.asarray(dec.angle_q14)
+        dist = np.asarray(dec.dist_q2)
+        qual = np.asarray(dec.quality)
+        flag = np.asarray(dec.flag)
+        valid = np.asarray(dec.node_valid)
+        drift = others = 0
+        for i in range(angle.shape[0]):
+            ref_nodes = per_pair_ref[i + 1]
+            if not ref_nodes:
+                others += int(valid[i].any())
+                continue
+            if not valid[i].all() or len(ref_nodes) != npts:
+                others += 1
+                continue
+            for k, n in enumerate(ref_nodes):
+                if flag[i, k] != n.flag:
+                    drift += 1
+                if (
+                    angle[i, k] != n.angle_q14
+                    or dist[i, k] != n.dist_q2
+                    or qual[i, k] != n.quality
+                ):
+                    others += 1
+        return drift, others
+
+    def _dense_frames(self, corrupt, starts=None):
+        rng = _rng()
+        frames = []
+        for i, s in enumerate(starts if starts is not None else self._starts()):
+            fr = bytearray(
+                wire.encode_dense_capsule(int(s), i == 0, rng.integers(1, 1 << 15, 40))
+            )
+            if i in corrupt:
+                fr[30] ^= 0x0F
+            frames.append(bytes(fr))
+        return frames
+
+    def _ud_frames(self, corrupt):
+        rng = _rng()
+        frames = []
+        for i, s in enumerate(self._starts()):
+            dmm = rng.integers(100, 2000, 64)
+            qual = rng.integers(0, 256, 64)
+            words = np.array([
+                wire.ultra_dense_encode_sample(int(d), int(q))
+                for d, q in zip(dmm, qual)
+            ])
+            fr = bytearray(wire.encode_ultra_dense_capsule(s, i == 0, words))
+            if i in corrupt:
+                fr[60] ^= 0xF0
+            frames.append(bytes(fr))
+        return frames
+
+    def test_dense_drift_is_exactly_one_flag(self):
+        # no corruption: the same geometry decodes bit-identically
+        clean = self._dense_frames(())
+        ref = _collect_ref(unpack_ref.DenseCapsuleDecoder(sample_duration_us=476), clean)
+        dec = unpack.unpack_dense_capsules(_frames_to_array(clean), 0, 476)
+        assert self._flag_drift(dec, ref, 40) == (0, 0)
+        # dropped capsule under the wrap: one re-fired flag, nothing else
+        bad = self._dense_frames((self.J,))
+        ref = _collect_ref(unpack_ref.DenseCapsuleDecoder(sample_duration_us=476), bad)
+        dec = unpack.unpack_dense_capsules(_frames_to_array(bad), 0, 476)
+        assert self._flag_drift(dec, ref, 40) == (1, 0)
+
+    def test_ultra_dense_drift_is_exactly_one_flag(self):
+        clean = self._ud_frames(())
+        ref = _collect_ref(
+            unpack_ref.UltraDenseCapsuleDecoder(sample_duration_us=476), clean
+        )
+        dec = unpack.unpack_ultra_dense_capsules(_frames_to_array(clean), 0, 0, 476)
+        assert self._flag_drift(dec, ref, 64) == (0, 0)
+        bad = self._ud_frames((self.J,))
+        ref = _collect_ref(
+            unpack_ref.UltraDenseCapsuleDecoder(sample_duration_us=476), bad
+        )
+        dec = unpack.unpack_ultra_dense_capsules(_frames_to_array(bad), 0, 0, 476)
+        assert self._flag_drift(dec, ref, 64) == (1, 0)
+
+    def test_drift_bounded_by_dropped_frames_random_streams(self):
+        """Randomized geometries: drift never exceeds one flag per
+        corrupted frame (and is usually zero — the wrap rarely straddles
+        the drop)."""
+        for seed in range(4):
+            rng = np.random.default_rng(seed)
+            starts = _angles(rng, self.M, step_q6=900)
+            corrupt = (3, 8)
+            frames = self._dense_frames(corrupt, starts=starts)
+            ref = _collect_ref(
+                unpack_ref.DenseCapsuleDecoder(sample_duration_us=476), frames
+            )
+            dec = unpack.unpack_dense_capsules(_frames_to_array(frames), 0, 476)
+            drift, others = self._flag_drift(dec, ref, 40)
+            assert others == 0
+            assert drift <= len(corrupt), (seed, drift)
