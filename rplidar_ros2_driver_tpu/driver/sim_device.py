@@ -740,6 +740,25 @@ class SerialSimulatedDevice(SimulatedDevice):
                 return False
         return True
 
+    def tx_backlog_bytes(self) -> int:
+        """Undrained bytes in the pty slave's input queue (FIONREAD on
+        the retained slave fd) — the serial analog of the TCP SIOCOUTQ
+        probe: a drain-limited consumer pins this near the pty buffer
+        size while a starved sim thread leaves it near zero.  Returns 0
+        on any failure, matching the base contract."""
+        import fcntl
+        import termios
+
+        with self._conn_lock:
+            fd = self._slave
+        if fd is None:
+            return 0
+        try:
+            buf = fcntl.ioctl(fd, termios.FIONREAD, b"\x00" * 4)
+            return struct.unpack("i", buf)[0]
+        except (OSError, AttributeError):
+            return 0
+
 
 class UdpSimulatedDevice(SimulatedDevice):
     """The emulator over UDP with connected-pair semantics: the device

@@ -16,7 +16,11 @@ import numpy as np
 import pytest
 
 from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
-from rplidar_ros2_driver_tpu.driver.sim_device import SimConfig, SimulatedDevice
+from rplidar_ros2_driver_tpu.driver.sim_device import (
+    SerialSimulatedDevice,
+    SimConfig,
+    SimulatedDevice,
+)
 
 
 from test_pytransport import _py_factory  # shared TCP fallback factory
@@ -24,7 +28,17 @@ from test_pytransport import _py_factory  # shared TCP fallback factory
 
 @pytest.mark.parametrize(
     "rate_mult,transport",
-    [(1.0, "native"), (3.0, "native"), (1.0, "python")],
+    [
+        (1.0, "native"),
+        (3.0, "native"),
+        (1.0, "python"),
+        # serial plane: the same DenseBoost cadence through a pty via the
+        # termios2/select path in native/src/channel.cc — the reference's
+        # production transport (arch/linux/net_serial.cpp:300-386) must
+        # hold the highest sustained rate too, not just round-trip tests
+        (1.0, "serial"),
+        (3.0, "serial"),
+    ],
 )
 def test_sustained_stream_keeps_up(rate_mult, transport):
     """At device pace and at 3x device pace the grab loop must see
@@ -33,17 +47,21 @@ def test_sustained_stream_keeps_up(rate_mult, transport):
     # DenseBoost cadence: 3200 pts/rev @ 10 rev/s = 800 frames/s (64
     # nodes/ultra-dense pair frame -> 50 frames/rev)
     frame_rate = 800.0 * rate_mult
-    sim = SimulatedDevice(
-        SimConfig(points_per_rev=3200, frame_rate_hz=frame_rate)
-    ).start()
+    cfg = SimConfig(points_per_rev=3200, frame_rate_hz=frame_rate)
+    serial = transport == "serial"
+    sim = (SerialSimulatedDevice(cfg) if serial else SimulatedDevice(cfg)).start()
     seconds = 4.0
     try:
-        drv = RealLidarDriver(
-            channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
-            motor_warmup_s=0.0,
-            transceiver_factory=_py_factory if transport == "python" else None,
-        )
-        assert drv.connect("sim", 0, False)
+        if serial:
+            drv = RealLidarDriver(channel_type="serial", motor_warmup_s=0.0)
+            assert drv.connect(sim.port_path, 115200, False)
+        else:
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+                transceiver_factory=_py_factory if transport == "python" else None,
+            )
+            assert drv.connect("sim", 0, False)
         drv.detect_and_init_strategy()
         assert drv.start_motor("DenseBoost", 600)
 
@@ -53,9 +71,9 @@ def test_sustained_stream_keeps_up(rate_mult, transport):
         t_end = time.monotonic() + seconds
         while time.monotonic() < t_end:
             got = drv.grab_scan_host(2.0)
-            # the sim is a TCP server under every parametrization (the
-            # "transport" axis selects the DRIVER side), so the kernel
-            # TX-queue probe applies to the python fallback too
+            # kernel queue probe: SIOCOUTQ on the TCP connection socket,
+            # FIONREAD on the pty slave input queue for serial — both
+            # report "bytes the consumer hasn't drained"
             backlogs.append(sim.tx_backlog_bytes())
             if got is None:
                 continue
@@ -91,7 +109,12 @@ def test_sustained_stream_keeps_up(rate_mult, transport):
     assert stalls <= 8, (stalls, span)
     if backlogs:
         med_backlog = float(np.median(backlogs))
-        assert med_backlog <= 64 * 1024, (med_backlog, max(backlogs))
+        # the pty input queue is 4096 bytes (a parked consumer pins it at
+        # 4095 with ZERO stalls — small writes block too briefly to trip
+        # the 100 ms stall counter, so this is the primary serial signal);
+        # TCP socket buffers are tens of KB, hence the larger bound
+        limit = 2048 if serial else 64 * 1024
+        assert med_backlog <= limit, (med_backlog, max(backlogs))
     produced_revs = emitted / 3200.0
     assert produced_revs >= 0.4 * seconds * 10.0 * rate_mult, produced_revs
     # the consumer must see at least ~70% of revolutions produced (slack
