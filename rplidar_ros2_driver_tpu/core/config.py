@@ -85,9 +85,19 @@ class DriverParams:
     intensity_min: float = 0.0
     voxel_grid_size: int = 256        # cells per side of the 2-D occupancy grid
     voxel_cell_m: float = 0.25        # metres per cell
-    # temporal-median implementation: "xla" (jnp.sort) or "pallas" (VMEM
-    # bitonic-network kernel, ops/pallas_kernels.py)
-    median_backend: str = "xla"
+    # temporal-median implementation: "xla" (jnp.sort), "pallas" (VMEM
+    # bitonic-network kernel, ops/pallas_kernels.py), or "auto" — pallas
+    # on a TPU device, xla elsewhere (pallas on CPU runs in interpret
+    # mode, which is pathologically slow).  The device-resident in-jit
+    # A/B behind the default: pallas 1.64x over xla at W=64,
+    # non-overlapping interleaved rounds; deeper windows at least
+    # 1.2-1.4x (docs/BENCHMARKS.md).
+    median_backend: str = "auto"
+    # pipelined publish seam: publish revolution N-1's chain output while
+    # revolution N computes on the device (one revolution of bounded
+    # staleness; the publish never waits on device compute).  Off by
+    # default — the reference publishes synchronously.
+    pipelined_publish: bool = False
 
     def validate(self) -> None:
         if self.qos_reliability not in VALID_QOS:
@@ -110,8 +120,8 @@ class DriverParams:
             )
         if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
             raise ValueError("invalid voxel grid configuration")
-        if self.median_backend not in ("xla", "pallas"):
-            raise ValueError("median_backend must be 'xla' or 'pallas'")
+        if self.median_backend not in ("auto", "xla", "pallas"):
+            raise ValueError("median_backend must be 'auto', 'xla' or 'pallas'")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

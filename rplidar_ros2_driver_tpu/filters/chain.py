@@ -53,10 +53,28 @@ def _pick_device(backend: str):
 DEFAULT_BEAMS = 2048
 
 
-def config_from_params(params: DriverParams, beams: int = DEFAULT_BEAMS) -> FilterConfig:
+def resolve_median_backend(requested: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` median backend for a device platform: pallas
+    on TPU (device-resident A/B: 1.64x over xla at W=64, at least
+    1.2-1.4x at deeper windows — docs/BENCHMARKS.md), xla everywhere
+    else (pallas on CPU runs in interpret mode).  Explicit requests pass
+    through."""
+    if requested != "auto":
+        return requested
+    if platform is None:
+        platform = jax.default_backend()
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def config_from_params(
+    params: DriverParams,
+    beams: int = DEFAULT_BEAMS,
+    platform: Optional[str] = None,
+) -> FilterConfig:
     """The one params -> FilterConfig mapping, shared by the single-stream
     chain and the multi-stream sharded service so their filtering behavior
-    (and checkpoint layouts) cannot drift."""
+    (and checkpoint layouts) cannot drift.  ``platform`` resolves the
+    ``auto`` median backend (defaults to the default JAX backend)."""
     chain = set(params.filter_chain)
     return FilterConfig(
         window=params.filter_window,
@@ -69,7 +87,7 @@ def config_from_params(params: DriverParams, beams: int = DEFAULT_BEAMS) -> Filt
         enable_clip="clip" in chain,
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
-        median_backend=params.median_backend,
+        median_backend=resolve_median_backend(params.median_backend, platform),
     )
 
 
@@ -92,15 +110,28 @@ class ScanFilterChain:
         beams: int = DEFAULT_BEAMS,
         *,
         warmup: bool = True,
+        capacity: Optional[int] = None,
     ) -> None:
-        self.cfg = config_from_params(params, beams)
         self.device = _pick_device(params.filter_backend)
+        self.cfg = config_from_params(params, beams, platform=self.device.platform)
         self.backend = params.filter_backend
+        # wire capacity (nodes per packed upload): MAX_SCAN_NODES holds any
+        # revolution; a device whose densest mode is known smaller (S2
+        # DenseBoost <= ~3300 nodes/rev at 600 RPM) can halve the per-scan
+        # transfer by passing e.g. 4096.  An oversized revolution (e.g.
+        # the motor slowed while the sample rate held) is truncated
+        # head-keep like the assembler's 8192-node overflow cap, never
+        # raised — a crash would take down the scan thread mid-stream.
+        self.capacity = capacity
+        self._overflow_warned = False
         self._lock = threading.Lock()
         self._state = jax.device_put(
             FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
             self.device,
         )
+        # double-buffered publish seam: the not-yet-fetched wire output of
+        # the newest dispatched step (process_raw_pipelined)
+        self._pending_wire: Optional[jax.Array] = None
         if warmup:
             self.precompile()
 
@@ -119,7 +150,7 @@ class ScanFilterChain:
             if int(np.asarray(self._state.filled)) != 0:
                 return
             zeros = np.zeros(0, np.int32)
-            buf = pack_host_scan_counted(zeros, zeros, zeros)
+            buf = pack_host_scan_counted(zeros, zeros, zeros, None, self.capacity)
             packed = jax.device_put(buf, self.device)
             state, _ = counted_filter_step_wire(self._state, packed, self.cfg)
             # the step donates its state argument: rebuild from the stepped
@@ -132,6 +163,24 @@ class ScanFilterChain:
                 cursor=state.cursor * 0,
                 filled=state.filled * 0,
             )
+
+    def _pack_capped(self, angle_q14, dist_q2, quality, flag):
+        """Pack one scan at ``self.capacity``, truncating an oversized
+        revolution head-keep (the assembler's overflow policy) with a
+        one-time warning instead of raising out of the scan thread."""
+        n = self.capacity
+        if n is not None and len(angle_q14) > n:
+            if not self._overflow_warned:
+                logging.getLogger("rplidar_tpu.chain").warning(
+                    "revolution of %d nodes exceeds wire capacity %d; "
+                    "truncating (head-keep) — raise the chain's capacity "
+                    "if this device/mode can legitimately exceed it",
+                    len(angle_q14), n,
+                )
+                self._overflow_warned = True
+            angle_q14, dist_q2, quality = angle_q14[:n], dist_q2[:n], quality[:n]
+            flag = flag[:n] if flag is not None else None
+        return pack_host_scan_counted(angle_q14, dist_q2, quality, flag, n)
 
     def process(self, batch: ScanBatch) -> FilterOutput:
         batch = jax.device_put(batch, self.device)
@@ -149,11 +198,49 @@ class ScanFilterChain:
         device->host fetch (the fused flat output vector).  Returns a
         numpy-backed FilterOutput.
         """
-        buf = pack_host_scan_counted(angle_q14, dist_q2, quality, flag)
+        buf = self._pack_capped(angle_q14, dist_q2, quality, flag)
         packed = jax.device_put(buf, self.device)
         with self._lock:
             self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
         return unpack_output_wire(wire, self.cfg)
+
+    def process_raw_pipelined(
+        self, angle_q14, dist_q2, quality, flag=None
+    ) -> Optional[FilterOutput]:
+        """Pipelined publish seam: dispatch THIS revolution's step, then
+        fetch and return the PREVIOUS revolution's output — one revolution
+        of bounded staleness in exchange for never waiting on device
+        compute at publish time (the device-side mirror of the reference's
+        double-buffered ScanDataHolder, sl_lidar_driver.cpp:237-371).
+
+        The returned output's step finished — and its device->host copy
+        was STARTED (``copy_to_host_async``) — during the previous
+        inter-revolution gap, so by the time this call collects it the
+        bytes are host-side and the publish pays neither device compute
+        nor a blocking transfer round-trip (through a remote-attached
+        device the blocking-fetch RTT alone can exceed the whole latency
+        budget; the async copy buys it back).  Returns None on the first
+        call after a start/reset (nothing pending);
+        :meth:`flush_pipelined` drains the final pending output when the
+        stream stops.
+        """
+        buf = self._pack_capped(angle_q14, dist_q2, quality, flag)
+        packed = jax.device_put(buf, self.device)
+        with self._lock:
+            self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
+            try:
+                wire.copy_to_host_async()
+            except Exception:
+                pass  # backend without async D2H: the later fetch blocks
+            pending, self._pending_wire = self._pending_wire, wire
+        return unpack_output_wire(pending, self.cfg) if pending is not None else None
+
+    def flush_pipelined(self) -> Optional[FilterOutput]:
+        """Fetch the last dispatched step's output (the one revolution
+        still in flight when the stream stops), or None."""
+        with self._lock:
+            pending, self._pending_wire = self._pending_wire, None
+        return unpack_output_wire(pending, self.cfg) if pending is not None else None
 
     # -- checkpoint surface -------------------------------------------------
 
@@ -230,10 +317,12 @@ class ScanFilterChain:
             )
             with self._lock:
                 self._state = fresh
+                self._pending_wire = None  # pre-reset output: never publish
             return False
         restored = jax.device_put(FilterState(**snap), self.device)
         with self._lock:
             self._state = restored
+            self._pending_wire = None
         return True
 
     def reset(self) -> None:

@@ -67,6 +67,9 @@ class RPlidarNode(LifecycleNode):
         self.tracer = StageTimer()
         self._param_lock = threading.Lock()
         self._chain_snapshot = None
+        # (stamp, duration) of the revolution whose chain output is still
+        # in flight when pipelined_publish is on
+        self._pipeline_meta: Optional[tuple[float, float]] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -133,6 +136,13 @@ class RPlidarNode(LifecycleNode):
     def on_deactivate(self) -> bool:
         if self.fsm:
             self.fsm.stop()
+        # drain the pipelined publish seam: the last revolution's output
+        # is still in flight when the scan thread stops
+        if self.chain is not None and self._pipeline_meta is not None:
+            out = self.chain.flush_pipelined()
+            meta, self._pipeline_meta = self._pipeline_meta, None
+            if out is not None:
+                self._publish_chain_output(out, *meta)
         # preserve the rolling window across deactivate/activate — the
         # framework's checkpoint surface (SURVEY.md §5)
         if self.chain is not None:
@@ -216,78 +226,106 @@ class RPlidarNode(LifecycleNode):
         with self.tracer.stage("filter"):
             out = None
             if self.chain is not None:
-                out = self.chain.process_raw(
-                    scan["angle_q14"], scan["dist_q2"], scan["quality"],
-                    scan.get("flag"),
-                )
-
-        with self.tracer.stage("convert"):
-            if out is not None:
-                # chain output is already on the fixed angular grid
-                beams = self.chain.cfg.beams
-                ranges = np.asarray(out.ranges)
-                inten = np.asarray(out.intensities)
-                msg = LaserScanHost(
-                    stamp=start_time,
-                    frame_id=params.frame_id,
-                    angle_min=0.0,
-                    angle_max=2.0 * np.pi,
-                    angle_increment=2.0 * np.pi / beams,
-                    time_increment=duration / beams,
-                    scan_time=duration,
-                    range_min=params.range_clip_min_m,
-                    range_max=max_range,
-                    ranges=ranges,
-                    intensities=inten,
-                )
-            else:
-                from rplidar_ros2_driver_tpu.ops.ascend import (
-                    apply_angle_compensation,
-                )
-
-                batch = apply_angle_compensation(
-                    ScanBatch.from_numpy(
+                if params.pipelined_publish:
+                    # publish revolution N-1 while N computes: the fetch
+                    # below touches an already-finished step, so the
+                    # publish never waits on device compute (one
+                    # revolution of declared staleness; the stamp below
+                    # is N-1's own)
+                    out = self.chain.process_raw_pipelined(
                         scan["angle_q14"], scan["dist_q2"], scan["quality"],
                         scan.get("flag"),
-                    ),
-                    params.angle_compensate,
-                )
-                ls = to_laserscan(
-                    batch,
-                    duration,
-                    max_range,
-                    scan_processing=params.scan_processing,
-                    inverted=params.inverted,
-                    is_new_type=is_new,
-                )
-                bc = int(ls.beam_count)
-                if bc == 0:
-                    return
-                msg = LaserScanHost(
-                    stamp=start_time,
-                    frame_id=params.frame_id,
-                    angle_min=float(ls.angle_min),
-                    angle_max=float(ls.angle_max),
-                    angle_increment=float(ls.angle_increment),
-                    time_increment=float(ls.time_increment),
-                    scan_time=float(ls.scan_time),
-                    range_min=float(ls.range_min),
-                    range_max=float(ls.range_max),
-                    ranges=np.asarray(ls.ranges)[:bc],
-                    intensities=np.asarray(ls.intensities)[:bc],
-                )
+                    )
+                    meta, self._pipeline_meta = (
+                        self._pipeline_meta, (start_time, duration)
+                    )
+                    if out is None or meta is None:
+                        return  # first revolution of the stream: nothing pending
+                    start_time, duration = meta
+                else:
+                    out = self.chain.process_raw(
+                        scan["angle_q14"], scan["dist_q2"], scan["quality"],
+                        scan.get("flag"),
+                    )
+
+        if out is not None:
+            self._publish_chain_output(out, start_time, duration, max_range)
+            return
+
+        with self.tracer.stage("convert"):
+            from rplidar_ros2_driver_tpu.ops.ascend import (
+                apply_angle_compensation,
+            )
+
+            batch = apply_angle_compensation(
+                ScanBatch.from_numpy(
+                    scan["angle_q14"], scan["dist_q2"], scan["quality"],
+                    scan.get("flag"),
+                ),
+                params.angle_compensate,
+            )
+            ls = to_laserscan(
+                batch,
+                duration,
+                max_range,
+                scan_processing=params.scan_processing,
+                inverted=params.inverted,
+                is_new_type=is_new,
+            )
+            bc = int(ls.beam_count)
+            if bc == 0:
+                return
+            msg = LaserScanHost(
+                stamp=start_time,
+                frame_id=params.frame_id,
+                angle_min=float(ls.angle_min),
+                angle_max=float(ls.angle_max),
+                angle_increment=float(ls.angle_increment),
+                time_increment=float(ls.time_increment),
+                scan_time=float(ls.scan_time),
+                range_min=float(ls.range_min),
+                range_max=float(ls.range_max),
+                ranges=np.asarray(ls.ranges)[:bc],
+                intensities=np.asarray(ls.intensities)[:bc],
+            )
 
         with self.tracer.stage("publish"):
             self.publisher.publish_scan(msg)
-            if out is not None:
-                self.publisher.publish_cloud(
-                    PointCloudHost(
-                        stamp=start_time,
-                        frame_id=params.frame_id,
-                        points_xy=np.asarray(out.points_xy)[np.asarray(out.point_mask)],
-                        voxel=np.asarray(out.voxel),
-                    )
+
+    def _publish_chain_output(
+        self, out, stamp: float, duration: float, max_range: Optional[float] = None
+    ) -> None:
+        """Convert + publish one chain FilterOutput (shared by the
+        synchronous path, the pipelined path, and the deactivate-time
+        pipeline drain).  The output is already on the fixed angular grid."""
+        params = self.params
+        if max_range is None:
+            max_range = (self.fsm.cached_max_range if self.fsm else None) or 40.0
+        with self.tracer.stage("convert"):
+            beams = self.chain.cfg.beams
+            msg = LaserScanHost(
+                stamp=stamp,
+                frame_id=params.frame_id,
+                angle_min=0.0,
+                angle_max=2.0 * np.pi,
+                angle_increment=2.0 * np.pi / beams,
+                time_increment=duration / beams,
+                scan_time=duration,
+                range_min=params.range_clip_min_m,
+                range_max=max_range,
+                ranges=np.asarray(out.ranges),
+                intensities=np.asarray(out.intensities),
+            )
+        with self.tracer.stage("publish"):
+            self.publisher.publish_scan(msg)
+            self.publisher.publish_cloud(
+                PointCloudHost(
+                    stamp=stamp,
+                    frame_id=params.frame_id,
+                    points_xy=np.asarray(out.points_xy)[np.asarray(out.point_mask)],
+                    voxel=np.asarray(out.voxel),
                 )
+            )
 
     # ------------------------------------------------------------------
     # diagnostics (src/rplidar_node.cpp:490-545)

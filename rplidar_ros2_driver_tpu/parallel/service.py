@@ -63,7 +63,9 @@ class ShardedFilterService:
             multihost.initialize()
             mesh = make_mesh()
         self.mesh = mesh
-        self.cfg = config_from_params(params, beams)
+        self.cfg = config_from_params(
+            params, beams, platform=mesh.devices.flat[0].platform
+        )
         self.streams = streams
         self.capacity = capacity
         sharded_step = build_sharded_step(self.mesh, self.cfg)

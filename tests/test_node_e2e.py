@@ -101,6 +101,67 @@ class TestScanContent:
         assert len(msg.ranges) == 360
         assert np.isfinite(msg.ranges).sum() > 300
 
+    def test_pipelined_publish_matches_sync_shifted(self):
+        """pipelined_publish must publish the same chain outputs as the
+        synchronous seam, one revolution late, with the matching (earlier)
+        stamps — and the deactivate-time drain must flush the final
+        in-flight revolution rather than dropping it."""
+
+        class TimestampingPublisher(CollectingPublisher):
+            def __init__(self):
+                super().__init__()
+                self.pub_times = []
+
+            def publish_scan(self, msg):
+                super().publish_scan(msg)
+                self.pub_times.append(time.monotonic())
+
+        chain_kw = dict(
+            dummy_mode=True,
+            filter_backend="cpu",
+            filter_chain=("clip", "median", "voxel"),
+            filter_window=4,
+            voxel_grid_size=32,
+        )
+
+        def run(params):
+            pub = TimestampingPublisher()
+            node = RPlidarNode(
+                params, pub,
+                driver_factory=lambda: DummyLidarDriver(scan_rate_hz=50.0),
+                fsm_timings=FsmTimings.fast(),
+            )
+            launch(node)
+            assert _wait(lambda: pub.scan_count >= 6)
+            node.deactivate()  # pipelined: drains the in-flight revolution
+            node.shutdown()
+            return pub
+
+        pub_s = run(DriverParams(**chain_kw))
+        pub_p = run(DriverParams(pipelined_publish=True, **chain_kw))
+        # the dummy's phase advances deterministically per revolution, so
+        # scan k is identical across nodes: pipelined output k must equal
+        # the synchronous output k (published one revolution later, but
+        # stamped with its own revolution's time)
+        n = min(pub_s.scan_count, pub_p.scan_count)
+        assert n >= 5
+        for k in range(n):
+            np.testing.assert_array_equal(
+                pub_p.scans[k].ranges, pub_s.scans[k].ranges
+            )
+        # each pipelined message keeps its OWN revolution's stamp, so its
+        # stamp-to-publish age runs ~one revolution period older than the
+        # synchronous path's (this is the declared staleness; a regression
+        # stamping with the publish-time revolution would erase the gap)
+        period = pub_p.scans[0].scan_time  # dummy: 1/50 s
+        age_p = np.median([
+            pub_p.pub_times[k] - pub_p.scans[k].stamp for k in range(n)
+        ])
+        age_s = np.median([
+            pub_s.pub_times[k] - pub_s.scans[k].stamp for k in range(n)
+        ])
+        assert age_p - age_s > 0.5 * period, (age_p, age_s, period)
+
 
 class FlakyDriver(DummyLidarDriver):
     """Fault-injecting fake: healthy scans, then grab failures, then

@@ -62,6 +62,77 @@ def test_chain_process_raw_matches_process():
         np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
 
 
+def test_chain_pipelined_is_sync_shifted_by_one():
+    """The pipelined publish seam returns exactly the synchronous path's
+    outputs delayed by one revolution (bounded staleness of 1), and
+    flush_pipelined drains the final in-flight output."""
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    c_sync = ScanFilterChain(params, beams=128)
+    c_pipe = ScanFilterChain(params, beams=128)
+    sync_outs, pipe_outs = [], []
+    for k in range(5):
+        angle, dist, qual = _raw_scan(k + 200)
+        sync_outs.append(c_sync.process_raw(angle, dist, qual))
+        pipe_outs.append(c_pipe.process_raw_pipelined(angle, dist, qual))
+    assert pipe_outs[0] is None
+    for k in range(1, 5):
+        np.testing.assert_array_equal(
+            np.asarray(pipe_outs[k].ranges), np.asarray(sync_outs[k - 1].ranges)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pipe_outs[k].voxel), np.asarray(sync_outs[k - 1].voxel)
+        )
+    tail = c_pipe.flush_pipelined()
+    np.testing.assert_array_equal(
+        np.asarray(tail.ranges), np.asarray(sync_outs[4].ranges)
+    )
+    assert c_pipe.flush_pipelined() is None  # drained
+
+
+def test_chain_capacity_truncates_oversized_revolution():
+    """A revolution exceeding the chain's wire capacity is truncated
+    head-keep (the assembler's overflow policy) instead of raising out
+    of the scan thread; the result matches the pre-truncated scan, and
+    the capacity-capped warmup compile covers the capped shape."""
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    cap = 256
+    chain = ScanFilterChain(params, beams=128, capacity=cap)
+    ref = ScanFilterChain(params, beams=128, capacity=cap)
+    angle, dist, qual = _raw_scan(42, points=cap + 60)
+    out = chain.process_raw(angle, dist, qual)
+    out_ref = ref.process_raw(angle[:cap], dist[:cap], qual[:cap])
+    np.testing.assert_array_equal(np.asarray(out.ranges), np.asarray(out_ref.ranges))
+    # pipelined path truncates identically
+    assert chain.process_raw_pipelined(angle, dist, qual) is None
+
+
+def test_chain_pipelined_reset_drops_pending():
+    """A reset/restore must clear the in-flight output: pre-reset data
+    must never be published into the post-reset stream."""
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    chain = ScanFilterChain(params, beams=128)
+    angle, dist, qual = _raw_scan(300)
+    assert chain.process_raw_pipelined(angle, dist, qual) is None
+    chain.reset()
+    assert chain.flush_pipelined() is None
+    assert chain.process_raw_pipelined(angle, dist, qual) is None
+
+
 def test_compact_step_matches_scanbatch_step():
     """The 6-byte/point bit-packed wire form must be lossless for
         in-range values (18-bit distances, 6-bit flags)."""
