@@ -347,6 +347,8 @@ def _e2e_phase(chain, rate_mult: float, seconds: float, timer, label: str) -> in
         timer.meta[label] = {
             "frames_decoded": dec.frames_decoded,
             "nodes_decoded": dec.nodes_decoded,
+            # 2 = SCHED_RR, 1 = nice boost, 0 = default, -1 = py fallback
+            "rx_priority": drv._engine.rx_priority if drv._engine else -1,
         }
         drv.stop_motor()
         drv.disconnect()
@@ -455,6 +457,7 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
         "loaded": {
             "rate_mult": 3.0,
             "host_load_procs": os.cpu_count() or 4,
+            "rx_priority": timer.meta["loaded"]["rx_priority"],
             "published_per_sec": round(loaded_published / loaded_seconds, 2),
             "publish_p99_ms": round(timer.percentile("loaded_publish", 99) * 1e3, 3),
             "publish_p50_ms": round(timer.percentile("loaded_publish", 50) * 1e3, 3),

@@ -236,12 +236,15 @@ class RPlidarNode(LifecycleNode):
                         scan["angle_q14"], scan["dist_q2"], scan["quality"],
                         scan.get("flag"),
                     )
+                    # max_range travels with the revolution too: a
+                    # scan-mode hot-swap between N-1 and N must not pair
+                    # N-1's ranges with N's range_max in the header
                     meta, self._pipeline_meta = (
-                        self._pipeline_meta, (start_time, duration)
+                        self._pipeline_meta, (start_time, duration, max_range)
                     )
                     if out is None or meta is None:
                         return  # first revolution of the stream: nothing pending
-                    start_time, duration = meta
+                    start_time, duration, max_range = meta
                 else:
                     out = self.chain.process_raw(
                         scan["angle_q14"], scan["dist_q2"], scan["quality"],

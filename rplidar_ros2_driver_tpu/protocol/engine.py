@@ -101,6 +101,13 @@ class CommandEngine:
         return self._running.is_set() and not self.link_error.is_set()
 
     @property
+    def rx_priority(self) -> int:
+        """Scheduling class the rx thread achieved, when the transceiver
+        reports it (native: 2 = SCHED_RR, 1 = nice boost, 0 = default);
+        -1 for transports without elevation (pure-Python fallback)."""
+        return int(getattr(self._tx, "rx_priority", -1))
+
+    @property
     def channel(self):
         """Underlying byte channel, when the transceiver exposes one (the
         raw-access escape hatch for DTR motor control and autobaud)."""

@@ -227,6 +227,13 @@ def replay_fleet(
                 n_dev, stream = n, ok[0]
                 break
         mesh = make_mesh(n_devices=n_dev, stream=stream)
+    # re-resolve the config against the MESH devices' platform: with
+    # median_backend="auto" an explicit CPU mesh on a TPU-default host
+    # must get the xla median, not interpret-mode pallas (the cfg above
+    # was only needed for cfg.beams during mesh selection)
+    cfg = config_from_params(
+        params, beams or DEFAULT_BEAMS, platform=mesh.devices.flat[0].platform
+    )
     k_total = min(len(r) for r in stream_revolutions)
     scan_fn = build_sharded_scan(mesh, cfg)
     state = create_sharded_state(mesh, cfg, streams)

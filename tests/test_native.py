@@ -286,6 +286,12 @@ class TestTransceiver:
         m = tx.wait_message(timeout_ms=2000)
         assert m is not None
         assert tx.rx_priority in (0, 1, 2), tx.rx_priority
+        # the engine relays the achieved class (bench artifacts record it)
+        from rplidar_ros2_driver_tpu.protocol.engine import CommandEngine
+
+        eng = CommandEngine.__new__(CommandEngine)
+        eng._tx = tx
+        assert eng.rx_priority == tx.rx_priority
         tx.stop()
         t.join(3)
 
