@@ -31,7 +31,7 @@ from rplidar_ros2_driver_tpu.core.types import ScanBatch
 from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
 from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
 from rplidar_ros2_driver_tpu.node.diagnostics import DiagnosticsUpdater
-from rplidar_ros2_driver_tpu.node.fsm import FsmTimings, ScanLoopFsm
+from rplidar_ros2_driver_tpu.node.fsm import DriverState, FsmTimings, ScanLoopFsm
 from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleNode, LifecycleState
 from rplidar_ros2_driver_tpu.node.messages import (
     LaserScanHost,
@@ -67,9 +67,9 @@ class RPlidarNode(LifecycleNode):
         self.tracer = StageTimer()
         self._param_lock = threading.Lock()
         self._chain_snapshot = None
-        # (stamp, duration) of the revolution whose chain output is still
-        # in flight when pipelined_publish is on
-        self._pipeline_meta: Optional[tuple[float, float]] = None
+        # (stamp, duration, max_range) of the revolution whose chain
+        # output is still in flight when pipelined_publish is on
+        self._pipeline_meta: Optional[tuple[float, float, float]] = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -107,7 +107,7 @@ class RPlidarNode(LifecycleNode):
             self._on_scan,
             params=self.params,
             timings=self._fsm_timings,
-            on_state_change=lambda s: self._update_diagnostics(),
+            on_state_change=self._on_fsm_state,
         )
         if self.params.filter_chain:
             self.chain = ScanFilterChain(self.params)
@@ -133,16 +133,41 @@ class RPlidarNode(LifecycleNode):
         self._update_diagnostics()
         return True
 
+    def _on_fsm_state(self, state) -> None:
+        # leaving RUNNING (deactivate, hot-unplug, RESETTING): drain the
+        # pipelined publish seam NOW — the chain (and its pending output)
+        # survives driver recreation, and an output held across a
+        # recovery gap would otherwise be published arbitrarily late
+        # into the resumed stream
+        if state is not DriverState.RUNNING:
+            self._drain_pipeline()
+        self._update_diagnostics()
+
+    def _drain_pipeline(self) -> None:
+        """Publish the pipelined seam's in-flight revolution, if any.
+
+        Must never raise: it runs inside the FSM loop's error handler
+        (leaving RUNNING on a fault), where an escaping exception —
+        e.g. the flush fetch failing on the same broken device path that
+        caused the fault — would unwind the scan thread and kill
+        recovery.  The pending output is dropped in that case."""
+        if self.chain is None or self._pipeline_meta is None:
+            return
+        meta, self._pipeline_meta = self._pipeline_meta, None
+        try:
+            out = self.chain.flush_pipelined()
+            if out is not None:
+                self._publish_chain_output(out, *meta)
+        except Exception:
+            log.warning("dropping in-flight pipelined output (drain failed)",
+                        exc_info=True)
+
     def on_deactivate(self) -> bool:
         if self.fsm:
             self.fsm.stop()
         # drain the pipelined publish seam: the last revolution's output
         # is still in flight when the scan thread stops
-        if self.chain is not None and self._pipeline_meta is not None:
-            out = self.chain.flush_pipelined()
-            meta, self._pipeline_meta = self._pipeline_meta, None
-            if out is not None:
-                self._publish_chain_output(out, *meta)
+        self._drain_pipeline()
         # preserve the rolling window across deactivate/activate — the
         # framework's checkpoint surface (SURVEY.md §5)
         if self.chain is not None:

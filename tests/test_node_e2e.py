@@ -228,6 +228,63 @@ class RaisingDriver(DummyLidarDriver):
 
 
 class TestFaultRecovery:
+    def test_pipelined_pending_drained_at_reset_not_after_recovery(self):
+        """With pipelined_publish on, the revolution in flight when the
+        device dies must be published as the FSM LEAVES RUNNING — not
+        held across the recovery backoff and published (stale by the
+        whole gap) into the resumed stream.  Discriminator: no message's
+        publish time may trail its own revolution by anywhere near the
+        reset backoff (an undrained pending would trail by at least
+        backoff + reconnect)."""
+        import time as _time
+
+        class TimestampingPublisher(CollectingPublisher):
+            def __init__(self):
+                super().__init__()
+                self.pub_times = []
+
+            def publish_scan(self, msg):
+                super().publish_scan(msg)
+                self.pub_times.append(_time.monotonic())
+
+        FlakyDriver.instances = 0
+        backoff = 0.4
+        params = DriverParams(
+            dummy_mode=True,
+            max_retries=2,
+            filter_backend="cpu",
+            filter_chain=("clip", "median", "voxel"),
+            filter_window=4,
+            voxel_grid_size=32,
+            pipelined_publish=True,
+        )
+        timings = FsmTimings.fast()
+        timings = type(timings)(**{
+            **{f: getattr(timings, f) for f in timings.__dataclass_fields__},
+            "reset_backoff_s": backoff,
+        })
+        pub = TimestampingPublisher()
+        node = RPlidarNode(
+            params, pub,
+            driver_factory=FlakyDriver,
+            fsm_timings=timings,
+        )
+        launch(node)
+        assert _wait(lambda: node.fsm.reset_count >= 1)
+        before = pub.scan_count
+        assert _wait(lambda: pub.scan_count > before + 2)
+        node.shutdown()
+        # stamps strictly increase through the reset...
+        stamps = [pub.scans[k].stamp for k in range(pub.scan_count)]
+        assert all(b > a for a, b in zip(stamps, stamps[1:])), stamps
+        # ...and every publish happened promptly relative to its own
+        # revolution — nothing crossed the recovery backoff undrained
+        ages = [
+            pub.pub_times[k] - pub.scans[k].stamp
+            for k in range(pub.scan_count)
+        ]
+        assert max(ages) < 0.5 * backoff, max(ages)
+
     def test_raising_driver_recovers_via_reset(self):
         RaisingDriver.instances = 0
         node, pub = make_node(factory=RaisingDriver)
