@@ -105,3 +105,10 @@ class LidarDriverInterface(abc.ABC):
 
     def get_device_info_str(self) -> str:
         return "[Dummy] Virtual Driver"
+
+    def rx_scheduling_class(self) -> Optional[int]:
+        """Scheduling class of the transport's rx thread (2 = SCHED_RR,
+        1 = nice boost, 0 = default, -1 = no elevation support); None for
+        drivers without an rx thread (dummy) — /diagnostics omits the
+        field then."""
+        return None

@@ -612,6 +612,20 @@ class RealLidarDriver(LidarDriverInterface):
     def get_device_info_str(self) -> str:
         return self.device_info.summary() if self.device_info else "N/A"
 
+    def rx_scheduling_class(self) -> Optional[int]:
+        """Scheduling class the rx thread achieved (2 = SCHED_RR,
+        1 = nice boost, 0 = default, -1 = transport without elevation);
+        None when disconnected.  Surfaces in /diagnostics and the bench
+        artifacts — the observable for the reference's PRIORITY_HIGH
+        contract (sl_async_transceiver.cpp:299-409).
+
+        Deliberately lock-free: the driver RLock is held across
+        multi-second connect/disconnect/reset sequences, and diagnostics
+        must never stall behind them.  One atomic attribute read; a
+        mid-teardown engine still answers its (plain-int) property."""
+        engine = self._engine
+        return engine.rx_priority if engine is not None else None
+
     def print_summary(self) -> None:
         for line in self.profile.summary_lines():
             log.info("%s", line)

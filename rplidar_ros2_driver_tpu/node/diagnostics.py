@@ -47,6 +47,7 @@ class DiagnosticsUpdater:
         rpm: int,
         device_info: str,
         latency_p99_ms: Optional[dict[str, float]] = None,
+        rx_scheduling: Optional[int] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -56,6 +57,11 @@ class DiagnosticsUpdater:
             "FSM State": fsm_state.value if fsm_state else "n/a",
             "Lifecycle": lifecycle.value,
         }
+        if rx_scheduling is not None:
+            # the reference's PRIORITY_HIGH rx/decoder contract, observable
+            values["RX Scheduling"] = {
+                2: "SCHED_RR", 1: "nice boost", 0: "default"
+            }.get(rx_scheduling, "n/a")
         # per-stage p99 latencies (utils/tracing.py) — the observability for
         # the <10 ms added-p99 publish-latency north star (BASELINE.md)
         if latency_p99_ms:

@@ -369,6 +369,8 @@ class RPlidarNode(LifecycleNode):
             p = self.tracer.percentile(stage, 99.0)
             if p > 0:
                 lat[stage] = 1e3 * p
+        driver = self.fsm.driver if self.fsm else None
+        rx_sched = driver.rx_scheduling_class() if driver is not None else None
         self.diagnostics.update(
             lifecycle=lc,
             fsm_state=fsm_state,
@@ -376,6 +378,7 @@ class RPlidarNode(LifecycleNode):
             rpm=self.params.rpm,
             device_info=self.fsm.cached_device_info if self.fsm else "",
             latency_p99_ms=lat or None,
+            rx_scheduling=rx_sched,
         )
 
     # ------------------------------------------------------------------

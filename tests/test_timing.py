@@ -238,4 +238,40 @@ class TestFrequencyAndDiag:
         node._update_diagnostics()
         d = node.diagnostics.last
         assert any(k.startswith("p99 ") for k in d.values), d.values
+        # dummy driver has no rx thread: the scheduling field is omitted
+        assert "RX Scheduling" not in d.values
         node.deactivate(); node.cleanup(); node.shutdown()
+
+    def test_diagnostics_carry_rx_scheduling_for_real_driver(self):
+        """Against the protocol sim, /diagnostics surfaces the scheduling
+        class the rx thread achieved (the observable for the reference's
+        PRIORITY_HIGH contract, sl_async_transceiver.cpp:299-409)."""
+        from rplidar_ros2_driver_tpu.core.config import DriverParams
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode, launch
+
+        sim = SimulatedDevice().start()
+        node = None
+        try:
+            node = RPlidarNode(
+                DriverParams(channel_type="tcp"),
+                driver_factory=lambda: RealLidarDriver(
+                    channel_type="tcp", tcp_host="127.0.0.1",
+                    tcp_port=sim.port, motor_warmup_s=0.0,
+                ),
+            )
+            launch(node)
+            import time as _time
+            t0 = _time.monotonic()
+            while node.publisher.scan_count < 1 and _time.monotonic() - t0 < 10:
+                _time.sleep(0.02)
+            node._update_diagnostics()
+            d = node.diagnostics.last
+            assert d.values.get("RX Scheduling") in (
+                "SCHED_RR", "nice boost", "default", "n/a"
+            ), d.values
+        finally:
+            if node is not None:
+                node.shutdown()
+            sim.stop()
