@@ -88,6 +88,7 @@ def config_from_params(
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
         median_backend=resolve_median_backend(params.median_backend, platform),
+        resample_backend=params.resample_backend,
     )
 
 

@@ -92,6 +92,11 @@ class FilterConfig:
     # tuned all-reduce, default) or "ring" (explicit ppermute
     # rotate-accumulate) — parallel/sharding.py; ignored single-device
     voxel_reduce: str = "psum"
+    # per-scan streaming-step resampler: "scatter" (jnp .at[].min) or
+    # "dense" (the fused path's tiled masked-min, grid_resample_batch
+    # with K=1 — scatter-min serializes on TPU).  Fused replay always
+    # uses the dense tile regardless.
+    resample_backend: str = "scatter"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -244,7 +249,17 @@ def _filter_step_impl(
     """
     if cfg.enable_clip:
         batch = clip_filter(batch, cfg)
-    ranges, inten = grid_resample(batch, cfg.beams)
+    if cfg.resample_backend == "dense":
+        beam, packed = _resample_keys(batch, cfg.beams)
+        ranges, inten = grid_resample_batch(beam[None], packed[None], cfg.beams)
+        ranges, inten = ranges[0], inten[0]
+    elif cfg.resample_backend == "scatter":
+        ranges, inten = grid_resample(batch, cfg.beams)
+    else:
+        raise ValueError(
+            f"resample_backend must be 'scatter' or 'dense', got "
+            f"{cfg.resample_backend!r}"
+        )
 
     rw = jax.lax.dynamic_update_index_in_dim(state.range_window, ranges, state.cursor, 0)
     iw = jax.lax.dynamic_update_index_in_dim(state.inten_window, inten, state.cursor, 0)

@@ -211,6 +211,28 @@ def test_compact_roundtrip_field_ranges():
     )
 
 
+def test_dense_step_resample_matches_scatter():
+    """The streaming step's dense-tile resampler (resample_backend=
+    "dense", the fused path's formulation at K=1) must be bit-identical
+    to the scatter-min default across a multi-step trajectory."""
+    base = dict(window=4, beams=128, grid=32, cell_m=0.5)
+    cfg_s = FilterConfig(**base)
+    cfg_d = FilterConfig(resample_backend="dense", **base)
+    s_a = FilterState.create(4, 128, 32)
+    s_b = FilterState.create(4, 128, 32)
+    for k in range(6):
+        angle, dist, qual = _raw_scan(k + 700)
+        buf = pack_host_scan_counted(angle, dist, qual, None, 1024)
+        s_a, out_a = counted_filter_step(s_a, buf, cfg_s)
+        s_b, out_b = counted_filter_step(s_b, buf, cfg_d)
+        np.testing.assert_array_equal(np.asarray(out_a.ranges), np.asarray(out_b.ranges))
+        np.testing.assert_array_equal(
+            np.asarray(out_a.intensities), np.asarray(out_b.intensities)
+        )
+        np.testing.assert_array_equal(np.asarray(out_a.voxel), np.asarray(out_b.voxel))
+    np.testing.assert_array_equal(np.asarray(s_a.voxel_acc), np.asarray(s_b.voxel_acc))
+
+
 @pytest.mark.parametrize("backend", ["xla", "pallas"])
 def test_fused_scan_matches_sequential_steps(backend):
     """compact_filter_scan (K scans, one dispatch) must reproduce the exact
