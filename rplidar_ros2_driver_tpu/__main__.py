@@ -243,12 +243,18 @@ def _cmd_doctor(args) -> int:
             got = None
             while got is None and _time.monotonic() - t0 < 10:
                 got = drv.grab_scan_host(2.0)
+            from rplidar_ros2_driver_tpu.node.diagnostics import (
+                rx_scheduling_label,
+            )
+
+            sched = rx_scheduling_label(drv.rx_scheduling_class())
             drv.stop_motor()
             drv.disconnect()
             if got is None:
                 return "FAIL", "no revolution within 10 s"
             return "PASS", (f"full protocol round-trip: {len(got[0]['angle_q14'])} "
-                            f"nodes/rev through channel->codec->decode->assembly")
+                            f"nodes/rev through channel->codec->decode->assembly; "
+                            f"rx thread at {sched}")
         finally:
             sim.stop()
 

@@ -33,6 +33,17 @@ def summarize(
     return DiagLevel.WARN, "Unknown"
 
 
+def rx_scheduling_label(code: int) -> str:
+    """Human label for a driver rx_scheduling_class code — the ONE
+    mapping, shared by /diagnostics and the doctor CLI."""
+    return {
+        2: "SCHED_RR",
+        1: "nice boost",
+        0: "default",
+        -1: "no elevation",
+    }.get(code, "n/a")
+
+
 class DiagnosticsUpdater:
     def __init__(self, hardware_id: str, publisher) -> None:
         self.hardware_id = hardware_id
@@ -59,9 +70,7 @@ class DiagnosticsUpdater:
         }
         if rx_scheduling is not None:
             # the reference's PRIORITY_HIGH rx/decoder contract, observable
-            values["RX Scheduling"] = {
-                2: "SCHED_RR", 1: "nice boost", 0: "default"
-            }.get(rx_scheduling, "n/a")
+            values["RX Scheduling"] = rx_scheduling_label(rx_scheduling)
         # per-stage p99 latencies (utils/tracing.py) — the observability for
         # the <10 ms added-p99 publish-latency north star (BASELINE.md)
         if latency_p99_ms:
