@@ -245,3 +245,15 @@ class TestAscend:
         b = make_batch([10.0, 20.0], [0.0, 0.0], n=16)
         _, ok = ascend_scan(b)
         assert not bool(ok)
+
+
+class TestBackendResolution:
+    def test_auto_resolves_per_platform(self):
+        from rplidar_ros2_driver_tpu.filters.chain import resolve_median_backend
+
+        assert resolve_median_backend("auto", "tpu") == "pallas"
+        assert resolve_median_backend("auto", "cpu") == "xla"
+        assert resolve_median_backend("auto", "gpu") == "xla"
+        # explicit choices pass through regardless of platform
+        assert resolve_median_backend("xla", "tpu") == "xla"
+        assert resolve_median_backend("pallas", "cpu") == "pallas"

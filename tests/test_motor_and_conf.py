@@ -268,3 +268,22 @@ def test_autobaud_rejected_on_non_serial():
     drv._connected = True
     assert drv.negotiate_serial_baud(256000) is None
     drv._engine.stop()
+
+
+def test_supports_conf_commands_boundaries():
+    """Table-level pin of the gate the driver tests above exercise
+    end-to-end: ND magic starts at major id 4, triangle firmware at
+    exactly 1.24 (sl_lidar_driver.cpp:1176-1196, 1467-1470)."""
+    from rplidar_ros2_driver_tpu.models.tables import (
+        DeviceInfo,
+        supports_conf_commands,
+    )
+
+    assert supports_conf_commands(DeviceInfo(model=0x40, firmware_version=0))
+    assert not supports_conf_commands(DeviceInfo(model=0x3F, firmware_version=0))
+    assert supports_conf_commands(
+        DeviceInfo(model=0x18, firmware_version=(1 << 8) | 24)
+    )
+    assert not supports_conf_commands(
+        DeviceInfo(model=0x18, firmware_version=(1 << 8) | 23)
+    )
