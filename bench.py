@@ -305,8 +305,10 @@ def _e2e_phase(chain, rate_mult: float, seconds: float, timer, label: str) -> in
     completed measurement and carries revolution N-1's output (one
     revolution of declared staleness), so the added latency of a publish
     is t_publish_done - rev_end(N) — decode + assembly wake + pack +
-    upload + dispatch enqueue + collecting N-1's (already host-side,
-    copy_to_host_async'd a revolution ago) output."""
+    collecting N-1's (already host-side, copy_to_host_async'd a
+    revolution ago) output + N's upload and dispatch enqueue (the seam
+    collects BEFORE dispatching, but the node-path publish happens after
+    the whole call returns, so both orderings are inside the anchor)."""
     from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
     from rplidar_ros2_driver_tpu.driver.sim_device import SimConfig, SimulatedDevice
 
@@ -450,6 +452,7 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
         # headline latency: directly measured per-publish distribution
         # (fetch included; staleness = one declared revolution)
         "publish_p99_ms": round(pub_p99, 3),
+        "publish_p90_ms": round(timer.percentile("idle_publish", 90) * 1e3, 3),
         "publish_p50_ms": round(timer.percentile("idle_publish", 50) * 1e3, 3),
         "grab_to_publish_p99_ms": round(timer.percentile("idle_grab", 99) * 1e3, 3),
         "staleness_revolutions": 1,
@@ -460,6 +463,7 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
             "rx_priority": timer.meta["loaded"]["rx_priority"],
             "published_per_sec": round(loaded_published / loaded_seconds, 2),
             "publish_p99_ms": round(timer.percentile("loaded_publish", 99) * 1e3, 3),
+            "publish_p90_ms": round(timer.percentile("loaded_publish", 90) * 1e3, 3),
             "publish_p50_ms": round(timer.percentile("loaded_publish", 50) * 1e3, 3),
             "grab_to_publish_p99_ms": round(
                 timer.percentile("loaded_grab", 99) * 1e3, 3

@@ -220,21 +220,43 @@ class ScanFilterChain:
         bytes are host-side and the publish pays neither device compute
         nor a blocking transfer round-trip (through a remote-attached
         device the blocking-fetch RTT alone can exceed the whole latency
-        budget; the async copy buys it back).  Returns None on the first
-        call after a start/reset (nothing pending);
-        :meth:`flush_pipelined` drains the final pending output when the
-        stream stops.
+        budget; the async copy buys it back).  The pending output is
+        collected BEFORE this revolution's upload/dispatch: publishing
+        N-1 needs nothing from N, and issuing fresh host->device traffic
+        first would race the landing D2H bytes on a single-channel
+        remote link.  Returns None on the first call after a start/reset
+        (nothing pending); :meth:`flush_pipelined` drains the final
+        pending output when the stream stops.
         """
         buf = self._pack_capped(angle_q14, dist_q2, quality, flag)
-        packed = jax.device_put(buf, self.device)
+        # not flush_pipelined(): the wire handle must stay reachable so a
+        # failed upload/dispatch below can re-stash it for the drain
         with self._lock:
-            self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
-            try:
-                wire.copy_to_host_async()
-            except Exception:
-                pass  # backend without async D2H: the later fetch blocks
-            pending, self._pending_wire = self._pending_wire, wire
-        return unpack_output_wire(pending, self.cfg) if pending is not None else None
+            pending, self._pending_wire = self._pending_wire, None
+        out = (
+            unpack_output_wire(pending, self.cfg) if pending is not None else None
+        )
+        try:
+            packed = jax.device_put(buf, self.device)
+            with self._lock:
+                self._state, wire = counted_filter_step_wire(
+                    self._state, packed, self.cfg
+                )
+                try:
+                    wire.copy_to_host_async()
+                except Exception:
+                    pass  # backend without async D2H: the later fetch blocks
+                self._pending_wire = wire
+        except Exception:
+            # upload/dispatch of N failed AFTER N-1 was popped: re-stash
+            # the wire so the caller's drain (flush_pipelined) can still
+            # publish N-1 instead of silently losing it
+            if pending is not None:
+                with self._lock:
+                    if self._pending_wire is None:
+                        self._pending_wire = pending
+            raise
+        return out
 
     def flush_pipelined(self) -> Optional[FilterOutput]:
         """Fetch the last dispatched step's output (the one revolution

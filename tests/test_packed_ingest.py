@@ -116,6 +116,39 @@ def test_chain_capacity_truncates_oversized_revolution():
     assert chain.process_raw_pipelined(angle, dist, qual) is None
 
 
+def test_chain_pipelined_dispatch_failure_keeps_pending(monkeypatch):
+    """If revolution N's upload/dispatch fails after N-1 was popped, the
+    pending wire must be re-stashed so the drain can still publish N-1
+    (a transient link fault must not silently lose a revolution)."""
+    import rplidar_ros2_driver_tpu.filters.chain as chain_mod
+
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    chain = ScanFilterChain(params, beams=128)
+    ref = ScanFilterChain(params, beams=128)
+    a1, d1, q1 = _raw_scan(400)
+    assert chain.process_raw_pipelined(a1, d1, q1) is None
+    ref_out = ref.process_raw(a1, d1, q1)
+
+    def boom(*a, **k):
+        raise RuntimeError("link died")
+
+    monkeypatch.setattr(chain_mod, "counted_filter_step_wire", boom)
+    a2, d2, q2 = _raw_scan(401)
+    with pytest.raises(RuntimeError):
+        chain.process_raw_pipelined(a2, d2, q2)
+    monkeypatch.undo()
+    tail = chain.flush_pipelined()
+    assert tail is not None
+    np.testing.assert_array_equal(
+        np.asarray(tail.ranges), np.asarray(ref_out.ranges)
+    )
+
+
 def test_chain_pipelined_reset_drops_pending():
     """A reset/restore must clear the in-flight output: pre-reset data
     must never be published into the post-reset stream."""
