@@ -92,6 +92,11 @@ class ShardedFilterService:
         # collectives across hosts (see save_sharded's docstring).
         self._lock = threading.Lock()
         self._state = create_sharded_state(self.mesh, self.cfg, streams)
+        # (FilterOutput, live-mask) of the newest dispatched tick not yet
+        # collected (submit_pipelined); _epoch advances on every restore/
+        # load so a failed tick cannot re-stash pre-restore outputs
+        self._pending = None
+        self._epoch = 0
 
     # -- ingest -------------------------------------------------------------
 
@@ -174,16 +179,22 @@ class ShardedFilterService:
         packed = jax.device_put(packed_np, self._packed_sharding)
         with self._lock:
             self._state, out = self._step(self._state, packed)
-        # one fetch per array (already stream-batched: 5 fetches per TICK,
-        # amortized over all streams)
+        return self._materialize(out, [s is not None for s in scans])
+
+    def _materialize(
+        self, out: FilterOutput, live: Sequence[bool]
+    ) -> list[Optional[FilterOutput]]:
+        """Fetch one tick's stream-batched outputs to host numpy — one
+        fetch per array (5 per TICK, amortized over all streams) — and
+        split into per-stream FilterOutputs (None for idle streams)."""
         ranges = np.asarray(out.ranges)
         inten = np.asarray(out.intensities)
         xy = np.asarray(out.points_xy)
         mask = np.asarray(out.point_mask)
         voxel = np.asarray(out.voxel)
         results: list[Optional[FilterOutput]] = []
-        for i, scan in enumerate(scans):
-            if scan is None:
+        for i, is_live in enumerate(live):
+            if not is_live:
                 results.append(None)
                 continue
             results.append(
@@ -196,6 +207,58 @@ class ShardedFilterService:
                 )
             )
         return results
+
+    def submit_pipelined(
+        self, scans: Sequence[Optional[dict]]
+    ) -> list[Optional[FilterOutput]]:
+        """Fleet analog of ScanFilterChain.process_raw_pipelined: dispatch
+        THIS tick's step, return the PREVIOUS tick's per-stream outputs —
+        one tick of declared staleness in exchange for a publish that
+        never waits on device compute, with the previous outputs'
+        device->host copies started at their own dispatch time
+        (``copy_to_host_async``).  The previous tick is collected BEFORE
+        this tick's upload so fresh host->device traffic cannot race the
+        landing bytes on a single-channel remote link.  Returns all-None
+        on the first tick; :meth:`flush_pipelined` drains the last tick
+        when the fleet stops.  Single-controller only (the outputs must
+        be globally addressable, like :meth:`submit`).
+        """
+        if len(scans) != self.streams:
+            raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
+        packed_np = self._stack(scans)
+        with self._lock:
+            pending, self._pending = self._pending, None
+            epoch = self._epoch
+        prev = self._materialize(*pending) if pending is not None else None
+        try:
+            packed = jax.device_put(packed_np, self._packed_sharding)
+            with self._lock:
+                self._state, out = self._step(self._state, packed)
+                for arr in (out.ranges, out.intensities, out.points_xy,
+                            out.point_mask, out.voxel):
+                    try:
+                        arr.copy_to_host_async()
+                    except Exception:
+                        pass  # backend without async D2H: the fetch blocks
+                self._pending = (out, [s is not None for s in scans])
+        except Exception:
+            # this tick's upload/dispatch failed after the previous tick
+            # was popped: re-stash it so flush_pipelined can still drain
+            # it — unless a restore/load happened meanwhile (epoch moved),
+            # in which case pre-restore outputs must stay dropped
+            if pending is not None:
+                with self._lock:
+                    if self._pending is None and self._epoch == epoch:
+                        self._pending = pending
+            raise
+        return prev if prev is not None else [None] * self.streams
+
+    def flush_pipelined(self) -> Optional[list[Optional[FilterOutput]]]:
+        """Collect the last dispatched tick's outputs (the ones still in
+        flight when the fleet stops), or None."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        return self._materialize(*pending) if pending is not None else None
 
     def submit_local(
         self, local_scans: Sequence[Optional[dict]]
@@ -270,26 +333,15 @@ class ShardedFilterService:
                 )
             return buf
 
-        ranges = local_rows(out.ranges)
-        inten = local_rows(out.intensities)
-        xy = local_rows(out.points_xy)
-        mask = local_rows(out.point_mask)
-        voxel = local_rows(out.voxel)
-        results: list[Optional[FilterOutput]] = []
-        for i, scan in enumerate(local_scans):
-            if scan is None:
-                results.append(None)
-                continue
-            results.append(
-                FilterOutput(
-                    ranges=ranges[i],
-                    intensities=inten[i],
-                    points_xy=xy[i],
-                    point_mask=mask[i],
-                    voxel=voxel[i],
-                )
-            )
-        return results
+        local_out = FilterOutput(
+            ranges=local_rows(out.ranges),
+            intensities=local_rows(out.intensities),
+            points_xy=local_rows(out.points_xy),
+            point_mask=local_rows(out.point_mask),
+            voxel=local_rows(out.voxel),
+        )
+        # np.asarray inside _materialize is a no-op on these host arrays
+        return self._materialize(local_out, [s is not None for s in local_scans])
 
     # -- checkpoint surface (mirrors ScanFilterChain's) ---------------------
 
@@ -336,6 +388,8 @@ class ShardedFilterService:
             return False
         with self._lock:
             self._state = got
+            self._pending = None  # pre-restore outputs: never publish
+            self._epoch += 1
         return True
 
     def restore(self, snap: Optional[dict[str, np.ndarray]]) -> bool:
@@ -360,8 +414,12 @@ class ShardedFilterService:
             restored = place_state(self.mesh, FilterState(**snap))
             with self._lock:
                 self._state = restored
+                self._pending = None
+                self._epoch += 1
             return True
         fresh = create_sharded_state(self.mesh, self.cfg, self.streams)
         with self._lock:
             self._state = fresh
+            self._pending = None
+            self._epoch += 1
         return False

@@ -104,6 +104,56 @@ class TestService:
         other = ShardedFilterService(_params(filter_window=8), streams=2, mesh=mesh, beams=128)
         assert not other.restore(snap)
 
+    def test_submit_pipelined_is_submit_shifted_by_one_tick(self, mesh):
+        """The pipelined fleet tick returns exactly submit's outputs
+        delayed by one tick (all-None first), flush_pipelined drains the
+        final tick, and idle-stream None slots follow each tick's OWN
+        live mask."""
+        svc_p = ShardedFilterService(_params(), streams=4, mesh=None, beams=128)
+        svc_s = ShardedFilterService(_params(), streams=4, mesh=None, beams=128)
+        # mesh=None default also exercises the service's own mesh pick
+        ticks = [
+            [_scan(1), None, _scan(3), _scan(4)],
+            [None, _scan(5), _scan(6), None],
+            [_scan(7), _scan(8), None, _scan(9)],
+        ]
+        outs_s = [svc_s.submit(t) for t in ticks]
+        outs_p = [svc_p.submit_pipelined(t) for t in ticks]
+        assert outs_p[0] == [None, None, None, None]
+        for k in range(1, len(ticks)):
+            for a, b in zip(outs_p[k], outs_s[k - 1]):
+                assert (a is None) == (b is None)
+                if a is not None:
+                    np.testing.assert_array_equal(a.ranges, b.ranges)
+                    np.testing.assert_array_equal(a.voxel, b.voxel)
+        tail = svc_p.flush_pipelined()
+        for a, b in zip(tail, outs_s[-1]):
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_array_equal(a.ranges, b.ranges)
+        assert svc_p.flush_pipelined() is None
+
+    def test_submit_pipelined_restore_clears_pending(self, mesh):
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit_pipelined([_scan(1), _scan(2)])
+        svc.restore(None)
+        assert svc.flush_pipelined() is None
+
+    def test_submit_pipelined_dispatch_failure_keeps_pending(self, mesh):
+        """A failed tick dispatch after the previous tick was popped must
+        re-stash it so the drain can still publish it."""
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        ref = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit_pipelined([_scan(1), _scan(2)])
+        ref_out = ref.submit([_scan(1), _scan(2)])
+        step, svc._step = svc._step, None  # next tick: TypeError
+        with pytest.raises(TypeError):
+            svc.submit_pipelined([_scan(3), _scan(4)])
+        svc._step = step
+        tail = svc.flush_pipelined()
+        assert tail is not None
+        np.testing.assert_array_equal(tail[0].ranges, ref_out[0].ranges)
+
     def test_submit_local_truncates_oversized_scan(self, mesh):
         """An oversized scan must not raise out of submit_local — a
         per-process ValueError before the collective would hang every
