@@ -62,10 +62,18 @@ def main() -> int:
                 scans.append(got[0] if got else None)
                 if got:
                     captures[s].append(got[0])
-            outs = svc.submit(scans)
+            # pipelined fleet tick: collect the PREVIOUS tick's outputs
+            # while this tick computes (one tick of declared staleness —
+            # the publish never waits on device compute)
+            outs = svc.submit_pipelined(scans)
             live = sum(o is not None for o in outs)
             occ = [int(np.asarray(o.voxel).sum()) if o else 0 for o in outs]
-            print(f"tick {tick}: {live}/{args.streams} streams, voxel occ {occ}")
+            print(f"tick {tick}: {live}/{args.streams} streams (prev tick), "
+                  f"voxel occ {occ}")
+        tail = svc.flush_pipelined()
+        if tail is not None:
+            live = sum(o is not None for o in tail)
+            print(f"drained final tick: {live}/{args.streams} streams")
 
         # the same revolutions again, offline: fused fleet replay over the
         # service's mesh — one dispatch per chunk for the whole fleet
