@@ -131,8 +131,11 @@ class ScanFilterChain:
             self.device,
         )
         # double-buffered publish seam: the not-yet-fetched wire output of
-        # the newest dispatched step (process_raw_pipelined)
+        # the newest dispatched step (process_raw_pipelined); _epoch
+        # advances on restore/reset so a failed dispatch cannot re-stash
+        # a pre-restore output
         self._pending_wire: Optional[jax.Array] = None
+        self._epoch = 0
         if warmup:
             self.precompile()
 
@@ -233,6 +236,7 @@ class ScanFilterChain:
         # failed upload/dispatch below can re-stash it for the drain
         with self._lock:
             pending, self._pending_wire = self._pending_wire, None
+            epoch = self._epoch
         out = (
             unpack_output_wire(pending, self.cfg) if pending is not None else None
         )
@@ -250,12 +254,19 @@ class ScanFilterChain:
         except Exception:
             # upload/dispatch of N failed AFTER N-1 was popped: re-stash
             # the wire so the caller's drain (flush_pipelined) can still
-            # publish N-1 instead of silently losing it
+            # publish N-1 instead of silently losing it — unless a
+            # restore/reset moved the epoch meanwhile (pre-restore
+            # outputs must stay dropped)
             if pending is not None:
                 with self._lock:
-                    if self._pending_wire is None:
+                    if self._pending_wire is None and self._epoch == epoch:
                         self._pending_wire = pending
             raise
+        with self._lock:
+            if self._epoch != epoch:
+                # a restore/reset raced in after the pop: the popped
+                # output is pre-restore and must not be published
+                out = None
         return out
 
     def flush_pipelined(self) -> Optional[FilterOutput]:
@@ -341,11 +352,13 @@ class ScanFilterChain:
             with self._lock:
                 self._state = fresh
                 self._pending_wire = None  # pre-reset output: never publish
+                self._epoch += 1
             return False
         restored = jax.device_put(FilterState(**snap), self.device)
         with self._lock:
             self._state = restored
             self._pending_wire = None
+            self._epoch += 1
         return True
 
     def reset(self) -> None:

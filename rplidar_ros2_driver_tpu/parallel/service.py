@@ -251,6 +251,11 @@ class ShardedFilterService:
                     if self._pending is None and self._epoch == epoch:
                         self._pending = pending
             raise
+        with self._lock:
+            if self._epoch != epoch:
+                # a restore/load raced in after the pop: the popped tick
+                # is pre-restore and must not be published
+                prev = None
         return prev if prev is not None else [None] * self.streams
 
     def flush_pipelined(self) -> Optional[list[Optional[FilterOutput]]]:
