@@ -409,6 +409,8 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
     finally:
         for p in load_procs:
             p.kill()
+        for p in load_procs:
+            p.wait()  # reap — kill() alone leaves a zombie per CPU
 
     # sustained device compute per scan, measured inside ONE dispatch so
     # the tunnel's per-dispatch RPC (drifts ms-scale on this rig) does

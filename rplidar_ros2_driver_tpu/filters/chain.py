@@ -237,9 +237,17 @@ class ScanFilterChain:
         with self._lock:
             pending, self._pending_wire = self._pending_wire, None
             epoch = self._epoch
-        out = (
-            unpack_output_wire(pending, self.cfg) if pending is not None else None
-        )
+        out = None
+        if pending is not None:
+            try:
+                out = unpack_output_wire(pending, self.cfg)
+            except Exception:
+                # the device->host fetch of N-1 itself failed (same
+                # transient-link fault class as the dispatch path below):
+                # re-stash the wire so flush_pipelined can retry the
+                # fetch, instead of losing the revolution
+                self._restash_pending(pending, epoch)
+                raise
         try:
             packed = jax.device_put(buf, self.device)
             with self._lock:
@@ -254,13 +262,9 @@ class ScanFilterChain:
         except Exception:
             # upload/dispatch of N failed AFTER N-1 was popped: re-stash
             # the wire so the caller's drain (flush_pipelined) can still
-            # publish N-1 instead of silently losing it — unless a
-            # restore/reset moved the epoch meanwhile (pre-restore
-            # outputs must stay dropped)
+            # publish N-1 instead of silently losing it
             if pending is not None:
-                with self._lock:
-                    if self._pending_wire is None and self._epoch == epoch:
-                        self._pending_wire = pending
+                self._restash_pending(pending, epoch)
             raise
         with self._lock:
             if self._epoch != epoch:
@@ -268,6 +272,14 @@ class ScanFilterChain:
                 # output is pre-restore and must not be published
                 out = None
         return out
+
+    def _restash_pending(self, pending, epoch: int) -> None:
+        """Put a popped-but-unpublished wire back for the drain — unless a
+        restore/reset moved the epoch meanwhile (pre-restore outputs must
+        stay dropped) or a newer dispatch already stashed its own."""
+        with self._lock:
+            if self._pending_wire is None and self._epoch == epoch:
+                self._pending_wire = pending
 
     def flush_pipelined(self) -> Optional[FilterOutput]:
         """Fetch the last dispatched step's output (the one revolution

@@ -271,6 +271,13 @@ class RPlidarNode(LifecycleNode):
                         return  # first revolution of the stream: nothing pending
                     start_time, duration, max_range = meta
                 else:
+                    if self._pipeline_meta is not None:
+                        # pipelined_publish was toggled off mid-stream:
+                        # the in-flight revolution would otherwise sit
+                        # pending until the next FSM transition and then
+                        # publish arbitrarily late — drain it now, in
+                        # order, before this revolution's blocking step
+                        self._drain_pipeline()
                     out = self.chain.process_raw(
                         scan["angle_q14"], scan["dist_q2"], scan["quality"],
                         scan.get("flag"),

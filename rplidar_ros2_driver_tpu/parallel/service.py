@@ -229,7 +229,17 @@ class ShardedFilterService:
         with self._lock:
             pending, self._pending = self._pending, None
             epoch = self._epoch
-        prev = self._materialize(*pending) if pending is not None else None
+        prev = None
+        if pending is not None:
+            try:
+                prev = self._materialize(*pending)
+            except Exception:
+                # the device->host fetch of the previous tick itself
+                # failed (same transient-link fault class as the dispatch
+                # path below): re-stash it so flush_pipelined can retry
+                # instead of losing the tick
+                self._restash_pending(pending, epoch)
+                raise
         try:
             packed = jax.device_put(packed_np, self._packed_sharding)
             with self._lock:
@@ -243,13 +253,9 @@ class ShardedFilterService:
                 self._pending = (out, [s is not None for s in scans])
         except Exception:
             # this tick's upload/dispatch failed after the previous tick
-            # was popped: re-stash it so flush_pipelined can still drain
-            # it — unless a restore/load happened meanwhile (epoch moved),
-            # in which case pre-restore outputs must stay dropped
+            # was popped: re-stash it so flush_pipelined can still drain it
             if pending is not None:
-                with self._lock:
-                    if self._pending is None and self._epoch == epoch:
-                        self._pending = pending
+                self._restash_pending(pending, epoch)
             raise
         with self._lock:
             if self._epoch != epoch:
@@ -257,6 +263,14 @@ class ShardedFilterService:
                 # is pre-restore and must not be published
                 prev = None
         return prev if prev is not None else [None] * self.streams
+
+    def _restash_pending(self, pending, epoch: int) -> None:
+        """Put a popped-but-unpublished tick back for the drain — unless a
+        restore/load moved the epoch meanwhile (pre-restore outputs must
+        stay dropped) or a newer dispatch already stashed its own."""
+        with self._lock:
+            if self._pending is None and self._epoch == epoch:
+                self._pending = pending
 
     def flush_pipelined(self) -> Optional[list[Optional[FilterOutput]]]:
         """Collect the last dispatched tick's outputs (the ones still in

@@ -162,6 +162,48 @@ class TestScanContent:
         ])
         assert age_p - age_s > 0.5 * period, (age_p, age_s, period)
 
+    def test_pipeline_drained_when_toggled_off_midstream(self):
+        """Flipping pipelined_publish off mid-stream must drain the
+        in-flight revolution immediately and in order — not hold it until
+        the next FSM transition and publish it arbitrarily late (advisor
+        round-3 finding).  Discriminator: the toggled run's message
+        sequence stays gap-free and identical to an all-synchronous run's
+        (the dummy's phase is deterministic per revolution)."""
+        chain_kw = dict(
+            dummy_mode=True,
+            filter_backend="cpu",
+            filter_chain=("clip", "median", "voxel"),
+            filter_window=4,
+            voxel_grid_size=32,
+        )
+
+        def run(params, toggle_off_at=None):
+            pub = CollectingPublisher()
+            node = RPlidarNode(
+                params, pub,
+                driver_factory=lambda: DummyLidarDriver(scan_rate_hz=50.0),
+                fsm_timings=FsmTimings.fast(),
+            )
+            launch(node)
+            if toggle_off_at is not None:
+                assert _wait(lambda: pub.scan_count >= toggle_off_at)
+                params.pipelined_publish = False
+            assert _wait(lambda: pub.scan_count >= 8)
+            node.deactivate()
+            node.shutdown()
+            return pub
+
+        pub_t = run(
+            DriverParams(pipelined_publish=True, **chain_kw), toggle_off_at=3
+        )
+        pub_s = run(DriverParams(**chain_kw))
+        n = min(pub_t.scan_count, pub_s.scan_count)
+        assert n >= 8
+        for k in range(n):
+            np.testing.assert_array_equal(
+                pub_t.scans[k].ranges, pub_s.scans[k].ranges
+            )
+
 
 class FlakyDriver(DummyLidarDriver):
     """Fault-injecting fake: healthy scans, then grab failures, then

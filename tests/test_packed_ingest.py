@@ -149,6 +149,39 @@ def test_chain_pipelined_dispatch_failure_keeps_pending(monkeypatch):
     )
 
 
+def test_chain_pipelined_fetch_failure_keeps_pending(monkeypatch):
+    """If the device->host fetch of N-1 itself fails (the same transient
+    link fault class as a dispatch failure), the pending wire must be
+    re-stashed so a later drain can retry the fetch — not dropped."""
+    import rplidar_ros2_driver_tpu.filters.chain as chain_mod
+
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_window=4,
+        filter_chain=("clip", "median", "voxel"),
+        voxel_grid_size=32,
+    )
+    chain = ScanFilterChain(params, beams=128)
+    ref = ScanFilterChain(params, beams=128)
+    a1, d1, q1 = _raw_scan(410)
+    assert chain.process_raw_pipelined(a1, d1, q1) is None
+    ref_out = ref.process_raw(a1, d1, q1)
+
+    def boom(*a, **k):
+        raise RuntimeError("fetch died")
+
+    monkeypatch.setattr(chain_mod, "unpack_output_wire", boom)
+    a2, d2, q2 = _raw_scan(411)
+    with pytest.raises(RuntimeError):
+        chain.process_raw_pipelined(a2, d2, q2)
+    monkeypatch.undo()
+    tail = chain.flush_pipelined()
+    assert tail is not None
+    np.testing.assert_array_equal(
+        np.asarray(tail.ranges), np.asarray(ref_out.ranges)
+    )
+
+
 def test_chain_pipelined_reset_drops_pending():
     """A reset/restore must clear the in-flight output: pre-reset data
     must never be published into the post-reset stream."""

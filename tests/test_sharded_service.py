@@ -154,6 +154,27 @@ class TestService:
         assert tail is not None
         np.testing.assert_array_equal(tail[0].ranges, ref_out[0].ranges)
 
+    def test_submit_pipelined_fetch_failure_keeps_pending(self, mesh):
+        """If the device->host materialize of the previous tick itself
+        fails, the pending tick must be re-stashed so the drain can retry
+        the fetch — not dropped."""
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        ref = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit_pipelined([_scan(1), _scan(2)])
+        ref_out = ref.submit([_scan(1), _scan(2)])
+
+        def boom(*a, **k):
+            raise RuntimeError("fetch died")
+
+        materialize = svc._materialize
+        svc._materialize = boom
+        with pytest.raises(RuntimeError):
+            svc.submit_pipelined([_scan(3), _scan(4)])
+        svc._materialize = materialize
+        tail = svc.flush_pipelined()
+        assert tail is not None
+        np.testing.assert_array_equal(tail[0].ranges, ref_out[0].ranges)
+
     def test_submit_local_truncates_oversized_scan(self, mesh):
         """An oversized scan must not raise out of submit_local — a
         per-process ValueError before the collective would hang every
