@@ -630,21 +630,22 @@ def metric_name(config: int) -> str:
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
-def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
+def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
+    """Run one graded config and return its artifact dict (the caller
+    prints it as the ONE JSON line and maintains the last-good sidecar)."""
     kind, points, over = GRADED[config]
     if kind == "passthrough":
-        print(json.dumps(bench_passthrough(points)))
-        return
+        return bench_passthrough(points)
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
         fn = {"e2e": bench_e2e, "fused": bench_fused, "fleet": bench_fleet}[kind]
-        print(json.dumps(fn()))
-        return
+        return fn()
     cfg = FilterConfig(
         beams=BEAMS, grid=GRID, cell_m=0.25, median_backend=median, **over
     )
-    if config == 5 and cfg.enable_median:
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if config == 5 and cfg.enable_median and not on_cpu:
         # HEADLINE (re-anchored, r2 VERDICT #2): the device-resident
         # in-jit streaming rate — the number a locally-attached chip
         # sustains, independent of the remote-attach tunnel whose
@@ -693,6 +694,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
         sync_p99_ms = runners[median].measure_sync_p99()
         link_put_ms = runners[median].measure_link_put_ms()
     else:
+        # on CPU the A/B is meaningless (pallas runs in interpret mode),
+        # so the device_unavailable fallback path lands here too
         scans_per_sec, sync_p99_ms = _run_chain(cfg, points)
         ab = link_put_ms = streaming = None
 
@@ -713,11 +716,70 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> None:
         result["median_ab"] = ab
         result["streaming_scans_per_sec_link_bound"] = round(streaming, 2)
         result["link_put_ms"] = round(link_put_ms, 3)
-    print(json.dumps(result))
+    return result
+
+
+LAST_GOOD_PATH = "LAST_GOOD_DEVICE.json"
+
+
+def _load_last_good() -> dict:
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), LAST_GOOD_PATH)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _record_last_good(result: dict) -> None:
+    """After a successful on-device run, remember the headline so a later
+    outage can report 'last good + when' instead of zeroing the series."""
+    import datetime
+    import os
+
+    if result.get("device") in (None, "cpu") or not result.get("value"):
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), LAST_GOOD_PATH)
+    data = _load_last_good()
+    data[result["metric"]] = {
+        "value": result["value"],
+        "unit": result.get("unit", "scans/s"),
+        "date": datetime.date.today().isoformat(),
+        "device": result["device"],
+        "measurement": result.get("measurement", "streaming"),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _fallback_artifact(config: int, probe_error: str) -> dict:
+    """The outage artifact (r3 VERDICT #1): the device is unreachable, so
+    record that EXPLICITLY — plus the CPU-computable number for this
+    config and the last committed on-device headline with its date —
+    instead of a 0.0 that reads as a framework regression."""
+    jax.config.update("jax_platforms", "cpu")
+    result = main(config, "xla")  # pallas would run in interpret mode
+    result["device_unavailable"] = True
+    result["probe_error"] = probe_error
+    last = _load_last_good()
+    mine = last.get(metric_name(config))
+    if mine is not None:
+        result["last_good_device"] = mine
+    headline = last.get(metric_name(5))
+    if headline is not None and headline is not mine:
+        result["last_good_headline"] = headline
+    return result
 
 
 if __name__ == "__main__":
     import argparse
+    import os
+    import sys
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -744,26 +806,70 @@ if __name__ == "__main__":
     )
     args = ap.parse_args()
 
-    # Backend-init watchdog: a dead remote-attach tunnel makes
-    # jax.devices() block forever — probe with the shared hang guard so a
-    # broken link yields ONE honest JSON line instead of a silent hang.
-    from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
+    # Backend-init watchdog with retry (r3 VERDICT #1): a dead
+    # remote-attach tunnel makes jax.devices() block forever, and a
+    # single timed-out probe once zeroed a whole round's artifact.  Probe
+    # in throwaway subprocesses with backoff; only after the budget is
+    # spent fall back to a structured device_unavailable artifact that
+    # still carries a CPU-computed number and the last good on-device
+    # headline — the series must never read 0.0 for an unchanged
+    # framework.  Progress goes to stderr (stdout is the ONE JSON line).
+    import subprocess
 
-    _ok, _detail = probe_jax_backend(240.0)
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        probe_jax_backend,
+        probe_jax_backend_with_retry,
+    )
+
+    per_probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
+    if os.environ.get("BENCH_FORCE_PROBE_FAIL"):
+        # test hook AND the poisoned-parent re-exec below: this process's
+        # backend was never dialed, so the CPU fallback is safe in-process
+        _detail = os.environ.get(
+            "BENCH_PROBE_ERROR", "forced by BENCH_FORCE_PROBE_FAIL"
+        )
+        print(json.dumps(_fallback_artifact(args.config, _detail)))
+        raise SystemExit(0)
+
+    _ok, _detail = probe_jax_backend_with_retry(
+        total_budget_s=float(os.environ.get("BENCH_PROBE_BUDGET_S", 1200)),
+        per_probe_s=per_probe_s,
+        interval_s=float(os.environ.get("BENCH_PROBE_INTERVAL_S", 120)),
+        log=lambda msg: print(msg, file=sys.stderr, flush=True),
+    )
+    poisoned = False
+    if _ok:
+        # the subprocess probe only proved the link was up moments ago —
+        # THIS process's init is the one that matters, and the tunnel can
+        # wedge in the window between the probe's exit and this init.
+        # Run it under the in-process hang guard (costs a second tunnel
+        # init on healthy runs; a silent infinite hang costs the round).
+        _ok, _detail = probe_jax_backend(per_probe_s)
+        poisoned = not _ok
     if not _ok:
-        print(json.dumps({
-            "metric": metric_name(args.config),
-            "value": 0.0,
-            "unit": "scans/s",
-            "vs_baseline": 0.0,
-            "error": _detail,
-        }))
-        raise SystemExit(3)
+        if poisoned:
+            # the hung init holds this process's backend for good (the
+            # daemon probe thread is stuck inside it), so even the CPU
+            # fallback would block here — compute it in a fresh process
+            env = dict(os.environ, BENCH_FORCE_PROBE_FAIL="1",
+                       BENCH_PROBE_ERROR=_detail)
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", str(args.config)],
+                env=env, capture_output=True, text=True,
+            )
+            sys.stderr.write(r.stderr)
+            sys.stdout.write(r.stdout)
+            raise SystemExit(r.returncode)
+        print(json.dumps(_fallback_artifact(args.config, _detail)))
+        raise SystemExit(0)
 
     if args.profile:
         from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
 
         with profile_trace(args.profile):
-            main(args.config, args.median)
+            result = main(args.config, args.median)
     else:
-        main(args.config, args.median)
+        result = main(args.config, args.median)
+    _record_last_good(result)
+    print(json.dumps(result))

@@ -9,7 +9,10 @@ and the ``doctor`` CLI both use this single implementation.
 
 from __future__ import annotations
 
+import subprocess
+import sys
 import threading
+import time
 
 
 def probe_jax_backend(timeout_s: float) -> tuple[bool, str]:
@@ -37,3 +40,63 @@ def probe_jax_backend(timeout_s: float) -> tuple[bool, str]:
     if "err" in out:
         return False, out["err"]
     return True, ", ".join(str(d) for d in out["devices"])
+
+
+def probe_jax_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
+    """Like :func:`probe_jax_backend`, but in a THROWAWAY subprocess.
+
+    Backend init is once-per-process: after an in-process probe hangs,
+    every later ``jax.devices()`` in the same process blocks on the same
+    wedged init, so an in-process probe can never be retried.  A
+    subprocess probe leaves this process's backend untouched until a
+    probe has actually succeeded — and the remote link serves one client
+    at a time, so the probe must fully exit (``subprocess.run`` waits)
+    before the caller initializes its own backend.
+    """
+    code = "import jax; print(', '.join(str(d) for d in jax.devices()))"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, (f"jax backend init timed out after {timeout_s:.0f} s "
+                       "(remote-attach tunnel unreachable)")
+    if r.returncode != 0:
+        tail = (r.stderr.strip().splitlines() or ["backend init failed"])[-1]
+        return False, tail
+    return True, r.stdout.strip()
+
+
+def probe_jax_backend_with_retry(
+    total_budget_s: float = 1200.0,
+    per_probe_s: float = 240.0,
+    interval_s: float = 120.0,
+    log=None,
+    _probe=probe_jax_backend_subprocess,
+) -> tuple[bool, str]:
+    """Probe with retry/backoff: a transient link outage (relay restart,
+    tunnel hiccup) should cost minutes, not a round's artifact.
+
+    Probes in subprocesses every ``interval_s`` for up to
+    ``total_budget_s`` before giving up; returns the first success or
+    (False, last-error) once the budget is spent.  ``log`` (if given)
+    receives one progress line per failed attempt — callers whose stdout
+    is a machine-read artifact should pass a stderr writer.
+    """
+    deadline = time.monotonic() + total_budget_s
+    attempt = 0
+    detail = "no probe attempted"
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        ok, detail = _probe(min(per_probe_s, max(remaining, 10.0)))
+        if ok:
+            return True, detail
+        if log is not None:
+            log(f"backend probe {attempt} failed ({detail}); "
+                f"{max(deadline - time.monotonic(), 0):.0f} s of budget left")
+        if time.monotonic() + interval_s >= deadline:
+            return False, (f"backend unreachable after {attempt} probes "
+                           f"over {total_budget_s:.0f} s: {detail}")
+        time.sleep(interval_s)
