@@ -749,6 +749,11 @@ def _record_last_good(result: dict) -> None:
         "date": datetime.date.today().isoformat(),
         "device": result["device"],
         "measurement": result.get("measurement", "streaming"),
+        # self-describing: an --median xla A/B run overwrites the entry
+        # with the slower backend's number, and a later outage artifact
+        # must not present that as a pallas-headline regression
+        **({"median_backend": result["median_backend"]}
+           if "median_backend" in result else {}),
     }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -871,5 +876,11 @@ if __name__ == "__main__":
             result = main(args.config, args.median)
     else:
         result = main(args.config, args.median)
-    _record_last_good(result)
-    print(json.dumps(result))
+    # the ONE JSON line first — the sidecar is best-effort bookkeeping
+    # and must never cost a successfully measured round its artifact
+    print(json.dumps(result), flush=True)
+    try:
+        _record_last_good(result)
+    except OSError:
+        print("warning: could not update LAST_GOOD_DEVICE.json",
+              file=sys.stderr)

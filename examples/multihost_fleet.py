@@ -58,6 +58,23 @@ _WORKER = textwrap.dedent(
         outs = svc.submit_local([scan])   # collective: both procs tick
         occ = int(outs[0].voxel.sum())
         print(f"proc {pid} tick {tick}: voxel occ {occ}", flush=True)
+
+    # pipelined ticks: publish tick N-1 while N computes — the collect
+    # touches only this process's shards, so the collective cadence stays
+    # identical across peers (ALL processes must use the pipelined
+    # variant together; see submit_local_pipelined's docstring)
+    for tick in range(ticks):
+        scan, _ts0, _dur = lidar.grab_scan_host(2.0)
+        prev = svc.submit_local_pipelined([scan])
+        label = (
+            f"{int(prev[0].voxel.sum())}" if prev[0] is not None else "(warming)"
+        )
+        print(f"proc {pid} pipelined tick {tick}: prev-tick occ {label}",
+              flush=True)
+    tail = svc.flush_pipelined()
+    if tail is not None and tail[0] is not None:
+        print(f"proc {pid}: drained final tick occ {int(tail[0].voxel.sum())}",
+              flush=True)
     lidar.stop_motor()
     lidar.disconnect()
     print(f"proc {pid}: done", flush=True)

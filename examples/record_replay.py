@@ -45,9 +45,15 @@ def main() -> int:
         drv.detect_and_init_strategy()
         assert drv.start_motor("DenseBoost", 600)
         drv.start_recording(path)
+        # run for --seconds, but gate on the OUTCOME: at least 3 grabbed
+        # revolutions (with a generous ceiling), so a loaded box cannot
+        # produce an empty recording and a spurious failure
         t_end = time.monotonic() + args.seconds
+        t_giveup = time.monotonic() + max(args.seconds, 60.0)
         grabbed = 0
-        while time.monotonic() < t_end:
+        while time.monotonic() < t_end or (
+            grabbed < 3 and time.monotonic() < t_giveup
+        ):
             if drv.grab_scan_host(2.0) is not None:
                 grabbed += 1
         frames = drv.stop_recording()

@@ -232,7 +232,7 @@ class ShardedFilterService:
         prev = None
         if pending is not None:
             try:
-                prev = self._materialize(*pending)
+                prev = self._collect_pending(pending)
             except Exception:
                 # the device->host fetch of the previous tick itself
                 # failed (same transient-link fault class as the dispatch
@@ -250,7 +250,9 @@ class ShardedFilterService:
                         arr.copy_to_host_async()
                     except Exception:
                         pass  # backend without async D2H: the fetch blocks
-                self._pending = (out, [s is not None for s in scans])
+                self._pending = (
+                    out, [s is not None for s in scans], "_materialize"
+                )
         except Exception:
             # this tick's upload/dispatch failed after the previous tick
             # was popped: re-stash it so flush_pipelined can still drain it
@@ -272,12 +274,24 @@ class ShardedFilterService:
             if self._pending is None and self._epoch == epoch:
                 self._pending = pending
 
+    def _collect_pending(self, pending) -> list[Optional[FilterOutput]]:
+        """Materialize a stashed tick via the collector it was stashed
+        with (_materialize for controller-global ticks, _collect_local
+        for multi-controller ticks — the pending slot can hold either).
+        The collector travels as a NAME resolved at collect time, not a
+        bound method captured at stash time, so tests (and subclasses)
+        can intercept the fetch path dynamically."""
+        out, live, collect = pending
+        return getattr(self, collect)(out, live)
+
     def flush_pipelined(self) -> Optional[list[Optional[FilterOutput]]]:
         """Collect the last dispatched tick's outputs (the ones still in
-        flight when the fleet stops), or None."""
+        flight when the fleet stops), or None.  After pipelined LOCAL
+        ticks this returns only this process's stream block, and is
+        per-process (not collective)."""
         with self._lock:
             pending, self._pending = self._pending, None
-        return self._materialize(*pending) if pending is not None else None
+        return self._collect_pending(pending) if pending is not None else None
 
     def submit_local(
         self, local_scans: Sequence[Optional[dict]]
@@ -308,6 +322,21 @@ class ShardedFilterService:
         it is a deployment bug, not per-tick data, and fails on every
         process identically.
         """
+        local_scans, packed_local = self._pack_local(local_scans)
+        packed = jax.make_array_from_process_local_data(
+            self._packed_sharding, packed_local
+        )
+        with self._lock:
+            self._state, out = self._step(self._state, packed)
+        return self._collect_local(out, [s is not None for s in local_scans])
+
+    def _pack_local(
+        self, local_scans: Sequence[Optional[dict]]
+    ) -> tuple[list[Optional[dict]], np.ndarray]:
+        """Shared ingest prologue of the local tick variants: validate
+        the block length, clip to capacity, pack (malformed scans degrade
+        to idle rows — see submit_local).  Returns the clipped scans (the
+        live mask must reflect them) and the packed local block."""
         from rplidar_ros2_driver_tpu.parallel import multihost
 
         slc = multihost.local_stream_slice(self.streams)
@@ -318,49 +347,138 @@ class ShardedFilterService:
                 f"of {self.streams}), got {len(local_scans)}"
             )
         local_scans = [self._clip_to_capacity(s) for s in local_scans]
-        packed_local = self._stack(local_scans, offset=slc.start, malformed="idle")
-        packed = jax.make_array_from_process_local_data(
-            self._packed_sharding, packed_local
+        return local_scans, self._stack(
+            local_scans, offset=slc.start, malformed="idle"
         )
-        with self._lock:
-            self._state, out = self._step(self._state, packed)
 
-        def local_rows(arr):
-            """Reassemble this process's stream rows from addressable
-            shards (beam-sharded axes are split across local devices)."""
-            shape = (n_local,) + arr.shape[1:]
-            buf = np.zeros(shape, arr.dtype)
-            seen = np.zeros(shape, bool)
-            for shard in arr.addressable_shards:
-                idx = shard.index
-                # an unsharded stream dim yields slice(None): the global
-                # stream count is the stop fallback, clipped to our block
-                s0 = max(idx[0].start or 0, slc.start)
-                s1 = min(idx[0].stop or self.streams, slc.stop)
-                if s1 <= s0:
-                    continue
-                data = np.asarray(shard.data)
-                d0 = s0 - (idx[0].start or 0)
-                local_idx = (slice(s0 - slc.start, s1 - slc.start),) + idx[1:]
-                buf[local_idx] = data[d0 : d0 + (s1 - s0)]
-                seen[local_idx] = True
-            if not seen.all():
-                raise RuntimeError(
-                    "submit_local needs each process's stream rows fully "
-                    "addressable — use the stream-major mesh from "
-                    "multihost.make_global_mesh"
-                )
-            return buf
+    def _local_rows(self, arr, slc) -> np.ndarray:
+        """Reassemble this process's stream rows from addressable
+        shards (beam-sharded axes are split across local devices)."""
+        n_local = slc.stop - slc.start
+        shape = (n_local,) + arr.shape[1:]
+        buf = np.zeros(shape, arr.dtype)
+        seen = np.zeros(shape, bool)
+        for shard in arr.addressable_shards:
+            idx = shard.index
+            # an unsharded stream dim yields slice(None): the global
+            # stream count is the stop fallback, clipped to our block
+            s0 = max(idx[0].start or 0, slc.start)
+            s1 = min(idx[0].stop or self.streams, slc.stop)
+            if s1 <= s0:
+                continue
+            data = np.asarray(shard.data)
+            d0 = s0 - (idx[0].start or 0)
+            local_idx = (slice(s0 - slc.start, s1 - slc.start),) + idx[1:]
+            buf[local_idx] = data[d0 : d0 + (s1 - s0)]
+            seen[local_idx] = True
+        if not seen.all():
+            raise RuntimeError(
+                "submit_local needs each process's stream rows fully "
+                "addressable — use the stream-major mesh from "
+                "multihost.make_global_mesh"
+            )
+        return buf
 
+    def _collect_local(
+        self, out: FilterOutput, live: list[bool]
+    ) -> list[Optional[FilterOutput]]:
+        """Materialize THIS process's stream block of a (possibly
+        process-spanning) tick output.  Touches only addressable shards —
+        never a collective, so processes may collect at different times."""
+        from rplidar_ros2_driver_tpu.parallel import multihost
+
+        slc = multihost.local_stream_slice(self.streams)
         local_out = FilterOutput(
-            ranges=local_rows(out.ranges),
-            intensities=local_rows(out.intensities),
-            points_xy=local_rows(out.points_xy),
-            point_mask=local_rows(out.point_mask),
-            voxel=local_rows(out.voxel),
+            ranges=self._local_rows(out.ranges, slc),
+            intensities=self._local_rows(out.intensities, slc),
+            points_xy=self._local_rows(out.points_xy, slc),
+            point_mask=self._local_rows(out.point_mask, slc),
+            voxel=self._local_rows(out.voxel, slc),
         )
         # np.asarray inside _materialize is a no-op on these host arrays
-        return self._materialize(local_out, [s is not None for s in local_scans])
+        return self._materialize(local_out, live)
+
+    def submit_local_pipelined(
+        self, local_scans: Sequence[Optional[dict]]
+    ) -> list[Optional[FilterOutput]]:
+        """Pipelined multi-controller tick: dispatch THIS tick's
+        collective step, return the PREVIOUS tick's outputs for this
+        process's stream block — submit_local's analog of
+        :meth:`submit_pipelined`, so a fleet spanning hosts stops paying
+        the blocking collect every tick.
+
+        Collective safety: the only cross-process operations here are
+        the global-array build and the step dispatch, and every process
+        executes them exactly once per call in the same order — whether
+        or not a previous tick is pending, because collecting the
+        previous tick touches only this process's addressable shards
+        (:meth:`_collect_local` is not a collective).  All processes
+        must use the pipelined variant together and call it each tick in
+        the same order relative to other collectives (save_sharded etc.,
+        same contract as :meth:`submit_local`); a mixed
+        pipelined/blocking fleet would interleave collectives
+        differently across peers and deadlock the mesh.
+
+        Failure policy differs from :meth:`submit_pipelined` on the
+        COLLECT side: a previous-tick fetch failure is logged and the
+        tick dropped (returning all-None) instead of raised, because
+        raising before this tick's dispatch would abort this process
+        while every peer blocks inside the collective — one process's
+        transient D2H fault must not hang the fleet.  Dispatch failures
+        still raise (the collective itself died, which every peer
+        observes).  Returns all-None on the first tick;
+        :meth:`flush_pipelined` drains the last tick when the fleet
+        stops.
+        """
+        local_scans, packed_local = self._pack_local(local_scans)
+        n_local = len(local_scans)
+        with self._lock:
+            pending, self._pending = self._pending, None
+            epoch = self._epoch
+        prev = None
+        if pending is not None:
+            try:
+                prev = self._collect_pending(pending)
+            except Exception:
+                # see the docstring: dropping beats hanging the fleet —
+                # the slot is about to be taken by this tick's output, so
+                # a re-stash could not preserve the tick anyway
+                logger.warning(
+                    "dropping previous pipelined tick (collect failed)",
+                    exc_info=True,
+                )
+                prev = None
+        try:
+            packed = jax.make_array_from_process_local_data(
+                self._packed_sharding, packed_local
+            )
+            with self._lock:
+                self._state, out = self._step(self._state, packed)
+                for arr in (out.ranges, out.intensities, out.points_xy,
+                            out.point_mask, out.voxel):
+                    try:
+                        arr.copy_to_host_async()  # addressable shards only
+                    except Exception:
+                        pass  # backend without async D2H: the fetch blocks
+                self._pending = (
+                    out, [s is not None for s in local_scans],
+                    "_collect_local",
+                )
+        except Exception:
+            # the collective dispatch died (every peer observes this):
+            # re-stash so flush_pipelined can still drain the prior tick.
+            # Unconditional like submit_pipelined — even when the collect
+            # above succeeded, this raise discards `prev`, so the flush's
+            # re-collect (idempotent host fetches) is the only publish
+            if pending is not None:
+                self._restash_pending(pending, epoch)
+            raise
+        with self._lock:
+            if self._epoch != epoch:
+                # a restore/load raced in after the pop: the popped tick
+                # is pre-restore and must not be published
+                prev = None
+        return prev if prev is not None else [None] * n_local
 
     # -- checkpoint surface (mirrors ScanFilterChain's) ---------------------
 

@@ -27,6 +27,17 @@ def _wait_scans(node, n, timeout=20.0):
         time.sleep(0.05)
 
 
+def _wait_ans_type(sim, ans, timeout=10.0):
+    """Scan starts are fire-and-forget on the wire (send_only, like the
+    reference), so the sim's rx thread observes the command a beat after
+    start_motor returns — poll instead of racing it."""
+    from conftest import wait_for
+
+    assert wait_for(lambda: sim.active_ans_type == ans, timeout), (
+        f"sim never switched to ans {ans} (at {sim.active_ans_type})"
+    )
+
+
 def test_scan_mode_hot_swap_and_fallback():
     sim = SimulatedDevice().start()
     node = None
@@ -46,7 +57,7 @@ def test_scan_mode_hot_swap_and_fallback():
         assert node.activate()
         _wait_scans(node, 2)
         assert node.fsm.driver.profile.active_mode == "DenseBoost"
-        assert sim.active_ans_type == Ans.MEASUREMENT_DENSE_CAPSULED
+        _wait_ans_type(sim, Ans.MEASUREMENT_DENSE_CAPSULED)
 
         # hot-swap to Standard: device switches wire format, stream resumes
         ok, msg = node.set_parameters({"scan_mode": "Standard"})
@@ -54,7 +65,7 @@ def test_scan_mode_hot_swap_and_fallback():
         assert node.params.scan_mode == "Standard"
         _wait_scans(node, 2)
         assert node.fsm.driver.profile.active_mode == "Standard"
-        assert sim.active_ans_type == Ans.MEASUREMENT
+        _wait_ans_type(sim, Ans.MEASUREMENT)
 
         # a mode the device does not advertise: the DRIVER's preference
         # fallback kicks in (user pref -> DenseBoost -> Sensitivity,
@@ -64,7 +75,7 @@ def test_scan_mode_hot_swap_and_fallback():
         assert ok, msg
         _wait_scans(node, 2)
         assert node.fsm.driver.profile.active_mode == "DenseBoost"
-        assert sim.active_ans_type == Ans.MEASUREMENT_DENSE_CAPSULED
+        _wait_ans_type(sim, Ans.MEASUREMENT_DENSE_CAPSULED)
         assert node.fsm.reset_count == 0
     finally:
         if node is not None:

@@ -114,17 +114,43 @@ _WORKER = textwrap.dedent(
     svc = ShardedFilterService(params, streams=2, mesh=mesh2, beams=64,
                                capacity=cap)
     ref_chain = ScanFilterChain(params, beams=64)
+    wants = []
     for j in range(k):
         scan = per_stream[pid][j]  # this process's OWN stream only
         outs = svc.submit_local([scan])
         want = ref_chain.process_raw(
             scan["angle_q14"], scan["dist_q2"], scan["quality"]
         )
+        wants.append(want)
         np.testing.assert_array_equal(
             outs[0].ranges, np.asarray(want.ranges)
         )
         np.testing.assert_array_equal(outs[0].voxel, np.asarray(want.voxel))
     print(f"proc {pid}: multi-controller service ticks bit-exact", flush=True)
+
+    # --- pipelined multi-controller ticks: same stream, outputs shifted
+    # by exactly one tick, flush drains the last one.  Both processes run
+    # the pipelined variant together (mixed fleets would deadlock) -------
+    svc_p = ShardedFilterService(params, streams=2, mesh=mesh2, beams=64,
+                                 capacity=cap)
+    prevs = []
+    for j in range(k):
+        scan = per_stream[pid][j]
+        outs_p = svc_p.submit_local_pipelined([scan])
+        prevs.append(outs_p[0])
+    tail = svc_p.flush_pipelined()
+    assert prevs[0] is None
+    for j in range(1, k):
+        np.testing.assert_array_equal(
+            prevs[j].ranges, np.asarray(wants[j - 1].ranges)
+        )
+        np.testing.assert_array_equal(
+            prevs[j].voxel, np.asarray(wants[j - 1].voxel)
+        )
+    np.testing.assert_array_equal(tail[0].ranges, np.asarray(wants[-1].ranges))
+    assert svc_p.flush_pipelined() is None
+    print(f"proc {pid}: pipelined local ticks bit-exact one tick late",
+          flush=True)
     """
 )
 
@@ -174,3 +200,4 @@ def test_two_process_distributed_fleet_replay():
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert "fleet replay bit-exact" in out, out[-1000:]
         assert "service ticks bit-exact" in out, out[-1000:]
+        assert "pipelined local ticks bit-exact one tick late" in out, out[-1000:]

@@ -197,7 +197,12 @@ class TestScanStreaming:
             assert not drv.conf_supported
             assert drv.start_motor("", 0)
             assert drv.profile.active_mode == "Express"
-            assert dev.active_ans_type == Ans.MEASUREMENT_CAPSULED
+            # start_motor is fire-and-forget on the wire (send_only, like
+            # the reference): poll until the sim's rx thread has observed
+            # the EXPRESS_SCAN rather than racing it (load-flaky otherwise)
+            assert wait_for(
+                lambda: dev.active_ans_type == Ans.MEASUREMENT_CAPSULED, 10.0
+            ), dev.active_ans_type
             # the wrapper profile keeps the A-series 12 m limit; 16 m is
             # SDK mode metadata only
             assert drv.get_hw_max_distance() == 12.0
