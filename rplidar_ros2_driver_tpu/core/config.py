@@ -93,11 +93,13 @@ class DriverParams:
     # non-overlapping interleaved rounds; deeper windows at least
     # 1.2-1.4x (docs/BENCHMARKS.md).
     median_backend: str = "auto"
-    # per-scan streaming-step resampler: "scatter" (jnp .at[].min) or
+    # per-scan streaming-step resampler: "scatter" (jnp .at[].min),
     # "dense" (the fused path's tiled masked-min at K=1; bit-identical,
-    # parity-tested).  Default stays "scatter" until the device-resident
-    # A/B decides — the fused replay path always uses the dense tile.
-    resample_backend: str = "scatter"
+    # parity-tested), or "auto" — resolved per device platform from the
+    # streaming-step ablation evidence (scripts/step_ablation.py;
+    # resolve_resample_backend in filters/chain.py holds the mapping and
+    # its provenance).  The fused replay path always uses the dense tile.
+    resample_backend: str = "auto"
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -127,8 +129,10 @@ class DriverParams:
             raise ValueError("invalid voxel grid configuration")
         if self.median_backend not in ("auto", "xla", "pallas"):
             raise ValueError("median_backend must be 'auto', 'xla' or 'pallas'")
-        if self.resample_backend not in ("scatter", "dense"):
-            raise ValueError("resample_backend must be 'scatter' or 'dense'")
+        if self.resample_backend not in ("auto", "scatter", "dense"):
+            raise ValueError(
+                "resample_backend must be 'auto', 'scatter' or 'dense'"
+            )
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

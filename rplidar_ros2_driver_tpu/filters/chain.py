@@ -66,6 +66,21 @@ def resolve_median_backend(requested: str, platform: Optional[str] = None) -> st
     return "pallas" if platform == "tpu" else "xla"
 
 
+def resolve_resample_backend(requested: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` streaming-step resampler per device platform
+    (mirrors :func:`resolve_median_backend`; explicit requests pass
+    through).  Evidence source: scripts/step_ablation.py's full_scatter
+    vs full_dense A/B on the real counted step.  CPU: scatter (the dense
+    one-hot tile materializes a beams x capacity mask per scan, which the
+    host backend pays for).  TPU: scatter until the on-chip ablation
+    artifact decides otherwise — the ~2x dense win measured so far is
+    from the FUSED replay path (K scans amortize the tile), not the
+    K=1 streaming step (docs/BENCHMARKS.md)."""
+    if requested != "auto":
+        return requested
+    return "scatter"
+
+
 def config_from_params(
     params: DriverParams,
     beams: int = DEFAULT_BEAMS,
@@ -88,7 +103,9 @@ def config_from_params(
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
         median_backend=resolve_median_backend(params.median_backend, platform),
-        resample_backend=params.resample_backend,
+        resample_backend=resolve_resample_backend(
+            params.resample_backend, platform
+        ),
     )
 
 

@@ -1,0 +1,147 @@
+"""Per-stage ablation of the streaming filter step (r3 VERDICT #3).
+
+Explains where the headline step's time goes by measuring the REAL
+``counted_filter_step`` under config ablations (so the numbers cannot
+drift from the production program): median on/off, voxel on/off, clip
+on/off, and the grid-resample backend A/B (vmapped scatter-min vs the
+dense one-hot tile — the fused replay path measured dense ~2x faster on
+TPU; this script decides the STREAMING default per platform, feeding
+``resolve_resample_backend``).
+
+Measurement discipline is bench.py's ``measure_device_only`` pattern:
+the step loops inside ONE jit dispatch (``_min_fold_loop``), outputs
+fold into the carry so XLA cannot eliminate the work, and the section
+ends with a dependent fetch — through a remote-attached device, a
+per-dispatch loop or ``block_until_ready`` measures the link, not the
+device (docs/BENCHMARKS.md).
+
+    python scripts/step_ablation.py [--cpu] [--iters 3000] [--rounds 3]
+
+Prints one human-readable table and ONE machine-readable JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument("--iters", type=int, default=3000,
+                    help="in-jit steps per round (>=3000 amortizes the one "
+                    "barrier-fetch RTT below ~5%% on the remote rig)")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--window", type=int, default=None,
+                    help="override the headline 64-scan window")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
+
+        ok, detail = probe_jax_backend(240.0)
+        if not ok:
+            print(json.dumps({"error": detail}))
+            return 3
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        FilterConfig,
+        FilterState,
+        counted_filter_step,
+        pack_host_scan_counted,
+    )
+
+    from rplidar_ros2_driver_tpu.filters.chain import resolve_median_backend
+
+    device = jax.devices()[0]
+    window = args.window or bench.WINDOW
+    scan = bench._host_scans(1, bench.POINTS)[0]
+    buf = pack_host_scan_counted(
+        scan["angle_q14"], scan["dist_q2"], scan["quality"], None, bench.CAPACITY
+    )
+
+    def cfg(**over) -> FilterConfig:
+        base = dict(
+            window=window, beams=bench.BEAMS, grid=bench.GRID, cell_m=0.25,
+            # resolve per the ACTUAL platform, not the --cpu flag: without
+            # a TPU attached the probe still succeeds (CPU devices), and
+            # pallas would run in interpret mode, poisoning the numbers
+            median_backend=resolve_median_backend("auto", device.platform),
+        )
+        base.update(over)
+        return FilterConfig(**base)
+
+    def measure(c: FilterConfig) -> float:
+        """Best-of-rounds µs per streaming step for one config."""
+
+        def step_ranges(st, p):
+            st, out = counted_filter_step(st, p, c)
+            return st, out.ranges
+
+        run = bench._min_fold_loop(step_ranges, (c.beams,), args.iters)
+        state = jax.device_put(
+            FilterState.create(c.window, c.beams, c.grid), device
+        )
+        p = jax.device_put(buf, device)
+        state, acc = run(state, p)  # compile outside the timed region
+        bench._device_barrier(jnp.min(acc))
+        best = None
+        for _ in range(args.rounds):
+            p = jax.device_put(buf, device)
+            t0 = time.perf_counter()
+            state, acc = run(state, p)
+            bench._device_barrier(jnp.min(acc))
+            dt = (time.perf_counter() - t0) / args.iters
+            best = dt if best is None else min(best, dt)
+        return best * 1e6
+
+    cases = {
+        "full_scatter": cfg(resample_backend="scatter"),
+        "full_dense": cfg(resample_backend="dense"),
+        "no_median": cfg(enable_median=False),
+        "no_voxel": cfg(enable_voxel=False),
+        "no_clip": cfg(enable_clip=False),
+        "resample_only": cfg(enable_median=False, enable_voxel=False),
+    }
+    us = {}
+    for name, c in cases.items():
+        us[name] = measure(c)
+        print(f"{name:16s} {us[name]:8.2f} us/scan", file=sys.stderr, flush=True)
+
+    full = us["full_scatter"]
+    derived = {
+        # stage costs by subtraction from the full step (scatter resample)
+        "median_us": round(full - us["no_median"], 2),
+        "voxel_us": round(full - us["no_voxel"], 2),
+        "clip_us": round(full - us["no_clip"], 2),
+        "dense_vs_scatter_speedup": round(us["full_scatter"] / us["full_dense"], 3),
+    }
+    print(json.dumps({
+        "ablation_us": {k: round(v, 2) for k, v in us.items()},
+        "derived": derived,
+        "device": str(device.platform),
+        "window": window,
+        "iters": args.iters,
+        "rounds": args.rounds,
+        "method": "device_resident_in_jit",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,6 +62,30 @@ def test_probe_retry_exhausts_budget_with_last_error():
     assert logs  # progress was reported
 
 
+def test_step_ablation_smoke():
+    """The ablation tool must keep running against the real counted step
+    (tiny shapes — this pins the harness, not the numbers)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/step_ablation.py", "--cpu",
+         "--iters", "10", "--rounds", "1", "--window", "4"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert set(out["ablation_us"]) == {
+        "full_scatter", "full_dense", "no_median", "no_voxel", "no_clip",
+        "resample_only",
+    }
+    assert all(v > 0 for v in out["ablation_us"].values())
+    assert out["device"] == "cpu"
+
+
 def test_bench_outage_artifact_is_structured_not_zero():
     """With the probe forced to fail, bench must still emit a nonzero
     CPU-computed artifact flagged device_unavailable, carrying the last

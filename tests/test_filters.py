@@ -257,3 +257,26 @@ class TestBackendResolution:
         # explicit choices pass through regardless of platform
         assert resolve_median_backend("xla", "tpu") == "xla"
         assert resolve_median_backend("pallas", "cpu") == "pallas"
+
+    def test_resample_auto_resolves_per_platform(self):
+        from rplidar_ros2_driver_tpu.filters.chain import (
+            resolve_resample_backend,
+        )
+
+        # scatter everywhere pending an on-chip streaming-step ablation
+        # artifact (the fused-path dense win does not transfer at K=1)
+        assert resolve_resample_backend("auto", "cpu") == "scatter"
+        assert resolve_resample_backend("auto", "tpu") == "scatter"
+        assert resolve_resample_backend("dense", "cpu") == "dense"
+        assert resolve_resample_backend("scatter", "tpu") == "scatter"
+
+    def test_config_from_params_resolves_both_autos(self):
+        from rplidar_ros2_driver_tpu.core.config import DriverParams
+        from rplidar_ros2_driver_tpu.filters.chain import config_from_params
+
+        cfg = config_from_params(DriverParams(), platform="tpu")
+        assert cfg.median_backend == "pallas"
+        assert cfg.resample_backend in ("scatter", "dense")  # resolved
+        cfg = config_from_params(DriverParams(), platform="cpu")
+        assert cfg.median_backend == "xla"
+        assert cfg.resample_backend == "scatter"
