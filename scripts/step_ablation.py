@@ -47,9 +47,25 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
     else:
-        from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
+        # same two-stage guard as bench.py: budgeted subprocess probes
+        # (retryable — an in-process probe that hangs wedges this
+        # process's backend for good), then THIS process's init under
+        # the in-process hang guard
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            probe_jax_backend,
+            probe_jax_backend_with_retry,
+        )
 
-        ok, detail = probe_jax_backend(240.0)
+        ok, detail = probe_jax_backend_with_retry(
+            total_budget_s=float(os.environ.get("BENCH_PROBE_BUDGET_S", 600)),
+            per_probe_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240)),
+            interval_s=float(os.environ.get("BENCH_PROBE_INTERVAL_S", 60)),
+            log=lambda m: print(m, file=sys.stderr, flush=True),
+        )
+        if ok:
+            ok, detail = probe_jax_backend(
+                float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
+            )
         if not ok:
             print(json.dumps({"error": detail}))
             return 3
@@ -116,7 +132,8 @@ def main() -> int:
         "no_median": cfg(enable_median=False),
         "no_voxel": cfg(enable_voxel=False),
         "no_clip": cfg(enable_clip=False),
-        "resample_only": cfg(enable_median=False, enable_voxel=False),
+        "resample_only": cfg(enable_median=False, enable_voxel=False,
+                             enable_clip=False),
     }
     us = {}
     for name, c in cases.items():

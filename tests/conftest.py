@@ -25,6 +25,19 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def pytest_collection_modifyitems(config, items):
+    """soak_long tests run for minutes: skip them unless the operator
+    selected the marker explicitly (``-m soak_long``)."""
+    import pytest
+
+    if "soak_long" in (config.option.markexpr or ""):
+        return
+    skip = pytest.mark.skip(reason="opt-in endurance soak: run with -m soak_long")
+    for item in items:
+        if "soak_long" in item.keywords:
+            item.add_marker(skip)
+
+
 def wait_for(predicate, timeout=20.0, interval=0.02):
     """Poll ``predicate`` until truthy or ``timeout`` elapses; returns
     whether it became true.  The one wait helper for all suites (was
