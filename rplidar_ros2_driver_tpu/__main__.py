@@ -219,9 +219,26 @@ def _cmd_doctor(args) -> int:
                         "fallback will be used (no SCHED_RR rx elevation)")
 
     def jax_backend():
-        from rplidar_ros2_driver_tpu.utils.backend import probe_jax_backend
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            probe_jax_backend,
+            probe_jax_backend_subprocess,
+        )
 
-        ok, detail = probe_jax_backend(args.device_timeout)
+        if args.cpu:
+            # CPU backend init cannot hang, and the --cpu config update
+            # (main()) only exists in THIS process — a subprocess child
+            # would dial the device link the flag is trying to avoid
+            ok, detail = probe_jax_backend(args.device_timeout)
+        else:
+            # two-stage guard (same as bench.py): a throwaway child takes
+            # the wedge risk first, then THIS process's init runs under
+            # the in-process hang guard — sim_roundtrip's decode must
+            # never be the parent's first (unguarded) backend init, or a
+            # link that drops between child exit and parent init hangs
+            # the doctor despite --device-timeout
+            ok, detail = probe_jax_backend_subprocess(args.device_timeout)
+            if ok:
+                ok, detail = probe_jax_backend(args.device_timeout)
         return ("PASS" if ok else "FAIL"), detail
 
     def sim_roundtrip():
