@@ -821,12 +821,8 @@ if __name__ == "__main__":
     # framework.  Progress goes to stderr (stdout is the ONE JSON line).
     import subprocess
 
-    from rplidar_ros2_driver_tpu.utils.backend import (
-        probe_jax_backend,
-        probe_jax_backend_with_retry,
-    )
+    from rplidar_ros2_driver_tpu.utils.backend import guarded_backend_init
 
-    per_probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
     if os.environ.get("BENCH_FORCE_PROBE_FAIL"):
         # test hook AND the poisoned-parent re-exec below: this process's
         # backend was never dialed, so the CPU fallback is safe in-process
@@ -836,21 +832,14 @@ if __name__ == "__main__":
         print(json.dumps(_fallback_artifact(args.config, _detail)))
         raise SystemExit(0)
 
-    _ok, _detail = probe_jax_backend_with_retry(
-        total_budget_s=float(os.environ.get("BENCH_PROBE_BUDGET_S", 1200)),
-        per_probe_s=per_probe_s,
-        interval_s=float(os.environ.get("BENCH_PROBE_INTERVAL_S", 120)),
+    # two-stage guard: budgeted subprocess probes, then THIS process's
+    # init under the in-process hang guard (a healthy run pays a second
+    # tunnel init; a silent infinite hang would cost the round)
+    _ok, _detail, poisoned = guarded_backend_init(
+        default_budget_s=1200.0,
+        default_interval_s=120.0,
         log=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
-    poisoned = False
-    if _ok:
-        # the subprocess probe only proved the link was up moments ago —
-        # THIS process's init is the one that matters, and the tunnel can
-        # wedge in the window between the probe's exit and this init.
-        # Run it under the in-process hang guard (costs a second tunnel
-        # init on healthy runs; a silent infinite hang costs the round).
-        _ok, _detail = probe_jax_backend(per_probe_s)
-        poisoned = not _ok
     if not _ok:
         if poisoned:
             # the hung init holds this process's backend for good (the

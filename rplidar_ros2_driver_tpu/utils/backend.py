@@ -68,6 +68,46 @@ def probe_jax_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
     return True, r.stdout.strip()
 
 
+def guarded_backend_init(
+    default_budget_s: float = 600.0,
+    default_interval_s: float = 60.0,
+    log=None,
+) -> tuple[bool, str, bool]:
+    """The two-stage backend guard shared by every measurement CLI
+    (bench.py, scripts/step_ablation.py, scripts/deep_window_ab.py):
+    budgeted subprocess probes first (retryable — an in-process probe
+    that hangs wedges this process's backend for good), then THIS
+    process's real init under the in-process hang guard (the link can
+    drop between the child's exit and this init).
+
+    Env-tunable: ``BENCH_PROBE_BUDGET_S`` (total retry budget),
+    ``BENCH_PROBE_TIMEOUT_S`` (per probe), ``BENCH_PROBE_INTERVAL_S``.
+
+    Returns ``(ok, detail, poisoned)`` — ``poisoned`` means the
+    in-process init was attempted and hung, so this process's backend
+    is unusable even for CPU fallback work (compute it in a fresh
+    process, as bench.py's outage path does).
+    """
+    import os
+
+    per_probe_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
+    ok, detail = probe_jax_backend_with_retry(
+        total_budget_s=float(
+            os.environ.get("BENCH_PROBE_BUDGET_S", default_budget_s)
+        ),
+        per_probe_s=per_probe_s,
+        interval_s=float(
+            os.environ.get("BENCH_PROBE_INTERVAL_S", default_interval_s)
+        ),
+        log=log,
+    )
+    poisoned = False
+    if ok:
+        ok, detail = probe_jax_backend(per_probe_s)
+        poisoned = not ok
+    return ok, detail, poisoned
+
+
 def probe_jax_backend_with_retry(
     total_budget_s: float = 1200.0,
     per_probe_s: float = 240.0,

@@ -1,0 +1,105 @@
+"""Deep-window median A/B with the round-3 measurement discipline
+(r3 VERDICT #6).
+
+The committed W=256/512 pallas-vs-xla rows were 200-iteration probes
+carrying un-amortized barrier RTT (docs/BENCHMARKS.md:37-47); this
+script re-runs them exactly like the headline: device-resident input,
+the step loop inside ONE jit dispatch, >=3000 in-jit iterations per
+round so the single barrier fetch amortizes below ~5%, rounds
+INTERLEAVED across the two backends so link drift cancels.
+
+    python scripts/deep_window_ab.py [--windows 64 256 512] [--iters 3000]
+
+Prints one human line per window to stderr and ONE JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--windows", type=int, nargs="+", default=[64, 256, 512])
+    ap.add_argument("--iters", type=int, default=3000)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="CPU smoke mode (xla only makes sense there; "
+                    "pallas runs in interpret mode — use tiny iters)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from rplidar_ros2_driver_tpu.utils.backend import guarded_backend_init
+
+        ok, detail, _poisoned = guarded_backend_init(
+            log=lambda m: print(m, file=sys.stderr, flush=True)
+        )
+        if not ok:
+            print(json.dumps({"error": detail}))
+            return 3
+
+    import jax
+    import numpy as np
+
+    import bench
+    from bench import _ChainRunner
+    from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
+
+    results = {}
+    for window in args.windows:
+        try:
+            runners = {
+                name: _ChainRunner(
+                    FilterConfig(
+                        window=window, beams=bench.BEAMS, grid=bench.GRID,
+                        cell_m=0.25, median_backend=name,
+                    ),
+                    bench.POINTS,
+                )
+                for name in ("pallas", "xla")
+            }
+            rounds: dict[str, list[float]] = {n: [] for n in runners}
+            for _ in range(args.rounds):
+                for name, r in runners.items():  # interleaved: drift cancels
+                    rounds[name].append(r.measure_device_only(args.iters))
+            med = {n: float(np.median(v)) for n, v in rounds.items()}
+            results[str(window)] = {
+                "pallas_scans_per_sec": round(med["pallas"], 1),
+                "xla_scans_per_sec": round(med["xla"], 1),
+                "speedup": round(med["pallas"] / med["xla"], 3),
+                "rounds": {
+                    n: [round(x, 1) for x in v] for n, v in rounds.items()
+                },
+            }
+            print(
+                f"W={window}: pallas {med['pallas']:.0f} vs xla "
+                f"{med['xla']:.0f} scans/s "
+                f"({med['pallas'] / med['xla']:.2f}x)",
+                file=sys.stderr, flush=True,
+            )
+        except Exception as e:  # noqa: BLE001 - a dead link mid-sequence
+            # must not discard the windows already measured: rig time is
+            # scarce, so completed results still reach the artifact
+            results[str(window)] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"W={window}: FAILED ({e})", file=sys.stderr, flush=True)
+    print(json.dumps({
+        "deep_window_ab": results,
+        "device": str(jax.devices()[0].platform),
+        "iters": args.iters,
+        "rounds": args.rounds,
+        "method": "device_resident_in_jit_interleaved",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

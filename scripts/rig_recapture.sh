@@ -1,0 +1,32 @@
+#!/bin/sh
+# One rig window -> every queued on-chip measurement, in sequence
+# (the remote link serves ONE client at a time — never parallelize):
+#   1. config 5 headline (device-resident in-jit + median A/B + sidecar)
+#   2. config 6 e2e (post-reorder pipelined publish tail distributions)
+#   3. deep-window median A/B at W=256/512 (3000-iter discipline)
+#   4. streaming-step ablation (decides resample_backend's TPU mapping)
+# Each line of the output artifact is one command's JSON (or a failure
+# record); stderr goes to the sidecar .log.  Probe budgets are
+# env-tunable (BENCH_PROBE_BUDGET_S et al.).
+set -u
+cd "$(dirname "$0")/.."
+out="artifacts/rig_recapture_$(date +%Y%m%d_%H%M).jsonl"
+mkdir -p artifacts
+for cmd in \
+    "python bench.py --config 5" \
+    "python bench.py --config 6" \
+    "python scripts/deep_window_ab.py --windows 256 512" \
+    "python scripts/step_ablation.py"; do
+  echo "{\"cmd\": \"$cmd\"}" >> "$out"
+  tmp=$(mktemp)
+  $cmd > "$tmp" 2>> "$out.log"
+  if [ -s "$tmp" ]; then
+    # the command spoke for itself (a measurement, a device_unavailable
+    # fallback, or an {"error": ...} line) — exactly one record each
+    cat "$tmp" >> "$out"
+  else
+    echo "{\"failed\": \"$cmd\"}" >> "$out"
+  fi
+  rm -f "$tmp"
+done
+echo "$out"

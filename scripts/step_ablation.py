@@ -47,25 +47,11 @@ def main() -> int:
 
         jax.config.update("jax_platforms", "cpu")
     else:
-        # same two-stage guard as bench.py: budgeted subprocess probes
-        # (retryable — an in-process probe that hangs wedges this
-        # process's backend for good), then THIS process's init under
-        # the in-process hang guard
-        from rplidar_ros2_driver_tpu.utils.backend import (
-            probe_jax_backend,
-            probe_jax_backend_with_retry,
-        )
+        from rplidar_ros2_driver_tpu.utils.backend import guarded_backend_init
 
-        ok, detail = probe_jax_backend_with_retry(
-            total_budget_s=float(os.environ.get("BENCH_PROBE_BUDGET_S", 600)),
-            per_probe_s=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240)),
-            interval_s=float(os.environ.get("BENCH_PROBE_INTERVAL_S", 60)),
-            log=lambda m: print(m, file=sys.stderr, flush=True),
+        ok, detail, _poisoned = guarded_backend_init(
+            log=lambda m: print(m, file=sys.stderr, flush=True)
         )
-        if ok:
-            ok, detail = probe_jax_backend(
-                float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 240))
-            )
         if not ok:
             print(json.dumps({"error": detail}))
             return 3
