@@ -405,7 +405,10 @@ class ShardedFilterService:
         collective step, return the PREVIOUS tick's outputs for this
         process's stream block — submit_local's analog of
         :meth:`submit_pipelined`, so a fleet spanning hosts stops paying
-        the blocking collect every tick.
+        the blocking collect every tick.  Like the single-stream seam,
+        this mirrors the reference's double-buffered ScanDataHolder
+        (acquisition overlaps consumption, sl_lidar_driver.cpp:237-371)
+        at fleet scale.
 
         Collective safety: the only cross-process operations here are
         the global-array build and the step dispatch, and every process

@@ -113,7 +113,7 @@ def probe_jax_backend_with_retry(
     per_probe_s: float = 240.0,
     interval_s: float = 120.0,
     log=None,
-    _probe=probe_jax_backend_subprocess,
+    _probe=None,
 ) -> tuple[bool, str]:
     """Probe with retry/backoff: a transient link outage (relay restart,
     tunnel hiccup) should cost minutes, not a round's artifact.
@@ -124,6 +124,10 @@ def probe_jax_backend_with_retry(
     receives one progress line per failed attempt — callers whose stdout
     is a machine-read artifact should pass a stderr writer.
     """
+    if _probe is None:
+        # resolved at call time, not def time, so tests (and callers)
+        # can substitute the subprocess probe via the module attribute
+        _probe = probe_jax_backend_subprocess
     deadline = time.monotonic() + total_budget_s
     attempt = 0
     detail = "no probe attempted"

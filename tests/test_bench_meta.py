@@ -62,6 +62,37 @@ def test_probe_retry_exhausts_budget_with_last_error():
     assert logs  # progress was reported
 
 
+def test_guarded_backend_init_env_and_poisoned_flag(monkeypatch):
+    """The shared two-stage guard must honor the BENCH_PROBE_* env knobs
+    and report poisoned=True only when the subprocess probe succeeded
+    but this process's init then hung."""
+    from rplidar_ros2_driver_tpu.utils import backend as B
+
+    monkeypatch.setenv("BENCH_PROBE_BUDGET_S", "0.05")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "5")
+    monkeypatch.setenv("BENCH_PROBE_INTERVAL_S", "0.02")
+
+    # stage 1 exhausts: not ok, NOT poisoned (in-process init never ran)
+    monkeypatch.setattr(
+        B, "probe_jax_backend_subprocess", lambda t: (False, "down")
+    )
+    ok, detail, poisoned = B.guarded_backend_init()
+    assert not ok and not poisoned and "down" in detail
+
+    # stage 1 passes, stage 2 (in-process) hangs: poisoned
+    monkeypatch.setattr(
+        B, "probe_jax_backend_subprocess", lambda t: (True, "up")
+    )
+    monkeypatch.setattr(B, "probe_jax_backend", lambda t: (False, "hung"))
+    ok, detail, poisoned = B.guarded_backend_init()
+    assert not ok and poisoned and detail == "hung"
+
+    # both pass
+    monkeypatch.setattr(B, "probe_jax_backend", lambda t: (True, "dev0"))
+    ok, detail, poisoned = B.guarded_backend_init()
+    assert ok and not poisoned and detail == "dev0"
+
+
 def test_step_ablation_smoke():
     """The ablation tool must keep running against the real counted step
     (tiny shapes — this pins the harness, not the numbers)."""
