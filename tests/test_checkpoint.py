@@ -12,7 +12,6 @@ import os
 import time
 
 import numpy as np
-import pytest
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain

@@ -13,7 +13,6 @@ any exception, deadlock, or stall fails the test.
 import threading
 import time
 
-import pytest
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver

@@ -7,7 +7,6 @@ MAC / static-IP conf keys (:887-955), and negotiateSerialBaudRate
 """
 
 import struct
-import threading
 import time
 
 import pytest

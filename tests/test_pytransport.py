@@ -9,7 +9,6 @@ just importable.
 import time
 from unittest import mock
 
-import pytest
 
 from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
 from rplidar_ros2_driver_tpu.driver.sim_device import (
