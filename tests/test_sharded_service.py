@@ -139,6 +139,23 @@ class TestService:
         svc.restore(None)
         assert svc.flush_pipelined() is None
 
+    def test_submit_pipelined_restore_drops_next_tick_output(self, mesh):
+        """A restore between ticks drops the pre-restore pending tick
+        (the NEXT pipelined tick returns all-None, it does not republish
+        pre-restore outputs), and the post-restore stream then resumes
+        normally — the deterministic statement of the epoch guard that
+        the concurrency hammer exercises under racing."""
+        svc = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        ref = ShardedFilterService(_params(), streams=2, mesh=mesh, beams=128)
+        svc.submit_pipelined([_scan(1), _scan(2)])
+        svc.restore(None)
+        assert svc.submit_pipelined([_scan(3), _scan(4)]) == [None, None]
+        ref.submit([_scan(1), _scan(2)])
+        ref.restore(None)
+        ref_out = ref.submit([_scan(3), _scan(4)])
+        out = svc.submit_pipelined([_scan(5), _scan(6)])
+        np.testing.assert_array_equal(out[0].ranges, ref_out[0].ranges)
+
     def test_submit_pipelined_dispatch_failure_keeps_pending(self, mesh):
         """A failed tick dispatch after the previous tick was popped must
         re-stash it so the drain can still publish it."""
