@@ -1,0 +1,73 @@
+"""Unit tests for the per-stage latency tracer (utils/tracing.py) — the
+node's `--stats` output and the bench's stage decompositions both read
+through this surface, so its ring-capacity and percentile behavior are
+load-bearing."""
+
+import threading
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.utils.tracing import StageTimer
+
+
+def test_stage_and_record_accumulate():
+    t = StageTimer()
+    with t.stage("a"):
+        pass
+    t.record("a", 0.010)
+    t.record("b", 0.500)
+    s = t.summary()
+    assert s["a"]["n"] == 2
+    assert s["b"]["p50_ms"] == 500.0
+    assert s["b"]["max_ms"] == 500.0
+    assert np.isfinite(s["a"]["p99_ms"])
+
+
+def test_ring_capacity_keeps_newest():
+    t = StageTimer(capacity=8)
+    for k in range(100):
+        t.record("x", float(k))
+    s = t.summary()["x"]
+    assert s["n"] == 8
+    # oldest samples were evicted: the minimum surviving value is 92
+    assert t.percentile("x", 0) == 92.0
+    assert s["max_ms"] == 99.0 * 1e3
+
+
+def test_percentile_of_unknown_stage_is_nan():
+    t = StageTimer()
+    assert np.isnan(t.percentile("nope", 99))
+    assert t.summary() == {}
+
+
+def test_reset_clears():
+    t = StageTimer()
+    t.record("a", 1.0)
+    t.reset()
+    assert t.summary() == {}
+
+
+def test_concurrent_recording_is_safe():
+    t = StageTimer(capacity=1024)
+    errors = []
+
+    def worker(name):
+        try:
+            for k in range(500):
+                t.record(name, k * 1e-6)
+                if k % 50 == 0:
+                    t.summary()
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(f"s{i % 3}",)) for i in range(6)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(10.0)
+        assert not th.is_alive()
+    assert not errors, errors
+    total = sum(v["n"] for v in t.summary().values())
+    assert total == 6 * 500  # capacity 1024 per stage, 2 threads/stage
