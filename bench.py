@@ -81,6 +81,71 @@ def _device_barrier(arr) -> None:
     np.asarray(jnp.ravel(arr)[:1])
 
 
+def _barrier_rtt_ms(device, probes: int = 7) -> float:
+    """Round-trip cost of the ONE dependent fetch that ends every timed
+    section, measured on a trivial fresh result each probe (a
+    materialized array's host copy is cached by JAX, so re-fetching the
+    same array would measure nothing).  The RTT is rig weather —
+    observed anywhere from ~1 ms to 200+ ms across rounds — so every
+    artifact that a link round-trip can contaminate embeds this
+    calibration, and the in-jit rounds are sized off it."""
+    add = jax.jit(lambda a, b: a + b)
+    y = jax.device_put(np.zeros((1,), np.float32), device)
+    one = jax.device_put(np.ones((1,), np.float32), device)
+    y = add(y, one)
+    _device_barrier(y)  # compile outside the probes
+    ts = []
+    for _ in range(probes):
+        y = add(y, one)
+        t0 = time.perf_counter()
+        _device_barrier(y)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e3)
+
+
+def _rtt_adaptive_iters(measure_round, rtt_ms: float, base_iters: int,
+                        rtt_frac: float = 0.05,
+                        max_round_s: float = 15.0) -> int:
+    """Size a device-resident in-jit round so the single barrier fetch
+    stays below ``rtt_frac`` of the round.  ``measure_round(iters)`` runs
+    one probe round at ``base_iters`` and returns its rate (steps/s);
+    the probe's own elapsed time minus the RTT calibrates the per-step
+    cost.  Capped at ~``max_round_s`` per round so a healthy rig never
+    crawls; floored at ``base_iters`` so a local chip (sub-ms RTT) keeps
+    the short rounds."""
+    e1 = base_iters / measure_round(base_iters)
+    step_s = (e1 - rtt_ms * 1e-3) / base_iters
+    if step_s <= e1 / base_iters / 20:
+        # RTT-dominated probe: the subtraction kept <5% of the elapsed
+        # time, so one draw cannot separate step time from an RTT whose
+        # draws themselves drift 2x over seconds — the estimate would be
+        # noise (too small -> minutes-long rounds; clamped too big ->
+        # under-sized rounds whose barrier fraction defeats the whole
+        # point).  Difference method instead: an 8x-longer probe carries
+        # ~the same one-RTT offset, so the elapsed DELTA is pure compute
+        # and the offset cancels.
+        n2 = 8 * base_iters
+        e2 = n2 / measure_round(n2)
+        step_s = (e2 - e1) / (n2 - base_iters)
+        if step_s <= 0:  # drift swamped the delta; be conservative
+            step_s = e2 / n2
+    want = int(rtt_ms * 1e-3 / rtt_frac / step_s) + 1
+    cap = max(int(max_round_s / step_s), base_iters)
+    return min(max(base_iters, want), cap)
+
+
+def iters_arg(v: str):
+    """argparse ``type=`` for the measurement scripts' --iters: 'auto'
+    (RTT-adaptive sizing via :func:`_rtt_adaptive_iters`) or a positive
+    int — validated at parse time, not after backend init."""
+    if v == "auto":
+        return v
+    n = int(v)
+    if n <= 0:
+        raise ValueError("iters must be positive")
+    return n
+
+
 def _host_scans(n: int, points: int = POINTS) -> list[dict[str, np.ndarray]]:
     """Pre-generate n raw host scans (numpy — as arriving from the unpacker)."""
     rng = np.random.default_rng(0)
@@ -339,8 +404,16 @@ def _e2e_phase(chain, rate_mult: float, seconds: float, timer, label: str) -> in
             t_pub = time.monotonic()
             if out is not None:
                 published += 1
-                timer.record(f"{label}_publish", t_pub - rev_end)
+                lat = t_pub - rev_end
+                # the collect's block on the landing D2H copy is link
+                # weather (~0 on a locally-attached chip — the copy had
+                # a whole revolution to land), recorded separately so
+                # the artifact can state the framework-attributable tail
+                wait = chain.last_collect_wait_s
+                timer.record(f"{label}_publish", lat)
                 timer.record(f"{label}_grab", t_pub - t_grab)
+                timer.record(f"{label}_collect", wait)
+                timer.record(f"{label}_pub_ex_collect", lat - wait)
         chain.flush_pipelined()
         if published == 0:
             raise RuntimeError("e2e bench produced no scans (sim stream broken?)")
@@ -457,6 +530,25 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
         "publish_p90_ms": round(timer.percentile("idle_publish", 90) * 1e3, 3),
         "publish_p50_ms": round(timer.percentile("idle_publish", 50) * 1e3, 3),
         "grab_to_publish_p99_ms": round(timer.percentile("idle_grab", 99) * 1e3, 3),
+        # the same distribution with the collect's block on the landing
+        # D2H copy subtracted: the framework-attributable tail.  The
+        # collect wait is link weather (compare collect_wait_p99_ms with
+        # barrier_rtt_ms) — on a locally-attached chip the async copy
+        # lands well inside the 100 ms inter-revolution gap and the wait
+        # is ~0, so ex-collect IS the local-chip distribution.
+        "publish_p99_ms_ex_collect_wait": round(
+            timer.percentile("idle_pub_ex_collect", 99) * 1e3, 3
+        ),
+        "publish_p50_ms_ex_collect_wait": round(
+            timer.percentile("idle_pub_ex_collect", 50) * 1e3, 3
+        ),
+        "collect_wait_p99_ms": round(
+            timer.percentile("idle_collect", 99) * 1e3, 3
+        ),
+        "collect_wait_p50_ms": round(
+            timer.percentile("idle_collect", 50) * 1e3, 3
+        ),
+        "barrier_rtt_ms": round(_barrier_rtt_ms(device), 3),
         "staleness_revolutions": 1,
         "device_compute_ms_per_scan": round(device_ms, 3),
         "loaded": {
@@ -469,6 +561,12 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
             "publish_p50_ms": round(timer.percentile("loaded_publish", 50) * 1e3, 3),
             "grab_to_publish_p99_ms": round(
                 timer.percentile("loaded_grab", 99) * 1e3, 3
+            ),
+            "publish_p99_ms_ex_collect_wait": round(
+                timer.percentile("loaded_pub_ex_collect", 99) * 1e3, 3
+            ),
+            "collect_wait_p99_ms": round(
+                timer.percentile("loaded_collect", 99) * 1e3, 3
             ),
         },
         "median_backend": MEDIAN_BACKEND,
@@ -604,6 +702,9 @@ class _ChainRunner:
         _device_barrier(jnp.min(acc))
         return iters / (time.perf_counter() - t0)
 
+    def measure_barrier_rtt_ms(self, probes: int = 7) -> float:
+        return _barrier_rtt_ms(self.device, probes)
+
     def measure_link_put_ms(self, iters: int = 60) -> float:
         """Amortized host->device transfer cost of one packed scan (the
         streaming regime's per-scan link tax).  The tunnel's throughput
@@ -669,14 +770,25 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         }
         dev_rounds = {name: [] for name in runners}
         n_rounds = 5
-        # enough in-jit iterations that the ONE barrier fetch per round
-        # (a full link RTT — measured up to ~66 ms when the tunnel is
-        # sick) is amortized below ~5% of the round, else RTT drift
-        # masquerades as device variance
-        device_iters = 10 * ITERS
+        # The ONE barrier fetch per round costs a full link RTT, and the
+        # RTT is rig weather: ~1 ms on a good day, 200+ ms on a bad one.
+        # A FIXED round length calibrated for one day's RTT silently
+        # breaks on another's (r4 recapture: 3000-iteration rounds were
+        # SHORTER than that day's ~200 ms RTT, deflating the median 3x
+        # and inverting the A/B while the best round and the on-chip
+        # ablation agreed the device rate was unchanged).  So size each
+        # backend's rounds off a measured RTT and a probe round: enough
+        # in-jit iterations that the barrier stays <=5% of the round,
+        # capped at ~15 s/round so a healthy rig never crawls.
+        rtt_ms = runners[median].measure_barrier_rtt_ms()
+        iters_for = {
+            # the probe round also pays the compile, outside the timing
+            name: _rtt_adaptive_iters(r.measure_device_only, rtt_ms, 10 * ITERS)
+            for name, r in runners.items()
+        }
         for _ in range(n_rounds):
             for name, r in runners.items():
-                dev_rounds[name].append(r.measure_device_only(device_iters))
+                dev_rounds[name].append(r.measure_device_only(iters_for[name]))
         dev_med = {name: float(np.median(v)) for name, v in dev_rounds.items()}
         scans_per_sec = dev_med[median]
         ab = {
@@ -685,6 +797,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
             other: round(dev_med[other], 2),
             "speedup": round(dev_med["pallas"] / dev_med["xla"], 3),
             "rounds": {k: [round(x, 1) for x in v] for k, v in dev_rounds.items()},
+            "barrier_rtt_ms": round(rtt_ms, 3),
+            "round_iters": dict(iters_for),
         }
         # context: what THIS rig's link-bound streaming path does, plus
         # the per-scan transfer calibration that explains it

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import jax
@@ -153,6 +154,9 @@ class ScanFilterChain:
         # a pre-restore output
         self._pending_wire: Optional[jax.Array] = None
         self._epoch = 0
+        # seconds the newest pipelined collect spent blocking on the
+        # pending output's D2H copy (diagnostic for latency artifacts)
+        self.last_collect_wait_s = 0.0
         if warmup:
             self.precompile()
 
@@ -255,9 +259,18 @@ class ScanFilterChain:
             pending, self._pending_wire = self._pending_wire, None
             epoch = self._epoch
         out = None
+        self.last_collect_wait_s = 0.0
         if pending is not None:
+            t_collect = time.perf_counter()
             try:
                 out = unpack_output_wire(pending, self.cfg)
+                # how long the collect blocked waiting for the async
+                # D2H copy to land: ~0 when the copy beat the
+                # inter-revolution gap (local chip: always), up to one
+                # link RTT when it didn't (remote-attach tunnel on a
+                # bad day) — recorded so latency artifacts can separate
+                # framework time from link weather
+                self.last_collect_wait_s = time.perf_counter() - t_collect
             except Exception:
                 # the device->host fetch of N-1 itself failed (same
                 # transient-link fault class as the dispatch path below):

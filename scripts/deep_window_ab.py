@@ -8,9 +8,13 @@ the step loop inside ONE jit dispatch, >=3000 in-jit iterations per
 round so the single barrier fetch amortizes below ~5%, rounds
 INTERLEAVED across the two backends so link drift cancels.
 
-    python scripts/deep_window_ab.py [--windows 64 256 512] [--iters 3000]
+    python scripts/deep_window_ab.py [--windows 64 256 512] [--iters auto]
 
-Prints one human line per window to stderr and ONE JSON line to stdout.
+``--iters auto`` (default) sizes each backend's rounds off a measured
+barrier RTT (bench._rtt_adaptive_iters) — a fixed count calibrated for
+one day's link breaks on another's (the r4 recapture saw a ~200 ms RTT
+eat 3000-iteration rounds whole).  Prints one human line per window to
+stderr and ONE JSON line to stdout.
 """
 
 from __future__ import annotations
@@ -22,11 +26,15 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import bench  # noqa: E402 - safe pre-init (no device use at import)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--windows", type=int, nargs="+", default=[64, 256, 512])
-    ap.add_argument("--iters", type=int, default=3000)
+    ap.add_argument("--iters", type=bench.iters_arg, default="auto",
+                    help="in-jit iterations per round, or 'auto' to size "
+                    "off the measured barrier RTT (default)")
     ap.add_argument("--rounds", type=int, default=5)
     ap.add_argument("--cpu", action="store_true",
                     help="CPU smoke mode (xla only makes sense there; "
@@ -50,10 +58,12 @@ def main() -> int:
     import jax
     import numpy as np
 
-    import bench
     from bench import _ChainRunner
     from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
 
+    auto = args.iters == "auto"
+    base_iters = 3000 if auto else args.iters
+    rtt_ms = None
     results = {}
     for window in args.windows:
         try:
@@ -67,10 +77,21 @@ def main() -> int:
                 )
                 for name in ("pallas", "xla")
             }
+            if auto:
+                if rtt_ms is None:
+                    rtt_ms = next(iter(runners.values())).measure_barrier_rtt_ms()
+                iters_for = {
+                    n: bench._rtt_adaptive_iters(
+                        r.measure_device_only, rtt_ms, base_iters
+                    )
+                    for n, r in runners.items()
+                }
+            else:
+                iters_for = {n: base_iters for n in runners}
             rounds: dict[str, list[float]] = {n: [] for n in runners}
             for _ in range(args.rounds):
                 for name, r in runners.items():  # interleaved: drift cancels
-                    rounds[name].append(r.measure_device_only(args.iters))
+                    rounds[name].append(r.measure_device_only(iters_for[name]))
             med = {n: float(np.median(v)) for n, v in rounds.items()}
             results[str(window)] = {
                 "pallas_scans_per_sec": round(med["pallas"], 1),
@@ -79,6 +100,7 @@ def main() -> int:
                 "rounds": {
                     n: [round(x, 1) for x in v] for n, v in rounds.items()
                 },
+                "round_iters": dict(iters_for),
             }
             print(
                 f"W={window}: pallas {med['pallas']:.0f} vs xla "
@@ -94,7 +116,8 @@ def main() -> int:
     print(json.dumps({
         "deep_window_ab": results,
         "device": str(jax.devices()[0].platform),
-        "iters": args.iters,
+        "iters": "auto" if auto else base_iters,
+        **({"barrier_rtt_ms": round(rtt_ms, 3)} if rtt_ms is not None else {}),
         "rounds": args.rounds,
         "method": "device_resident_in_jit_interleaved",
     }))

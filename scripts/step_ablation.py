@@ -30,13 +30,18 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import bench  # noqa: E402 - safe pre-init (no device use at import)
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
-    ap.add_argument("--iters", type=int, default=3000,
-                    help="in-jit steps per round (>=3000 amortizes the one "
-                    "barrier-fetch RTT below ~5%% on the remote rig)")
+    ap.add_argument("--iters", type=bench.iters_arg, default="auto",
+                    help="in-jit steps per round, or 'auto' (default) to "
+                    "size rounds off the measured barrier RTT so the one "
+                    "barrier fetch stays below ~5%% of a round — a fixed "
+                    "count breaks when the rig's RTT shifts (r4: a ~200 ms "
+                    "RTT added ~26 us/step to 3000-iteration rounds)")
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--window", type=int, default=None,
                     help="override the headline 64-scan window")
@@ -60,7 +65,6 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    import bench
     from rplidar_ros2_driver_tpu.ops.filters import (
         FilterConfig,
         FilterState,
@@ -88,14 +92,14 @@ def main() -> int:
         base.update(over)
         return FilterConfig(**base)
 
-    def measure(c: FilterConfig) -> float:
+    def measure(c: FilterConfig, iters: int, rounds: int) -> float:
         """Best-of-rounds µs per streaming step for one config."""
 
         def step_ranges(st, p):
             st, out = counted_filter_step(st, p, c)
             return st, out.ranges
 
-        run = bench._min_fold_loop(step_ranges, (c.beams,), args.iters)
+        run = bench._min_fold_loop(step_ranges, (c.beams,), iters)
         state = jax.device_put(
             FilterState.create(c.window, c.beams, c.grid), device
         )
@@ -103,12 +107,12 @@ def main() -> int:
         state, acc = run(state, p)  # compile outside the timed region
         bench._device_barrier(jnp.min(acc))
         best = None
-        for _ in range(args.rounds):
+        for _ in range(rounds):
             p = jax.device_put(buf, device)
             t0 = time.perf_counter()
             state, acc = run(state, p)
             bench._device_barrier(jnp.min(acc))
-            dt = (time.perf_counter() - t0) / args.iters
+            dt = (time.perf_counter() - t0) / iters
             best = dt if best is None else min(best, dt)
         return best * 1e6
 
@@ -121,9 +125,23 @@ def main() -> int:
         "resample_only": cfg(enable_median=False, enable_voxel=False,
                              enable_clip=False),
     }
+    auto = args.iters == "auto"
+    iters = 3000 if auto else args.iters
+    rtt_ms = None
+    if auto:
+        # probe the full step once, then size ALL cases' rounds off the
+        # measured RTT (uniform iters keep the subtraction deltas on an
+        # identical — and now negligible — per-step barrier bias)
+        rtt_ms = bench._barrier_rtt_ms(device)
+        iters = bench._rtt_adaptive_iters(
+            lambda it: 1e6 / measure(cases["full_scatter"], it, 1),
+            rtt_ms, iters,
+        )
+        print(f"auto: rtt {rtt_ms:.1f} ms -> {iters} iters/round",
+              file=sys.stderr, flush=True)
     us = {}
     for name, c in cases.items():
-        us[name] = measure(c)
+        us[name] = measure(c, iters, args.rounds)
         print(f"{name:16s} {us[name]:8.2f} us/scan", file=sys.stderr, flush=True)
 
     full = us["full_scatter"]
@@ -139,7 +157,8 @@ def main() -> int:
         "derived": derived,
         "device": str(device.platform),
         "window": window,
-        "iters": args.iters,
+        "iters": iters,
+        **({"barrier_rtt_ms": round(rtt_ms, 3)} if rtt_ms is not None else {}),
         "rounds": args.rounds,
         "method": "device_resident_in_jit",
     }))
