@@ -1,10 +1,20 @@
 #!/bin/sh
 # One rig window -> every queued on-chip measurement, in sequence
-# (the remote link serves ONE client at a time — never parallelize):
-#   1. config 5 headline (device-resident in-jit + median A/B + sidecar)
-#   2. config 6 e2e (post-reorder pipelined publish tail distributions)
-#   3. deep-window median A/B at W=256/512 (3000-iter discipline)
-#   4. streaming-step ablation (decides resample_backend's TPU mapping)
+# (the remote link serves ONE client at a time — never parallelize,
+# and do not share this box with CPU-heavy jobs while measuring: a
+# starved relay wedges the tunnel).
+#
+# Default queue:
+#   1. config 5 headline (RTT-adaptive in-jit rounds + median A/B)
+#   2. config 6 e2e (pipelined publish tail, collect-wait decomposed)
+#   3. deep-window median A/B at W=256/512 (--iters auto)
+#   4. streaming-step ablation (--iters auto)
+# Override by passing commands as arguments (one quoted string each).
+#
+# WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
+# every 5 min for up to that long before starting (for catching the
+# next window of a currently-wedged tunnel).
+#
 # Each line of the output artifact is one command's JSON (or a failure
 # record); stderr goes to the sidecar .log.  Probe budgets are
 # env-tunable (BENCH_PROBE_BUDGET_S et al.).
@@ -12,12 +22,42 @@ set -u
 cd "$(dirname "$0")/.."
 out="artifacts/rig_recapture_$(date +%Y%m%d_%H%M).jsonl"
 mkdir -p artifacts
-for cmd in \
+
+case "${WAIT_FOR_LINK_S:-0}" in
+  *[!0-9]*)
+    echo "WAIT_FOR_LINK_S must be a whole number of seconds, got: ${WAIT_FOR_LINK_S}" >&2
+    exit 2 ;;
+esac
+if [ "${WAIT_FOR_LINK_S:-0}" -gt 0 ]; then
+  deadline=$(( $(date +%s) + WAIT_FOR_LINK_S ))
+  while :; do
+    if timeout 120 python -c "import jax; jax.devices()" 2>> "$out.log"; then
+      echo "link up at $(date -u)" >> "$out.log"
+      break
+    fi
+    now=$(date +%s)
+    if [ "$now" -ge "$deadline" ]; then
+      echo "{\"error\": \"link still down after ${WAIT_FOR_LINK_S}s of waiting\"}" >> "$out"
+      echo "$out"
+      exit 3
+    fi
+    echo "link down at $(date -u); retrying in 300 s" >> "$out.log"
+    sleep 300
+  done
+fi
+
+if [ $# -eq 0 ]; then
+  set -- \
     "python bench.py --config 5" \
     "python bench.py --config 6" \
     "python scripts/deep_window_ab.py --windows 256 512" \
-    "python scripts/step_ablation.py"; do
-  echo "{\"cmd\": \"$cmd\"}" >> "$out"
+    "python scripts/step_ablation.py"
+fi
+for cmd in "$@"; do
+  # NOTE: commands are split on whitespace (plain sh expansion) — pass
+  # simple space-separated words only, no shell quoting inside a command
+  cmd_json=$(printf '%s' "$cmd" | sed 's/\\/\\\\/g; s/"/\\"/g')
+  echo "{\"cmd\": \"$cmd_json\"}" >> "$out"
   tmp=$(mktemp)
   $cmd > "$tmp" 2>> "$out.log"
   if [ -s "$tmp" ]; then
