@@ -105,6 +105,14 @@ class DriverParams:
     # staleness; the publish never waits on device compute).  Off by
     # default — the reference publishes synchronously.
     pipelined_publish: bool = False
+    # bound on the pipelined collect's device->host fetch, mirroring the
+    # reference's bounded grab (every wait in its SDK carries a timeout,
+    # 2000 ms default — sl_lidar_driver.h:332).  A wedged remote-attach
+    # link can otherwise block the publish path indefinitely (observed
+    # >30 min on this rig).  On expiry the revolution is re-stashed and
+    # the fault surfaces to the FSM like any transient device error.
+    # 0/None = unbounded (a locally-attached chip's D2H is microseconds).
+    collect_timeout_s: float | None = None
 
     def validate(self) -> None:
         if self.qos_reliability not in VALID_QOS:
@@ -133,6 +141,8 @@ class DriverParams:
             raise ValueError(
                 "resample_backend must be 'auto', 'scatter' or 'dense'"
             )
+        if self.collect_timeout_s is not None and self.collect_timeout_s < 0:
+            raise ValueError("collect_timeout_s must be >= 0 (or None)")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

@@ -26,6 +26,7 @@ import numpy as np
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
 from rplidar_ros2_driver_tpu.core.types import ScanBatch
+from rplidar_ros2_driver_tpu.utils.fetch import bounded_fetch
 from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
@@ -142,6 +143,9 @@ class ScanFilterChain:
         # head-keep like the assembler's 8192-node overflow cap, never
         # raised — a crash would take down the scan thread mid-stream.
         self.capacity = capacity
+        # bound on the pipelined collect's device->host fetch (see
+        # _collect); 0/None = unbounded
+        self.collect_timeout_s = params.collect_timeout_s
         self._overflow_warned = False
         self._lock = threading.Lock()
         self._state = jax.device_put(
@@ -227,7 +231,9 @@ class ScanFilterChain:
         packed = jax.device_put(buf, self.device)
         with self._lock:
             self._state, wire = counted_filter_step_wire(self._state, packed, self.cfg)
-        return unpack_output_wire(wire, self.cfg)
+        # bounded like the pipelined collect: the synchronous publish is
+        # this framework's analog of the reference's timed grab
+        return self._collect(wire)
 
     def process_raw_pipelined(
         self, angle_q14, dist_q2, quality, flag=None
@@ -263,7 +269,7 @@ class ScanFilterChain:
         if pending is not None:
             t_collect = time.perf_counter()
             try:
-                out = unpack_output_wire(pending, self.cfg)
+                out = self._collect(pending)
                 # how long the collect blocked waiting for the async
                 # D2H copy to land: ~0 when the copy beat the
                 # inter-revolution gap (local chip: always), up to one
@@ -311,12 +317,49 @@ class ScanFilterChain:
             if self._pending_wire is None and self._epoch == epoch:
                 self._pending_wire = pending
 
+    def _collect(self, wire) -> FilterOutput:
+        """Fetch + unpack one wire output, bounded by
+        ``collect_timeout_s`` when set (utils/fetch.bounded_fetch) —
+        the analog of the reference's timed grab
+        (sl_lidar_driver.h:332).  On expiry a TimeoutError surfaces to
+        the caller's existing transient-fault path (re-stash + raise ->
+        FSM recovery, which drains once and then resets), so a wedged
+        link costs at most one stranded fetch thread per recovery
+        cycle, not per tick."""
+        return bounded_fetch(
+            lambda: unpack_output_wire(wire, self.cfg),
+            self.collect_timeout_s,
+            "publish collect (device->host)",
+        )
+
+    def discard_pipelined(self) -> None:
+        """Drop the pending pipelined output without fetching it.
+
+        For callers whose failure policy is drop-not-retry (the node's
+        drain): flush_pipelined re-stashes on a fetch fault/timeout so
+        that retrying callers don't lose the revolution, but a caller
+        that has already consumed its publish metadata must discard the
+        orphaned wire or it would linger (and a resumed stream would
+        spend a fetch materializing stale data)."""
+        with self._lock:
+            self._pending_wire = None
+
     def flush_pipelined(self) -> Optional[FilterOutput]:
         """Fetch the last dispatched step's output (the one revolution
-        still in flight when the stream stops), or None."""
+        still in flight when the stream stops), or None.  Bounded by
+        ``collect_timeout_s`` when set; on expiry the wire is re-stashed
+        (same contract as the streaming collect) so a later drain can
+        retry, and the TimeoutError surfaces to the caller."""
         with self._lock:
             pending, self._pending_wire = self._pending_wire, None
-        return unpack_output_wire(pending, self.cfg) if pending is not None else None
+            epoch = self._epoch
+        if pending is None:
+            return None
+        try:
+            return self._collect(pending)
+        except Exception:
+            self._restash_pending(pending, epoch)
+            raise
 
     # -- checkpoint surface -------------------------------------------------
 

@@ -159,6 +159,10 @@ class RPlidarNode(LifecycleNode):
             if out is not None:
                 self._publish_chain_output(out, *meta)
         except Exception:
+            # the meta is spent, so the re-stashed wire (flush re-stashes
+            # on fetch faults/timeouts for retrying callers) must go too
+            # — otherwise a resumed stream would fetch stale data
+            self.chain.discard_pipelined()
             log.warning("dropping in-flight pipelined output (drain failed)",
                         exc_info=True)
 

@@ -26,6 +26,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.utils.fetch import bounded_fetch
 from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
 from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS, config_from_params
 from rplidar_ros2_driver_tpu.ops.filters import (
@@ -68,6 +69,9 @@ class ShardedFilterService:
         )
         self.streams = streams
         self.capacity = capacity
+        # bound on pipelined tick collects (see _collect_pending);
+        # 0/None = unbounded
+        self.collect_timeout_s = params.collect_timeout_s
         sharded_step = build_sharded_step(self.mesh, self.cfg)
 
         # counted compact ingest, like the single-stream wire path: one
@@ -179,7 +183,14 @@ class ShardedFilterService:
         packed = jax.device_put(packed_np, self._packed_sharding)
         with self._lock:
             self._state, out = self._step(self._state, packed)
-        return self._materialize(out, [s is not None for s in scans])
+        # bounded like the pipelined collect: the synchronous tick is the
+        # fleet analog of the chain's process_raw (reference timed grab)
+        live = [s is not None for s in scans]
+        return bounded_fetch(
+            lambda: self._materialize(out, live),
+            self.collect_timeout_s,
+            "fleet tick materialize (device->host)",
+        )
 
     def _materialize(
         self, out: FilterOutput, live: Sequence[bool]
@@ -282,16 +293,40 @@ class ShardedFilterService:
         bound method captured at stash time, so tests (and subclasses)
         can intercept the fetch path dynamically."""
         out, live, collect = pending
-        return getattr(self, collect)(out, live)
+        # bounded like ScanFilterChain._collect: a wedged link surfaces
+        # a TimeoutError on the caller's transient-fault path (re-stash
+        # in submit_pipelined/flush, drop-with-warning in the local
+        # path) instead of blocking the tick loop indefinitely
+        return bounded_fetch(
+            lambda: getattr(self, collect)(out, live),
+            self.collect_timeout_s,
+            "fleet tick collect (device->host)",
+        )
+
+    def discard_pipelined(self) -> None:
+        """Drop the pending pipelined tick without fetching it — for
+        callers whose failure policy is drop-not-retry (mirror of
+        ScanFilterChain.discard_pipelined)."""
+        with self._lock:
+            self._pending = None
 
     def flush_pipelined(self) -> Optional[list[Optional[FilterOutput]]]:
         """Collect the last dispatched tick's outputs (the ones still in
         flight when the fleet stops), or None.  After pipelined LOCAL
         ticks this returns only this process's stream block, and is
-        per-process (not collective)."""
+        per-process (not collective).  On a fetch fault/timeout the tick
+        is re-stashed (same contract as the chain's drain) so a later
+        flush can retry, and the error surfaces to the caller."""
         with self._lock:
             pending, self._pending = self._pending, None
-        return self._collect_pending(pending) if pending is not None else None
+            epoch = self._epoch
+        if pending is None:
+            return None
+        try:
+            return self._collect_pending(pending)
+        except Exception:
+            self._restash_pending(pending, epoch)
+            raise
 
     def submit_local(
         self, local_scans: Sequence[Optional[dict]]
@@ -328,7 +363,12 @@ class ShardedFilterService:
         )
         with self._lock:
             self._state, out = self._step(self._state, packed)
-        return self._collect_local(out, [s is not None for s in local_scans])
+        live = [s is not None for s in local_scans]
+        return bounded_fetch(
+            lambda: self._collect_local(out, live),
+            self.collect_timeout_s,
+            "fleet tick collect (device->host)",
+        )
 
     def _pack_local(
         self, local_scans: Sequence[Optional[dict]]

@@ -1,0 +1,168 @@
+"""Bounded pipelined/synchronous collect (collect_timeout_s).
+
+The reference bounds every wait on the device (grab timeout 2000 ms
+default, sl_lidar_driver.h:332).  This framework's analog is the
+publish path's device->host fetch, which a wedged remote-attach link
+can block indefinitely; with ``collect_timeout_s`` set, the fetch is
+raced against a deadline and a TimeoutError surfaces to the FSM's
+transient-fault path while the revolution is re-stashed for the drain.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.ops.filters import wire_output_len
+
+BEAMS = 64
+
+
+class _BlockingWire:
+    """Stands in for a dispatched wire output whose D2H fetch stalls
+    until ``release`` is set (np.asarray enters __array__)."""
+
+    def __init__(self, release: threading.Event, payload: np.ndarray):
+        self._release = release
+        self._payload = payload
+
+    def __array__(self, dtype=None, copy=None):
+        self._release.wait()
+        p = self._payload
+        return p.astype(dtype) if dtype is not None else p
+
+
+def _chain(**over) -> ScanFilterChain:
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_chain=("clip",),
+        filter_window=2,
+        voxel_grid_size=8,
+        pipelined_publish=True,
+        **over,
+    )
+    return ScanFilterChain(params, beams=BEAMS, warmup=False)
+
+
+def _payload(chain: ScanFilterChain) -> np.ndarray:
+    return np.zeros(wire_output_len(chain.cfg), np.float32)
+
+
+def test_flush_times_out_restashes_and_recovers():
+    chain = _chain(collect_timeout_s=0.2)
+    release = threading.Event()
+    chain._pending_wire = _BlockingWire(release, _payload(chain))
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        chain.flush_pipelined()
+    assert time.monotonic() - t0 < 5.0  # bounded, not wedged
+    # the revolution is re-stashed so a later drain can retry ...
+    assert chain._pending_wire is not None
+    # ... and once the link resolves, the retry publishes it
+    release.set()
+    out = chain.flush_pipelined()
+    assert out is not None
+    assert chain._pending_wire is None
+
+
+def test_streaming_collect_times_out_and_restashes():
+    chain = _chain(collect_timeout_s=0.2)
+    release = threading.Event()
+    chain._pending_wire = _BlockingWire(release, _payload(chain))
+    rng = np.random.default_rng(0)
+    angle = (rng.uniform(0, 1 << 14, 200)).astype(np.uint16)
+    dist = (rng.uniform(400, 4000, 200)).astype(np.uint16)
+    qual = np.full(200, 47, np.uint8)
+    with pytest.raises(TimeoutError):
+        chain.process_raw_pipelined(angle, dist, qual)
+    # popped-but-unpublished revolution went back for the drain
+    assert isinstance(chain._pending_wire, _BlockingWire)
+    release.set()
+    assert chain.flush_pipelined() is not None
+
+
+def test_timeout_zero_or_none_is_unbounded():
+    # None (default) and 0 both mean "no deadline": the fetch runs
+    # inline on the calling thread (no helper thread involved)
+    from rplidar_ros2_driver_tpu.utils.fetch import bounded_fetch
+
+    for v in (None, 0):
+        assert bounded_fetch(threading.get_ident, v) == threading.get_ident()
+        chain = _chain(collect_timeout_s=v)
+        release = threading.Event()
+        release.set()  # never blocks
+        chain._pending_wire = _BlockingWire(release, _payload(chain))
+        assert chain.flush_pipelined() is not None
+
+
+def test_node_drain_discards_on_timeout():
+    # the node's drain policy is drop-not-retry: after a timed-out drain
+    # the chain must hold no orphaned wire (node/node.py discards it)
+    chain = _chain(collect_timeout_s=0.2)
+    release = threading.Event()
+    chain._pending_wire = _BlockingWire(release, _payload(chain))
+    with pytest.raises(TimeoutError):
+        chain.flush_pipelined()
+    assert chain._pending_wire is not None  # re-stashed by flush ...
+    chain.discard_pipelined()  # ... and explicitly dropped by the node
+    assert chain._pending_wire is None
+    release.set()
+    assert chain.flush_pipelined() is None
+
+
+def test_epoch_guard_still_wins_over_restash():
+    # a reset between pop and re-stash must keep the pre-reset output
+    # dropped (restore-race invariant, unchanged by the timeout path)
+    chain = _chain(collect_timeout_s=0.2)
+    release = threading.Event()
+    chain._pending_wire = _BlockingWire(release, _payload(chain))
+    with pytest.raises(TimeoutError):
+        chain.flush_pipelined()
+    chain.reset()  # epoch moves; pending cleared
+    assert chain._pending_wire is None
+    release.set()
+    assert chain.flush_pipelined() is None
+
+
+def test_service_tick_collect_times_out_and_restashes():
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    params = DriverParams(
+        filter_backend="cpu",
+        filter_chain=("clip",),
+        filter_window=2,
+        voxel_grid_size=8,
+        collect_timeout_s=0.2,
+    )
+    svc = ShardedFilterService(
+        params, streams=2, mesh=make_mesh(8), beams=BEAMS, capacity=256
+    )
+    release = threading.Event()
+    n = svc.streams
+
+    def blocked(out, live):  # instance attr: called unbound as (out, live)
+        release.wait()
+        return [object()] * n
+
+    svc._blocked = blocked
+    svc._pending = (None, [True] * n, "_blocked")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        svc.flush_pipelined()
+    assert time.monotonic() - t0 < 5.0
+    assert svc._pending is not None  # re-stashed for a later drain
+    release.set()
+    assert svc.flush_pipelined() is not None
+
+
+def test_collect_timeout_validation():
+    with pytest.raises(ValueError):
+        DriverParams(collect_timeout_s=-1.0).validate()
+    DriverParams(collect_timeout_s=2.0).validate()
+    DriverParams(collect_timeout_s=None).validate()
