@@ -161,6 +161,78 @@ def test_service_tick_collect_times_out_and_restashes():
     assert svc.flush_pipelined() is not None
 
 
+def test_fsm_recovers_from_wedged_collect():
+    """Full node path: a wedged device->host fetch mid-stream must trip
+    collect_timeout_s, surface as a transient fault, drive the FSM
+    through RESETTING, and — once the link resolves — resume publishing.
+    This is the behavior the reference's bounded grab buys its FSM
+    (src/rplidar_node.cpp:417-448), reproduced at this framework's
+    publish seam."""
+    from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
+    from rplidar_ros2_driver_tpu.node.fsm import FsmTimings
+    from rplidar_ros2_driver_tpu.node.node import CollectingPublisher, RPlidarNode
+    from rplidar_ros2_driver_tpu.ops.filters import unpack_output_wire
+    from rplidar_ros2_driver_tpu.utils.fetch import bounded_fetch
+
+    params = DriverParams(
+        dummy_mode=True,
+        max_retries=2,
+        filter_backend="cpu",
+        filter_chain=("clip",),
+        filter_window=2,
+        voxel_grid_size=8,
+        pipelined_publish=True,
+        collect_timeout_s=0.15,
+    )
+    pub = CollectingPublisher()
+    node = RPlidarNode(
+        params, pub,
+        driver_factory=lambda: DummyLidarDriver(scan_rate_hz=200.0),
+        fsm_timings=FsmTimings.fast(),
+    )
+    wedge = threading.Event()
+
+    def deadline():
+        return time.monotonic() + 20.0
+
+    from rplidar_ros2_driver_tpu.node.node import launch
+
+    launch(node)
+    try:
+        chain = node.chain
+
+        def wedgeable_collect(wire):
+            def fetch():
+                while wedge.is_set():  # the "link": blocked while wedged
+                    time.sleep(0.01)
+                return unpack_output_wire(wire, chain.cfg)
+
+            return bounded_fetch(fetch, chain.collect_timeout_s, "test fetch")
+
+        chain._collect = wedgeable_collect
+
+        t_end = deadline()
+        while pub.scan_count < 3 and time.monotonic() < t_end:
+            time.sleep(0.01)
+        assert pub.scan_count >= 3  # streaming before the wedge
+
+        wedge.set()
+        t_end = deadline()
+        while node.fsm.reset_count < 1 and time.monotonic() < t_end:
+            time.sleep(0.01)
+        assert node.fsm.reset_count >= 1  # bounded fault -> FSM recovery
+
+        wedge.clear()
+        before = pub.scan_count
+        t_end = deadline()
+        while pub.scan_count < before + 3 and time.monotonic() < t_end:
+            time.sleep(0.01)
+        assert pub.scan_count >= before + 3  # stream resumed after the wedge
+    finally:
+        wedge.clear()
+        node.shutdown()
+
+
 def test_collect_timeout_validation():
     with pytest.raises(ValueError):
         DriverParams(collect_timeout_s=-1.0).validate()
