@@ -100,6 +100,11 @@ class DriverParams:
     # resolve_resample_backend in filters/chain.py holds the mapping and
     # its provenance).  The fused replay path always uses the dense tile.
     resample_backend: str = "auto"
+    # voxel accumulation kernel: "scatter" (.at[].add histogram),
+    # "matmul" (one-hot einsum on the MXU, exact counts), or "auto" —
+    # resolved per platform from the step-ablation evidence
+    # (resolve_voxel_backend in filters/chain.py)
+    voxel_backend: str = "auto"
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -140,6 +145,10 @@ class DriverParams:
         if self.resample_backend not in ("auto", "scatter", "dense"):
             raise ValueError(
                 "resample_backend must be 'auto', 'scatter' or 'dense'"
+            )
+        if self.voxel_backend not in ("auto", "scatter", "matmul"):
+            raise ValueError(
+                "voxel_backend must be 'auto', 'scatter' or 'matmul'"
             )
         if self.collect_timeout_s is not None and self.collect_timeout_s < 0:
             raise ValueError("collect_timeout_s must be >= 0 (or None)")

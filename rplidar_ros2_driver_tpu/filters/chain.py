@@ -83,6 +83,21 @@ def resolve_resample_backend(requested: str, platform: Optional[str] = None) -> 
     return "scatter"
 
 
+def resolve_voxel_backend(requested: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` voxel-accumulation kernel per device platform
+    (mirrors :func:`resolve_resample_backend`).  "scatter" is the
+    jnp ``.at[].add`` histogram; "matmul" is the one-hot bf16 einsum
+    with f32 accumulation (exact counts — ops/filters.voxel_hits_matmul)
+    that rides the MXU where scatters serialize.  CPU: scatter (the
+    einsum materializes two beams x grid one-hots the host pays for).
+    TPU: scatter until the on-chip ablation artifact
+    (scripts/step_ablation.py, full_voxel_matmul case) decides
+    otherwise — same evidence bar the other two backends met."""
+    if requested != "auto":
+        return requested
+    return "scatter"
+
+
 def config_from_params(
     params: DriverParams,
     beams: int = DEFAULT_BEAMS,
@@ -108,6 +123,7 @@ def config_from_params(
         resample_backend=resolve_resample_backend(
             params.resample_backend, platform
         ),
+        voxel_backend=resolve_voxel_backend(params.voxel_backend, platform),
     )
 
 

@@ -97,6 +97,10 @@ class FilterConfig:
     # with K=1 — scatter-min serializes on TPU).  Fused replay always
     # uses the dense tile regardless.
     resample_backend: str = "scatter"
+    # voxel accumulation kernel: "scatter" (jnp .at[].add) or "matmul"
+    # (one-hot bf16 einsum with f32 accumulation — exact counts, rides
+    # the MXU; voxel_hits_matmul)
+    voxel_backend: str = "scatter"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -216,14 +220,65 @@ def polar_to_cartesian(ranges: jax.Array, beams: int):
     return xy, finite
 
 
-def voxel_hits(xy: jax.Array, mask: jax.Array, grid: int, cell_m: float) -> jax.Array:
-    """(G, G) occupancy counts for one scan, origin at the grid centre."""
+def _voxel_cells(
+    xy: jax.Array, mask: jax.Array, grid: int, cell_m: float
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(gx, gy, in_bounds) cell indices for one scan — the ONE place the
+    grid-indexing convention (origin at the grid centre, floor
+    semantics) lives, shared by both voxel kernels so their bit-parity
+    contract cannot drift."""
     half = grid // 2
     ij = jnp.floor(xy / cell_m).astype(jnp.int32) + half
-    inb = mask & (ij[:, 0] >= 0) & (ij[:, 0] < grid) & (ij[:, 1] >= 0) & (ij[:, 1] < grid)
-    flat = jnp.where(inb, ij[:, 0] * grid + ij[:, 1], grid * grid)
+    gx, gy = ij[:, 0], ij[:, 1]
+    inb = mask & (gx >= 0) & (gx < grid) & (gy >= 0) & (gy < grid)
+    return gx, gy, inb
+
+
+def voxel_hits(xy: jax.Array, mask: jax.Array, grid: int, cell_m: float) -> jax.Array:
+    """(G, G) occupancy counts for one scan, origin at the grid centre."""
+    gx, gy, inb = _voxel_cells(xy, mask, grid, cell_m)
+    flat = jnp.where(inb, gx * grid + gy, grid * grid)
     counts = jnp.zeros((grid * grid,), jnp.int32).at[flat].add(1, mode="drop")
     return counts.reshape(grid, grid)
+
+
+def voxel_hits_matmul(
+    xy: jax.Array, mask: jax.Array, grid: int, cell_m: float
+) -> jax.Array:
+    """(G, G) occupancy counts via a one-hot einsum — the MXU-riding
+    alternative to :func:`voxel_hits`'s scatter-add (scatters serialize
+    on TPU; a 0/1 outer-product accumulation is one (G, B) @ (B, G)
+    matmul the systolic array eats whole).
+
+    Exactness: the one-hots are exactly 0/1 in bf16, every product is
+    exact, and the accumulation happens in f32
+    (``preferred_element_type``) — integer counts are exact up to 2**24
+    hits per cell (a scan contributes at most ``beams``).  Bit-identical
+    to :func:`voxel_hits` (parity-tested); selected by
+    ``FilterConfig.voxel_backend``.
+    """
+    gx, gy, inb = _voxel_cells(xy, mask, grid, cell_m)
+    cells = jnp.arange(grid, dtype=jnp.int32)
+    # mask folded into one side only: a dead/out-of-grid point is all-zero
+    ohx = ((gx[:, None] == cells[None, :]) & inb[:, None]).astype(jnp.bfloat16)
+    ohy = (gy[:, None] == cells[None, :]).astype(jnp.bfloat16)
+    counts = jnp.einsum(
+        "bi,bj->ij", ohx, ohy, preferred_element_type=jnp.float32
+    )
+    return counts.astype(jnp.int32)
+
+
+def select_voxel_hits(backend: str):
+    """The one ``voxel_backend`` -> kernel mapping ("scatter" | "matmul").
+    Strict: an unresolved "auto" or a typo must fail loudly, not silently
+    run the scatter kernel under a mislabeled A/B."""
+    try:
+        return {"scatter": voxel_hits, "matmul": voxel_hits_matmul}[backend]
+    except KeyError:
+        raise ValueError(
+            f"voxel_backend must be 'scatter' or 'matmul' once resolved, "
+            f"got {backend!r}"
+        ) from None
 
 
 # ---------------------------------------------------------------------------
@@ -279,7 +334,9 @@ def _filter_step_impl(
     xy, mask = polar_to_cartesian(med, cfg.beams)
 
     if cfg.enable_voxel:
-        new_hits = voxel_hits(xy, mask, cfg.grid, cfg.cell_m)
+        new_hits = select_voxel_hits(cfg.voxel_backend)(
+            xy, mask, cfg.grid, cfg.cell_m
+        )
         old_hits = jax.lax.dynamic_index_in_dim(
             state.hit_window, state.cursor, 0, keepdims=False
         )
@@ -638,7 +695,7 @@ def compact_filter_scan(
         keys_fn=lambda batch: _resample_keys(batch, cfg.beams),
         polar_fn=lambda row: polar_to_cartesian(row, cfg.beams),
         hits_fn=lambda xy, mask: jax.vmap(
-            voxel_hits, in_axes=(0, 0, None, None)
+            select_voxel_hits(cfg.voxel_backend), in_axes=(0, 0, None, None)
         )(xy, mask, cfg.grid, cfg.cell_m),
     )
 

@@ -44,6 +44,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     _grid_decode,
     clip_filter,
     fused_scan_core,
+    select_voxel_hits,
     temporal_median,
 )
 
@@ -126,14 +127,10 @@ def _polar_to_cartesian_shard(ranges: jax.Array, cfg: FilterConfig, b_local: int
 
 
 def _voxel_hits_partial(xy: jax.Array, mask: jax.Array, cfg: FilterConfig) -> jax.Array:
-    """This beam shard's partial (G, G) occupancy counts for one scan."""
-    grid = cfg.grid
-    half = grid // 2
-    ij = jnp.floor(xy / cfg.cell_m).astype(jnp.int32) + half
-    inb = mask & (ij[:, 0] >= 0) & (ij[:, 0] < grid) & (ij[:, 1] >= 0) & (ij[:, 1] < grid)
-    flat = jnp.where(inb, ij[:, 0] * grid + ij[:, 1], grid * grid)
-    counts = jnp.zeros((grid * grid,), jnp.int32).at[flat].add(1, mode="drop")
-    return counts.reshape(grid, grid)
+    """This beam shard's partial (G, G) occupancy counts for one scan
+    (kernel per ``cfg.voxel_backend``, like the single-device step —
+    counts are additive over beam shards for either kernel)."""
+    return select_voxel_hits(cfg.voxel_backend)(xy, mask, cfg.grid, cfg.cell_m)
 
 
 def _ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:

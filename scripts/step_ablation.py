@@ -119,6 +119,7 @@ def main() -> int:
     cases = {
         "full_scatter": cfg(resample_backend="scatter"),
         "full_dense": cfg(resample_backend="dense"),
+        "full_voxel_matmul": cfg(voxel_backend="matmul"),
         "no_median": cfg(enable_median=False),
         "no_voxel": cfg(enable_voxel=False),
         "no_clip": cfg(enable_clip=False),
@@ -151,6 +152,9 @@ def main() -> int:
         "voxel_us": round(full - us["no_voxel"], 2),
         "clip_us": round(full - us["no_clip"], 2),
         "dense_vs_scatter_speedup": round(us["full_scatter"] / us["full_dense"], 3),
+        "matmul_vs_scatter_voxel_speedup": round(
+            us["full_scatter"] / us["full_voxel_matmul"], 3
+        ),
     }
     print(json.dumps({
         "ablation_us": {k: round(v, 2) for k, v in us.items()},

@@ -94,6 +94,41 @@ class TestVoxel:
         assert grid[32 + 1, 32 + 1] == 1
         assert grid[32 - 2, 32 + 1] == 1
 
+    def test_matmul_backend_bit_identical_to_scatter(self):
+        # voxel_hits_matmul's contract is exactness: 0/1 bf16 products
+        # accumulated in f32 are exact integers, so the MXU formulation
+        # must match the scatter histogram bit for bit — including
+        # masked and out-of-grid points
+        rng = np.random.default_rng(7)
+        xy = jnp.asarray(rng.uniform(-12, 12, (2048, 2)).astype(np.float32))
+        mask = jnp.asarray(rng.random(2048) < 0.8)
+        a = np.asarray(filters.voxel_hits(xy, mask, 64, 0.25))
+        b = np.asarray(filters.voxel_hits_matmul(xy, mask, 64, 0.25))
+        assert a.dtype == b.dtype == np.int32
+        np.testing.assert_array_equal(a, b)
+        # many points into ONE cell: accumulation exactness beyond 256
+        # (where bf16 would saturate integer representation)
+        xy1 = jnp.zeros((2048, 2), jnp.float32) + 0.1
+        all_on = jnp.ones(2048, bool)
+        m = np.asarray(filters.voxel_hits_matmul(xy1, all_on, 64, 0.25))
+        assert m.sum() == 2048 and m.max() == 2048
+
+    def test_full_step_parity_across_voxel_backends(self):
+        outs = {}
+        for backend in ("scatter", "matmul"):
+            cfg = filters.FilterConfig(
+                window=4, beams=CFG.beams, grid=32, cell_m=0.25,
+                voxel_backend=backend,
+            )
+            state = filters.FilterState.create(cfg.window, cfg.beams, cfg.grid)
+            for k in range(6):
+                b = make_batch(
+                    np.arange(0, 360, 1.5), np.full(240, 2.0 + 0.1 * k), n=1024
+                )
+                state, out = filters.filter_step(state, b, cfg)
+            outs[backend] = np.asarray(out.voxel)
+        np.testing.assert_array_equal(outs["scatter"], outs["matmul"])
+
     def test_window_accumulation_retires_old_scans(self):
         state = filters.FilterState.create(CFG.window, CFG.beams, CFG.grid)
         b = make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0), n=1024)
