@@ -64,9 +64,9 @@ BASELINE_SCANS_PER_SEC = 10.0  # real-time requirement at 600 RPM
 # VMEM bitonic-network median (ops/pallas_kernels.py) vs the XLA sort path:
 # config 5 measures BOTH on the device-resident in-jit step and records the
 # A/B in the artifact ("median_ab"); --median selects the headline backend.
-# pallas is the evidenced default: 1.64x over xla at W=64 device-resident,
-# non-overlapping interleaved rounds (docs/BENCHMARKS.md).  Falls back to
-# interpret mode on CPU.
+# pallas is the evidenced default: 2.14x over xla at W=64 device-resident
+# (RTT-adaptive rounds, 2026-07-31 recapture; non-overlapping interleaved
+# rounds — docs/BENCHMARKS.md).  Falls back to interpret mode on CPU.
 MEDIAN_BACKEND = "pallas"
 # wire capacity: smallest power of two holding a DenseBoost revolution —
 # halves the per-scan transfer vs the 8192-node default (24 KB at 6 B/pt)
@@ -229,7 +229,7 @@ def bench_fused(k_scans: int = 32768, chunk: int = 512) -> dict:
     seq_np, counts_np = pack_host_scans_compact(
         [scans[i % len(scans)] for i in range(chunk)], CAPACITY
     )
-    state = jax.device_put(FilterState.create(cfg.window, cfg.beams, cfg.grid), device)
+    state = jax.device_put(FilterState.for_config(cfg), device)
     seq = jax.device_put(seq_np, device)
     counts = jax.device_put(counts_np, device)
 
@@ -490,7 +490,7 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
     # not masquerade as framework time
     reps = 100
     cfg = chain.cfg
-    state = jax.device_put(FilterState.create(cfg.window, cfg.beams, cfg.grid), device)
+    state = jax.device_put(FilterState.for_config(cfg), device)
     scans = _host_scans(1, POINTS)
     p = jax.device_put(
         pack_host_scan_counted(
@@ -632,7 +632,7 @@ class _ChainRunner:
         self.cfg = cfg
         self.device = jax.devices()[0]
         self.state = jax.device_put(
-            FilterState.create(cfg.window, cfg.beams, cfg.grid), self.device
+            FilterState.for_config(cfg), self.device
         )
         scans = _host_scans(32, points)
         self.packed = [
@@ -756,9 +756,9 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         # The median A/B (r2 VERDICT #3) also runs on the device-resident
         # step — the streaming A/B was link-bound and could not resolve
         # (r2: fully overlapping distributions).  Device-resident, the
-        # separation is clean: pallas 1.64x over xla at W=64 (and at
-        # least 1.2-1.4x at W=256/512 — docs/BENCHMARKS.md), hence the
-        # pallas default.
+        # separation is clean: pallas 2.14x over xla at W=64 and
+        # 2.1-2.5x at W=256/512 (RTT-adaptive recapture, 2026-07-31 —
+        # docs/BENCHMARKS.md), hence the pallas default.
         other = "xla" if median == "pallas" else "pallas"
         runners = {
             median: _ChainRunner(cfg, points),

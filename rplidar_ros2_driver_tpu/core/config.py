@@ -86,12 +86,12 @@ class DriverParams:
     voxel_grid_size: int = 256        # cells per side of the 2-D occupancy grid
     voxel_cell_m: float = 0.25        # metres per cell
     # temporal-median implementation: "xla" (jnp.sort), "pallas" (VMEM
-    # bitonic-network kernel, ops/pallas_kernels.py), or "auto" — pallas
-    # on a TPU device, xla elsewhere (pallas on CPU runs in interpret
-    # mode, which is pathologically slow).  The device-resident in-jit
-    # A/B behind the default: pallas 1.64x over xla at W=64,
-    # non-overlapping interleaved rounds; deeper windows at least
-    # 1.2-1.4x (docs/BENCHMARKS.md).
+    # bitonic-network kernel, ops/pallas_kernels.py), "inc" (incremental
+    # sliding median over a sorted-window carried state), or "auto" —
+    # pallas on TPU, inc on CPU, xla elsewhere.  Evidence behind the
+    # mapping (docs/BENCHMARKS.md): pallas 2.14x over xla at W=64 and
+    # 2.1-2.5x at W=256/512 (RTT-adaptive device-resident rounds,
+    # 2026-07-31); inc 3.8x on the CPU full step.
     median_backend: str = "auto"
     # per-scan streaming-step resampler: "scatter" (jnp .at[].min),
     # "dense" (the fused path's tiled masked-min at K=1; bit-identical,
@@ -140,8 +140,10 @@ class DriverParams:
             )
         if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
             raise ValueError("invalid voxel grid configuration")
-        if self.median_backend not in ("auto", "xla", "pallas"):
-            raise ValueError("median_backend must be 'auto', 'xla' or 'pallas'")
+        if self.median_backend not in ("auto", "xla", "pallas", "inc"):
+            raise ValueError(
+                "median_backend must be 'auto', 'xla', 'pallas' or 'inc'"
+            )
         if self.resample_backend not in ("auto", "scatter", "dense"):
             raise ValueError(
                 "resample_backend must be 'auto', 'scatter' or 'dense'"

@@ -57,15 +57,26 @@ DEFAULT_BEAMS = 2048
 
 def resolve_median_backend(requested: str, platform: Optional[str] = None) -> str:
     """Resolve the ``auto`` median backend for a device platform: pallas
-    on TPU (device-resident A/B: 1.64x over xla at W=64, at least
-    1.2-1.4x at deeper windows — docs/BENCHMARKS.md), xla everywhere
-    else (pallas on CPU runs in interpret mode).  Explicit requests pass
-    through."""
+    on TPU (device-resident A/B: 2.14x over xla at W=64, 2.1-2.5x at
+    deeper windows — docs/BENCHMARKS.md), xla everywhere else (pallas on
+    CPU runs in interpret mode).  Explicit requests — including "inc",
+    the incremental sliding median (sorted-window carried state, O(W)
+    per revolution) — pass through; "inc" joins the auto mapping when
+    the on-chip ablation (full_median_inc) clears the same evidence bar
+    the current mapping did."""
     if requested != "auto":
         return requested
     if platform is None:
         platform = jax.default_backend()
-    return "pallas" if platform == "tpu" else "xla"
+    # Evidence-gated per platform, same bar for each: TPU stays pallas
+    # pending the on-chip full_median_inc ablation; CPU is inc — the
+    # step-ablation artifact measured the incremental path 3.8x faster
+    # on the full W=64 step (median stage ~23x vs jnp.sort, 2026-07-31),
+    # bit-exact outputs (tests/test_filters.py parity suite); anything
+    # else (GPU) keeps the xla sort until it has its own measurement.
+    if platform == "tpu":
+        return "pallas"
+    return "inc" if platform == "cpu" else "xla"
 
 
 def resolve_resample_backend(requested: str, platform: Optional[str] = None) -> str:
@@ -165,8 +176,7 @@ class ScanFilterChain:
         self._overflow_warned = False
         self._lock = threading.Lock()
         self._state = jax.device_put(
-            FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
-            self.device,
+            FilterState.for_config(self.cfg), self.device
         )
         # double-buffered publish seam: the not-yet-fetched wire output of
         # the newest dispatched step (process_raw_pipelined); _epoch
@@ -207,6 +217,10 @@ class ScanFilterChain:
                 voxel_acc=state.voxel_acc,
                 cursor=state.cursor * 0,
                 filled=state.filled * 0,
+                # the zero-count warmup replaced an all-inf ring row with
+                # an all-inf row, so the stepped sorted window is still
+                # the sorted view of the rolled-back ring
+                median_sorted=state.median_sorted,
             )
 
     def _pack_capped(self, angle_q14, dist_q2, quality, flag):
@@ -388,16 +402,29 @@ class ScanFilterChain:
         path for the duration of a device->host fetch."""
         with self._lock:
             state = jax.tree_util.tree_map(jnp.copy, self._state)
-        return {k: np.asarray(v) for k, v in vars(state).items()}
+        # median_sorted is DERIVED state (the sorted view of
+        # range_window) — excluded so the snapshot format is identical
+        # across median backends and restore recomputes it as needed
+        return {
+            k: np.asarray(v)
+            for k, v in vars(state).items()
+            if k != "median_sorted"
+        }
 
     @staticmethod
     def _shape_mismatch(
         snap: dict[str, np.ndarray], window: int, beams: int, grid: int
     ) -> Optional[tuple[dict, dict]]:
         """(got, expected) when incompatible, None when compatible.
-        Host-side — no device transfer."""
+        Host-side — no device transfer.  The derived median_sorted key
+        (present in no current snapshot, tolerated for forward compat)
+        is ignored."""
         expected = FilterState.shapes(window, beams, grid)
-        got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+        got = {
+            k: tuple(np.asarray(v).shape)
+            for k, v in snap.items()
+            if k != "median_sorted"
+        }
         return None if expected == got else (got, expected)
 
     @classmethod
@@ -445,17 +472,29 @@ class ScanFilterChain:
         # build the new device state OUTSIDE the lock (the H2D upload is
         # several MB at default geometry); only the reference swap — O(1)
         # — holds the streaming lock
+        with_sorted = self.cfg.median_backend == "inc"
         if snap is None:
             fresh = jax.device_put(
-                FilterState.create(self.cfg.window, self.cfg.beams, self.cfg.grid),
-                self.device,
+                FilterState.for_config(self.cfg), self.device
             )
             with self._lock:
                 self._state = fresh
                 self._pending_wire = None  # pre-reset output: never publish
                 self._epoch += 1
             return False
-        restored = jax.device_put(FilterState(**snap), self.device)
+        core = {k: v for k, v in snap.items() if k != "median_sorted"}
+        restored = jax.device_put(
+            FilterState(
+                **core,
+                # derived state: recompute from the restored ring so any
+                # snapshot (legacy, cross-backend) restores under "inc"
+                median_sorted=(
+                    np.sort(core["range_window"], axis=0)
+                    if with_sorted else None
+                ),
+            ),
+            self.device,
+        )
         with self._lock:
             self._state = restored
             self._pending_wire = None

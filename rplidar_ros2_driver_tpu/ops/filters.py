@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,11 +46,20 @@ class FilterState:
     voxel_acc: jax.Array      # (G, G) int32 running sum over the window
     cursor: jax.Array         # int32 ring write position
     filled: jax.Array         # int32 number of scans pushed (saturates at W)
+    # derived state for median_backend == "inc": the window's multiset
+    # kept sorted ascending per beam (None for the other backends; a
+    # None pytree leaf is an empty subtree, so state structure stays
+    # jit/donation-stable per compiled config).  Invariant: always the
+    # sorted view of range_window's multiset — maintained incrementally
+    # by the step, recomputed wholesale by the fused path and restore.
+    median_sorted: Optional[jax.Array] = None  # (W, B) float32
 
     @staticmethod
     def shapes(window: int, beams: int, grid: int) -> dict[str, tuple[int, ...]]:
         """Array shapes of a state with this geometry — host-side, no
-        allocation (used to validate checkpoints before touching devices)."""
+        allocation (used to validate checkpoints before touching
+        devices).  Derived fields (median_sorted) are not part of the
+        checkpoint surface, so they don't appear here."""
         return {
             "range_window": (window, beams),
             "inten_window": (window, beams),
@@ -59,8 +69,20 @@ class FilterState:
             "filled": (),
         }
 
+    @classmethod
+    def for_config(cls, cfg: "FilterConfig") -> "FilterState":
+        """The one config -> fresh-state mapping: backends that carry
+        derived state (median_backend == "inc" needs the sorted window)
+        get it here, so call sites can't forget the coupling."""
+        return cls.create(
+            cfg.window, cfg.beams, cfg.grid,
+            with_sorted=cfg.median_backend == "inc",
+        )
+
     @staticmethod
-    def create(window: int, beams: int, grid: int) -> "FilterState":
+    def create(
+        window: int, beams: int, grid: int, with_sorted: bool = False
+    ) -> "FilterState":
         return FilterState(
             range_window=jnp.full((window, beams), jnp.inf, jnp.float32),
             inten_window=jnp.zeros((window, beams), jnp.float32),
@@ -68,6 +90,11 @@ class FilterState:
             voxel_acc=jnp.zeros((grid, grid), jnp.int32),
             cursor=jnp.asarray(0, jnp.int32),
             filled=jnp.asarray(0, jnp.int32),
+            # an all-inf ring is trivially sorted
+            median_sorted=(
+                jnp.full((window, beams), jnp.inf, jnp.float32)
+                if with_sorted else None
+            ),
         )
 
 
@@ -86,7 +113,11 @@ class FilterConfig:
     enable_median: bool = True
     enable_voxel: bool = True
     # "xla" = jnp.sort path; "pallas" = VMEM bitonic-network kernel
-    # (ops/pallas_kernels.temporal_median_pallas)
+    # (ops/pallas_kernels.temporal_median_pallas); "inc" = incremental
+    # sliding median over a sorted-window carried state (sorted_replace
+    # — O(W) elementwise per step; requires FilterState created with
+    # with_sorted=True; the fused path computes "inc" via the xla
+    # windows and re-sorts the carried state per chunk)
     median_backend: str = "xla"
     # sharded-step voxel all-reduce over the beam axis: "psum" (XLA's
     # tuned all-reduce, default) or "ring" (explicit ppermute
@@ -211,6 +242,59 @@ def temporal_median(window: jax.Array) -> jax.Array:
     return jnp.where(nvalid > 0, med, jnp.inf)
 
 
+def sorted_replace(
+    sorted_w: jax.Array, old_v: jax.Array, new_v: jax.Array
+) -> jax.Array:
+    """Multiset update of a per-beam sorted window: delete one occurrence
+    of ``old_v``, insert ``new_v``, keep it sorted — branch-free, O(W)
+    elementwise work per beam instead of a fresh O(W log^2 W) sort.
+
+    This is the sliding-window trick the streaming step's geometry
+    invites: the ring evicts exactly one value per revolution
+    (``range_window[cursor]``, bit-exactly the value inserted W steps
+    ago), so between steps the sorted multiset changes by one delete and
+    one insert.  The shift between the delete and insert positions is at
+    most one slot per element, so the new array is a 3-way select over
+    {left-neighbor, self, right-neighbor} — two rolls and a few compares
+    on (W, B), no gather, no sort network.
+
+    Args: sorted_w (W, B) ascending per column; old_v (B,) MUST be
+    present in each column (exact float equality — guaranteed when it
+    came from the same ring); new_v (B,).  +inf entries participate like
+    any value (missing returns / unfilled slots).  Returns (W, B).
+    """
+    w = sorted_w.shape[0]
+    iota = jnp.arange(w, dtype=jnp.int32)[:, None]                   # (W, 1)
+    # d: first slot holding old_v (ties: any occurrence is the same value)
+    d = jnp.argmax(sorted_w == old_v[None, :], axis=0).astype(jnp.int32)  # (B,)
+    # p: insertion index of new_v in the W-1 multiset without old_v —
+    # count of strictly-smaller survivors ("insert after equals": stable)
+    p = (
+        jnp.sum(sorted_w < new_v[None, :], axis=0)
+        - (old_v < new_v).astype(jnp.int32)
+    ).astype(jnp.int32)                                              # (B,)
+    left = jnp.roll(sorted_w, 1, axis=0)    # left[i]  = s[i-1]
+    right = jnp.roll(sorted_w, -1, axis=0)  # right[i] = s[i+1]
+    # d < p: slots [d, p) close the gap from the right (take s[i+1]);
+    # d > p: slots (p, d] make room from the left (take s[i-1]);
+    # the wrap rows of the rolls are never selected (i<p<=W-1, i>p>=0)
+    shift_l = (d[None, :] < p[None, :]) & (iota >= d[None, :]) & (iota < p[None, :])
+    shift_r = (d[None, :] > p[None, :]) & (iota > p[None, :]) & (iota <= d[None, :])
+    out = jnp.where(shift_l, right, jnp.where(shift_r, left, sorted_w))
+    return jnp.where(iota == p[None, :], new_v[None, :], out)
+
+
+def median_from_sorted(sorted_w: jax.Array) -> jax.Array:
+    """Per-beam lower median given the already-sorted (W, B) window —
+    identical semantics to :func:`temporal_median` (+inf marks missing;
+    all-inf beams stay +inf), minus the sort."""
+    w = sorted_w.shape[0]
+    nvalid = jnp.sum(jnp.isfinite(sorted_w), axis=0)
+    pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)
+    med = jnp.take_along_axis(sorted_w, pick[None, :], axis=0)[0]
+    return jnp.where(nvalid > 0, med, jnp.inf)
+
+
 def polar_to_cartesian(ranges: jax.Array, beams: int):
     """Beam-grid ranges -> (B, 2) XY metres + finite mask."""
     theta = (jnp.arange(beams, dtype=jnp.float32) + 0.5) * (TWO_PI / beams)
@@ -320,8 +404,24 @@ def _filter_step_impl(
     iw = jax.lax.dynamic_update_index_in_dim(state.inten_window, inten, state.cursor, 0)
     filled = jnp.minimum(state.filled + 1, rw.shape[0])
 
+    ms = state.median_sorted
     if cfg.enable_median:
-        if cfg.median_backend == "pallas":
+        if cfg.median_backend == "inc":
+            # incremental sliding median: the ring evicts exactly ONE
+            # value per step, so the sorted multiset is maintained by a
+            # delete+insert (O(W) elementwise) instead of re-sorted
+            if ms is None:
+                raise ValueError(
+                    "median_backend='inc' requires a state created with "
+                    "with_sorted=True (FilterState.create) — the sorted "
+                    "window is carried state"
+                )
+            old_v = jax.lax.dynamic_index_in_dim(
+                state.range_window, state.cursor, 0, keepdims=False
+            )
+            ms = sorted_replace(ms, old_v, ranges)
+            med = median_from_sorted(ms)
+        elif cfg.median_backend == "pallas":
             from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
                 temporal_median_pallas,
             )
@@ -355,6 +455,7 @@ def _filter_step_impl(
         voxel_acc=voxel_acc,
         cursor=(state.cursor + 1) % rw.shape[0],
         filled=filled,
+        median_sorted=ms,
     )
     out = FilterOutput(
         ranges=med,
@@ -672,6 +773,13 @@ def fused_scan_core(
         voxel_acc=voxel_acc,
         cursor=cursor2,
         filled=filled,
+        # the fused path advances K scans at once, so the incremental
+        # backend's derived state is re-sorted wholesale (one sort per
+        # K-chunk, amortized) to restore the invariant
+        median_sorted=(
+            jnp.sort(range_window, axis=0)
+            if state.median_sorted is not None else None
+        ),
     )
     return final, med
 

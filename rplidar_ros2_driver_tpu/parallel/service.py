@@ -534,7 +534,11 @@ class ShardedFilterService:
 
     def snapshot(self) -> dict[str, np.ndarray]:
         state = self._copy_state()
-        return {k: np.asarray(v) for k, v in vars(state).items()}
+        # optional derived fields (median_sorted) are absent (None) in
+        # sharded states and excluded from snapshots either way
+        return {
+            k: np.asarray(v) for k, v in vars(state).items() if v is not None
+        }
 
     def save_sharded(self, path: str) -> None:
         """Persist the sharded state with Orbax — no host gather: each
@@ -582,7 +586,11 @@ class ShardedFilterService:
                     self.cfg.window, self.cfg.beams, self.cfg.grid
                 ).items()
             }
-            got = {k: tuple(np.asarray(v).shape) for k, v in snap.items()}
+            got = {
+                k: tuple(np.asarray(v).shape)
+                for k, v in snap.items()
+                if k != "median_sorted"  # derived, never carried sharded
+            }
             if expected != got:
                 logger.warning(
                     "rejecting incompatible sharded snapshot (%s != %s)",
@@ -591,7 +599,12 @@ class ShardedFilterService:
                 )
                 return False
             # H2D placement outside the lock; only the O(1) swap inside
-            restored = place_state(self.mesh, FilterState(**snap))
+            restored = place_state(
+                self.mesh,
+                FilterState(
+                    **{k: v for k, v in snap.items() if k != "median_sorted"}
+                ),
+            )
             with self._lock:
                 self._state = restored
                 self._pending = None

@@ -386,6 +386,9 @@ def abstract_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> Filte
             sharding=NamedSharding(mesh, getattr(STATE_SPEC, f.name)),
         )
         for f in dataclasses.fields(FilterState)
+        # optional derived fields (median_sorted) are absent (None) in
+        # sharded states — the sharded step recomputes medians directly
+        if getattr(per, f.name) is not None
     })
 
 

@@ -160,7 +160,7 @@ def replay_through_chain(
     )
 
     cfg = config_from_params(params, beams or DEFAULT_BEAMS)
-    state = FilterState.create(cfg.window, cfg.beams, cfg.grid)
+    state = FilterState.for_config(cfg)
     outs = []
     for i in range(0, len(revolutions), chunk):
         seq, counts = pack_host_scans_compact(revolutions[i : i + chunk], capacity)

@@ -100,9 +100,7 @@ def main() -> int:
             return st, out.ranges
 
         run = bench._min_fold_loop(step_ranges, (c.beams,), iters)
-        state = jax.device_put(
-            FilterState.create(c.window, c.beams, c.grid), device
-        )
+        state = jax.device_put(FilterState.for_config(c), device)
         p = jax.device_put(buf, device)
         state, acc = run(state, p)  # compile outside the timed region
         bench._device_barrier(jnp.min(acc))
@@ -120,6 +118,12 @@ def main() -> int:
         "full_scatter": cfg(resample_backend="scatter"),
         "full_dense": cfg(resample_backend="dense"),
         "full_voxel_matmul": cfg(voxel_backend="matmul"),
+        # median backends pinned explicitly: full_scatter's median is
+        # whatever auto resolves to (pallas on TPU, inc on CPU), so the
+        # inc-vs-sort comparison needs its own xla baseline to stay
+        # reproducible after auto flips
+        "full_median_xla": cfg(median_backend="xla"),
+        "full_median_inc": cfg(median_backend="inc"),
         "no_median": cfg(enable_median=False),
         "no_voxel": cfg(enable_voxel=False),
         "no_clip": cfg(enable_clip=False),
@@ -154,6 +158,15 @@ def main() -> int:
         "dense_vs_scatter_speedup": round(us["full_scatter"] / us["full_dense"], 3),
         "matmul_vs_scatter_voxel_speedup": round(
             us["full_scatter"] / us["full_voxel_matmul"], 3
+        ),
+        # inc vs the explicit sort path (platform-independent baseline)
+        "inc_vs_xla_median_speedup": round(
+            us["full_median_xla"] / us["full_median_inc"], 3
+        ),
+        # inc vs whatever auto currently resolves to (pallas on TPU —
+        # the comparison that decides the TPU auto mapping)
+        "inc_vs_auto_median_speedup": round(
+            us["full_scatter"] / us["full_median_inc"], 3
         ),
     }
     print(json.dumps({

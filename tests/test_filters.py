@@ -85,6 +85,114 @@ class TestTemporalMedian:
         assert np.allclose(finite, 2.0)  # the 9 m spike scan is voted out
 
 
+class TestIncrementalMedian:
+    def test_sorted_replace_matches_resort(self):
+        rng = np.random.default_rng(3)
+        W, B = 16, 64
+        ring = np.full((W, B), np.inf, np.float32)
+        sor = np.sort(ring, axis=0)
+        cursor = 0
+        for step in range(120):
+            new = rng.uniform(0.1, 40.0, B).astype(np.float32)
+            new[rng.random(B) < 0.25] = np.inf        # missing returns
+            if step % 7 == 0:
+                new[:] = new[0]                        # heavy ties
+            old = ring[cursor].copy()
+            sor = np.asarray(
+                filters.sorted_replace(
+                    jnp.asarray(sor), jnp.asarray(old), jnp.asarray(new)
+                )
+            )
+            ring[cursor] = new
+            cursor = (cursor + 1) % W
+            np.testing.assert_array_equal(sor, np.sort(ring, axis=0))
+
+    def test_full_step_parity_inc_vs_xla(self):
+        # medians (and therefore every downstream output) must be
+        # bit-identical between the sort path and the incremental path,
+        # through unfilled windows AND full wraparound
+        cfgs = {
+            b: filters.FilterConfig(
+                window=6, beams=CFG.beams, grid=32, cell_m=0.25,
+                median_backend=b,
+            )
+            for b in ("xla", "inc")
+        }
+        states = {
+            b: filters.FilterState.create(
+                c.window, c.beams, c.grid, with_sorted=(b == "inc")
+            )
+            for b, c in cfgs.items()
+        }
+        rng = np.random.default_rng(11)
+        for k in range(15):  # > 2 full window wraps
+            dist = np.full(240, 2.0 + 0.2 * k) + rng.normal(0, 0.05, 240)
+            b = make_batch(np.arange(0, 360, 1.5), dist, n=1024)
+            outs = {}
+            for name in cfgs:
+                states[name], outs[name] = filters.filter_step(
+                    states[name], b, cfgs[name]
+                )
+            np.testing.assert_array_equal(
+                np.asarray(outs["xla"].ranges), np.asarray(outs["inc"].ranges)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(outs["xla"].voxel), np.asarray(outs["inc"].voxel)
+            )
+
+    def test_fused_chunk_restores_inc_invariant(self):
+        # the fused path re-sorts the carried state per chunk; streaming
+        # steps after a fused chunk must continue bit-exactly
+        cfg = filters.FilterConfig(
+            window=4, beams=CFG.beams, grid=32, cell_m=0.25,
+            median_backend="inc",
+        )
+        state = filters.FilterState.create(
+            cfg.window, cfg.beams, cfg.grid, with_sorted=True
+        )
+        scans = [
+            make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0 + 0.3 * k), n=1024)
+            for k in range(6)
+        ]
+        packed, counts = filters.pack_host_scans_compact(
+            [
+                {
+                    "angle_q14": np.asarray(s.angle_q14),
+                    "dist_q2": np.asarray(s.dist_q2),
+                    "quality": np.asarray(s.quality),
+                    "flag": None,
+                }
+                for s in scans
+            ]
+        )
+        state, _ = filters.compact_filter_scan(
+            state, jnp.asarray(packed), jnp.asarray(counts), cfg
+        )
+        assert state.median_sorted is not None
+        np.testing.assert_array_equal(
+            np.asarray(state.median_sorted),
+            np.sort(np.asarray(state.range_window), axis=0),
+        )
+        # one more streaming step keeps parity with the xla path run
+        # over the same full history
+        nxt = make_batch(np.arange(0, 360, 1.5), np.full(240, 5.0), n=1024)
+        state, out = filters.filter_step(state, nxt, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(state.median_sorted),
+            np.sort(np.asarray(state.range_window), axis=0),
+        )
+
+    def test_inc_requires_sorted_state(self):
+        cfg = filters.FilterConfig(
+            window=4, beams=CFG.beams, grid=32, cell_m=0.25,
+            median_backend="inc",
+        )
+        state = filters.FilterState.create(cfg.window, cfg.beams, cfg.grid)
+        b = make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0), n=1024)
+        with pytest.raises(ValueError, match="with_sorted"):
+            filters.filter_step(state, b, cfg)
+
+
 class TestVoxel:
     def test_hits_land_in_cells(self):
         xy = jnp.asarray(np.array([[0.3, 0.3], [-0.3, 0.3], [100.0, 0.0]], np.float32))
@@ -287,7 +395,10 @@ class TestBackendResolution:
         from rplidar_ros2_driver_tpu.filters.chain import resolve_median_backend
 
         assert resolve_median_backend("auto", "tpu") == "pallas"
-        assert resolve_median_backend("auto", "cpu") == "xla"
+        # CPU: the incremental sliding median (3.8x full-step on the
+        # CPU ablation; bit-exact vs the sort path); GPU keeps the sort
+        # until it has its own measurement
+        assert resolve_median_backend("auto", "cpu") == "inc"
         assert resolve_median_backend("auto", "gpu") == "xla"
         # explicit choices pass through regardless of platform
         assert resolve_median_backend("xla", "tpu") == "xla"
@@ -313,5 +424,5 @@ class TestBackendResolution:
         assert cfg.median_backend == "pallas"
         assert cfg.resample_backend in ("scatter", "dense")  # resolved
         cfg = config_from_params(DriverParams(), platform="cpu")
-        assert cfg.median_backend == "xla"
+        assert cfg.median_backend == "inc"
         assert cfg.resample_backend == "scatter"
