@@ -34,6 +34,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     counted_filter_step_wire,
     filter_step,
     pack_host_scan_counted,
+    recompute_median_sorted,
     unpack_output_wire,
 )
 
@@ -489,7 +490,7 @@ class ScanFilterChain:
                 # derived state: recompute from the restored ring so any
                 # snapshot (legacy, cross-backend) restores under "inc"
                 median_sorted=(
-                    np.sort(core["range_window"], axis=0)
+                    recompute_median_sorted(core["range_window"])
                     if with_sorted else None
                 ),
             ),

@@ -284,6 +284,36 @@ def sorted_replace(
     return jnp.where(iota == p[None, :], new_v[None, :], out)
 
 
+def inc_median(
+    range_window: jax.Array,
+    cursor: jax.Array,
+    median_sorted: Optional[jax.Array],
+    new_ranges: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """One incremental-median step, shared by the single-device and
+    sharded step implementations so the two cannot drift: evict the
+    PRE-update ring row at ``cursor`` from the carried sorted window,
+    insert ``new_ranges``, return (updated sorted window, median)."""
+    if median_sorted is None:
+        raise ValueError(
+            "median_backend='inc' requires a state carrying the sorted "
+            "window (FilterState.for_config / create_sharded_state "
+            "provide it per config)"
+        )
+    old_v = jax.lax.dynamic_index_in_dim(
+        range_window, cursor, 0, keepdims=False
+    )
+    ms = sorted_replace(median_sorted, old_v, new_ranges)
+    return ms, median_from_sorted(ms)
+
+
+def recompute_median_sorted(range_window) -> jax.Array:
+    """Rebuild the derived sorted window from the ring — the ONE
+    restore/fused-boundary recompute, sorting along the window axis
+    (axis=-2 covers both the (W, B) and (streams, W, B) layouts)."""
+    return jnp.sort(jnp.asarray(range_window), axis=-2)
+
+
 def median_from_sorted(sorted_w: jax.Array) -> jax.Array:
     """Per-beam lower median given the already-sorted (W, B) window —
     identical semantics to :func:`temporal_median` (+inf marks missing;
@@ -410,17 +440,7 @@ def _filter_step_impl(
             # incremental sliding median: the ring evicts exactly ONE
             # value per step, so the sorted multiset is maintained by a
             # delete+insert (O(W) elementwise) instead of re-sorted
-            if ms is None:
-                raise ValueError(
-                    "median_backend='inc' requires a state created with "
-                    "with_sorted=True (FilterState.create) — the sorted "
-                    "window is carried state"
-                )
-            old_v = jax.lax.dynamic_index_in_dim(
-                state.range_window, state.cursor, 0, keepdims=False
-            )
-            ms = sorted_replace(ms, old_v, ranges)
-            med = median_from_sorted(ms)
+            ms, med = inc_median(state.range_window, state.cursor, ms, ranges)
         elif cfg.median_backend == "pallas":
             from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
                 temporal_median_pallas,
@@ -777,7 +797,7 @@ def fused_scan_core(
         # backend's derived state is re-sorted wholesale (one sort per
         # K-chunk, amortized) to restore the invariant
         median_sorted=(
-            jnp.sort(range_window, axis=0)
+            recompute_median_sorted(range_window)
             if state.median_sorted is not None else None
         ),
     )

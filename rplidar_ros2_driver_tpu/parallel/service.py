@@ -15,6 +15,7 @@ contract.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 import threading
@@ -34,6 +35,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterState,
     _unpack_compact,
     pack_host_scan_counted,
+    recompute_median_sorted,
 )
 from rplidar_ros2_driver_tpu.parallel.sharding import (
     build_sharded_step,
@@ -530,14 +532,21 @@ class ShardedFilterService:
         held only for the (cheap, on-device) copy dispatch, never across a
         host gather or disk write, so checkpoints don't stall ticks."""
         with self._lock:
-            return jax.tree_util.tree_map(jnp.copy, self._state)
+            # derived state (median_sorted) never reaches checkpoints, so
+            # don't pay a device copy of it
+            return jax.tree_util.tree_map(
+                jnp.copy, dataclasses.replace(self._state, median_sorted=None)
+            )
 
     def snapshot(self) -> dict[str, np.ndarray]:
         state = self._copy_state()
-        # optional derived fields (median_sorted) are absent (None) in
-        # sharded states and excluded from snapshots either way
+        # median_sorted is DERIVED (the sorted view of range_window) and
+        # excluded so the snapshot format is identical across median
+        # backends; restore recomputes it as needed
         return {
-            k: np.asarray(v) for k, v in vars(state).items() if v is not None
+            k: np.asarray(v)
+            for k, v in vars(state).items()
+            if v is not None and k != "median_sorted"
         }
 
     def save_sharded(self, path: str) -> None:
@@ -555,6 +564,7 @@ class ShardedFilterService:
         """
         from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
 
+        # _copy_state already strips the derived median_sorted
         checkpoint_orbax.save_sharded(path, self._copy_state())
 
     def load_sharded(self, path: str) -> bool:
@@ -570,6 +580,12 @@ class ShardedFilterService:
         got = checkpoint_orbax.restore_sharded(path, template)
         if got is None:
             return False
+        if self.cfg.median_backend == "inc":
+            # recompute the derived sorted window on the mesh (the sort
+            # runs along the unsharded window axis — shard-local)
+            got = dataclasses.replace(
+                got, median_sorted=recompute_median_sorted(got.range_window)
+            )
         with self._lock:
             self._state = got
             self._pending = None  # pre-restore outputs: never publish
@@ -599,10 +615,17 @@ class ShardedFilterService:
                 )
                 return False
             # H2D placement outside the lock; only the O(1) swap inside
+            core = {k: v for k, v in snap.items() if k != "median_sorted"}
             restored = place_state(
                 self.mesh,
                 FilterState(
-                    **{k: v for k, v in snap.items() if k != "median_sorted"}
+                    **core,
+                    # derived: recomputed so any snapshot restores
+                    # under the "inc" backend
+                    median_sorted=(
+                        recompute_median_sorted(core["range_window"])
+                        if self.cfg.median_backend == "inc" else None
+                    ),
                 ),
             )
             with self._lock:

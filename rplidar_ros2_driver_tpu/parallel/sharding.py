@@ -44,6 +44,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     _grid_decode,
     clip_filter,
     fused_scan_core,
+    inc_median,
     select_voxel_hits,
     temporal_median,
 )
@@ -179,7 +180,17 @@ def _filter_step_shard(
     iw = jax.lax.dynamic_update_index_in_dim(state.inten_window, inten, state.cursor, 0)
     filled = jnp.minimum(state.filled + 1, rw.shape[0])
 
-    med = temporal_median(rw) if cfg.enable_median else ranges
+    ms = state.median_sorted
+    if not cfg.enable_median:
+        med = ranges
+    elif cfg.median_backend == "inc":
+        # incremental sliding median, beam-local like everything else in
+        # the shard (the sorted window is per-beam state, so the shard's
+        # slice updates independently — no collective)
+        ms, med = inc_median(state.range_window, state.cursor, ms, ranges)
+    else:
+        # the xla sort; pallas is not used inside shard_map
+        med = temporal_median(rw)
     xy, mask = _polar_to_cartesian_shard(med, cfg, b_local)
 
     if cfg.enable_voxel:
@@ -203,6 +214,7 @@ def _filter_step_shard(
         voxel_acc=voxel_acc,
         cursor=(state.cursor + 1) % rw.shape[0],
         filled=filled,
+        median_sorted=ms,
     )
     out = FilterOutput(
         ranges=med, intensities=inten, points_xy=xy, point_mask=mask, voxel=voxel_acc
@@ -222,7 +234,27 @@ STATE_SPEC = FilterState(
     voxel_acc=P("stream", None, None),
     cursor=P("stream"),
     filled=P("stream"),
+    # median_sorted left at its None default: the derived sorted window
+    # exists only under median_backend == "inc" (see _spec_for_state)
 )
+# per-beam derived state shards exactly like the ring it mirrors
+_MEDIAN_SORTED_SPEC = P("stream", None, "beam")
+
+
+def _spec_for_state(state: FilterState) -> FilterState:
+    """STATE_SPEC with the optional derived field's spec present exactly
+    when the state carries it, so the two pytrees always match."""
+    if state.median_sorted is None:
+        return STATE_SPEC
+    return dataclasses.replace(STATE_SPEC, median_sorted=_MEDIAN_SORTED_SPEC)
+
+
+def _spec_for_cfg(cfg: FilterConfig) -> FilterState:
+    """STATE_SPEC as produced/consumed by steps compiled for ``cfg`` —
+    the shard_map twin of :func:`_spec_for_state`."""
+    if cfg.median_backend != "inc":
+        return STATE_SPEC
+    return dataclasses.replace(STATE_SPEC, median_sorted=_MEDIAN_SORTED_SPEC)
 BATCH_SPEC = ScanBatch(
     angle_q14=P("stream", None),
     dist_q2=P("stream", None),
@@ -271,8 +303,9 @@ def build_sharded_step(mesh: Mesh, cfg: FilterConfig) -> Callable:
         step = functools.partial(_filter_step_shard, cfg=cfg, b_local=b_local)
         return jax.vmap(step)(state, batch)
 
+    spec = _spec_for_cfg(cfg)
     return _shard_mapped(
-        per_shard, mesh, (STATE_SPEC, BATCH_SPEC), (STATE_SPEC, OUT_SPEC)
+        per_shard, mesh, (spec, BATCH_SPEC), (spec, OUT_SPEC)
     )
 
 
@@ -332,8 +365,9 @@ def build_sharded_scan(mesh: Mesh, cfg: FilterConfig) -> Callable:
         scan = functools.partial(_filter_scan_shard, cfg=cfg, b_local=b_local)
         return jax.vmap(scan)(state, packed_seq, counts)
 
+    spec = _spec_for_cfg(cfg)
     return _shard_mapped(
-        per_shard, mesh, (STATE_SPEC, SEQ_SPEC, COUNTS_SPEC), (STATE_SPEC, RANGES_SEQ_SPEC)
+        per_shard, mesh, (spec, SEQ_SPEC, COUNTS_SPEC), (spec, RANGES_SEQ_SPEC)
     )
 
 
@@ -344,7 +378,7 @@ def place_state(mesh: Mesh, state: FilterState) -> FilterState:
         state,
         jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec),
-            STATE_SPEC,
+            _spec_for_state(state),
             is_leaf=lambda x: isinstance(x, P),
         ),
     )
@@ -363,14 +397,21 @@ def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterS
         voxel_acc=jnp.zeros((streams, cfg.grid, cfg.grid), jnp.int32),
         cursor=jnp.zeros((streams,), jnp.int32),
         filled=jnp.zeros((streams,), jnp.int32),
+        # an all-inf ring is trivially sorted (mirror of FilterState.create)
+        median_sorted=(
+            jnp.full((streams, cfg.window, cfg.beams), jnp.inf, jnp.float32)
+            if cfg.median_backend == "inc" else None
+        ),
     )
     return place_state(mesh, base)
 
 
 def abstract_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterState:
-    """ShapeDtypeStruct pytree matching :func:`create_sharded_state` —
-    same shapes, dtypes, shardings, and validation, but NO device
-    allocation.  The checkpoint-restore template: restoring through this
+    """ShapeDtypeStruct pytree matching :func:`create_sharded_state`'s
+    CHECKPOINT surface — same shapes, dtypes, shardings, and validation,
+    but NO device allocation, and without the derived ``median_sorted``
+    field (checkpoints exclude it; load_sharded recomputes it when the
+    config needs it).  The checkpoint-restore template: restoring through this
     places shards straight onto the mesh without first materializing a
     throwaway state.  Shapes/dtypes are derived from the single-stream
     constructor via ``jax.eval_shape`` so they cannot drift from it."""

@@ -189,7 +189,7 @@ class TestIncrementalMedian:
         )
         state = filters.FilterState.create(cfg.window, cfg.beams, cfg.grid)
         b = make_batch(np.arange(0, 360, 1.5), np.full(240, 2.0), n=1024)
-        with pytest.raises(ValueError, match="with_sorted"):
+        with pytest.raises(ValueError, match="sorted window"):
             filters.filter_step(state, b, cfg)
 
 

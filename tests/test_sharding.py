@@ -63,6 +63,38 @@ def test_sharded_matches_single_device():
     )
 
 
+def test_sharded_inc_median_matches_single_device():
+    # the incremental sliding median is beam-local, so its sorted state
+    # shards like the ring; outputs must stay bit-identical to the
+    # single-device inc path AND (transitively) the sort path
+    mesh = make_mesh(8, stream=2)
+    cfg = FilterConfig(
+        window=4, beams=64, grid=16, cell_m=0.5, median_backend="inc"
+    )
+    streams = 4
+
+    step = build_sharded_step(mesh, cfg)
+    state = create_sharded_state(mesh, cfg, streams)
+    assert state.median_sorted is not None
+    batch = _make_batch(streams)
+    sbatch = shard_batch(mesh, batch)
+    for _ in range(6):  # > one full wrap
+        state, out = step(state, sbatch)
+
+    ref_state = jax.vmap(
+        lambda: FilterState.for_config(cfg), axis_size=streams
+    )()
+    ref = jax.vmap(lambda s, b: filter_step(s, b, cfg))
+    for _ in range(6):
+        ref_state, ref_out = ref(ref_state, batch)
+
+    np.testing.assert_array_equal(np.asarray(out.ranges), np.asarray(ref_out.ranges))
+    np.testing.assert_array_equal(np.asarray(out.voxel), np.asarray(ref_out.voxel))
+    np.testing.assert_array_equal(
+        np.asarray(state.median_sorted), np.asarray(ref_state.median_sorted)
+    )
+
+
 @pytest.mark.parametrize("backend", ["xla", "pallas"])
 def test_sharded_scan_matches_sharded_steps(backend):
     """build_sharded_scan (fused K-scan fleet replay) must reproduce the
