@@ -110,8 +110,9 @@ def test_step_ablation_smoke():
     assert r.returncode == 0, r.stderr[-2000:]
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert set(out["ablation_us"]) == {
-        "full_scatter", "full_dense", "no_median", "no_voxel", "no_clip",
-        "resample_only",
+        "full_scatter", "full_dense", "full_voxel_matmul",
+        "full_median_xla", "full_median_inc",
+        "no_median", "no_voxel", "no_clip", "resample_only",
     }
     assert all(v > 0 for v in out["ablation_us"].values())
     assert out["device"] == "cpu"
