@@ -61,9 +61,10 @@ WARMUP = 10
 ITERS = 300
 SYNC_ITERS = 30
 BASELINE_SCANS_PER_SEC = 10.0  # real-time requirement at 600 RPM
-# VMEM bitonic-network median (ops/pallas_kernels.py) vs the XLA sort path:
-# config 5 measures BOTH on the device-resident in-jit step and records the
-# A/B in the artifact ("median_ab"); --median selects the headline backend.
+# Temporal-median A/B: config 5 measures ALL THREE formulations (pallas
+# bitonic network / xla sort / incremental sliding median) on the
+# device-resident in-jit step and records them in the artifact
+# ("median_ab"); --median selects the headline backend.
 # pallas is the evidenced default: 2.14x over xla at W=64 device-resident
 # (RTT-adaptive rounds, 2026-07-31 recapture; non-overlapping interleaved
 # rounds — docs/BENCHMARKS.md).  Falls back to interpret mode on CPU.
@@ -113,6 +114,18 @@ def _rtt_adaptive_iters(measure_round, rtt_ms: float, base_iters: int,
     cost.  Capped at ~``max_round_s`` per round so a healthy rig never
     crawls; floored at ``base_iters`` so a local chip (sub-ms RTT) keeps
     the short rounds."""
+    micro = max(base_iters // 30, 30)
+    e_mu = micro / measure_round(micro)
+    if e_mu > max_round_s / 4:
+        # pathologically slow step (an unproven backend on new hardware):
+        # size straight from the micro probe — a full-length probe round
+        # could take minutes.  The threshold is far above any observed
+        # RTT, so the micro elapsed is compute-dominated and accurate
+        # enough to bound the rounds.
+        step_s = max((e_mu - rtt_ms * 1e-3) / micro, e_mu / micro / 4, 5e-6)
+        want = int(rtt_ms * 1e-3 / rtt_frac / step_s) + 1
+        cap = max(int(max_round_s / step_s), 1)
+        return min(max(min(base_iters, cap), want), cap)
     e1 = base_iters / measure_round(base_iters)
     step_s = (e1 - rtt_ms * 1e-3) / base_iters
     if step_s <= e1 / base_iters / 20:
@@ -130,8 +143,10 @@ def _rtt_adaptive_iters(measure_round, rtt_ms: float, base_iters: int,
         if step_s <= 0:  # drift swamped the delta; be conservative
             step_s = e2 / n2
     want = int(rtt_ms * 1e-3 / rtt_frac / step_s) + 1
-    cap = max(int(max_round_s / step_s), base_iters)
-    return min(max(base_iters, want), cap)
+    cap = max(int(max_round_s / step_s), 1)
+    # floor at base_iters for the local-chip fast path, but never let the
+    # floor defeat the wall cap when the step turns out slow
+    return min(max(min(base_iters, cap), want), cap)
 
 
 def iters_arg(v: str):
@@ -759,14 +774,20 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         # separation is clean: pallas 2.14x over xla at W=64 and
         # 2.1-2.5x at W=256/512 (RTT-adaptive recapture, 2026-07-31 —
         # docs/BENCHMARKS.md), hence the pallas default.
-        other = "xla" if median == "pallas" else "pallas"
+        # three arms: the selected headline backend plus every other
+        # median formulation, so the scoreboard artifact always carries
+        # the full on-chip A/B (the "inc" arm is the evidence that can
+        # flip the TPU auto mapping — filters/chain.py resolver)
+        arms = [median] + [b for b in ("pallas", "xla", "inc") if b != median]
         runners = {
-            median: _ChainRunner(cfg, points),
-            other: _ChainRunner(
-                FilterConfig(beams=BEAMS, grid=GRID, cell_m=0.25,
-                             median_backend=other, **over),
+            name: _ChainRunner(
+                cfg if name == median else FilterConfig(
+                    beams=BEAMS, grid=GRID, cell_m=0.25,
+                    median_backend=name, **over,
+                ),
                 points,
-            ),
+            )
+            for name in arms
         }
         dev_rounds = {name: [] for name in runners}
         n_rounds = 5
@@ -793,9 +814,12 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         scans_per_sec = dev_med[median]
         ab = {
             "method": "device_resident_in_jit",
-            median: round(dev_med[median], 2),
-            other: round(dev_med[other], 2),
+            **{name: round(dev_med[name], 2) for name in arms},
+            # series-continuity key (r2 onward): the pallas-vs-xla ratio
             "speedup": round(dev_med["pallas"] / dev_med["xla"], 3),
+            "inc_vs_headline_speedup": round(
+                dev_med["inc"] / dev_med[median], 3
+            ),
             "rounds": {k: [round(x, 1) for x in v] for k, v in dev_rounds.items()},
             "barrier_rtt_ms": round(rtt_ms, 3),
             "round_iters": dict(iters_for),
@@ -925,7 +949,8 @@ if __name__ == "__main__":
         "--median",
         choices=("pallas", "xla"),
         default=MEDIAN_BACKEND,
-        help="temporal-median kernel backend (config 5 records an A/B of both)",
+        help="headline temporal-median backend (config 5 additionally "
+        "records all three formulations' A/B in median_ab)",
     )
     ap.add_argument(
         "--profile",

@@ -145,3 +145,47 @@ def test_bench_outage_artifact_is_structured_not_zero():
     # the seeded sidecar's headline entry rides along with its date
     assert out["last_good_headline"]["value"] > 0
     assert out["last_good_headline"]["date"]
+
+
+def test_config5_three_arm_branch_executes(monkeypatch):
+    """The device branch of config 5 (three median arms, RTT-adaptive
+    rounds) must execute end to end — a crash here would zero the
+    driver's end-of-round artifact.  Runners and the platform check are
+    stubbed so the branch's own logic runs host-side."""
+    import bench
+
+    class FakeRunner:
+        rates = {"pallas": 30000.0, "xla": 15000.0, "inc": 45000.0}
+
+        def __init__(self, cfg, points):
+            self.cfg = cfg
+            self._rate = self.rates[cfg.median_backend]
+
+        def measure_barrier_rtt_ms(self):
+            return 1.0
+
+        def measure_device_only(self, iters):
+            return self._rate
+
+        def measure_round(self, iters):
+            return 300.0
+
+        def measure_sync_p99(self):
+            return 5.0
+
+        def measure_link_put_ms(self):
+            return 1.0
+
+    class FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(bench, "_ChainRunner", FakeRunner)
+    monkeypatch.setattr(bench.jax, "devices", lambda: [FakeDev()])
+    out = bench.main(5, "pallas")
+    ab = out["median_ab"]
+    assert out["value"] == 30000.0  # headline stays the selected backend
+    assert {"pallas", "xla", "inc"} <= set(ab)
+    assert ab["speedup"] == 2.0                    # pallas/xla continuity key
+    assert ab["inc_vs_headline_speedup"] == 1.5    # the flip-decision ratio
+    assert set(ab["rounds"]) == {"pallas", "xla", "inc"}
+    assert "barrier_rtt_ms" in ab and set(ab["round_iters"]) == set(ab["rounds"])
