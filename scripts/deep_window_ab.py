@@ -1,12 +1,14 @@
 """Deep-window median A/B with the round-3 measurement discipline
 (r3 VERDICT #6).
 
-The committed W=256/512 pallas-vs-xla rows were 200-iteration probes
-carrying un-amortized barrier RTT (docs/BENCHMARKS.md:37-47); this
-script re-runs them exactly like the headline: device-resident input,
-the step loop inside ONE jit dispatch, >=3000 in-jit iterations per
-round so the single barrier fetch amortizes below ~5%, rounds
-INTERLEAVED across the two backends so link drift cancels.
+Deep-window temporal-median A/B — by default all THREE formulations
+(pallas bitonic network / xla sort / incremental sliding median),
+measured exactly like the headline: device-resident input, the step
+loop inside ONE jit dispatch, RTT-adaptive in-jit iterations per round
+so the single barrier fetch amortizes below ~5%, rounds INTERLEAVED
+across the arms so link drift cancels.  The inc arm is the
+long-context claim: its O(W) update vs the sorts' O(W log^2 W) should
+WIDEN with window depth.
 
     python scripts/deep_window_ab.py [--windows 64 256 512] [--iters auto]
 
@@ -32,6 +34,12 @@ import bench  # noqa: E402 - safe pre-init (no device use at import)
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--windows", type=int, nargs="+", default=[64, 256, 512])
+    ap.add_argument("--backends", nargs="+",
+                    default=["pallas", "xla", "inc"],
+                    choices=["pallas", "xla", "inc"],
+                    help="median arms to interleave (inc's O(W) update "
+                    "vs the sorts' O(W log^2 W) should WIDEN with window "
+                    "depth — the long-context scaling claim)")
     ap.add_argument("--iters", type=bench.iters_arg, default="auto",
                     help="in-jit iterations per round, or 'auto' to size "
                     "off the measured barrier RTT (default)")
@@ -75,7 +83,7 @@ def main() -> int:
                     ),
                     bench.POINTS,
                 )
-                for name in ("pallas", "xla")
+                for name in args.backends
             }
             if auto:
                 if rtt_ms is None:
@@ -93,19 +101,28 @@ def main() -> int:
                 for name, r in runners.items():  # interleaved: drift cancels
                     rounds[name].append(r.measure_device_only(iters_for[name]))
             med = {n: float(np.median(v)) for n, v in rounds.items()}
-            results[str(window)] = {
-                "pallas_scans_per_sec": round(med["pallas"], 1),
-                "xla_scans_per_sec": round(med["xla"], 1),
-                "speedup": round(med["pallas"] / med["xla"], 3),
-                "rounds": {
-                    n: [round(x, 1) for x in v] for n, v in rounds.items()
-                },
-                "round_iters": dict(iters_for),
+            row = {
+                f"{n}_scans_per_sec": round(med[n], 1) for n in args.backends
             }
+            if "pallas" in med and "xla" in med:
+                # the series-continuity key (pallas/xla, r3 onward)
+                row["speedup"] = round(med["pallas"] / med["xla"], 3)
+            if "inc" in med:
+                sorts = [med[n] for n in ("pallas", "xla") if n in med]
+                if sorts:
+                    row["inc_vs_best_sort_speedup"] = round(
+                        med["inc"] / max(sorts), 3
+                    )
+            row["rounds"] = {
+                n: [round(x, 1) for x in v] for n, v in rounds.items()
+            }
+            row["round_iters"] = dict(iters_for)
+            results[str(window)] = row
             print(
-                f"W={window}: pallas {med['pallas']:.0f} vs xla "
-                f"{med['xla']:.0f} scans/s "
-                f"({med['pallas'] / med['xla']:.2f}x)",
+                "W=%d: %s" % (
+                    window,
+                    "  ".join(f"{n} {med[n]:.0f}" for n in args.backends),
+                ),
                 file=sys.stderr, flush=True,
             )
         except Exception as e:  # noqa: BLE001 - a dead link mid-sequence
