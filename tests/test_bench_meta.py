@@ -189,3 +189,42 @@ def test_config5_three_arm_branch_executes(monkeypatch):
     assert ab["inc_vs_headline_speedup"] == 1.5    # the flip-decision ratio
     assert set(ab["rounds"]) == {"pallas", "xla", "inc"}
     assert "barrier_rtt_ms" in ab and set(ab["round_iters"]) == set(ab["rounds"])
+
+
+def test_rtt_adaptive_iters_scenarios():
+    """The round-sizing helper across the regimes that have actually
+    bitten: local chip, sick link, quiet-probe RTT draw, fast kernel on
+    a sick link, pathologically slow arm."""
+
+    def mk(step_s, rtt_s):
+        return lambda it: it / (it * step_s + rtt_s)
+
+    # local chip (sub-ms RTT): keep the short base rounds
+    assert bench._rtt_adaptive_iters(mk(30e-6, 0.05e-3), 0.05, 3000) == 3000
+    # 78 ms RTT, 30 us step: the r4 recapture regime (~52k iters)
+    n = bench._rtt_adaptive_iters(mk(30e-6, 78e-3), 78.0, 3000)
+    assert 40_000 < n < 70_000
+    # quiet-probe draw (probe rtt 100 ms vs median 200): difference
+    # estimator recovers the true step; rounds stay minutes-free
+    seq = [100e-3] * 3
+
+    def quiet(it):
+        return it / (it * 30e-6 + seq.pop(0))
+
+    n = bench._rtt_adaptive_iters(quiet, 200.0, 3000)
+    assert n * 30e-6 < 16
+    # fast kernel (3 us) on a 200 ms link: barrier held near 5%
+    n = bench._rtt_adaptive_iters(mk(3e-6, 200e-3), 200.0, 3000)
+    frac = 200e-3 / (n * 3e-6 + 200e-3)
+    assert frac < 0.06
+    # pathologically slow arm (100 ms/step): micro probe bounds every
+    # round to the wall cap instead of a 5-minute probe
+    calls = []
+
+    def slow(it):
+        calls.append(it)
+        return it / (it * 0.1 + 78e-3)
+
+    n = bench._rtt_adaptive_iters(slow, 78.0, 3000)
+    assert n * 0.1 <= 16
+    assert max(calls) < 3000  # never ran the full-length probe
