@@ -990,31 +990,74 @@ if __name__ == "__main__":
         default_interval_s=120.0,
         log=lambda msg: print(msg, file=sys.stderr, flush=True),
     )
-    if not _ok:
-        if poisoned:
-            # the hung init holds this process's backend for good (the
-            # daemon probe thread is stuck inside it), so even the CPU
-            # fallback would block here — compute it in a fresh process
-            env = dict(os.environ, BENCH_FORCE_PROBE_FAIL="1",
-                       BENCH_PROBE_ERROR=_detail)
+    def _fallback_in_fresh_process(detail: str) -> None:
+        # this process's backend is unusable (hung init, or a fetch that
+        # wedged mid-run and will never return), so even the CPU
+        # fallback would block here — compute it in a fresh process
+        env = dict(os.environ, BENCH_FORCE_PROBE_FAIL="1",
+                   BENCH_PROBE_ERROR=detail)
+        try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--config", str(args.config)],
                 env=env, capture_output=True, text=True,
+                # the fallback child only does CPU work, but an
+                # unbounded wait here would reintroduce the silent-hang
+                # class this guard exists to eliminate
+                timeout=float(os.environ.get("BENCH_RUN_DEADLINE_S", 1800)),
             )
             sys.stderr.write(r.stderr)
             sys.stdout.write(r.stdout)
-            raise SystemExit(r.returncode)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            print(json.dumps({
+                "metric": metric_name(args.config), "value": 0.0,
+                "unit": "scans/s", "vs_baseline": 0.0,
+                "error": f"{detail}; CPU fallback itself timed out",
+            }))
+            rc = 3
+        # a daemon thread (hung init probe or wedged fetch) may still be
+        # blocked inside native runtime code; normal interpreter
+        # teardown aborts on it — skip destructors, the artifact is out
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            exit_skipping_destructors,
+        )
+
+        exit_skipping_destructors(rc)
+
+    if not _ok:
+        if poisoned:
+            _fallback_in_fresh_process(_detail)
         print(json.dumps(_fallback_artifact(args.config, _detail)))
         raise SystemExit(0)
 
-    if args.profile:
-        from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
+    # mid-run wedge guard: init succeeding does not make the link safe —
+    # a D2H fetch has hung >30 min mid-measurement on this rig.  The
+    # deadline turns that into a structured device_unavailable artifact
+    # (computed in a fresh process; this one's backend is hostage to the
+    # blocked fetch) instead of a hang the driver can only kill.
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        run_with_deadline,
+    )
 
-        with profile_trace(args.profile):
-            result = main(args.config, args.median)
-    else:
-        result = main(args.config, args.median)
+    _run_deadline_s = float(os.environ.get("BENCH_RUN_DEADLINE_S", 1800))
+
+    def _measured_run():
+        if args.profile:
+            from rplidar_ros2_driver_tpu.utils.tracing import profile_trace
+
+            with profile_trace(args.profile):
+                return main(args.config, args.median)
+        return main(args.config, args.median)
+
+    try:
+        result = run_with_deadline(
+            _measured_run, _run_deadline_s,
+            what=f"config {args.config} measurement",
+        )
+    except MeasurementWedgedError as e:
+        _fallback_in_fresh_process(f"{type(e).__name__}: {e}")
     # the ONE JSON line first — the sidecar is best-effort bookkeeping
     # and must never cost a successfully measured round its artifact
     print(json.dumps(result), flush=True)

@@ -22,24 +22,16 @@ def probe_jax_backend(timeout_s: float) -> tuple[bool, str]:
     """
     import jax
 
-    out: dict = {}
-    done = threading.Event()
-
-    def _probe() -> None:
-        try:
-            out["devices"] = list(jax.devices())
-        except BaseException as e:  # report the real failure, not a timeout
-            out["err"] = f"{type(e).__name__}: {e}"
-        finally:
-            done.set()
-
-    threading.Thread(target=_probe, daemon=True).start()
-    if not done.wait(timeout_s):
+    try:
+        devices = run_with_deadline(
+            lambda: list(jax.devices()), timeout_s, what="jax backend init"
+        )
+    except MeasurementWedgedError:
         return False, (f"jax backend init timed out after {timeout_s:.0f} s "
                        "(remote-attach tunnel unreachable)")
-    if "err" in out:
-        return False, out["err"]
-    return True, ", ".join(str(d) for d in out["devices"])
+    except BaseException as e:  # report the real failure, not a timeout
+        return False, f"{type(e).__name__}: {e}"
+    return True, ", ".join(str(d) for d in devices)
 
 
 def probe_jax_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
@@ -106,6 +98,70 @@ def guarded_backend_init(
         ok, detail = probe_jax_backend(per_probe_s)
         poisoned = not ok
     return ok, detail, poisoned
+
+
+class MeasurementWedgedError(RuntimeError):
+    """A device round-trip blocked past its deadline mid-measurement.
+
+    Init guards cannot catch this class of failure: the backend dialed
+    fine, rounds were completing, and then one D2H fetch through the
+    remote link never returned (observed: a deep-window A/B sat 25 min
+    in ``wait_woken`` with zero CPU accumulation, and an e2e fetch once
+    hung >30 min).  Once it happens the process's device is unusable —
+    the blocked fetch never returns — so callers must emit whatever
+    they already measured and exit rather than retry in-process.
+    """
+
+
+def run_with_deadline(fn, timeout_s: float, what: str = "device round-trip"):
+    """Run ``fn()`` in a daemon thread, bounded by ``timeout_s``.
+
+    The mid-run analog of :func:`probe_jax_backend`: a wedged device
+    fetch blocks in native code holding no Python signal opportunity,
+    so neither SIGALRM nor an exception can break it — but a daemon
+    thread lets the caller walk away.  Raises
+    :class:`MeasurementWedgedError` on timeout; exceptions from ``fn``
+    propagate unchanged.  The abandoned thread keeps the wedged fetch
+    (and the process's backend) hostage, so treat a wedge as terminal
+    for device work in this process.
+    """
+    out: dict = {}
+    done = threading.Event()
+
+    def _run() -> None:
+        try:
+            out["value"] = fn()
+        except BaseException as e:  # propagate the real failure
+            out["err"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=_run, daemon=True).start()
+    if not done.wait(timeout_s):
+        raise MeasurementWedgedError(
+            f"{what} blocked past {timeout_s:.0f} s (link wedged mid-run)"
+        )
+    if "err" in out:
+        raise out["err"]
+    return out["value"]
+
+
+def exit_skipping_destructors(code: int = 0) -> None:
+    """Flush stdio and ``os._exit`` — the only safe exit after a wedge.
+
+    A thread abandoned by :func:`run_with_deadline` (or a hung init
+    probe) is still blocked inside native runtime code; normal
+    interpreter teardown aborts on it ("FATAL: exception not
+    rethrown"), which would turn an already-emitted artifact into a
+    nonzero exit.  The flush matters: ``os._exit`` skips atexit AND
+    stdio flushing, so without it the artifact this exit is protecting
+    can be silently dropped.
+    """
+    import os
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
 
 
 def probe_jax_backend_with_retry(

@@ -69,37 +69,74 @@ def main() -> int:
     from bench import _ChainRunner
     from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
 
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        exit_skipping_destructors,
+        run_with_deadline,
+    )
+
     auto = args.iters == "auto"
     base_iters = 3000 if auto else args.iters
     rtt_ms = None
     results = {}
+    # a wedged mid-run fetch (link dies while a window measures) blocks
+    # forever in native code: without a deadline the whole artifact —
+    # including windows ALREADY measured — dies with the process (it
+    # happened: W=256 completed, W=512 wedged, nothing was emitted).
+    # One budget per window; a wedge poisons this process's backend, so
+    # later windows are marked skipped rather than re-attempted.
+    window_deadline_s = float(
+        os.environ.get("BENCH_WINDOW_DEADLINE_S", 900)
+    )
+    wedged = None
     for window in args.windows:
-        try:
-            runners = {
-                name: _ChainRunner(
-                    FilterConfig(
-                        window=window, beams=bench.BEAMS, grid=bench.GRID,
-                        cell_m=0.25, median_backend=name,
-                    ),
-                    bench.POINTS,
-                )
-                for name in args.backends
+        if wedged is not None:
+            results[str(window)] = {
+                "skipped": f"link wedged during W={wedged}"
             }
-            if auto:
-                if rtt_ms is None:
-                    rtt_ms = next(iter(runners.values())).measure_barrier_rtt_ms()
-                iters_for = {
-                    n: bench._rtt_adaptive_iters(
-                        r.measure_device_only, rtt_ms, base_iters
+            continue
+        try:
+            def _measure_window() -> tuple[dict, dict]:
+                # runner construction included: warmup does device_put +
+                # submit + a blocking D2H barrier — the same round-trips
+                # that wedge — so it must sit under the deadline too
+                nonlocal rtt_ms
+                runners = {
+                    name: _ChainRunner(
+                        FilterConfig(
+                            window=window, beams=bench.BEAMS,
+                            grid=bench.GRID, cell_m=0.25,
+                            median_backend=name,
+                        ),
+                        bench.POINTS,
                     )
-                    for n, r in runners.items()
+                    for name in args.backends
                 }
-            else:
-                iters_for = {n: base_iters for n in runners}
-            rounds: dict[str, list[float]] = {n: [] for n in runners}
-            for _ in range(args.rounds):
-                for name, r in runners.items():  # interleaved: drift cancels
-                    rounds[name].append(r.measure_device_only(iters_for[name]))
+                if auto:
+                    if rtt_ms is None:
+                        rtt_ms = next(
+                            iter(runners.values())
+                        ).measure_barrier_rtt_ms()
+                    iters_for = {
+                        n: bench._rtt_adaptive_iters(
+                            r.measure_device_only, rtt_ms, base_iters
+                        )
+                        for n, r in runners.items()
+                    }
+                else:
+                    iters_for = {n: base_iters for n in runners}
+                rounds: dict[str, list[float]] = {n: [] for n in runners}
+                for _ in range(args.rounds):
+                    for name, r in runners.items():  # interleaved
+                        rounds[name].append(
+                            r.measure_device_only(iters_for[name])
+                        )
+                return iters_for, rounds
+
+            iters_for, rounds = run_with_deadline(
+                _measure_window, window_deadline_s,
+                what=f"W={window} measurement",
+            )
             med = {n: float(np.median(v)) for n, v in rounds.items()}
             row = {
                 f"{n}_scans_per_sec": round(med[n], 1) for n in args.backends
@@ -125,6 +162,12 @@ def main() -> int:
                 ),
                 file=sys.stderr, flush=True,
             )
+        except MeasurementWedgedError as e:
+            # terminal for this process's backend: the blocked fetch
+            # never returns, so later windows can only be skipped
+            results[str(window)] = {"error": f"{type(e).__name__}: {e}"}
+            wedged = window
+            print(f"W={window}: WEDGED ({e})", file=sys.stderr, flush=True)
         except Exception as e:  # noqa: BLE001 - a dead link mid-sequence
             # must not discard the windows already measured: rig time is
             # scarce, so completed results still reach the artifact
@@ -137,7 +180,9 @@ def main() -> int:
         **({"barrier_rtt_ms": round(rtt_ms, 3)} if rtt_ms is not None else {}),
         "rounds": args.rounds,
         "method": "device_resident_in_jit_interleaved",
-    }))
+    }), flush=True)
+    if wedged is not None:
+        exit_skipping_destructors(0)
     return 0
 
 

@@ -130,55 +130,114 @@ def main() -> int:
         "resample_only": cfg(enable_median=False, enable_voxel=False,
                              enable_clip=False),
     }
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        exit_skipping_destructors,
+        run_with_deadline,
+    )
+
+    # a wedged mid-run fetch would otherwise hang the process and lose
+    # every case already measured (the deep-window A/B lost a completed
+    # window exactly this way); one budget per case, partial artifact
+    # on wedge
+    case_deadline_s = float(os.environ.get("BENCH_CASE_DEADLINE_S", 600))
+
     auto = args.iters == "auto"
     iters = 3000 if auto else args.iters
     rtt_ms = None
-    if auto:
-        # probe the full step once, then size ALL cases' rounds off the
-        # measured RTT (uniform iters keep the subtraction deltas on an
-        # identical — and now negligible — per-step barrier bias)
-        rtt_ms = bench._barrier_rtt_ms(device)
-        iters = bench._rtt_adaptive_iters(
-            lambda it: 1e6 / measure(cases["full_scatter"], it, 1),
-            rtt_ms, iters,
-        )
-        print(f"auto: rtt {rtt_ms:.1f} ms -> {iters} iters/round",
-              file=sys.stderr, flush=True)
-    us = {}
-    for name, c in cases.items():
-        us[name] = measure(c, iters, args.rounds)
-        print(f"{name:16s} {us[name]:8.2f} us/scan", file=sys.stderr, flush=True)
+    wedge_error = None
+    us: dict[str, float] = {}
+    case_errors: dict[str, str] = {}
+    try:
+        if auto:
+            # probe the full step once, then size ALL cases' rounds off
+            # the measured RTT (uniform iters keep the subtraction deltas
+            # on an identical — and now negligible — per-step barrier
+            # bias)
+            def _size() -> tuple[float, int]:
+                rtt = bench._barrier_rtt_ms(device)
+                return rtt, bench._rtt_adaptive_iters(
+                    lambda it: 1e6 / measure(cases["full_scatter"], it, 1),
+                    rtt, iters,
+                )
 
-    full = us["full_scatter"]
+            rtt_ms, iters = run_with_deadline(
+                _size, case_deadline_s, what="RTT-adaptive sizing probe"
+            )
+            print(f"auto: rtt {rtt_ms:.1f} ms -> {iters} iters/round",
+                  file=sys.stderr, flush=True)
+        for name, c in cases.items():
+            try:
+                us[name] = run_with_deadline(
+                    lambda c=c: measure(c, iters, args.rounds),
+                    case_deadline_s, what=f"ablation case {name}",
+                )
+            except Exception as e:  # noqa: BLE001 - dead link mid-case
+                # a RAISING failure (RPC error etc.) must not discard
+                # the cases already measured; a wedge is terminal for
+                # the backend and aborts the sequence via the outer try
+                if isinstance(e, MeasurementWedgedError):
+                    raise
+                case_errors[name] = f"{type(e).__name__}: {e}"
+                print(f"{name:16s} FAILED ({e})",
+                      file=sys.stderr, flush=True)
+                continue
+            print(f"{name:16s} {us[name]:8.2f} us/scan",
+                  file=sys.stderr, flush=True)
+    except MeasurementWedgedError as e:
+        wedge_error = f"{type(e).__name__}: {e}"
+        for name in cases:
+            if name not in us and name not in case_errors:
+                # same contract as deep_window_ab's skipped rows: a
+                # reader must be able to tell "never attempted" from
+                # "silently missing"
+                case_errors[name] = "skipped: link wedged"
+        print(f"WEDGED: {e}", file=sys.stderr, flush=True)
+
+    def ratio(num: str, den: str):
+        if num in us and den in us and us[den]:
+            return round(us[num] / us[den], 3)
+        return None
+
     derived = {
-        # stage costs by subtraction from the full step (scatter resample)
-        "median_us": round(full - us["no_median"], 2),
-        "voxel_us": round(full - us["no_voxel"], 2),
-        "clip_us": round(full - us["no_clip"], 2),
-        "dense_vs_scatter_speedup": round(us["full_scatter"] / us["full_dense"], 3),
-        "matmul_vs_scatter_voxel_speedup": round(
-            us["full_scatter"] / us["full_voxel_matmul"], 3
+        # stage costs by subtraction from the full step (scatter
+        # resample); entries whose inputs did not complete are omitted
+        # rather than fabricated
+        "median_us": (round(us["full_scatter"] - us["no_median"], 2)
+                      if "full_scatter" in us and "no_median" in us else None),
+        "voxel_us": (round(us["full_scatter"] - us["no_voxel"], 2)
+                     if "full_scatter" in us and "no_voxel" in us else None),
+        "clip_us": (round(us["full_scatter"] - us["no_clip"], 2)
+                    if "full_scatter" in us and "no_clip" in us else None),
+        "dense_vs_scatter_speedup": ratio("full_scatter", "full_dense"),
+        "matmul_vs_scatter_voxel_speedup": ratio(
+            "full_scatter", "full_voxel_matmul"
         ),
         # inc vs the explicit sort path (platform-independent baseline)
-        "inc_vs_xla_median_speedup": round(
-            us["full_median_xla"] / us["full_median_inc"], 3
+        "inc_vs_xla_median_speedup": ratio(
+            "full_median_xla", "full_median_inc"
         ),
         # inc vs whatever auto currently resolves to (pallas on TPU —
         # the comparison that decides the TPU auto mapping)
-        "inc_vs_auto_median_speedup": round(
-            us["full_scatter"] / us["full_median_inc"], 3
+        "inc_vs_auto_median_speedup": ratio(
+            "full_scatter", "full_median_inc"
         ),
     }
+    derived = {k: v for k, v in derived.items() if v is not None}
     print(json.dumps({
         "ablation_us": {k: round(v, 2) for k, v in us.items()},
         "derived": derived,
+        **({"case_errors": case_errors} if case_errors else {}),
+        **({"error": wedge_error} if wedge_error else {}),
         "device": str(device.platform),
         "window": window,
         "iters": iters,
         **({"barrier_rtt_ms": round(rtt_ms, 3)} if rtt_ms is not None else {}),
         "rounds": args.rounds,
         "method": "device_resident_in_jit",
-    }))
+    }), flush=True)
+    if wedge_error is not None:
+        exit_skipping_destructors(0)
     return 0
 
 

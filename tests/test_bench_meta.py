@@ -228,3 +228,85 @@ def test_rtt_adaptive_iters_scenarios():
     n = bench._rtt_adaptive_iters(slow, 78.0, 3000)
     assert n * 0.1 <= 16
     assert max(calls) < 3000  # never ran the full-length probe
+
+
+def test_run_with_deadline_semantics():
+    """Value passthrough, exception passthrough, and the wedge timeout
+    (a blocked device fetch sits in native code where no signal can
+    reach it — the daemon-thread deadline is the only way out)."""
+    import time
+
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        run_with_deadline,
+    )
+
+    assert run_with_deadline(lambda: 42, 5.0) == 42
+
+    class Boom(RuntimeError):
+        pass
+
+    def raises():
+        raise Boom("real failure")
+
+    try:
+        run_with_deadline(raises, 5.0)
+        raise AssertionError("exception should propagate")
+    except Boom:
+        pass
+
+    t0 = time.monotonic()
+    try:
+        run_with_deadline(lambda: time.sleep(30), 0.2, what="fake fetch")
+        raise AssertionError("timeout should raise")
+    except MeasurementWedgedError as e:
+        assert "fake fetch" in str(e)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_deep_window_ab_emits_partial_artifact_on_wedge():
+    """A wedged window must not cost the windows already measured, and
+    later windows are skipped (the process's backend is hostage to the
+    blocked fetch) — the artifact still lands with exit 0.  Forced via
+    a sub-measurement deadline."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_WINDOW_DEADLINE_S="0.001")
+    r = subprocess.run(
+        [sys.executable, "scripts/deep_window_ab.py", "--cpu",
+         "--windows", "4", "8", "--backends", "xla",
+         "--iters", "5", "--rounds", "1"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    ab = out["deep_window_ab"]
+    assert "wedged" in ab["4"]["error"].lower() or "Wedged" in ab["4"]["error"]
+    assert ab["8"]["skipped"] == "link wedged during W=4"
+
+
+def test_step_ablation_emits_partial_artifact_on_wedge():
+    """Same contract for the ablation tool: a wedge mid-sequence emits
+    the cases measured so far plus an error key, exit 0, and derived
+    ratios are omitted (never fabricated) when their inputs are
+    missing."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_CASE_DEADLINE_S="0.001")
+    r = subprocess.run(
+        [sys.executable, "scripts/step_ablation.py", "--cpu",
+         "--iters", "5", "--rounds", "1", "--window", "4"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "wedged" in out["error"].lower()
+    assert out["derived"] == {}
