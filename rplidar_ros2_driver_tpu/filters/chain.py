@@ -56,25 +56,36 @@ def _pick_device(backend: str):
 DEFAULT_BEAMS = 2048
 
 
-def resolve_median_backend(requested: str, platform: Optional[str] = None) -> str:
-    """Resolve the ``auto`` median backend for a device platform: pallas
-    on TPU (device-resident A/B: 2.14x over xla at W=64, 2.1-2.5x at
-    deeper windows — docs/BENCHMARKS.md), xla everywhere else (pallas on
-    CPU runs in interpret mode).  Explicit requests — including "inc",
-    the incremental sliding median (sorted-window carried state, O(W)
-    per revolution) — pass through; "inc" joins the auto mapping when
-    the on-chip ablation (full_median_inc) clears the same evidence bar
-    the current mapping did."""
+def resolve_median_backend(
+    requested: str,
+    platform: Optional[str] = None,
+    window: Optional[int] = None,
+) -> str:
+    """Resolve the ``auto`` median backend for a device platform and
+    window length.  Explicit requests — including "inc", the
+    incremental sliding median (sorted-window carried state, O(W) per
+    revolution) — pass through.
+
+    The mapping is evidence-gated on committed measurement artifacts
+    (docs/BENCHMARKS.md "standing decision procedure"), one bar for
+    every entry:
+
+    - TPU: pallas bitonic network (device-resident A/B 2.17x over xla
+      at W=64; 2.1-2.5x at deeper windows).  Window-aware because the
+      O(W) incremental arm CLOSES with depth on-chip — 0.29x of pallas
+      at W=64 but 0.95x at W=256 (2026-07-31 three-arm) — so the
+      crossover, if the W=512 artifact confirms it, lands here as a
+      window threshold; until that artifact exists, pallas at every
+      depth.
+    - CPU: inc (3.8x over the sort on the full W=64 step, 2026-07-31;
+      bit-exact parity suite in tests/test_filters.py).
+    - anything else (GPU): xla sort until it has its own measurement.
+    """
     if requested != "auto":
         return requested
     if platform is None:
         platform = jax.default_backend()
-    # Evidence-gated per platform, same bar for each: TPU stays pallas
-    # pending the on-chip full_median_inc ablation; CPU is inc — the
-    # step-ablation artifact measured the incremental path 3.8x faster
-    # on the full W=64 step (median stage ~23x vs jnp.sort, 2026-07-31),
-    # bit-exact outputs (tests/test_filters.py parity suite); anything
-    # else (GPU) keeps the xla sort until it has its own measurement.
+    del window  # no measured crossover yet — threshold lands here
     if platform == "tpu":
         return "pallas"
     return "inc" if platform == "cpu" else "xla"
@@ -131,7 +142,9 @@ def config_from_params(
         enable_clip="clip" in chain,
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
-        median_backend=resolve_median_backend(params.median_backend, platform),
+        median_backend=resolve_median_backend(
+            params.median_backend, platform, window=params.filter_window
+        ),
         resample_backend=resolve_resample_backend(
             params.resample_backend, platform
         ),

@@ -403,6 +403,11 @@ class TestBackendResolution:
         # explicit choices pass through regardless of platform
         assert resolve_median_backend("xla", "tpu") == "xla"
         assert resolve_median_backend("pallas", "cpu") == "pallas"
+        # window-aware signature: no measured crossover yet, so depth
+        # does not change the TPU mapping (the W=512 three-arm artifact
+        # is what would move this — docs/BENCHMARKS.md decision table)
+        assert resolve_median_backend("auto", "tpu", window=512) == "pallas"
+        assert resolve_median_backend("inc", "tpu", window=64) == "inc"
 
     def test_resample_auto_resolves_per_platform(self):
         from rplidar_ros2_driver_tpu.filters.chain import (
