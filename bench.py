@@ -429,6 +429,13 @@ def _e2e_phase(chain, rate_mult: float, seconds: float, timer, label: str) -> in
                 timer.record(f"{label}_grab", t_pub - t_grab)
                 timer.record(f"{label}_collect", wait)
                 timer.record(f"{label}_pub_ex_collect", lat - wait)
+                # the upload+dispatch slice of the residual: link-priced
+                # (device_put rides the tunnel; link_put_ms calibrates
+                # it) — what remains after collect AND upload/dispatch
+                # is pure host-side pack/bookkeeping
+                timer.record(
+                    f"{label}_upload_dispatch", chain.last_upload_dispatch_s
+                )
         chain.flush_pipelined()
         if published == 0:
             raise RuntimeError("e2e bench produced no scans (sim stream broken?)")
@@ -562,6 +569,15 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
         ),
         "collect_wait_p50_ms": round(
             timer.percentile("idle_collect", 50) * 1e3, 3
+        ),
+        # the link-priced upload/dispatch slice of the ex-collect
+        # residual (device_put + step dispatch; calibrate against
+        # link_put_ms) — ex-collect minus this is host-side pack time
+        "upload_dispatch_p99_ms": round(
+            timer.percentile("idle_upload_dispatch", 99) * 1e3, 3
+        ),
+        "upload_dispatch_p50_ms": round(
+            timer.percentile("idle_upload_dispatch", 50) * 1e3, 3
         ),
         "barrier_rtt_ms": round(_barrier_rtt_ms(device), 3),
         "staleness_revolutions": 1,

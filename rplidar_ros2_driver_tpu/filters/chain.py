@@ -201,6 +201,12 @@ class ScanFilterChain:
         # seconds the newest pipelined collect spent blocking on the
         # pending output's D2H copy (diagnostic for latency artifacts)
         self.last_collect_wait_s = 0.0
+        # seconds the newest pipelined tick spent in device_put + step
+        # dispatch: through a remote link the upload alone can cost ms
+        # (link_put_ms has measured 1-8), so the latency artifact can
+        # split the residual tail into link-priced upload/dispatch vs
+        # pure host-side pack time
+        self.last_upload_dispatch_s = 0.0
         if warmup:
             self.precompile()
 
@@ -328,6 +334,11 @@ class ScanFilterChain:
                 # fetch, instead of losing the revolution
                 self._restash_pending(pending, epoch)
                 raise
+        # reset before the attempt (like last_collect_wait_s above): a
+        # failed upload/dispatch must not leave the previous tick's
+        # duration attributed to this one
+        self.last_upload_dispatch_s = 0.0
+        t_dispatch = time.perf_counter()
         try:
             packed = jax.device_put(buf, self.device)
             with self._lock:
@@ -339,6 +350,7 @@ class ScanFilterChain:
                 except Exception:
                     pass  # backend without async D2H: the later fetch blocks
                 self._pending_wire = wire
+            self.last_upload_dispatch_s = time.perf_counter() - t_dispatch
         except Exception:
             # upload/dispatch of N failed AFTER N-1 was popped: re-stash
             # the wire so the caller's drain (flush_pipelined) can still

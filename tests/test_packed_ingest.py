@@ -92,6 +92,19 @@ def test_chain_pipelined_is_sync_shifted_by_one():
         np.asarray(tail.ranges), np.asarray(sync_outs[4].ranges)
     )
     assert c_pipe.flush_pipelined() is None  # drained
+    # latency-attribution diagnostics populated every tick (the e2e
+    # artifact splits the publish tail into collect-wait /
+    # upload+dispatch / host-side pack from exactly these): flush does
+    # not dispatch, so a nonzero value proves the LAST pipelined tick
+    # set it; the collect-wait assert poisons the attribute first so it
+    # cannot pass on the 0.0 initializer alone
+    assert c_pipe.last_upload_dispatch_s > 0.0
+    c_pipe.last_collect_wait_s = -1.0
+    angle, dist, qual = _raw_scan(999)
+    c_pipe.process_raw_pipelined(angle, dist, qual)
+    assert c_pipe.last_collect_wait_s == 0.0  # nothing pending: reset, no wait
+    c_pipe.process_raw_pipelined(angle, dist, qual)
+    assert c_pipe.last_collect_wait_s > 0.0  # collected a pending output
 
 
 def test_chain_capacity_truncates_oversized_revolution():
