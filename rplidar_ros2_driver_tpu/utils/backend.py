@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import subprocess
 import sys
-import threading
 import time
 
 
@@ -121,29 +120,50 @@ def run_with_deadline(fn, timeout_s: float, what: str = "device round-trip"):
     so neither SIGALRM nor an exception can break it — but a daemon
     thread lets the caller walk away.  Raises
     :class:`MeasurementWedgedError` on timeout; exceptions from ``fn``
-    propagate unchanged.  The abandoned thread keeps the wedged fetch
-    (and the process's backend) hostage, so treat a wedge as terminal
-    for device work in this process.
+    propagate unchanged (including ``fn``'s own TimeoutErrors — only
+    the deadline sentinel converts).  The abandoned thread keeps the
+    wedged fetch (and the process's backend) hostage, so treat a wedge
+    as terminal for device work in this process.
+
+    Thin measurement-layer veneer over the production
+    :func:`~rplidar_ros2_driver_tpu.utils.fetch.bounded_fetch` (one
+    daemon-thread deadline implementation, two exception contracts).
     """
-    out: dict = {}
-    done = threading.Event()
+    from rplidar_ros2_driver_tpu.utils.fetch import (
+        DeadlineExpired,
+        bounded_fetch,
+    )
 
-    def _run() -> None:
+    if not timeout_s or timeout_s <= 0:
+        # bounded_fetch treats a falsy timeout as "run inline,
+        # unbounded" — correct for a local-chip fetch, but here it
+        # would silently remove the hang guard that is this function's
+        # entire purpose (deadlines arrive via env vars, where 0 is one
+        # typo away)
+        raise ValueError(
+            f"run_with_deadline requires a positive deadline, got "
+            f"{timeout_s!r}"
+        )
+
+    def _captured():
+        # fn's exceptions — including any DeadlineExpired from a NESTED
+        # bounded_fetch (e.g. a chain collect with collect_timeout_s) —
+        # come back as values, so a DeadlineExpired escaping the outer
+        # bounded_fetch can only be ITS OWN wait expiring
         try:
-            out["value"] = fn()
-        except BaseException as e:  # propagate the real failure
-            out["err"] = e
-        finally:
-            done.set()
+            return True, fn()
+        except BaseException as e:  # re-raised on the caller thread
+            return False, e
 
-    threading.Thread(target=_run, daemon=True).start()
-    if not done.wait(timeout_s):
+    try:
+        ok, value = bounded_fetch(_captured, timeout_s, what)
+    except DeadlineExpired:
         raise MeasurementWedgedError(
             f"{what} blocked past {timeout_s:.0f} s (link wedged mid-run)"
-        )
-    if "err" in out:
-        raise out["err"]
-    return out["value"]
+        ) from None
+    if not ok:
+        raise value
+    return value
 
 
 def exit_skipping_destructors(code: int = 0) -> None:

@@ -23,6 +23,14 @@ from typing import Callable, Optional, TypeVar
 T = TypeVar("T")
 
 
+class DeadlineExpired(TimeoutError):
+    """Raised by :func:`bounded_fetch` when the DEADLINE expires — never
+    by the wrapped ``fn`` — so layers that need to distinguish "the wait
+    ran out" from "the fetch itself raised TimeoutError" can (see
+    utils/backend.run_with_deadline).  A plain TimeoutError to every
+    existing caller."""
+
+
 def bounded_fetch(
     fn: Callable[[], T],
     timeout_s: Optional[float],
@@ -49,7 +57,7 @@ def bounded_fetch(
 
     threading.Thread(target=run, daemon=True, name="bounded-fetch").start()
     if not done.wait(timeout_s):
-        raise TimeoutError(f"{what} exceeded {timeout_s} s")
+        raise DeadlineExpired(f"{what} exceeded {timeout_s} s")
     if "err" in box:
         raise box["err"]  # type: ignore[misc]
     return box["out"]  # type: ignore[return-value]

@@ -310,3 +310,32 @@ def test_step_ablation_emits_partial_artifact_on_wedge():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert "wedged" in out["error"].lower()
     assert out["derived"] == {}
+
+
+def test_run_with_deadline_nested_timeout_not_mistaken_for_wedge():
+    """A DeadlineExpired raised by fn ITSELF (e.g. a nested
+    bounded_fetch / chain collect timeout) must propagate as-is — only
+    the outer wait's expiry converts to MeasurementWedgedError — and a
+    falsy deadline is rejected rather than silently unbounded."""
+    import pytest
+
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        run_with_deadline,
+    )
+    from rplidar_ros2_driver_tpu.utils.fetch import DeadlineExpired
+
+    def inner_timeout():
+        raise DeadlineExpired("publish collect (device->host) exceeded 5 s")
+
+    with pytest.raises(DeadlineExpired):
+        run_with_deadline(inner_timeout, 10.0)
+    try:
+        run_with_deadline(inner_timeout, 10.0)
+    except MeasurementWedgedError:
+        raise AssertionError("nested timeout misreported as wedge")
+    except DeadlineExpired:
+        pass
+
+    with pytest.raises(ValueError):
+        run_with_deadline(lambda: 1, 0)
