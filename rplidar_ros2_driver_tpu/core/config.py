@@ -140,9 +140,12 @@ class DriverParams:
             )
         if self.voxel_grid_size < 1 or self.voxel_cell_m <= 0:
             raise ValueError("invalid voxel grid configuration")
-        if self.median_backend not in ("auto", "xla", "pallas", "inc"):
+        if self.median_backend not in (
+            "auto", "xla", "pallas", "inc", "inc_xla", "inc_pallas"
+        ):
             raise ValueError(
-                "median_backend must be 'auto', 'xla', 'pallas' or 'inc'"
+                "median_backend must be 'auto', 'xla', 'pallas', 'inc', "
+                "'inc_xla' or 'inc_pallas'"
             )
         if self.resample_backend not in ("auto", "scatter", "dense"):
             raise ValueError(

@@ -121,6 +121,20 @@ def resolve_voxel_backend(requested: str, platform: Optional[str] = None) -> str
     return "scatter"
 
 
+def _pin_inc_lowering(median: str, platform: Optional[str]) -> str:
+    """Pin "inc" to a concrete lowering while the target platform is
+    still known.  Inside jit, ``inc_median``'s fallback can only consult
+    the PROCESS default backend — wrong for an explicit CPU chain/mesh
+    on a TPU-default host (the same hazard replay.py re-resolves "auto"
+    against the mesh platform to avoid).  "inc_pallas" is the fused VMEM
+    sorted_replace kernel; "inc_xla" the jnp formulation; bit-exact
+    either way (tests/test_pallas_median.py)."""
+    if median != "inc":
+        return median
+    p = platform if platform is not None else jax.default_backend()
+    return "inc_pallas" if p == "tpu" else "inc_xla"
+
+
 def config_from_params(
     params: DriverParams,
     beams: int = DEFAULT_BEAMS,
@@ -142,8 +156,11 @@ def config_from_params(
         enable_clip="clip" in chain,
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
-        median_backend=resolve_median_backend(
-            params.median_backend, platform, window=params.filter_window
+        median_backend=_pin_inc_lowering(
+            resolve_median_backend(
+                params.median_backend, platform, window=params.filter_window
+            ),
+            platform,
         ),
         resample_backend=resolve_resample_backend(
             params.resample_backend, platform
@@ -498,7 +515,7 @@ class ScanFilterChain:
         # build the new device state OUTSIDE the lock (the H2D upload is
         # several MB at default geometry); only the reference swap — O(1)
         # — holds the streaming lock
-        with_sorted = self.cfg.median_backend == "inc"
+        with_sorted = self.cfg.median_backend.startswith("inc")
         if snap is None:
             fresh = jax.device_put(
                 FilterState.for_config(self.cfg), self.device

@@ -76,7 +76,7 @@ class FilterState:
         get it here, so call sites can't forget the coupling."""
         return cls.create(
             cfg.window, cfg.beams, cfg.grid,
-            with_sorted=cfg.median_backend == "inc",
+            with_sorted=cfg.median_backend.startswith("inc"),
         )
 
     @staticmethod
@@ -114,10 +114,12 @@ class FilterConfig:
     enable_voxel: bool = True
     # "xla" = jnp.sort path; "pallas" = VMEM bitonic-network kernel
     # (ops/pallas_kernels.temporal_median_pallas); "inc" = incremental
-    # sliding median over a sorted-window carried state (sorted_replace
-    # — O(W) elementwise per step; requires FilterState created with
-    # with_sorted=True; the fused path computes "inc" via the xla
-    # windows and re-sorts the carried state per chunk)
+    # sliding median over a sorted-window carried state — O(W) per step,
+    # auto-lowered per platform ("inc_pallas", the fused VMEM
+    # sorted_replace kernel, on TPU; "inc_xla", the jnp formulation,
+    # elsewhere — both pinnable for A/B).  inc* requires FilterState
+    # created with with_sorted=True; the fused path computes inc* via
+    # the xla windows and re-sorts the carried state per chunk.
     median_backend: str = "xla"
     # sharded-step voxel all-reduce over the beam axis: "psum" (XLA's
     # tuned all-reduce, default) or "ring" (explicit ppermute
@@ -289,11 +291,19 @@ def inc_median(
     cursor: jax.Array,
     median_sorted: Optional[jax.Array],
     new_ranges: jax.Array,
+    backend: str = "inc",
 ) -> tuple[jax.Array, jax.Array]:
     """One incremental-median step, shared by the single-device and
     sharded step implementations so the two cannot drift: evict the
     PRE-update ring row at ``cursor`` from the carried sorted window,
-    insert ``new_ranges``, return (updated sorted window, median)."""
+    insert ``new_ranges``, return (updated sorted window, median).
+
+    ``backend`` selects the lowering: "inc" auto-resolves per platform
+    (the fused VMEM kernel on TPU — the jnp formulation's ~6 small ops
+    each round-trip HBM there, which is the whole reason the O(W)
+    update measured SLOWER than the O(W log^2 W) pallas sort at W=64);
+    "inc_xla" / "inc_pallas" pin a lowering for A/B.  All lowerings are
+    bit-exact (tests/test_pallas_median.py parity)."""
     if median_sorted is None:
         raise ValueError(
             "median_backend='inc' requires a state carrying the sorted "
@@ -303,6 +313,16 @@ def inc_median(
     old_v = jax.lax.dynamic_index_in_dim(
         range_window, cursor, 0, keepdims=False
     )
+    if backend == "inc":
+        backend = (
+            "inc_pallas" if jax.default_backend() == "tpu" else "inc_xla"
+        )
+    if backend == "inc_pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
+            sorted_replace_pallas,
+        )
+
+        return sorted_replace_pallas(median_sorted, old_v, new_ranges)
     ms = sorted_replace(median_sorted, old_v, new_ranges)
     return ms, median_from_sorted(ms)
 
@@ -436,11 +456,14 @@ def _filter_step_impl(
 
     ms = state.median_sorted
     if cfg.enable_median:
-        if cfg.median_backend == "inc":
+        if cfg.median_backend.startswith("inc"):
             # incremental sliding median: the ring evicts exactly ONE
             # value per step, so the sorted multiset is maintained by a
             # delete+insert (O(W) elementwise) instead of re-sorted
-            ms, med = inc_median(state.range_window, state.cursor, ms, ranges)
+            ms, med = inc_median(
+                state.range_window, state.cursor, ms, ranges,
+                backend=cfg.median_backend,
+            )
         elif cfg.median_backend == "pallas":
             from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
                 temporal_median_pallas,

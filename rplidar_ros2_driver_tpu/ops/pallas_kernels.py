@@ -84,13 +84,24 @@ def _pad_beam_tiles(x: jax.Array, block_beams: int, interpret: bool):
     return x, tb
 
 
+def _pick_lower_median(s: jax.Array, nvalid: jax.Array, w: int) -> jax.Array:
+    """(rows, TB) ALREADY-SORTED columns + per-lane finite count ->
+    (TB,) lower median.  The one kernel-side definition of the median
+    rule (gather-free select-by-iota; all-inf lanes stay +inf), shared
+    by the sort kernels (_median_select) and the fused sorted_replace
+    kernel — the host-side jnp twin is ops/filters.median_from_sorted."""
+    pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)                # (TB,)
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    med = jnp.sum(jnp.where(rows == pick[None, :], s, 0.0), axis=0)
+    return jnp.where(nvalid > 0, med, jnp.inf)
+
+
 def _median_select(win: jax.Array, w: int) -> jax.Array:
     """(>=W, TB) window -> (TB,) lower median of the finite values.
 
-    The one definition of the median rule shared by the streaming
-    (_median_kernel) and fused (_sliding_median_kernel) kernels: rows
-    beyond ``w`` must be +inf padding (they sort to the tail and cannot
-    shift the lower median); all-inf lanes stay +inf."""
+    Shared by the streaming (_median_kernel) and fused
+    (_sliding_median_kernel) kernels: rows beyond ``w`` must be +inf
+    padding (they sort to the tail and cannot shift the lower median)."""
     w_pad = _next_pow2(max(w, 2))
     nvalid = jnp.sum(jnp.isfinite(win[:w]), axis=0)             # (TB,)
     if win.shape[0] != w_pad:
@@ -98,10 +109,7 @@ def _median_select(win: jax.Array, w: int) -> jax.Array:
             [win, jnp.full((w_pad - win.shape[0], win.shape[1]), jnp.inf, win.dtype)]
         )
     s = _bitonic_sort_rows(win)                                 # inf sorts last
-    pick = jnp.clip((nvalid - 1) // 2, 0, w - 1)                # (TB,)
-    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-    med = jnp.sum(jnp.where(rows == pick[None, :], s, 0.0), axis=0)
-    return jnp.where(nvalid > 0, med, jnp.inf)
+    return _pick_lower_median(s, nvalid, w)
 
 
 def _median_kernel(win_ref, out_ref):
@@ -206,6 +214,127 @@ def sliding_median_pallas(
     ext, tb = _pad_beam_tiles(ext, block_beams, interpret)
     out = _sliding_median_call(ext, w, tb, interpret)
     return out[:k, :b]
+
+
+def _sorted_replace_kernel(w: int, s_ref, old_ref, new_ref, out_ref, med_ref):
+    """One (Wp, TB) tile of the sorted window: delete old, insert new,
+    emit the updated tile AND its lower median in one VMEM pass.
+
+    Same multiset algebra as ops/filters.sorted_replace (delete/insert
+    shift each element by at most one slot, so the result is a 3-way
+    select over {left-neighbor, self, right-neighbor}) — but executed
+    entirely in VMEM: the O(W) formulation loses to the bitonic network
+    on TPU at W=64 ONLY because its ~6 small XLA ops each round-trip
+    HBM; fused into one kernel the work is two (W, TB) streams and a
+    handful of VPU passes.  Rows >= w are +inf padding: the delete slot
+    d and insert slot p both land in [0, w), so pads never shift (see
+    sorted_replace_pallas).
+    """
+    s = s_ref[:]                                           # (Wp, TB)
+    old = old_ref[0, :]
+    new = new_ref[0, :]
+    wp = s.shape[0]
+    iota = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    # first slot holding old (ties: any occurrence is the same value)
+    d = jnp.min(jnp.where(s == old[None, :], iota, wp), axis=0)
+    # insertion index in the W-1 multiset without old ("insert after
+    # equals": stable, matches sorted_replace exactly)
+    p = (
+        jnp.sum((s < new[None, :]).astype(jnp.int32), axis=0)
+        - (old < new).astype(jnp.int32)
+    )
+    left = jnp.concatenate([s[:1], s[:-1]], axis=0)        # left[i]=s[i-1]
+    right = jnp.concatenate([s[1:], s[-1:]], axis=0)       # right[i]=s[i+1]
+    d_, p_ = d[None, :], p[None, :]
+    shift_l = (d_ < p_) & (iota >= d_) & (iota < p_)
+    shift_r = (d_ > p_) & (iota > p_) & (iota <= d_)
+    out = jnp.where(shift_l, right, jnp.where(shift_r, left, s))
+    out = jnp.where(iota == p_, new[None, :], out)
+    out_ref[:] = out
+    # lower median of the finite values (pads are +inf: excluded by
+    # isfinite, and pick < w keeps the selection inside the real rows)
+    nvalid = jnp.sum(jnp.isfinite(out) & (iota < w), axis=0)
+    med_ref[:] = _pick_lower_median(out, nvalid, w)[None, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("w", "block_beams", "interpret")
+)
+def _sorted_replace_call(s, old, new, w, block_beams, interpret):
+    wp, b = s.shape
+    grid = (b // block_beams,)
+    return pl.pallas_call(
+        functools.partial(_sorted_replace_kernel, w),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (wp, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (wp, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((wp, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.float32),
+        ],
+        interpret=interpret,
+    )(s, old, new)
+
+
+def sorted_replace_pallas(
+    sorted_w: jax.Array,
+    old_v: jax.Array,
+    new_v: jax.Array,
+    *,
+    block_beams: int = 512,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Fused multiset update + median of the per-beam sorted window.
+
+    Drop-in for ``sorted_replace(...)`` followed by
+    ``median_from_sorted(...)`` (ops/filters) — bit-exact (parity suite
+    in tests/test_pallas_median.py) — with the whole update running in
+    one VMEM pass per beam tile.  Same contract: ``sorted_w`` (W, B)
+    ascending per column, ``old_v`` (B,) present in each column (exact
+    float equality — guaranteed when it came from the same ring),
+    +inf participates like any value.  Returns (updated (W, B), median
+    (B,)).
+
+    Row padding to the sublane multiple (and +inf beam-tile padding) is
+    safe: pads sort to the tail, the delete slot is the FIRST
+    occurrence of old (a real row whenever the contract holds — for
+    old=+inf the sorted order puts a real +inf before the pads), and
+    the insert slot p <= W-1 (p counts strictly-smaller survivors of a
+    W-1 multiset), so no shift or insert ever touches a pad row.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    w, b = sorted_w.shape
+    s = sorted_w.astype(jnp.float32)
+    wp = ((w + 7) // 8) * 8 if not interpret else w
+    if wp != w:
+        s = jnp.pad(s, ((0, wp - w), (0, 0)), constant_values=jnp.inf)
+    s, tb = _pad_beam_tiles(s, block_beams, interpret)
+    bp = s.shape[1]
+    old = old_v.astype(jnp.float32)[None, :]
+    new = new_v.astype(jnp.float32)[None, :]
+    if bp != b:
+        old = jnp.pad(old, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
+        new = jnp.pad(new, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
+    out, med = _sorted_replace_call(s, old, new, w, tb, interpret)
+    return out[:w, :b], med[0, :b]
 
 
 def temporal_median_pallas(

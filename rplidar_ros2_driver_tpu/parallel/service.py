@@ -580,7 +580,7 @@ class ShardedFilterService:
         got = checkpoint_orbax.restore_sharded(path, template)
         if got is None:
             return False
-        if self.cfg.median_backend == "inc":
+        if self.cfg.median_backend.startswith("inc"):
             # recompute the derived sorted window on the mesh (the sort
             # runs along the unsharded window axis — shard-local)
             got = dataclasses.replace(
@@ -624,7 +624,8 @@ class ShardedFilterService:
                     # under the "inc" backend
                     median_sorted=(
                         recompute_median_sorted(core["range_window"])
-                        if self.cfg.median_backend == "inc" else None
+                        if self.cfg.median_backend.startswith("inc")
+                        else None
                     ),
                 ),
             )

@@ -183,11 +183,16 @@ def _filter_step_shard(
     ms = state.median_sorted
     if not cfg.enable_median:
         med = ranges
-    elif cfg.median_backend == "inc":
+    elif cfg.median_backend.startswith("inc"):
         # incremental sliding median, beam-local like everything else in
         # the shard (the sorted window is per-beam state, so the shard's
-        # slice updates independently — no collective)
-        ms, med = inc_median(state.range_window, state.cursor, ms, ranges)
+        # slice updates independently — no collective).  Lowering pinned
+        # to the jnp formulation: pallas is not used inside shard_map
+        # (same rule as the sort path below), and the lowerings are
+        # bit-exact so the pin cannot change results
+        ms, med = inc_median(
+            state.range_window, state.cursor, ms, ranges, backend="inc_xla"
+        )
     else:
         # the xla sort; pallas is not used inside shard_map
         med = temporal_median(rw)
@@ -252,7 +257,7 @@ def _spec_for_state(state: FilterState) -> FilterState:
 def _spec_for_cfg(cfg: FilterConfig) -> FilterState:
     """STATE_SPEC as produced/consumed by steps compiled for ``cfg`` —
     the shard_map twin of :func:`_spec_for_state`."""
-    if cfg.median_backend != "inc":
+    if not cfg.median_backend.startswith("inc"):
         return STATE_SPEC
     return dataclasses.replace(STATE_SPEC, median_sorted=_MEDIAN_SORTED_SPEC)
 BATCH_SPEC = ScanBatch(
@@ -400,7 +405,7 @@ def create_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> FilterS
         # an all-inf ring is trivially sorted (mirror of FilterState.create)
         median_sorted=(
             jnp.full((streams, cfg.window, cfg.beams), jnp.inf, jnp.float32)
-            if cfg.median_backend == "inc" else None
+            if cfg.median_backend.startswith("inc") else None
         ),
     )
     return place_state(mesh, base)

@@ -36,7 +36,7 @@ def main() -> int:
     ap.add_argument("--windows", type=int, nargs="+", default=[64, 256, 512])
     ap.add_argument("--backends", nargs="+",
                     default=["pallas", "xla", "inc"],
-                    choices=["pallas", "xla", "inc"],
+                    choices=["pallas", "xla", "inc", "inc_xla", "inc_pallas"],
                     help="median arms to interleave (inc's O(W) update "
                     "vs the sorts' O(W log^2 W) should WIDEN with window "
                     "depth — the long-context scaling claim)")

@@ -124,6 +124,11 @@ def main() -> int:
         # reproducible after auto flips
         "full_median_xla": cfg(median_backend="xla"),
         "full_median_inc": cfg(median_backend="inc"),
+        # the two pinned inc lowerings: the fused VMEM sorted_replace
+        # kernel vs the jnp formulation (whose ~6 small ops each
+        # round-trip HBM on TPU) — decides what "inc" auto-lowers to
+        "full_median_inc_pallas": cfg(median_backend="inc_pallas"),
+        "full_median_inc_xla": cfg(median_backend="inc_xla"),
         "no_median": cfg(enable_median=False),
         "no_voxel": cfg(enable_voxel=False),
         "no_clip": cfg(enable_clip=False),
@@ -221,6 +226,11 @@ def main() -> int:
         # the comparison that decides the TPU auto mapping)
         "inc_vs_auto_median_speedup": ratio(
             "full_scatter", "full_median_inc"
+        ),
+        # the inc lowering A/B: fused VMEM kernel vs jnp formulation
+        # (decides what "inc" auto-lowers to per platform)
+        "inc_pallas_vs_inc_xla_speedup": ratio(
+            "full_median_inc_xla", "full_median_inc_pallas"
         ),
     }
     derived = {k: v for k, v in derived.items() if v is not None}

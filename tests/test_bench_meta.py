@@ -112,6 +112,7 @@ def test_step_ablation_smoke():
     assert set(out["ablation_us"]) == {
         "full_scatter", "full_dense", "full_voxel_matmul",
         "full_median_xla", "full_median_inc",
+        "full_median_inc_pallas", "full_median_inc_xla",
         "no_median", "no_voxel", "no_clip", "resample_only",
     }
     assert all(v > 0 for v in out["ablation_us"].values())
@@ -339,3 +340,60 @@ def test_run_with_deadline_nested_timeout_not_mistaken_for_wedge():
 
     with pytest.raises(ValueError):
         run_with_deadline(lambda: 1, 0)
+
+
+def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
+    """A secondary A/B arm whose compile/measure raises (e.g. a kernel
+    lowering Mosaic rejects on new hardware) must be recorded in
+    arm_errors and excluded — never crash the headline artifact.  The
+    headline arm's own failure stays fatal."""
+    import pytest
+
+    import bench
+
+    class FakeRunner:
+        rates = {"pallas": 30000.0, "xla": 15000.0}
+
+        def __init__(self, cfg, points):
+            self.cfg = cfg
+            self.backend = cfg.median_backend
+
+        def measure_barrier_rtt_ms(self):
+            return 1.0
+
+        def measure_device_only(self, iters):
+            if self.backend == "inc":
+                raise RuntimeError("Mosaic lowering rejected")
+            return self.rates[self.backend]
+
+        def measure_round(self, iters):
+            return 300.0
+
+        def measure_sync_p99(self):
+            return 5.0
+
+        def measure_link_put_ms(self):
+            return 1.0
+
+    class FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(bench, "_ChainRunner", FakeRunner)
+    monkeypatch.setattr(bench.jax, "devices", lambda: [FakeDev()])
+    out = bench.main(5, "pallas")
+    ab = out["median_ab"]
+    assert out["value"] == 30000.0
+    assert ab["speedup"] == 2.0
+    assert "inc" not in ab["rounds"]
+    assert "Mosaic" in ab["arm_errors"]["inc"]
+    assert "inc_vs_headline_speedup" not in ab
+
+    class FatalRunner(FakeRunner):
+        def measure_device_only(self, iters):
+            if self.backend == "pallas":
+                raise RuntimeError("headline arm died")
+            return 1.0
+
+    monkeypatch.setattr(bench, "_ChainRunner", FatalRunner)
+    with pytest.raises(RuntimeError, match="headline arm died"):
+        bench.main(5, "pallas")

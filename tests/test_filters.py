@@ -429,5 +429,13 @@ class TestBackendResolution:
         assert cfg.median_backend == "pallas"
         assert cfg.resample_backend in ("scatter", "dense")  # resolved
         cfg = config_from_params(DriverParams(), platform="cpu")
-        assert cfg.median_backend == "inc"
+        # CPU auto -> inc, pinned to the jnp lowering while the target
+        # platform is known (inc_median's in-jit fallback can only see
+        # the process default backend)
+        assert cfg.median_backend == "inc_xla"
         assert cfg.resample_backend == "scatter"
+        # explicit "inc" also gets pinned per platform
+        cfg = config_from_params(
+            DriverParams(median_backend="inc"), platform="tpu"
+        )
+        assert cfg.median_backend == "inc_pallas"

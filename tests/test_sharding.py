@@ -122,7 +122,6 @@ def test_sharded_scan_matches_sharded_steps(backend):
         per_stream.append(scans)
 
     def batch_at(k):
-        from rplidar_ros2_driver_tpu.core.types import ScanBatch
         from rplidar_ros2_driver_tpu.ops.filters import pack_host_scan_compact
 
         bufs, counts = zip(*[
