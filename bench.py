@@ -796,16 +796,25 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         # the full on-chip A/B (the "inc" arm is the evidence that can
         # flip the TPU auto mapping — filters/chain.py resolver)
         arms = [median] + [b for b in ("pallas", "xla", "inc") if b != median]
-        runners = {
-            name: _ChainRunner(
-                cfg if name == median else FilterConfig(
-                    beams=BEAMS, grid=GRID, cell_m=0.25,
-                    median_backend=name, **over,
-                ),
-                points,
-            )
-            for name in arms
-        }
+        runners = {}
+        arm_errors = {}
+        for name in arms:
+            # constructor included in the per-arm guard: its WARMUP
+            # submit compiles the step, which is exactly where a kernel
+            # lowering Mosaic rejects would raise
+            try:
+                runners[name] = _ChainRunner(
+                    cfg if name == median else FilterConfig(
+                        beams=BEAMS, grid=GRID, cell_m=0.25,
+                        median_backend=name, **over,
+                    ),
+                    points,
+                )
+            except Exception as e:  # noqa: BLE001 - secondary A/B arm
+                if name == median:
+                    raise
+                arm_errors[name] = f"{type(e).__name__}: {e}"
+                print(f"A/B arm {name} failed: {e}", file=sys.stderr)
         dev_rounds = {name: [] for name in runners}
         n_rounds = 5
         # The ONE barrier fetch per round costs a full link RTT, and the
@@ -820,7 +829,6 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         # capped at ~15 s/round so a healthy rig never crawls.
         rtt_ms = runners[median].measure_barrier_rtt_ms()
         iters_for = {}
-        arm_errors = {}
         for name, r in list(runners.items()):
             # the probe round also pays the compile, outside the timing.
             # A SECONDARY arm that fails (e.g. a kernel lowering Mosaic

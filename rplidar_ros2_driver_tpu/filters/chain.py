@@ -34,6 +34,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     counted_filter_step_wire,
     filter_step,
     pack_host_scan_counted,
+    pin_inc_lowering,
     recompute_median_sorted,
     unpack_output_wire,
 )
@@ -121,20 +122,6 @@ def resolve_voxel_backend(requested: str, platform: Optional[str] = None) -> str
     return "scatter"
 
 
-def _pin_inc_lowering(median: str, platform: Optional[str]) -> str:
-    """Pin "inc" to a concrete lowering while the target platform is
-    still known.  Inside jit, ``inc_median``'s fallback can only consult
-    the PROCESS default backend — wrong for an explicit CPU chain/mesh
-    on a TPU-default host (the same hazard replay.py re-resolves "auto"
-    against the mesh platform to avoid).  "inc_pallas" is the fused VMEM
-    sorted_replace kernel; "inc_xla" the jnp formulation; bit-exact
-    either way (tests/test_pallas_median.py)."""
-    if median != "inc":
-        return median
-    p = platform if platform is not None else jax.default_backend()
-    return "inc_pallas" if p == "tpu" else "inc_xla"
-
-
 def config_from_params(
     params: DriverParams,
     beams: int = DEFAULT_BEAMS,
@@ -156,7 +143,12 @@ def config_from_params(
         enable_clip="clip" in chain,
         enable_median="median" in chain,
         enable_voxel="voxel" in chain,
-        median_backend=_pin_inc_lowering(
+        # the lowering is pinned HERE, while the target platform is
+        # known: inside jit, inc_median's fallback can only consult the
+        # process default backend — wrong for an explicit CPU chain/mesh
+        # on a TPU-default host (the same hazard replay.py re-resolves
+        # "auto" against the mesh platform to avoid)
+        median_backend=pin_inc_lowering(
             resolve_median_backend(
                 params.median_backend, platform, window=params.filter_window
             ),

@@ -286,6 +286,19 @@ def sorted_replace(
     return jnp.where(iota == p[None, :], new_v[None, :], out)
 
 
+def pin_inc_lowering(median: str, platform: Optional[str] = None) -> str:
+    """The ONE platform -> inc-lowering mapping ("inc_pallas", the fused
+    VMEM sorted_replace kernel, on TPU; "inc_xla", the jnp formulation,
+    elsewhere), shared by chain.config_from_params (which pins while the
+    target platform is known) and inc_median's in-jit fallback (which
+    can only see the process default backend) so the two cannot drift.
+    Non-"inc" values pass through."""
+    if median != "inc":
+        return median
+    p = platform if platform is not None else jax.default_backend()
+    return "inc_pallas" if p == "tpu" else "inc_xla"
+
+
 def inc_median(
     range_window: jax.Array,
     cursor: jax.Array,
@@ -313,10 +326,7 @@ def inc_median(
     old_v = jax.lax.dynamic_index_in_dim(
         range_window, cursor, 0, keepdims=False
     )
-    if backend == "inc":
-        backend = (
-            "inc_pallas" if jax.default_backend() == "tpu" else "inc_xla"
-        )
+    backend = pin_inc_lowering(backend)
     if backend == "inc_pallas":
         from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
             sorted_replace_pallas,

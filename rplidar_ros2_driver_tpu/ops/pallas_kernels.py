@@ -323,7 +323,10 @@ def sorted_replace_pallas(
         interpret = jax.default_backend() != "tpu"
     w, b = sorted_w.shape
     s = sorted_w.astype(jnp.float32)
-    wp = ((w + 7) // 8) * 8 if not interpret else w
+    # pad rows unconditionally (not just on hardware): the pad-row
+    # algebra is the kernel's trickiest branch, and interpret-mode CI
+    # must exercise the same code path TPU runs
+    wp = ((w + 7) // 8) * 8
     if wp != w:
         s = jnp.pad(s, ((0, wp - w), (0, 0)), constant_values=jnp.inf)
     s, tb = _pad_beam_tiles(s, block_beams, interpret)

@@ -96,51 +96,74 @@ def main() -> int:
             }
             continue
         try:
-            def _measure_window() -> tuple[dict, dict]:
-                # runner construction included: warmup does device_put +
-                # submit + a blocking D2H barrier — the same round-trips
-                # that wedge — so it must sit under the deadline too
+            def _measure_window() -> tuple[dict, dict, dict]:
+                # runner construction sits under the deadline (warmup
+                # does device_put + submit + a blocking D2H barrier —
+                # the same round-trips that wedge) AND under the per-arm
+                # guard (the warmup submit compiles the step, which is
+                # exactly where a kernel lowering Mosaic rejects raises)
                 nonlocal rtt_ms
-                runners = {
-                    name: _ChainRunner(
-                        FilterConfig(
-                            window=window, beams=bench.BEAMS,
-                            grid=bench.GRID, cell_m=0.25,
-                            median_backend=name,
-                        ),
-                        bench.POINTS,
-                    )
-                    for name in args.backends
-                }
-                if auto:
-                    if rtt_ms is None:
-                        rtt_ms = next(
-                            iter(runners.values())
-                        ).measure_barrier_rtt_ms()
-                    iters_for = {
-                        n: bench._rtt_adaptive_iters(
-                            r.measure_device_only, rtt_ms, base_iters
+                runners = {}
+                arm_errors = {}
+                for name in args.backends:
+                    try:
+                        runners[name] = _ChainRunner(
+                            FilterConfig(
+                                window=window, beams=bench.BEAMS,
+                                grid=bench.GRID, cell_m=0.25,
+                                median_backend=name,
+                            ),
+                            bench.POINTS,
                         )
-                        for n, r in runners.items()
-                    }
-                else:
-                    iters_for = {n: base_iters for n in runners}
+                    except Exception as e:  # noqa: BLE001
+                        arm_errors[name] = f"{type(e).__name__}: {e}"
+                        print(f"W={window} arm {name} failed: {e}",
+                              file=sys.stderr, flush=True)
+                if not runners:
+                    return {}, {}, arm_errors
+                if rtt_ms is None and auto:
+                    rtt_ms = next(
+                        iter(runners.values())
+                    ).measure_barrier_rtt_ms()
+                iters_for = {}
+                for n, r in list(runners.items()):
+                    # an arm whose probe raises must not cost the other
+                    # arms; with fixed --iters a tiny probe round still
+                    # runs so compile failures surface HERE, not in the
+                    # interleaved rounds loop (where they would discard
+                    # the healthy arms' collected rounds)
+                    try:
+                        if auto:
+                            iters_for[n] = bench._rtt_adaptive_iters(
+                                r.measure_device_only, rtt_ms, base_iters
+                            )
+                        else:
+                            r.measure_device_only(min(base_iters, 30))
+                            iters_for[n] = base_iters
+                    except Exception as e:  # noqa: BLE001
+                        arm_errors[n] = f"{type(e).__name__}: {e}"
+                        del runners[n]
+                        print(f"W={window} arm {n} failed: {e}",
+                              file=sys.stderr, flush=True)
                 rounds: dict[str, list[float]] = {n: [] for n in runners}
                 for _ in range(args.rounds):
                     for name, r in runners.items():  # interleaved
                         rounds[name].append(
                             r.measure_device_only(iters_for[name])
                         )
-                return iters_for, rounds
+                return iters_for, rounds, arm_errors
 
-            iters_for, rounds = run_with_deadline(
+            iters_for, rounds, arm_errors = run_with_deadline(
                 _measure_window, window_deadline_s,
                 what=f"W={window} measurement",
             )
             med = {n: float(np.median(v)) for n, v in rounds.items()}
             row = {
-                f"{n}_scans_per_sec": round(med[n], 1) for n in args.backends
+                f"{n}_scans_per_sec": round(med[n], 1)
+                for n in args.backends if n in med
             }
+            if arm_errors:
+                row["arm_errors"] = arm_errors
             if "pallas" in med and "xla" in med:
                 # the series-continuity key (pallas/xla, r3 onward)
                 row["speedup"] = round(med["pallas"] / med["xla"], 3)
@@ -158,7 +181,10 @@ def main() -> int:
             print(
                 "W=%d: %s" % (
                     window,
-                    "  ".join(f"{n} {med[n]:.0f}" for n in args.backends),
+                    "  ".join(
+                        f"{n} {med[n]:.0f}"
+                        for n in args.backends if n in med
+                    ),
                 ),
                 file=sys.stderr, flush=True,
             )

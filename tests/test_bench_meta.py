@@ -388,6 +388,21 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
     assert "Mosaic" in ab["arm_errors"]["inc"]
     assert "inc_vs_headline_speedup" not in ab
 
+    class CtorFailRunner(FakeRunner):
+        # the realistic failure site: the constructor's WARMUP submit
+        # compiles the step, where a Mosaic-rejected lowering raises
+        def __init__(self, cfg, points):
+            if cfg.median_backend == "inc":
+                raise RuntimeError("Mosaic rejected at compile")
+            super().__init__(cfg, points)
+
+    monkeypatch.setattr(bench, "_ChainRunner", CtorFailRunner)
+    out = bench.main(5, "pallas")
+    ab = out["median_ab"]
+    assert out["value"] == 30000.0
+    assert "Mosaic rejected at compile" in ab["arm_errors"]["inc"]
+    assert "inc" not in ab["rounds"]
+
     class FatalRunner(FakeRunner):
         def measure_device_only(self, iters):
             if self.backend == "pallas":
