@@ -42,7 +42,7 @@ from rplidar_ros2_driver_tpu.ops.filters import (
     FilterOutput,
     FilterState,
     _grid_decode,
-    clip_filter,
+    _clip_ok,
     fused_scan_core,
     inc_median,
     select_voxel_hits,
@@ -98,8 +98,14 @@ def _resample_keys_shard(batch: ScanBatch, cfg: FilterConfig, b_local: int):
     """
     offset = jax.lax.axis_index("beam") * b_local
     ok = batch.valid & (batch.dist_q2 != 0)
-    # same clip as the single-device grid_resample: malformed angles land in
-    # the edge beams rather than being dropped (bit-identical contract)
+    if cfg.enable_clip:
+        # the range/intensity clip folds into the drop mask here, like
+        # the single-device _resample_keys — bit-identical to a prior
+        # clip_filter pass without materializing a clipped batch
+        ok = ok & _clip_ok(batch, cfg)
+    # same angle clamp as the single-device grid_resample: malformed
+    # angles land in the edge beams rather than being dropped
+    # (bit-identical contract)
     beam_global = jnp.clip((batch.angle_q14 * cfg.beams) // 65536, 0, cfg.beams - 1)
     beam_local = beam_global - offset
     in_slice = ok & (beam_local >= 0) & (beam_local < b_local)
@@ -171,9 +177,9 @@ def _filter_step_shard(
 
     Beam-local throughout except the voxel partial-sum all-reduce at the
     end (``cfg.voxel_reduce``: compiler ``psum`` or explicit ``ring``).
+    The clip stage folds into the shard's resample-key mask
+    (_resample_keys_shard), like the single-device step.
     """
-    if cfg.enable_clip:
-        batch = clip_filter(batch, cfg)
     ranges, inten = _grid_resample_shard(batch, cfg, b_local)
 
     rw = jax.lax.dynamic_update_index_in_dim(state.range_window, ranges, state.cursor, 0)

@@ -39,6 +39,43 @@ class TestClip:
         assert d[3] == 8000
 
 
+class TestClipFusion:
+    def test_fused_clip_identical_to_standalone_pass(self):
+        """The step folds the clip predicate into the resample-key mask;
+        it must be bit-identical to the standalone clip_filter pass:
+        step(raw, clip enabled) == step(clip_filter(raw), clip
+        disabled), for both resample backends."""
+        rng = np.random.default_rng(5)
+        n = 300
+        b = make_batch(
+            np.sort(rng.uniform(0, 360, n)),
+            rng.uniform(0.01, 60.0, n),          # spans both clip bounds
+            quality=rng.integers(0, 255, n),
+        )
+        for backend in ("scatter", "dense"):
+            cfg = dataclasses.replace(
+                CFG, range_max_m=40.0, intensity_min=20.0,
+                resample_backend=backend,
+            )
+            cfg_noclip = dataclasses.replace(cfg, enable_clip=False)
+            s1 = filters.FilterState.for_config(cfg)
+            s2 = filters.FilterState.for_config(cfg_noclip)
+            _, out_fused = filters.filter_step(s1, b, cfg)
+            _, out_two_pass = filters.filter_step(
+                s2, filters.clip_filter(b, cfg), cfg_noclip
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_fused.ranges), np.asarray(out_two_pass.ranges)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_fused.intensities),
+                np.asarray(out_two_pass.intensities),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out_fused.voxel), np.asarray(out_two_pass.voxel)
+            )
+
+
 class TestGridResample:
     def test_min_range_wins_per_beam(self):
         # two points in the same beam: nearer one wins
