@@ -117,6 +117,9 @@ def test_step_ablation_smoke():
     }
     assert all(v > 0 for v in out["ablation_us"].values())
     assert out["device"] == "cpu"
+    # the lowering-A/B decision key must ride in derived whenever both
+    # pinned inc cases measured
+    assert "inc_pallas_vs_inc_xla_speedup" in out["derived"]
 
 
 def test_bench_outage_artifact_is_structured_not_zero():
