@@ -497,7 +497,8 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
     timer = StageTimer(capacity=1 << 14)
 
     published = _e2e_phase(chain, 1.0, seconds, timer, "idle")
-    load_procs = _spin_host_load(os.cpu_count() or 4)
+    ncpu = os.cpu_count() or 1
+    load_procs = _spin_host_load(ncpu)
     try:
         loaded_published = _e2e_phase(
             chain, 3.0, loaded_seconds, timer, "loaded"
@@ -507,6 +508,40 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
             p.kill()
         for p in load_procs:
             p.wait()  # reap — kill() alone leaves a zombie per CPU
+
+    # RR-vs-default A/B (r4 VERDICT #6): on a rig with >=2 CPUs where
+    # the elevation actually took (rx_priority > 0 — unprivileged EPERM
+    # leaves the default policy, making the two arms identical), the
+    # elevation has a core to win and its value is isolable — rerun the
+    # loaded phase with the knob off and record the delta.
+    # On a 1-CPU box the spinner, sim, pump, decode and this loop all
+    # share one core; the loaded p99 measures scheduler/GIL noise, not
+    # the elevation path, and the artifact says so instead of implying
+    # the RR path was exercised.
+    no_elev = None
+    if ncpu >= 2 and timer.meta["loaded"]["rx_priority"] > 0:
+        load_procs = _spin_host_load(ncpu)
+        os.environ["RPL_RX_NO_ELEVATE"] = "1"
+        try:
+            ne_published = _e2e_phase(
+                chain, 3.0, loaded_seconds, timer, "noelev"
+            )
+        finally:
+            os.environ.pop("RPL_RX_NO_ELEVATE", None)
+            for p in load_procs:
+                p.kill()
+            for p in load_procs:
+                p.wait()
+        no_elev = {
+            "rx_priority": timer.meta["noelev"]["rx_priority"],
+            "published_per_sec": round(ne_published / loaded_seconds, 2),
+            "publish_p99_ms": round(
+                timer.percentile("noelev_publish", 99) * 1e3, 3
+            ),
+            "publish_p50_ms": round(
+                timer.percentile("noelev_publish", 50) * 1e3, 3
+            ),
+        }
 
     # sustained device compute per scan, measured inside ONE dispatch so
     # the tunnel's per-dispatch RPC (drifts ms-scale on this rig) does
@@ -585,7 +620,19 @@ def bench_e2e(seconds: float = 15.0, loaded_seconds: float = 8.0) -> dict:
         "device_compute_ms_per_scan": round(device_ms, 3),
         "loaded": {
             "rate_mult": 3.0,
-            "host_load_procs": os.cpu_count() or 4,
+            "host_cpus": ncpu,
+            "host_load_procs": ncpu,
+            **({"scheduling_signal":
+                "limited — 1 host CPU: spinner, sim, pump, decode and "
+                "the bench loop share one core, so loaded p99 measures "
+                "scheduler/GIL noise, not the rx elevation path"}
+               if ncpu < 2 else
+               {"scheduling_signal":
+                "limited — rx elevation unavailable (EPERM fallback to "
+                "default policy), so an elevation-off arm would be "
+                "identical and no RR delta is measurable"}
+               if timer.meta["loaded"]["rx_priority"] <= 0 else {}),
+            **({"no_elevation_ab": no_elev} if no_elev else {}),
             "rx_priority": timer.meta["loaded"]["rx_priority"],
             "published_per_sec": round(loaded_published / loaded_seconds, 2),
             "publish_p99_ms": round(timer.percentile("loaded_publish", 99) * 1e3, 3),
@@ -791,11 +838,19 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         # separation is clean: pallas 2.14x over xla at W=64 and
         # 2.1-2.5x at W=256/512 (RTT-adaptive recapture, 2026-07-31 —
         # docs/BENCHMARKS.md), hence the pallas default.
-        # three arms: the selected headline backend plus every other
+        # four arms: the selected headline backend plus every other
         # median formulation, so the scoreboard artifact always carries
-        # the full on-chip A/B (the "inc" arm is the evidence that can
-        # flip the TPU auto mapping — filters/chain.py resolver)
-        arms = [median] + [b for b in ("pallas", "xla", "inc") if b != median]
+        # the full on-chip A/B.  The inc arm is PINNED per lowering
+        # ("inc_xla" is the series-continuity arm — the jnp formulation
+        # the committed r2..r4 artifacts measured; "inc_pallas" is the
+        # fused VMEM sorted_replace kernel whose on-chip verdict decides
+        # the TPU auto mapping — filters/chain.py resolver).  An
+        # unpinned "inc" would silently change meaning with the
+        # platform's auto-lowering.
+        arms = [median] + [
+            b for b in ("pallas", "xla", "inc_xla", "inc_pallas")
+            if b != median
+        ]
         runners = {}
         arm_errors = {}
         for name in arms:
@@ -863,10 +918,23 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         if "pallas" in dev_med and "xla" in dev_med:
             # series-continuity key (r2 onward): the pallas-vs-xla ratio
             ab["speedup"] = round(dev_med["pallas"] / dev_med["xla"], 3)
-        if "inc" in dev_med:
+        if "inc_xla" in dev_med:
+            # series-continuity key (r2..r4 measured the jnp "inc"
+            # formulation; the arm is now pinned so the ratio keeps
+            # meaning after auto-lowering changes)
             ab["inc_vs_headline_speedup"] = round(
-                dev_med["inc"] / dev_med[median], 3
+                dev_med["inc_xla"] / dev_med[median], 3
             )
+        if "inc_pallas" in dev_med:
+            ab["inc_pallas_vs_headline_speedup"] = round(
+                dev_med["inc_pallas"] / dev_med[median], 3
+            )
+            if "inc_xla" in dev_med:
+                # the lowering A/B that decides what "inc" resolves to
+                # on TPU (VERDICT r4 #3a)
+                ab["inc_pallas_vs_inc_xla_speedup"] = round(
+                    dev_med["inc_pallas"] / dev_med["inc_xla"], 3
+                )
         # context: what THIS rig's link-bound streaming path does, plus
         # the per-scan transfer calibration that explains it
         streaming = float(np.median(
@@ -914,9 +982,45 @@ def _load_last_good() -> dict:
         return {}
 
 
+_LINK_KEYS = ("link_put_ms", "barrier_rtt_ms")
+
+
+def _link_health(result: dict) -> dict:
+    """Whatever link calibrations the artifact carries (top level, or
+    config 5's median_ab) — stored with each sidecar entry so a reader
+    can tell a framework number from link weather."""
+    out = {}
+    for k in _LINK_KEYS:
+        v = result.get(k)
+        if isinstance(v, (int, float)):
+            out[k] = v
+    ab = result.get("median_ab")
+    if isinstance(ab, dict):
+        v = ab.get("barrier_rtt_ms")
+        if isinstance(v, (int, float)) and "barrier_rtt_ms" not in out:
+            out["barrier_rtt_ms"] = v
+    return out
+
+
+def _link_sicker(new: dict, old: dict, factor: float = 2.5) -> bool:
+    """True when the new entry's link calibration is decisively worse
+    than the old entry's on some shared axis.  The factor sits above
+    the healthy link's own ~2x weather drift; entries without
+    comparable calibrations (old format) are never 'sicker'."""
+    shared = [k for k in _LINK_KEYS if k in new and k in old]
+    return any(new[k] > factor * max(old[k], 1e-6) for k in shared)
+
+
 def _record_last_good(result: dict) -> None:
     """After a successful on-device run, remember the headline so a later
-    outage can report 'last good + when' instead of zeroing the series."""
+    outage can report 'last good + when' instead of zeroing the series.
+
+    Link-aware (r4 VERDICT weak #2/#5): every entry stores its link
+    calibration, and a link-priced ("streaming") run on a decisively
+    sicker link with a LOWER number does not overwrite the healthier
+    entry — it is recorded beside it as ``degraded_link_run``, so an
+    outage artifact can never present link weather (e.g. a 7.4 scans/s
+    e2e on a 7.8 ms/put tunnel) as the standing capability."""
     import datetime
     import os
 
@@ -924,7 +1028,7 @@ def _record_last_good(result: dict) -> None:
         return
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), LAST_GOOD_PATH)
     data = _load_last_good()
-    data[result["metric"]] = {
+    entry = {
         "value": result["value"],
         "unit": result.get("unit", "scans/s"),
         "date": datetime.date.today().isoformat(),
@@ -935,7 +1039,22 @@ def _record_last_good(result: dict) -> None:
         # must not present that as a pallas-headline regression
         **({"median_backend": result["median_backend"]}
            if "median_backend" in result else {}),
+        **_link_health(result),
     }
+    prev = data.get(result["metric"])
+    if (
+        isinstance(prev, dict)
+        and entry["measurement"] == "streaming"  # the link-priced class
+        and prev.get("measurement") == entry["measurement"]
+        and isinstance(prev.get("value"), (int, float))
+        and entry["value"] < prev["value"]
+        and _link_sicker(entry, prev)
+    ):
+        kept = {k: v for k, v in prev.items() if k != "degraded_link_run"}
+        kept["degraded_link_run"] = entry
+        data[result["metric"]] = kept
+    else:
+        data[result["metric"]] = entry
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
@@ -1053,11 +1172,32 @@ if __name__ == "__main__":
             sys.stdout.write(r.stdout)
             rc = r.returncode
         except subprocess.TimeoutExpired:
-            print(json.dumps({
+            # pure host-side double failure (hung init AND a wedged CPU
+            # fallback child).  The series must STILL never read 0.0
+            # for an unchanged framework: carry the last-good sidecar
+            # into the artifact, value included, self-described via
+            # value_is_last_good + the error key.
+            art = {
                 "metric": metric_name(args.config), "value": 0.0,
                 "unit": "scans/s", "vs_baseline": 0.0,
+                "device_unavailable": True,
                 "error": f"{detail}; CPU fallback itself timed out",
-            }))
+            }
+            last = _load_last_good()
+            mine = last.get(metric_name(args.config))
+            if mine is not None:
+                art["last_good_device"] = mine
+                if isinstance(mine.get("value"), (int, float)):
+                    art["value"] = mine["value"]
+                    art["unit"] = mine.get("unit", "scans/s")
+                    art["vs_baseline"] = round(
+                        mine["value"] / BASELINE_SCANS_PER_SEC, 3
+                    )
+                    art["value_is_last_good"] = True
+            headline = last.get(metric_name(5))
+            if headline is not None and headline is not mine:
+                art["last_good_headline"] = headline
+            print(json.dumps(art))
             rc = 3
         # a daemon thread (hung init probe or wedged fetch) may still be
         # blocked inside native runtime code; normal interpreter

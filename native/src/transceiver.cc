@@ -19,6 +19,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -52,7 +53,15 @@ constexpr size_t kMaxQueued = 8192;  // bound memory if the consumer stalls
 // back silently to a negative nice (also usually EPERM) and finally to the
 // default policy — latency under host load degrades gracefully instead of
 // failing startup.  Returns 2 (SCHED_RR), 1 (nice boost) or 0 (default).
+//
+// RPL_RX_NO_ELEVATE=1 skips the elevation entirely (returns 0): the
+// measurement knob for the RR-vs-default A/B under host load — without
+// it the elevation's value can never be isolated on a rig where it
+// succeeds.
 int ElevateSelfToHighPriority() {
+  if (const char* no = std::getenv("RPL_RX_NO_ELEVATE")) {
+    if (*no && *no != '0') return 0;
+  }
   const pid_t tid = static_cast<pid_t>(syscall(SYS_gettid));
   sched_param param{};
   param.sched_priority = sched_get_priority_min(SCHED_RR);

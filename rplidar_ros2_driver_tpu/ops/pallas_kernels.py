@@ -33,6 +33,20 @@ from jax.experimental.pallas import tpu as pltpu
 _LANES = 128
 
 
+def _lowering_dispatch(compiled_fn, interpret_fn, *args):
+    """Pick the Mosaic-compiled kernel vs interpret mode AT LOWERING
+    TIME (``lax.platform_dependent``), not from the process default
+    backend: a function traced for a CPU device on a TPU-default host
+    (e.g. a config pinned to ``inc_pallas`` jitted onto a CPU device)
+    must get the interpretable lowering — ``jax.default_backend()``
+    sees the host default, not the trace target.  Both branches are
+    traced; only the branch matching each lowering platform is
+    compiled, so the selection costs nothing at runtime."""
+    return jax.lax.platform_dependent(
+        *args, tpu=compiled_fn, default=interpret_fn
+    )
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -197,9 +211,11 @@ def sliding_median_pallas(
     over ``ext[i+1 : i+1+window]`` (exactly what K successive
     :func:`ops.filters.temporal_median` calls on the advancing ring would
     produce).  Non-power-of-two windows are padded with +inf rows inside
-    the kernel (they sort to the tail without shifting the lower median)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    the kernel (they sort to the tail without shifting the lower median).
+
+    ``interpret=None`` (default) resolves per LOWERING platform
+    (``_lowering_dispatch``), so the same traced function is correct on
+    a TPU target and a CPU target alike."""
     wk, b = ext.shape
     w = window
     k = wk - w
@@ -211,9 +227,19 @@ def sliding_median_pallas(
     if k_pad != k:
         ext = jnp.pad(ext, ((0, k_pad - k), (0, 0)), constant_values=jnp.inf)
 
-    ext, tb = _pad_beam_tiles(ext, block_beams, interpret)
-    out = _sliding_median_call(ext, w, tb, interpret)
-    return out[:k, :b]
+    def _impl(ext, interpret):
+        # beam-tile padding sits inside the per-lowering branch: the
+        # tile rule differs by mode, but the sliced output shape matches
+        padded, tb = _pad_beam_tiles(ext, block_beams, interpret)
+        return _sliding_median_call(padded, w, tb, interpret)[:k, :b]
+
+    if interpret is None:
+        return _lowering_dispatch(
+            functools.partial(_impl, interpret=False),
+            functools.partial(_impl, interpret=True),
+            ext,
+        )
+    return _impl(ext, interpret)
 
 
 def _sorted_replace_kernel(w: int, s_ref, old_ref, new_ref, out_ref, med_ref):
@@ -318,9 +344,11 @@ def sorted_replace_pallas(
     old=+inf the sorted order puts a real +inf before the pads), and
     the insert slot p <= W-1 (p counts strictly-smaller survivors of a
     W-1 multiset), so no shift or insert ever touches a pad row.
+
+    ``interpret=None`` (default) resolves per LOWERING platform
+    (``_lowering_dispatch``): a config pinned to ``inc_pallas`` but
+    traced for a CPU device on a TPU-default host still compiles.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     w, b = sorted_w.shape
     s = sorted_w.astype(jnp.float32)
     # pad rows unconditionally (not just on hardware): the pad-row
@@ -329,15 +357,25 @@ def sorted_replace_pallas(
     wp = ((w + 7) // 8) * 8
     if wp != w:
         s = jnp.pad(s, ((0, wp - w), (0, 0)), constant_values=jnp.inf)
-    s, tb = _pad_beam_tiles(s, block_beams, interpret)
-    bp = s.shape[1]
-    old = old_v.astype(jnp.float32)[None, :]
-    new = new_v.astype(jnp.float32)[None, :]
-    if bp != b:
-        old = jnp.pad(old, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
-        new = jnp.pad(new, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
-    out, med = _sorted_replace_call(s, old, new, w, tb, interpret)
-    return out[:w, :b], med[0, :b]
+
+    def _impl(s, old_v, new_v, interpret):
+        s, tb = _pad_beam_tiles(s, block_beams, interpret)
+        bp = s.shape[1]
+        old = old_v.astype(jnp.float32)[None, :]
+        new = new_v.astype(jnp.float32)[None, :]
+        if bp != b:
+            old = jnp.pad(old, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
+            new = jnp.pad(new, ((0, 0), (0, bp - b)), constant_values=jnp.inf)
+        out, med = _sorted_replace_call(s, old, new, w, tb, interpret)
+        return out[:w, :b], med[0, :b]
+
+    if interpret is None:
+        return _lowering_dispatch(
+            functools.partial(_impl, interpret=False),
+            functools.partial(_impl, interpret=True),
+            s, old_v, new_v,
+        )
+    return _impl(s, old_v, new_v, interpret)
 
 
 def temporal_median_pallas(
@@ -352,9 +390,11 @@ def temporal_median_pallas(
     missing returns / unfilled slots; all-inf beams stay +inf).  W is
     padded to the next power of two with +inf (sorts to the tail, does
     not shift the lower median); B is padded to the beam-tile multiple.
+
+    ``interpret=None`` (default) resolves per LOWERING platform
+    (``_lowering_dispatch``), so the same traced function is correct on
+    a TPU target and a CPU target alike.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     w, b = window.shape
     window = window.astype(jnp.float32)
 
@@ -362,6 +402,14 @@ def temporal_median_pallas(
     if w_pad != w:
         window = jnp.pad(window, ((0, w_pad - w), (0, 0)), constant_values=jnp.inf)
 
-    window, tb = _pad_beam_tiles(window, block_beams, interpret)
-    out = _median_call(window, tb, interpret)
-    return out[:b]
+    def _impl(window, interpret):
+        padded, tb = _pad_beam_tiles(window, block_beams, interpret)
+        return _median_call(padded, tb, interpret)[:b]
+
+    if interpret is None:
+        return _lowering_dispatch(
+            functools.partial(_impl, interpret=False),
+            functools.partial(_impl, interpret=True),
+            window,
+        )
+    return _impl(window, interpret)

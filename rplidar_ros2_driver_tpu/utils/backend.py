@@ -14,11 +14,30 @@ import sys
 import time
 
 
+def _nonpositive_timeout_detail(timeout_s: float) -> str | None:
+    """Probe timeouts arrive via env vars (``BENCH_PROBE_TIMEOUT_S``),
+    where 0 is one typo away.  Both probe flavors validate up front and
+    report a timeout-STYLE failure detail — letting the value reach
+    :func:`run_with_deadline` would surface its ValueError as the probe
+    diagnostic, reading like a code bug instead of a misconfiguration."""
+    try:
+        bad = not (timeout_s > 0)
+    except TypeError:
+        bad = True
+    if bad:
+        return (f"jax backend init not attempted: non-positive probe "
+                f"timeout {timeout_s!r} (check BENCH_PROBE_TIMEOUT_S)")
+    return None
+
+
 def probe_jax_backend(timeout_s: float) -> tuple[bool, str]:
     """(ok, detail) — detail is the device list on success, and on
     failure distinguishes a hang (link down) from an init error; a
     daemon probe thread means a hung init never blocks process exit.
     """
+    bad = _nonpositive_timeout_detail(timeout_s)
+    if bad is not None:
+        return False, bad
     import jax
 
     try:
@@ -44,6 +63,9 @@ def probe_jax_backend_subprocess(timeout_s: float) -> tuple[bool, str]:
     at a time, so the probe must fully exit (``subprocess.run`` waits)
     before the caller initializes its own backend.
     """
+    bad = _nonpositive_timeout_detail(timeout_s)
+    if bad is not None:
+        return False, bad
     code = "import jax; print(', '.join(str(d) for d in jax.devices()))"
     try:
         r = subprocess.run(

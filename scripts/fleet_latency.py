@@ -1,0 +1,249 @@
+"""Live multi-stream pipelined fleet latency (r4 VERDICT #9).
+
+Config 8 measures fleet REPLAY throughput; this measures the PRODUCTION
+fleet tick: N SimulatedDevices stream DenseBoost wire frames, each
+through its own RealLidarDriver (native channel -> batched decode ->
+assembler), and one ``ShardedFilterService.submit_pipelined`` tick per
+revolution period stacks every stream's newest revolution onto the
+(stream, beam) mesh.  The artifact records per-tick submit latency, the
+per-publish latency distribution (anchored like config 6: a publish
+event is triggered by the newest revolution's completed measurement and
+carries the previous tick's output — one tick of declared staleness),
+and the fleet keep-up ratio against the N x 10 scans/s device pace.
+
+Reference frame: this is the fleet-scale analog of the double-buffered
+acquisition/consumption overlap in the reference's ScanDataHolder
+(/root/reference/src/sdk/src/sl_lidar_driver.cpp:237-371) — with the
+whole fleet's filter work in ONE sharded dispatch per tick.
+
+    python scripts/fleet_latency.py [--streams 4] [--seconds 10]
+                                    [--rate-mult 1.0] [--cpu]
+
+Prints ONE JSON line (progress to stderr).  All the decode work runs on
+THIS host: on a 1-core box N streams at 1x pace contend for the core,
+so the artifact records host_cpus alongside the keep-up ratio.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402 - safe pre-init (no device use at import)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=4)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--rate-mult", type=float, default=1.0,
+                    help="device pace multiplier (1.0 = 800 frames/s = "
+                    "10 revolutions/s per stream)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="override the headline 64-scan window")
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from rplidar_ros2_driver_tpu.utils.backend import guarded_backend_init
+
+        ok, detail, _poisoned = guarded_backend_init(
+            log=lambda m: print(m, file=sys.stderr, flush=True)
+        )
+        if not ok:
+            print(json.dumps({"error": detail}))
+            return 3
+
+    import jax
+    import numpy as np
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+    from rplidar_ros2_driver_tpu.driver.sim_device import (
+        SimConfig,
+        SimulatedDevice,
+    )
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        exit_skipping_destructors,
+        run_with_deadline,
+    )
+
+    n = args.streams
+    window = args.window or bench.WINDOW
+    period_s = 0.1 / args.rate_mult  # one tick per revolution period
+    params = DriverParams(
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=window,
+        voxel_grid_size=bench.GRID,
+        voxel_cell_m=0.25,
+        median_backend="auto",  # resolved per the mesh platform
+        pipelined_publish=True,
+    )
+
+    sims = []
+    drvs = []
+    latest: list = [None] * n  # newest (scan, rev_end) per stream
+    lk = threading.Lock()
+    running = threading.Event()
+    running.set()
+
+    def pump(i: int, drv) -> None:
+        while running.is_set():
+            got = drv.grab_scan_host(0.5)
+            if got is None:
+                continue
+            scan, ts0, duration = got
+            with lk:
+                latest[i] = (scan, ts0 + duration)  # newest wins
+
+    threads = []
+    result = {}
+    try:
+        svc = ShardedFilterService(
+            params, streams=n, beams=bench.BEAMS, capacity=bench.CAPACITY
+        )
+        for _ in range(n):
+            sim = SimulatedDevice(SimConfig(
+                points_per_rev=bench.POINTS,
+                frame_rate_hz=800.0 * args.rate_mult,
+            )).start()
+            sims.append(sim)
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1",
+                tcp_port=sim.port, motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, False)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("DenseBoost", 600)
+            drvs.append(drv)
+        for i, drv in enumerate(drvs):
+            t = threading.Thread(target=pump, args=(i, drv), daemon=True)
+            t.start()
+            threads.append(t)
+
+        tick_s: list[float] = []
+        pub_lat_s: list[float] = []
+        published = 0
+        ticks = 0
+        live_in = 0
+
+        def _measured_run() -> None:
+            nonlocal published, ticks, live_in
+            # warm the compile outside the measured span (all-idle tick)
+            svc.submit_pipelined([None] * n)
+            svc.flush_pipelined()
+            t_start = time.monotonic()
+            next_t = t_start + period_s
+            t_end = t_start + args.seconds
+            while time.monotonic() < t_end:
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                next_t += period_s
+                with lk:
+                    scans = []
+                    rev_end = []
+                    for i in range(n):
+                        if latest[i] is not None:
+                            s, re = latest[i]
+                            latest[i] = None
+                            scans.append(s)
+                            rev_end.append(re)
+                        else:
+                            scans.append(None)
+                            rev_end.append(None)
+                t0 = time.monotonic()
+                outs = svc.submit_pipelined(scans)
+                t1 = time.monotonic()
+                ticks += 1
+                live_in += sum(s is not None for s in scans)
+                tick_s.append(t1 - t0)
+                for i, out in enumerate(outs):
+                    if out is None:
+                        continue
+                    published += 1
+                    if rev_end[i] is not None:
+                        # config-6 anchor: the publish is triggered by
+                        # the newest revolution; the payload is declared
+                        # one tick stale
+                        pub_lat_s.append(t1 - rev_end[i])
+            svc.flush_pipelined()
+
+        deadline_s = float(os.environ.get("BENCH_RUN_DEADLINE_S", 900))
+        try:
+            run_with_deadline(
+                _measured_run, deadline_s, what="fleet latency measurement"
+            )
+        except MeasurementWedgedError as e:
+            print(json.dumps({
+                "metric": "fleet_live_pipelined_tick",
+                "error": f"{type(e).__name__}: {e}",
+                "ticks_completed": ticks,
+            }), flush=True)
+            exit_skipping_destructors(0)
+
+        if ticks == 0 or published == 0:
+            raise RuntimeError(
+                f"fleet produced no output (ticks={ticks}, "
+                f"published={published}) — sim streams broken?"
+            )
+        elapsed = args.seconds
+        pace = 10.0 * args.rate_mult  # scans/s per stream at device pace
+        result = {
+            "metric": "fleet_live_pipelined_tick",
+            "value": round(published / elapsed, 2),
+            "unit": "scans/s",
+            "vs_baseline": round(
+                published / elapsed / (n * bench.BASELINE_SCANS_PER_SEC), 3
+            ),
+            "streams": n,
+            "rate_mult": args.rate_mult,
+            "ticks": ticks,
+            "live_inputs": live_in,
+            "keep_up": round(published / (pace * n * elapsed), 3),
+            "tick_p50_ms": round(float(np.percentile(tick_s, 50)) * 1e3, 3),
+            "tick_p99_ms": round(float(np.percentile(tick_s, 99)) * 1e3, 3),
+            "publish_p50_ms": round(
+                float(np.percentile(pub_lat_s, 50)) * 1e3, 3
+            ) if pub_lat_s else None,
+            "publish_p99_ms": round(
+                float(np.percentile(pub_lat_s, 99)) * 1e3, 3
+            ) if pub_lat_s else None,
+            "staleness_ticks": 1,
+            "points_per_scan": bench.POINTS,
+            "window": window,
+            "median_backend": svc.cfg.median_backend,
+            "mesh": dict(svc.mesh.shape),
+            "host_cpus": os.cpu_count() or 1,
+            "device": str(jax.devices()[0].platform),
+        }
+    finally:
+        running.clear()
+        for t in threads:
+            t.join(timeout=2.0)
+        for drv in drvs:
+            try:
+                drv.stop_motor()
+                drv.disconnect()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        for sim in sims:
+            sim.stop()
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

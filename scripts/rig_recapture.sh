@@ -4,11 +4,15 @@
 # and do not share this box with CPU-heavy jobs while measuring: a
 # starved relay wedges the tunnel).
 #
-# Default queue:
-#   1. config 5 headline (RTT-adaptive in-jit rounds + median A/B)
-#   2. config 6 e2e (pipelined publish tail, collect-wait decomposed)
-#   3. deep-window median A/B at W=256/512 (--iters auto)
-#   4. streaming-step ablation (--iters auto)
+# Default queue (r5 — VERDICT r4 items 1-4, 7, 9 in priority order):
+#   1. config 5 headline (RTT-adaptive in-jit rounds + 4-arm median A/B
+#      incl. the pinned inc_xla/inc_pallas lowering A/B)
+#   2. config 6 e2e (pipelined publish tail, collect-wait + upload/
+#      dispatch decomposed — the clean-link post-reorder p99)
+#   3. deep-window median A/B at W=256/512 (--iters auto, pinned arms)
+#   4. streaming-step ablation (--iters auto: unbiased absolutes,
+#      post-fold clip confirmation, voxel matmul arm)
+#   5. live multi-stream pipelined fleet latency artifact
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -51,7 +55,8 @@ if [ $# -eq 0 ]; then
     "python bench.py --config 5" \
     "python bench.py --config 6" \
     "python scripts/deep_window_ab.py --windows 256 512" \
-    "python scripts/step_ablation.py"
+    "python scripts/step_ablation.py" \
+    "python scripts/fleet_latency.py"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

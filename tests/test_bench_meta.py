@@ -93,6 +93,80 @@ def test_guarded_backend_init_env_and_poisoned_flag(monkeypatch):
     assert ok and not poisoned and detail == "dev0"
 
 
+def test_probe_nonpositive_timeout_reports_misconfig():
+    """A zero/negative probe timeout (one typo away in
+    BENCH_PROBE_TIMEOUT_S) must produce a configuration diagnostic, not
+    a ValueError from the deadline helper masquerading as the probe
+    failure (r4 ADVICE)."""
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        probe_jax_backend,
+        probe_jax_backend_subprocess,
+    )
+
+    for fn in (probe_jax_backend, probe_jax_backend_subprocess):
+        for bad in (0, -1, 0.0):
+            ok, detail = fn(bad)
+            assert not ok
+            assert "BENCH_PROBE_TIMEOUT_S" in detail, detail
+            assert "ValueError" not in detail
+
+
+def test_record_last_good_is_link_aware(monkeypatch, tmp_path):
+    """The sidecar must never let a decisively-sicker-link streaming run
+    overwrite a healthier entry with a lower number (r4 VERDICT #5: the
+    degraded-link 7.4 scans/s e2e must not stand as capability) — while
+    better numbers, healthier links, and the link-independent
+    device-resident class overwrite normally."""
+    metric = bench.metric_name(6)
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "lg.json"))
+
+    def rec(value, rtt, **over):
+        bench._record_last_good({
+            "metric": metric, "value": value, "unit": "scans/s",
+            "device": "tpu", "barrier_rtt_ms": rtt, **over,
+        })
+        return bench._load_last_good()[metric]
+
+    e = rec(30.0, 1.0)
+    assert e["value"] == 30.0 and e["barrier_rtt_ms"] == 1.0
+
+    # sicker link (>2.5x RTT) + lower value: refused, recorded beside
+    e = rec(7.4, 40.0)
+    assert e["value"] == 30.0
+    assert e["degraded_link_run"]["value"] == 7.4
+    assert e["degraded_link_run"]["barrier_rtt_ms"] == 40.0
+
+    # sicker link but a BETTER value: overwrites (not link-caused)
+    e = rec(50.0, 40.0)
+    assert e["value"] == 50.0 and "degraded_link_run" not in e
+
+    # healthier link, lower value: overwrites (a real regression must
+    # not be hidden behind the link heuristic)
+    e = rec(20.0, 1.0)
+    assert e["value"] == 20.0
+
+    # link weather within the healthy ~2x drift: overwrites
+    e = rec(18.0, 1.9)
+    assert e["value"] == 18.0
+
+    # the device-resident class is link-independent: always overwrites,
+    # and config 5's median_ab RTT rides into the entry
+    m5 = bench.metric_name(5)
+    bench._record_last_good({
+        "metric": m5, "value": 33000.0, "unit": "scans/s", "device": "tpu",
+        "measurement": "device_resident_in_jit",
+        "median_ab": {"barrier_rtt_ms": 1.0}, "link_put_ms": 2.0,
+    })
+    bench._record_last_good({
+        "metric": m5, "value": 32000.0, "unit": "scans/s", "device": "tpu",
+        "measurement": "device_resident_in_jit",
+        "median_ab": {"barrier_rtt_ms": 200.0}, "link_put_ms": 9.0,
+    })
+    e = bench._load_last_good()[m5]
+    assert e["value"] == 32000.0
+    assert e["barrier_rtt_ms"] == 200.0 and e["link_put_ms"] == 9.0
+
+
 def test_step_ablation_smoke():
     """The ablation tool must keep running against the real counted step
     (tiny shapes — this pins the harness, not the numbers)."""
@@ -120,6 +194,33 @@ def test_step_ablation_smoke():
     # the lowering-A/B decision key must ride in derived whenever both
     # pinned inc cases measured
     assert "inc_pallas_vs_inc_xla_speedup" in out["derived"]
+
+
+def test_fleet_latency_smoke():
+    """The live fleet-tick tool (N sim devices -> real drivers -> one
+    sharded pipelined tick per revolution period) must keep running end
+    to end and emit a well-formed artifact — tiny pace/shapes; this pins
+    the harness, not the numbers."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "scripts/fleet_latency.py", "--cpu",
+         "--streams", "2", "--seconds", "3", "--rate-mult", "0.3",
+         "--window", "4"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "fleet_live_pipelined_tick"
+    assert out["streams"] == 2 and out["ticks"] > 0
+    assert out["value"] > 0 and 0 < out["keep_up"] <= 1.2
+    assert out["tick_p99_ms"] > 0
+    assert out["staleness_ticks"] == 1
+    assert out["device"] == "cpu"
 
 
 def test_bench_outage_artifact_is_structured_not_zero():
@@ -151,15 +252,22 @@ def test_bench_outage_artifact_is_structured_not_zero():
     assert out["last_good_headline"]["date"]
 
 
-def test_config5_three_arm_branch_executes(monkeypatch):
-    """The device branch of config 5 (three median arms, RTT-adaptive
-    rounds) must execute end to end — a crash here would zero the
-    driver's end-of-round artifact.  Runners and the platform check are
-    stubbed so the branch's own logic runs host-side."""
+def test_config5_four_arm_branch_executes(monkeypatch):
+    """The device branch of config 5 (four median arms — the inc arm is
+    PINNED per lowering so the continuity key keeps its r2..r4 meaning —
+    with RTT-adaptive rounds) must execute end to end: a crash here
+    would zero the driver's end-of-round artifact.  Runners and the
+    platform check are stubbed so the branch's own logic runs
+    host-side."""
     import bench
 
     class FakeRunner:
-        rates = {"pallas": 30000.0, "xla": 15000.0, "inc": 45000.0}
+        rates = {
+            "pallas": 30000.0,
+            "xla": 15000.0,
+            "inc_xla": 45000.0,
+            "inc_pallas": 60000.0,
+        }
 
         def __init__(self, cfg, points):
             self.cfg = cfg
@@ -187,11 +295,16 @@ def test_config5_three_arm_branch_executes(monkeypatch):
     monkeypatch.setattr(bench.jax, "devices", lambda: [FakeDev()])
     out = bench.main(5, "pallas")
     ab = out["median_ab"]
+    arms = {"pallas", "xla", "inc_xla", "inc_pallas"}
     assert out["value"] == 30000.0  # headline stays the selected backend
-    assert {"pallas", "xla", "inc"} <= set(ab)
+    assert arms <= set(ab)
     assert ab["speedup"] == 2.0                    # pallas/xla continuity key
-    assert ab["inc_vs_headline_speedup"] == 1.5    # the flip-decision ratio
-    assert set(ab["rounds"]) == {"pallas", "xla", "inc"}
+    # continuity key still means "jnp inc formulation vs headline"
+    assert ab["inc_vs_headline_speedup"] == 1.5
+    # the lowering A/B that decides the TPU auto mapping
+    assert ab["inc_pallas_vs_headline_speedup"] == 2.0
+    assert ab["inc_pallas_vs_inc_xla_speedup"] == round(60000.0 / 45000.0, 3)
+    assert set(ab["rounds"]) == arms
     assert "barrier_rtt_ms" in ab and set(ab["round_iters"]) == set(ab["rounds"])
 
 
@@ -355,7 +468,7 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
     import bench
 
     class FakeRunner:
-        rates = {"pallas": 30000.0, "xla": 15000.0}
+        rates = {"pallas": 30000.0, "xla": 15000.0, "inc_xla": 45000.0}
 
         def __init__(self, cfg, points):
             self.cfg = cfg
@@ -365,7 +478,7 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
             return 1.0
 
         def measure_device_only(self, iters):
-            if self.backend == "inc":
+            if self.backend == "inc_pallas":
                 raise RuntimeError("Mosaic lowering rejected")
             return self.rates[self.backend]
 
@@ -387,15 +500,18 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
     ab = out["median_ab"]
     assert out["value"] == 30000.0
     assert ab["speedup"] == 2.0
-    assert "inc" not in ab["rounds"]
-    assert "Mosaic" in ab["arm_errors"]["inc"]
-    assert "inc_vs_headline_speedup" not in ab
+    assert "inc_pallas" not in ab["rounds"]
+    assert "Mosaic" in ab["arm_errors"]["inc_pallas"]
+    # the surviving pinned-jnp arm still carries the continuity key
+    assert ab["inc_vs_headline_speedup"] == 1.5
+    assert "inc_pallas_vs_headline_speedup" not in ab
+    assert "inc_pallas_vs_inc_xla_speedup" not in ab
 
     class CtorFailRunner(FakeRunner):
         # the realistic failure site: the constructor's WARMUP submit
         # compiles the step, where a Mosaic-rejected lowering raises
         def __init__(self, cfg, points):
-            if cfg.median_backend == "inc":
+            if cfg.median_backend == "inc_pallas":
                 raise RuntimeError("Mosaic rejected at compile")
             super().__init__(cfg, points)
 
@@ -403,8 +519,8 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
     out = bench.main(5, "pallas")
     ab = out["median_ab"]
     assert out["value"] == 30000.0
-    assert "Mosaic rejected at compile" in ab["arm_errors"]["inc"]
-    assert "inc" not in ab["rounds"]
+    assert "Mosaic rejected at compile" in ab["arm_errors"]["inc_pallas"]
+    assert "inc_pallas" not in ab["rounds"]
 
     class FatalRunner(FakeRunner):
         def measure_device_only(self, iters):
