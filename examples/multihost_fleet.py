@@ -1,15 +1,28 @@
-"""Multi-controller fleet: two jax.distributed processes, one mesh.
+"""Multi-controller fleet: N jax.distributed processes, one mesh.
 
-Self-launches two worker processes (the parent is only a launcher), each
-owning one lidar stream.  The workers join via
-``parallel.multihost.initialize`` (standard coordinator env vars), build
-the global stream-major ``(stream, beam)`` mesh, and tick
-``ShardedFilterService.submit_local`` — each process uploads ONLY its
-own stream's revolutions (`jax.make_array_from_process_local_data`, so
-ingest never crosses hosts) and reads back only its own output shards.
-On a real pod the same code spans hosts; here the two processes share
-one machine with 2 virtual CPU devices each (gloo collectives standing
-in for ICI/DCN).
+Two modes, one worker code path:
+
+* **Demo (default)**: self-launches two worker processes on this machine
+  (the parent is only a launcher), each owning one lidar stream, with 2
+  virtual CPU devices per process (gloo collectives standing in for
+  ICI/DCN).
+
+* **Pod runbook (--worker)**: the one command each host of a real pod
+  runs.  Set the standard coordinator variables and start the same
+  command on every host — the worker joins via
+  ``parallel.multihost.initialize``, builds the global stream-major
+  ``(stream, beam)`` mesh, and ticks the pipelined fleet:
+
+      JAX_COORDINATOR_ADDRESS=host0:8476 \\
+      JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=<this host's id> \\
+      python examples/multihost_fleet.py --worker --ticks 100
+
+  Each process uploads ONLY its own streams' revolutions
+  (``jax.make_array_from_process_local_data`` — ingest never crosses
+  hosts) and reads back only its own output shards; XLA routes the
+  beam-axis psum over ICI within a host and DCN across hosts.  Swap the
+  DummyLidarDriver for ``RealLidarDriver(port=...)`` per stream to feed
+  real sensors (docs/MULTIHOST_RUNBOOK.md).
 
     python examples/multihost_fleet.py [--ticks 5]
 """
@@ -19,43 +32,71 @@ import os
 import socket
 import subprocess
 import sys
-import textwrap
 
-_WORKER = textwrap.dedent(
+# the runbook invokes this file directly from any cwd: python only adds
+# examples/ to sys.path, so the package root must be added explicitly
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_worker(ticks: int, streams_per_host: int = 1,
+               window: int = 4, demo_cpu: bool = False,
+               allow_single: bool = False) -> int:
+    """The per-process fleet worker — the pod runbook entry point.
+
+    Topology comes from the standard coordinator env variables (see
+    module docstring).  ``demo_cpu`` is the local-demo switch: force the
+    CPU backend via jax.config (the env var alone can be overridden by
+    site shims that pre-set the platform config at interpreter start).
     """
-    import os, sys
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    if demo_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
-    jax.config.update("jax_platforms", "cpu")
 
-    port, pid, ticks = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
-    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
-    os.environ["JAX_NUM_PROCESSES"] = "2"
-    os.environ["JAX_PROCESS_ID"] = str(pid)
-
-    sys.path.insert(0, os.getcwd())  # launcher sets cwd to the repo root
     from rplidar_ros2_driver_tpu.core.config import DriverParams
     from rplidar_ros2_driver_tpu.driver.dummy import DummyLidarDriver
     from rplidar_ros2_driver_tpu.parallel import multihost
     from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
 
-    assert multihost.initialize()
-    mesh = multihost.make_global_mesh(stream=2)  # rows align to processes
+    if not multihost.initialize():
+        # a pod worker with no coordinator must FAIL here, not degrade:
+        # this host would tick its own 1-process mesh and exit 0 looking
+        # healthy while every peer blocks in initialize() waiting for it
+        if not allow_single:
+            print("error: no multi-process topology configured "
+                  f"({multihost._COORD_ENV} unset); a pod worker "
+                  "must not silently run alone — pass --single-process "
+                  "for a deliberate 1-process smoke run",
+                  file=sys.stderr, flush=True)
+            return 2
+        print("single-process smoke run (--single-process)", flush=True)
+    pid, nproc = jax.process_index(), jax.process_count()
+    streams = nproc * streams_per_host
+    mesh = multihost.make_global_mesh(stream=streams)
     print(f"proc {pid}: joined, mesh {dict(mesh.shape)} over "
-          f"{jax.process_count()} processes", flush=True)
+          f"{nproc} processes", flush=True)
 
-    params = DriverParams(filter_backend="cpu", filter_window=4,
+    params = DriverParams(filter_window=window,
                           filter_chain=("clip", "median", "voxel"),
-                          voxel_grid_size=32)
-    svc = ShardedFilterService(params, streams=2, mesh=mesh, beams=256,
-                               capacity=1024)
-    lidar = DummyLidarDriver()         # this host's OWN sensor
-    lidar.connect("dummy", 0, False)
-    lidar.start_motor("", 600)
+                          voxel_grid_size=32,
+                          **({"filter_backend": "cpu"} if demo_cpu else {}))
+    svc = ShardedFilterService(params, streams=streams, mesh=mesh,
+                               beams=256, capacity=1024)
+    # this host's OWN sensors — on a real rig, construct one
+    # RealLidarDriver(port=...) per local stream here instead
+    lidars = []
+    for _ in range(streams_per_host):
+        lidar = DummyLidarDriver()
+        lidar.connect("dummy", 0, False)
+        lidar.start_motor("", 600)
+        lidars.append(lidar)
+
+    def grab_local():
+        return [lidar.grab_scan_host(2.0)[0] for lidar in lidars]
+
     for tick in range(ticks):
-        scan, _ts0, _dur = lidar.grab_scan_host(2.0)
-        outs = svc.submit_local([scan])   # collective: both procs tick
+        outs = svc.submit_local(grab_local())  # collective: all procs tick
         occ = int(outs[0].voxel.sum())
         print(f"proc {pid} tick {tick}: voxel occ {occ}", flush=True)
 
@@ -64,8 +105,7 @@ _WORKER = textwrap.dedent(
     # identical across peers (ALL processes must use the pipelined
     # variant together; see submit_local_pipelined's docstring)
     for tick in range(ticks):
-        scan, _ts0, _dur = lidar.grab_scan_host(2.0)
-        prev = svc.submit_local_pipelined([scan])
+        prev = svc.submit_local_pipelined(grab_local())
         label = (
             f"{int(prev[0].voxel.sum())}" if prev[0] is not None else "(warming)"
         )
@@ -75,20 +115,37 @@ _WORKER = textwrap.dedent(
     if tail is not None and tail[0] is not None:
         print(f"proc {pid}: drained final tick occ {int(tail[0].voxel.sum())}",
               flush=True)
-    lidar.stop_motor()
-    lidar.disconnect()
+    for lidar in lidars:
+        lidar.stop_motor()
+        lidar.disconnect()
     print(f"proc {pid}: done", flush=True)
-    """
-)
+    return 0
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ticks", type=int, default=3)
-    # accepted for symmetry with the other examples; the workers force
-    # the CPU backend themselves (virtual 2-device processes)
+    ap.add_argument("--worker", action="store_true",
+                    help="run as ONE fleet process (the pod runbook "
+                    "command — topology from JAX_COORDINATOR_ADDRESS / "
+                    "JAX_NUM_PROCESSES / JAX_PROCESS_ID)")
+    ap.add_argument("--streams-per-host", type=int, default=1)
+    ap.add_argument("--single-process", action="store_true",
+                    help="with --worker: deliberately run a 1-process "
+                    "fleet without a coordinator (smoke runs only — a "
+                    "pod worker missing its coordinator is otherwise a "
+                    "hard error)")
+    ap.add_argument("--demo-cpu", action="store_true",
+                    help=argparse.SUPPRESS)  # set by the demo launcher
+    # accepted for symmetry with the other examples; the demo workers
+    # force the CPU backend themselves (virtual 2-device processes)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
+
+    if args.worker:
+        return run_worker(args.ticks, args.streams_per_host,
+                          demo_cpu=args.demo_cpu,
+                          allow_single=args.single_process)
 
     def free_port() -> int:
         with socket.socket() as s:
@@ -97,16 +154,24 @@ def main() -> int:
 
     def launch_once(port: int):
         here = os.path.dirname(os.path.abspath(__file__))
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", _WORKER, str(port), str(i), str(args.ticks)],
-                cwd=os.path.dirname(here),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
+        repo = os.path.dirname(here)
+        procs = []
+        for i in range(2):
+            env = dict(
+                os.environ,
+                XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                JAX_PLATFORMS="cpu",
+                JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                JAX_NUM_PROCESSES="2",
+                JAX_PROCESS_ID=str(i),
+                PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
             )
-            for i in range(2)
-        ]
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--demo-cpu", "--ticks", str(args.ticks)],
+                cwd=repo, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
         # timeout well under any harness timeout, and a hung worker takes
         # its sibling down with it (a lone survivor would orphan holding
         # the coordinator port)

@@ -93,23 +93,45 @@ def _asi32(frames) -> jax.Array:
     return f
 
 
-def _pair_geometry(start_q6: jax.Array, divisor: int, shift_mul: bool = False):
-    """Shared (prev, cur) angle interpolation setup.
-
-    Returns (prev_q8<<8, angle_inc_q16) for each of the M-1 pairs.
-    ``divisor`` is the number of interpolation steps the Q16 increment is
-    derived from: express uses ``diff<<3`` (32 pts), ultra ``(diff<<3)/3``
-    (96 pts), dense ``(diff<<8)/40``, ultra-dense ``(diff<<8)/64``.
-    """
+def _pair_diff(start_q6: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Shared (prev, cur) start-angle geometry of consecutive capsule
+    frames: returns (base_q16, diff_q8) for each of the M-1 pairs, where
+    ``base_q16`` is the previous frame's start angle in Q16 degrees and
+    ``diff_q8`` the angular span of the pair in Q8 degrees, wrapped to
+    one positive turn.  The per-format Q16 sample increment is derived
+    from ``diff_q8`` by the ``_*_increment`` constructor below matching
+    the wire format — one named fixed-point formula per format, exactly
+    mirroring the four interpolations in the reference's capsule
+    handlers (see each constructor's citation)."""
     cur_q8 = (start_q6[1:] & 0x7FFF) << 2
     prev_q8 = (start_q6[:-1] & 0x7FFF) << 2
     diff_q8 = cur_q8 - prev_q8
     diff_q8 = jnp.where(prev_q8 > cur_q8, diff_q8 + (360 << 8), diff_q8)
-    if shift_mul:
-        inc_q16 = (diff_q8 << 8) // divisor
-    else:
-        inc_q16 = (diff_q8 << 3) // (divisor // 32) if divisor != 32 else diff_q8 << 3
-    return prev_q8 << 8, inc_q16, diff_q8
+    return prev_q8 << 8, diff_q8
+
+
+def _express_increment(diff_q8: jax.Array) -> jax.Array:
+    """Express capsule: 32 samples/pair — diff_q8/32 in Q16 is a pure
+    shift, ``diff_q8 << 3`` (handler_capsules.cpp:206-266)."""
+    return diff_q8 << 3
+
+
+def _ultra_increment(diff_q8: jax.Array) -> jax.Array:
+    """Ultra capsule: 96 samples/pair — ``(diff_q8 << 3) // 3``
+    (handler_capsules.cpp:522-529; equal to (diff_q8 << 8) // 96)."""
+    return (diff_q8 << 3) // 3
+
+
+def _dense_increment(diff_q8: jax.Array) -> jax.Array:
+    """Dense capsule: 40 samples/pair — ``(diff_q8 << 8) // 40``
+    (handler_capsules.cpp:741-760)."""
+    return (diff_q8 << 8) // 40
+
+
+def _ultra_dense_increment(diff_q8: jax.Array) -> jax.Array:
+    """Ultra-dense (DenseBoost) capsule: 64 samples/pair —
+    ``(diff_q8 << 8) // 64`` (handler_capsules.cpp:949-989)."""
+    return (diff_q8 << 8) // 64
 
 
 def _sample_angles(base_q16: jax.Array, inc_q16: jax.Array, npts: int):
@@ -179,7 +201,8 @@ def unpack_capsules(frames) -> DecodedNodes:
     start_q6 = _u16(f, 2)
     new_scan = ((start_q6 & 0x8000) != 0) & frame_valid
 
-    base_q16, inc_q16, _ = _pair_geometry(start_q6, 32)
+    base_q16, diff_q8 = _pair_diff(start_q6)
+    inc_q16 = _express_increment(diff_q8)
     raw = _sample_angles(base_q16, inc_q16, 32)  # (M-1, 32)
 
     # cabin fields from the PREV frame of each pair
@@ -253,11 +276,9 @@ def unpack_ultra_capsules(frames) -> DecodedNodes:
     start_q6 = _u16(f, 2)
     new_scan = ((start_q6 & 0x8000) != 0) & frame_valid
 
-    cur_q8 = (start_q6[1:] & 0x7FFF) << 2
-    prev_q8 = (start_q6[:-1] & 0x7FFF) << 2
-    diff_q8 = jnp.where(prev_q8 > cur_q8, cur_q8 - prev_q8 + (360 << 8), cur_q8 - prev_q8)
-    inc_q16 = (diff_q8 << 3) // 3
-    raw = _sample_angles(prev_q8 << 8, inc_q16, 96)  # (M-1, 96)
+    base_q16, diff_q8 = _pair_diff(start_q6)
+    inc_q16 = _ultra_increment(diff_q8)
+    raw = _sample_angles(base_q16, inc_q16, 96)  # (M-1, 96)
 
     p = f[:-1]
     cab_off = 4 + 4 * jnp.arange(32, dtype=jnp.int32)
@@ -345,7 +366,8 @@ def unpack_dense_capsules(frames, last_sync_out=0, sample_duration_us: int = 476
     start_q6 = _u16(f, 2)
     new_scan = ((start_q6 & 0x8000) != 0) & frame_valid
 
-    base_q16, inc_q16, diff_q8 = _pair_geometry(start_q6, 40, shift_mul=True)
+    base_q16, diff_q8 = _pair_diff(start_q6)
+    inc_q16 = _dense_increment(diff_q8)
     max_diff_q8 = (360 * 100 * 40 // (1000000 // sample_duration_us)) << 8
     jump_ok = diff_q8 <= max_diff_q8
 
@@ -432,7 +454,8 @@ def unpack_ultra_dense_capsules(
     start_q6 = _u16(f, 8)
     new_scan = ((start_q6 & 0x8000) != 0) & frame_valid
 
-    base_q16, inc_q16, diff_q8 = _pair_geometry(start_q6, 64, shift_mul=True)
+    base_q16, diff_q8 = _pair_diff(start_q6)
+    inc_q16 = _ultra_dense_increment(diff_q8)
     max_diff_q8 = (360 * 100 * 32 // (1000000 // sample_duration_us)) << 8
     jump_ok = diff_q8 <= max_diff_q8
     pair_valid = frame_valid[:-1] & frame_valid[1:] & ~new_scan[1:] & jump_ok
