@@ -93,12 +93,19 @@ def run_worker(ticks: int, streams_per_host: int = 1,
         lidars.append(lidar)
 
     def grab_local():
-        return [lidar.grab_scan_host(2.0)[0] for lidar in lidars]
+        # a grab timeout degrades to an idle row (None) — raising here
+        # would abort this process AHEAD of the collective while every
+        # peer blocks inside theirs (submit_local's docstring)
+        grabs = [lidar.grab_scan_host(2.0) for lidar in lidars]
+        return [g[0] if g is not None else None for g in grabs]
 
     for tick in range(ticks):
         outs = svc.submit_local(grab_local())  # collective: all procs tick
-        occ = int(outs[0].voxel.sum())
-        print(f"proc {pid} tick {tick}: voxel occ {occ}", flush=True)
+        label = (
+            f"voxel occ {int(outs[0].voxel.sum())}"
+            if outs[0] is not None else "(idle)"
+        )
+        print(f"proc {pid} tick {tick}: {label}", flush=True)
 
     # pipelined ticks: publish tick N-1 while N computes — the collect
     # touches only this process's shards, so the collective cadence stays
@@ -130,6 +137,8 @@ def main() -> int:
                     "command — topology from JAX_COORDINATOR_ADDRESS / "
                     "JAX_NUM_PROCESSES / JAX_PROCESS_ID)")
     ap.add_argument("--streams-per-host", type=int, default=1)
+    ap.add_argument("--window", type=int, default=4,
+                    help="rolling temporal-median window per stream")
     ap.add_argument("--single-process", action="store_true",
                     help="with --worker: deliberately run a 1-process "
                     "fleet without a coordinator (smoke runs only — a "
@@ -143,8 +152,11 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.worker:
+        # --cpu forces the CPU backend in worker mode too (the hidden
+        # --demo-cpu is how the demo launcher asks for the same thing)
         return run_worker(args.ticks, args.streams_per_host,
-                          demo_cpu=args.demo_cpu,
+                          window=args.window,
+                          demo_cpu=args.demo_cpu or args.cpu,
                           allow_single=args.single_process)
 
     def free_port() -> int:
@@ -168,7 +180,9 @@ def main() -> int:
             )
             procs.append(subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--worker",
-                 "--demo-cpu", "--ticks", str(args.ticks)],
+                 "--demo-cpu", "--ticks", str(args.ticks),
+                 "--streams-per-host", str(args.streams_per_host),
+                 "--window", str(args.window)],
                 cwd=repo, env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             ))

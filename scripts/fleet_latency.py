@@ -3,9 +3,10 @@
 Config 8 measures fleet REPLAY throughput; this measures the PRODUCTION
 fleet tick: N SimulatedDevices stream DenseBoost wire frames, each
 through its own RealLidarDriver (native channel -> batched decode ->
-assembler), and one ``ShardedFilterService.submit_pipelined`` tick per
-revolution period stacks every stream's newest revolution onto the
-(stream, beam) mesh.  The artifact records per-tick submit latency, the
+assembler), and one ``ShardedFilterService.submit_pipelined`` tick
+stacks every stream's newest revolution onto the (stream, beam) mesh —
+event-driven: a tick fires when every stream has a fresh revolution,
+bounded by 1.5 revolution periods for laggard/idle streams.  The artifact records per-tick submit latency, the
 per-publish latency distribution (anchored like config 6: a publish
 event is triggered by the newest revolution's completed measurement and
 carries the previous tick's output — one tick of declared staleness),
@@ -82,7 +83,15 @@ def main() -> int:
 
     n = args.streams
     window = args.window or bench.WINDOW
-    period_s = 0.1 / args.rate_mult  # one tick per revolution period
+    # Tick policy: event-driven — tick as soon as EVERY stream has a
+    # fresh revolution, or when 1.5 revolution periods elapse since the
+    # last tick (laggard/idle-stream bound).  A fixed-phase tick at the
+    # revolution period would add up to a full period of tick-boundary
+    # wait to every publish latency, measuring the pacing loop instead
+    # of the framework; with the all-live trigger the anchor measures
+    # stream alignment skew + dispatch + collect.
+    period_s = 0.1 / args.rate_mult
+    tick_timeout_s = 1.5 * period_s
     params = DriverParams(
         filter_chain=("clip", "median", "voxel"),
         filter_window=window,
@@ -96,6 +105,7 @@ def main() -> int:
     drvs = []
     latest: list = [None] * n  # newest (scan, rev_end) per stream
     lk = threading.Lock()
+    fresh = threading.Condition(lk)
     running = threading.Event()
     running.set()
 
@@ -105,8 +115,10 @@ def main() -> int:
             if got is None:
                 continue
             scan, ts0, duration = got
-            with lk:
+            with fresh:
                 latest[i] = (scan, ts0 + duration)  # newest wins
+                if all(s is not None for s in latest):
+                    fresh.notify()
 
     threads = []
     result = {}
@@ -145,14 +157,15 @@ def main() -> int:
             svc.submit_pipelined([None] * n)
             svc.flush_pipelined()
             t_start = time.monotonic()
-            next_t = t_start + period_s
             t_end = t_start + args.seconds
             while time.monotonic() < t_end:
-                now = time.monotonic()
-                if now < next_t:
-                    time.sleep(next_t - now)
-                next_t += period_s
-                with lk:
+                with fresh:
+                    # all-live trigger with a laggard bound (see tick
+                    # policy above); wake early when every stream is in
+                    fresh.wait_for(
+                        lambda: all(s is not None for s in latest),
+                        timeout=tick_timeout_s,
+                    )
                     scans = []
                     rev_end = []
                     for i in range(n):
@@ -164,6 +177,8 @@ def main() -> int:
                         else:
                             scans.append(None)
                             rev_end.append(None)
+                if all(s is None for s in scans):
+                    continue  # timeout with nothing fresh: streams stalled
                 t0 = time.monotonic()
                 outs = svc.submit_pipelined(scans)
                 t1 = time.monotonic()
@@ -222,6 +237,7 @@ def main() -> int:
                 float(np.percentile(pub_lat_s, 99)) * 1e3, 3
             ) if pub_lat_s else None,
             "staleness_ticks": 1,
+            "tick_policy": "all_live_or_1.5_period",
             "points_per_scan": bench.POINTS,
             "window": window,
             "median_backend": svc.cfg.median_backend,
