@@ -223,6 +223,31 @@ def test_fleet_latency_smoke():
     assert out["device"] == "cpu"
 
 
+def test_fleet_latency_emits_error_artifact_on_wedge():
+    """Same wedge contract as the sibling tools: a blocked device
+    round-trip must emit a structured error artifact and exit 0 — never
+    hang the recapture queue (the tool runs LAST in one scarce rig
+    window)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_RUN_DEADLINE_S="0.001")
+    r = subprocess.run(
+        [sys.executable, "scripts/fleet_latency.py", "--cpu",
+         "--streams", "2", "--seconds", "2", "--rate-mult", "0.3",
+         "--window", "4"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "fleet_live_pipelined_tick"
+    assert "wedged" in out["error"].lower()
+    assert "ticks_completed" in out
+
+
 def test_bench_outage_artifact_is_structured_not_zero():
     """With the probe forced to fail, bench must still emit a nonzero
     CPU-computed artifact flagged device_unavailable, carrying the last

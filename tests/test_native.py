@@ -295,6 +295,29 @@ class TestTransceiver:
         tx.stop()
         t.join(3)
 
+    def test_rx_no_elevate_knob_forces_default_policy(self, monkeypatch):
+        """RPL_RX_NO_ELEVATE=1 (the RR-vs-default A/B knob, read by the
+        rx thread at elevation time) must skip elevation entirely —
+        reported class exactly 0 — and leave streaming intact."""
+        from rplidar_ros2_driver_tpu.native.runtime import (
+            NativeChannel,
+            NativeTransceiver,
+        )
+
+        frames = _frame(0x81, [bytes(5)], is_loop=True)
+        port, t, _ = self._lidar_server(frames, close_after=0.8)
+        ch = NativeChannel("tcp", "127.0.0.1", port=port)
+        tx = NativeTransceiver(ch)
+        monkeypatch.setenv("RPL_RX_NO_ELEVATE", "1")
+        try:
+            assert tx.start()
+            m = tx.wait_message(timeout_ms=2000)
+            assert m is not None
+            assert tx.rx_priority == 0, tx.rx_priority
+        finally:
+            tx.stop()
+            t.join(3)
+
     def test_reset_decoder_between_modes(self):
         from rplidar_ros2_driver_tpu.native.runtime import NativeChannel, NativeTransceiver
 

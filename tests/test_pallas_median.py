@@ -34,6 +34,48 @@ def test_matches_xla_reference(w, b):
     np.testing.assert_array_equal(ref, got)
 
 
+def test_lowering_dispatch_matches_pinned_interpret():
+    """interpret=None resolves per LOWERING platform (lax.platform_dependent,
+    r4 ADVICE): on the CPU test backend the dispatched result must be
+    bit-identical to an explicitly pinned interpret=True call, both
+    eagerly and under an outer jit, for all three entry points."""
+    import jax
+
+    from rplidar_ros2_driver_tpu.ops.pallas_kernels import (
+        sliding_median_pallas,
+        sorted_replace_pallas,
+    )
+
+    rng = np.random.default_rng(42)
+    win = rand_window(rng, 8, 130)
+
+    auto = np.asarray(temporal_median_pallas(jnp.asarray(win)))
+    pinned = np.asarray(temporal_median_pallas(jnp.asarray(win), interpret=True))
+    np.testing.assert_array_equal(auto, pinned)
+    jitted = np.asarray(
+        jax.jit(lambda x: temporal_median_pallas(x))(jnp.asarray(win))
+    )
+    np.testing.assert_array_equal(jitted, pinned)
+
+    ext = rand_window(rng, 8 + 16, 130)
+    np.testing.assert_array_equal(
+        np.asarray(sliding_median_pallas(jnp.asarray(ext), 8)),
+        np.asarray(sliding_median_pallas(jnp.asarray(ext), 8, interpret=True)),
+    )
+
+    s = np.sort(rand_window(rng, 8, 130, inf_frac=0.2), axis=0)
+    old = s[3].copy()
+    new = rng.uniform(0.1, 40.0, 130).astype(np.float32)
+    out_a, med_a = sorted_replace_pallas(
+        jnp.asarray(s), jnp.asarray(old), jnp.asarray(new)
+    )
+    out_p, med_p = sorted_replace_pallas(
+        jnp.asarray(s), jnp.asarray(old), jnp.asarray(new), interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_p))
+    np.testing.assert_array_equal(np.asarray(med_a), np.asarray(med_p))
+
+
 def test_all_finite_window_is_exact_lower_median():
     rng = np.random.default_rng(7)
     win = rand_window(rng, 8, 64, inf_frac=0.0)
