@@ -1067,8 +1067,25 @@ def _fallback_artifact(config: int, probe_error: str) -> dict:
     record that EXPLICITLY — plus the CPU-computable number for this
     config and the last committed on-device headline with its date —
     instead of a 0.0 that reads as a framework regression."""
+    from rplidar_ros2_driver_tpu.filters.chain import resolve_median_backend
+    from rplidar_ros2_driver_tpu.ops.filters import pin_inc_lowering
+
     jax.config.update("jax_platforms", "cpu")
-    result = main(config, "xla")  # pallas would run in interpret mode
+
+    # measure what the framework actually RUNS on a CPU host: the same
+    # evidence-gated auto resolution production uses (inc on CPU, 3.8x
+    # over the sort — docs/BENCHMARKS.md decision table), resolved PER
+    # CONFIG's window (the resolver is window-aware) and pinned to its
+    # lowering so the artifact records exactly what was measured (the
+    # same arm-pinning rule as the config-5 A/B).  Hard-pinning xla
+    # here understated the CPU reference ~3x.
+    def cpu_median_for(c: int) -> str:
+        window = GRADED[c][2].get("window")
+        return pin_inc_lowering(
+            resolve_median_backend("auto", "cpu", window=window), "cpu"
+        )
+
+    result = main(config, cpu_median_for(config))
     result["device_unavailable"] = True
     result["probe_error"] = probe_error
     if config == 5:
@@ -1078,7 +1095,7 @@ def _fallback_artifact(config: int, probe_error: str) -> dict:
         refs = {}
         for c in (1, 2, 3, 4):
             try:
-                refs[metric_name(c)] = main(c, "xla")["value"]
+                refs[metric_name(c)] = main(c, cpu_median_for(c))["value"]
             except Exception as e:  # noqa: BLE001 - partial refs still help
                 refs[metric_name(c)] = f"failed: {type(e).__name__}"
         result["cpu_reference_points"] = refs
