@@ -1,0 +1,187 @@
+"""Turn a rig-recapture artifact into `auto`-mapping recommendations.
+
+The standing decision procedure (docs/BENCHMARKS.md) as code: every
+`auto` backend default resolves from committed on-chip measurement
+artifacts, one bar for all of them.  This tool reads a
+`scripts/rig_recapture.sh` JSONL artifact (or any file of one-JSON-
+object-per-line measurement records), extracts the decision keys, and
+prints the current-vs-recommended table for each mapping — so a link
+window converts into resolver flips by reading ONE report instead of
+grepping artifacts.
+
+    python scripts/decide_backends.py artifacts/rig_recapture_X.jsonl ...
+
+Only TPU-device records carry decision weight (CPU fallbacks and smoke
+runs are reported but never recommend a TPU flip).  Prints a human
+table to stderr and ONE machine-readable JSON line to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+
+# the noise bar: a flip needs >5% on the decision key (the config-5
+# round spread on a healthy rig is ~1.4%; 5% clears weather without
+# hiding a real win)
+MARGIN = 1.05
+
+
+def _records(paths: list[str]):
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    yield rec
+
+
+_DECISION_KEYS = ("median_ab", "deep_window_ab", "derived")
+
+
+def _strength(value: float) -> float:
+    """Evidence strength of a speedup ratio: |log ratio|, symmetric in
+    wins and losses (abs(v-1) would rank a 1.25x win above a 1.30x
+    slowdown).  Non-positive ratios are malformed: strength 0 so they
+    can never displace real evidence."""
+    return abs(math.log(value)) if value > 0 else 0.0
+
+
+def analyze(records: list[dict]) -> dict:
+    """Decision keys -> recommendations.  Pure (testable on synthetic
+    records); the CLI wraps it.  Multi-record merges keep the STRONGEST
+    evidence per mapping (largest |log ratio|) — last-wins would let a
+    degraded-link record mask a healthy one."""
+    out: dict = {"recommendations": {}, "evidence": {}, "non_tpu_ignored": []}
+
+    def recommend(mapping: str, entry: dict) -> None:
+        prev = out["recommendations"].get(mapping)
+        if prev is None or _strength(entry["value"]) > _strength(prev["value"]):
+            out["recommendations"][mapping] = entry
+
+    def ratio_entry(current: str, proposed: str, key: str,
+                    value: float, source: str) -> dict:
+        return {
+            "current": current,
+            "recommended": proposed if value > MARGIN else current,
+            "flip": value > MARGIN,
+            "key": key,
+            "value": value,
+            "margin": MARGIN,
+            "source": source,
+        }
+
+    for rec in records:
+        if not any(k in rec for k in _DECISION_KEYS):
+            continue
+        dev = rec.get("device")
+        if dev != "tpu":
+            # reported once per record, never used for a TPU flip —
+            # including device-less records (malformed, but visible)
+            out["non_tpu_ignored"].append(
+                f"{rec.get('metric') or next(iter(rec), '?')}: device={dev!r}"
+            )
+            continue
+
+        # config 5 headline: the always-on median A/B
+        ab = rec.get("median_ab")
+        if isinstance(ab, dict):
+            v = ab.get("inc_pallas_vs_headline_speedup")
+            if isinstance(v, (int, float)):
+                recommend("median_backend.tpu", ratio_entry(
+                    "pallas", "inc",
+                    "config5 inc_pallas_vs_headline_speedup",
+                    float(v), "median_ab",
+                ))
+            out["evidence"].setdefault("config5_median_ab", []).append({
+                k: ab[k] for k in (
+                    "speedup", "inc_vs_headline_speedup",
+                    "inc_pallas_vs_headline_speedup",
+                    "inc_pallas_vs_inc_xla_speedup", "barrier_rtt_ms",
+                ) if k in ab
+            })
+
+        # deep-window A/B: the window-aware crossover
+        dw = rec.get("deep_window_ab")
+        if isinstance(dw, dict):
+            crossings = {}
+            for w, row in sorted(dw.items(), key=lambda kv: int(kv[0])):
+                if isinstance(row, dict):
+                    v = row.get("inc_vs_best_sort_speedup")
+                    if isinstance(v, (int, float)):
+                        crossings[int(w)] = float(v)
+            out["evidence"].setdefault(
+                "deep_window_inc_vs_best_sort", []
+            ).append({str(w): v for w, v in crossings.items()})
+            # the threshold must be UPWARD-CLOSED: every window at or
+            # above it clears the bar (one just-over-margin shallow
+            # window must not flip the whole depth range)
+            thr = None
+            for w in sorted(crossings, reverse=True):
+                if crossings[w] > MARGIN:
+                    thr = w
+                else:
+                    break
+            if thr is not None:
+                recommend("median_backend.tpu.window_threshold", {
+                    "current": "pallas at every depth",
+                    "recommended": f"inc for window >= {thr} (pallas below)",
+                    "flip": True,
+                    "key": "deep_window inc_vs_best_sort_speedup",
+                    "value": crossings[thr],
+                    "margin": MARGIN,
+                    "source": "deep_window_ab",
+                })
+
+        # ablation: resample + voxel kernels
+        derived = rec.get("derived")
+        if isinstance(derived, dict):
+            v = derived.get("matmul_vs_scatter_voxel_speedup")
+            if isinstance(v, (int, float)):
+                recommend("voxel_backend.tpu", ratio_entry(
+                    "scatter", "matmul",
+                    "matmul_vs_scatter_voxel_speedup", float(v), "ablation",
+                ))
+            v = derived.get("dense_vs_scatter_speedup")
+            if isinstance(v, (int, float)):
+                recommend("resample_backend.tpu", ratio_entry(
+                    "scatter", "dense",
+                    "dense_vs_scatter_speedup", float(v), "ablation",
+                ))
+            out["evidence"].setdefault("ablation_derived", []).append(derived)
+
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("artifacts", nargs="+",
+                    help="rig_recapture JSONL (or single-record JSON) files")
+    args = ap.parse_args()
+
+    result = analyze(list(_records(args.artifacts)))
+    recs = result["recommendations"]
+    if not recs:
+        print("no TPU decision keys found in the given artifacts",
+              file=sys.stderr)
+    for name, r in recs.items():
+        arrow = "FLIP ->" if r["flip"] else "keep"
+        print(
+            f"{name:40s} {r['current']:>10s} {arrow} {r['recommended']:<10s}"
+            f" ({r['key']} = {r['value']:.3f}, bar {r['margin']})",
+            file=sys.stderr,
+        )
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -196,6 +196,99 @@ def test_step_ablation_smoke():
     assert "inc_pallas_vs_inc_xla_speedup" in out["derived"]
 
 
+def test_decide_backends_analyze():
+    """The standing decision procedure as code: TPU records move the
+    recommendations past the 5% bar, CPU records never do, and the
+    window-aware crossover surfaces as a threshold."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        # remove by value: imports can insert their own sys.path entries,
+        # so pop(0) could evict the wrong one and leak scripts/ forever
+        sys.path.remove(scripts_dir)
+
+    records = [
+        {  # config 5 on TPU: inc_pallas decisively beats the headline
+            "metric": "denseboost64_filter_chain_scans_per_sec",
+            "device": "tpu",
+            "median_ab": {
+                "speedup": 2.1,
+                "inc_vs_headline_speedup": 0.3,
+                "inc_pallas_vs_headline_speedup": 1.4,
+                "inc_pallas_vs_inc_xla_speedup": 4.6,
+                "barrier_rtt_ms": 1.2,
+            },
+        },
+        {  # deep windows: crossover at 512
+            "device": "tpu",
+            "deep_window_ab": {
+                "256": {"inc_vs_best_sort_speedup": 0.95},
+                "512": {"inc_vs_best_sort_speedup": 1.31},
+            },
+        },
+        {  # ablation: voxel matmul wins, dense resample is a tie
+            "device": "tpu",
+            "derived": {
+                "matmul_vs_scatter_voxel_speedup": 1.22,
+                "dense_vs_scatter_speedup": 1.001,
+            },
+        },
+        {  # a CPU fallback must carry NO decision weight
+            "device": "cpu",
+            "derived": {"matmul_vs_scatter_voxel_speedup": 0.8},
+            "median_ab": {"inc_pallas_vs_headline_speedup": 9.0},
+        },
+        {  # a device-less record must be visibly reported, not dropped
+            "derived": {"matmul_vs_scatter_voxel_speedup": 7.0},
+        },
+    ]
+    out = db.analyze(records)
+    recs = out["recommendations"]
+    assert recs["median_backend.tpu"]["flip"] is True
+    assert recs["median_backend.tpu"]["recommended"] == "inc"
+    assert recs["median_backend.tpu"]["value"] == 1.4  # not the cpu 9.0
+    thr = recs["median_backend.tpu.window_threshold"]
+    assert "window >= 512" in thr["recommended"]
+    assert recs["voxel_backend.tpu"]["flip"] is True
+    assert recs["voxel_backend.tpu"]["recommended"] == "matmul"
+    assert recs["voxel_backend.tpu"]["value"] == 1.22  # not cpu 0.8/None 7.0
+    assert recs["resample_backend.tpu"]["flip"] is False
+    assert recs["resample_backend.tpu"]["recommended"] == "scatter"
+    assert len(out["non_tpu_ignored"]) == 2  # cpu + device-less, once each
+
+    # the threshold must be an upward-closed suffix: one just-over-bar
+    # shallow window with deeper windows below the bar flips nothing
+    noisy = db.analyze([{
+        "device": "tpu",
+        "deep_window_ab": {
+            "256": {"inc_vs_best_sort_speedup": 1.06},
+            "512": {"inc_vs_best_sort_speedup": 0.92},
+            "1024": {"inc_vs_best_sort_speedup": 1.2},
+        },
+    }])
+    thr = noisy["recommendations"]["median_backend.tpu.window_threshold"]
+    assert "window >= 1024" in thr["recommended"]
+
+    # strongest-evidence merge is symmetric in log space: a 1.30x
+    # slowdown outweighs a later 1.25x win for the same mapping
+    merged = db.analyze([
+        {"device": "tpu", "derived": {"matmul_vs_scatter_voxel_speedup": 0.77}},
+        {"device": "tpu", "derived": {"matmul_vs_scatter_voxel_speedup": 1.25}},
+    ])
+    assert merged["recommendations"]["voxel_backend.tpu"]["value"] == 0.77
+    assert merged["recommendations"]["voxel_backend.tpu"]["flip"] is False
+
+
 def test_fleet_latency_smoke():
     """The live fleet-tick tool (N sim devices -> real drivers -> one
     sharded pipelined tick per revolution period) must keep running end
