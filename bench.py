@@ -1241,7 +1241,16 @@ if __name__ == "__main__":
         run_with_deadline,
     )
 
-    _run_deadline_s = float(os.environ.get("BENCH_RUN_DEADLINE_S", 1800))
+    # default deadline: the guard catches WEDGES (a blocked fetch hangs
+    # tens of minutes with zero progress), not healthy-but-slow
+    # measurement.  Config 5's FOUR-arm A/B worst-cases near 30 min on a
+    # sick link (4 compiles at 20-40 s + RTT-adaptive sizing probes + 5
+    # interleaved rounds <= 15 s per arm), so its default gets headroom —
+    # a deadline that can expire on a healthy run would eat the round's
+    # headline exactly when the link finally works.
+    _run_deadline_s = float(os.environ.get(
+        "BENCH_RUN_DEADLINE_S", 2700 if args.config == 5 else 1800
+    ))
 
     def _measured_run():
         if args.profile:
