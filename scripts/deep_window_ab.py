@@ -90,8 +90,12 @@ def main() -> int:
     # happened: W=256 completed, W=512 wedged, nothing was emitted).
     # One budget per window; a wedge poisons this process's backend, so
     # later windows are marked skipped rather than re-attempted.
+    # per-window budget sized for the FOUR default arms (compile 20-40 s
+    # each — slower at deep windows — plus RTT-adaptive sizing probes
+    # plus 5 interleaved rounds <= 15 s per arm): the guard catches
+    # wedges, and must not expire on a healthy-but-slow W=512 window
     window_deadline_s = float(
-        os.environ.get("BENCH_WINDOW_DEADLINE_S", 900)
+        os.environ.get("BENCH_WINDOW_DEADLINE_S", 1200)
     )
     wedged = None
     for window in args.windows:
