@@ -214,6 +214,39 @@ def main() -> int:
                 f"fleet produced no output (ticks={ticks}, "
                 f"published={published}) — sim streams broken?"
             )
+        # quiesce the fleet BEFORE the link calibration: on a 1-core
+        # host the still-running pumps would inflate the probe with
+        # scheduler wait, overstating the very number readers subtract.
+        # The finally block then runs over emptied lists (no-op).
+        running.clear()
+        for t in threads:
+            t.join(timeout=2.0)
+        threads.clear()
+        for drv in drvs:
+            try:
+                drv.stop_motor()
+                drv.disconnect()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        drvs.clear()
+        for sim in sims:
+            sim.stop()
+        sims.clear()
+        # link calibration, same convention as every other artifact: the
+        # tick/publish latencies include device round-trips, and the
+        # link's RTT is rig weather a reader must be able to subtract.
+        # Deadline-bounded and optional: a link that wedges AFTER the
+        # measured span must not cost the artifact (step_ablation's
+        # convention).
+        rtt_ms = None
+        try:
+            rtt_ms = run_with_deadline(
+                lambda: bench._barrier_rtt_ms(jax.devices()[0]),
+                60.0, what="RTT calibration probe",
+            )
+        except Exception:  # noqa: BLE001 - calibration is context, not data
+            print("RTT calibration probe failed; artifact goes out "
+                  "without it", file=sys.stderr, flush=True)
         elapsed = args.seconds
         pace = 10.0 * args.rate_mult  # scans/s per stream at device pace
         result = {
@@ -238,6 +271,8 @@ def main() -> int:
             ) if pub_lat_s else None,
             "staleness_ticks": 1,
             "tick_policy": "all_live_or_1.5_period",
+            **({"barrier_rtt_ms": round(rtt_ms, 3)}
+               if rtt_ms is not None else {}),
             "points_per_scan": bench.POINTS,
             "window": window,
             "median_backend": svc.cfg.median_backend,
