@@ -192,6 +192,7 @@ GRADED = {
     6: ("e2e", POINTS, dict(window=WINDOW)),    # sim device -> decode -> chain
     7: ("fused", POINTS, dict(window=WINDOW)),  # offline fused multi-scan replay
     8: ("fleet", POINTS, dict(window=WINDOW)),  # N-stream fused replay on the mesh
+    9: ("ingest", POINTS, dict(window=WINDOW)),  # host vs fused ingest A/B
 }
 
 
@@ -692,6 +693,225 @@ def bench_passthrough(points: int) -> dict:
     }
 
 
+def _denseboost_wire_frames(revs: int, points_per_rev: int) -> list[bytes]:
+    """Pre-encoded DenseBoost (dense capsule, 40 samples/frame) wire
+    stream covering ``revs`` full revolutions — the raw bytes both ingest
+    backends consume.  Encoding is host-side setup, outside every timed
+    region."""
+    from rplidar_ros2_driver_tpu.ops import wire
+
+    frames = []
+    total = revs * points_per_rev
+    idx = 0
+    first = True
+    while idx < total:
+        theta = 360.0 * (idx % points_per_rev) / points_per_rev
+        pts = (np.arange(40) + idx) % points_per_rev
+        dists_mm = 2000.0 + 500.0 * np.sin(2 * np.pi * pts / points_per_rev)
+        frames.append(
+            wire.encode_dense_capsule(
+                int(theta * 64) & 0x7FFF, first, dists_mm.astype(int)
+            )
+        )
+        idx += 40
+        first = False
+    return frames
+
+
+def bench_ingest(smoke: bool = False) -> dict:
+    """Config 9 — the ingest-backend A/B: identical raw DenseBoost wire
+    frames, bytes -> filter output, through BOTH seams:
+
+      * host  — BatchScanDecoder (CPU-pinned unpack) -> ScanAssembler
+        (Python revolution split) -> ScanFilterChain.process_raw (packed
+        upload + counted step + wire fetch): the golden path, two device
+        round-trips per frame run.
+      * fused — FusedIngest: ONE staged upload + ONE fused dispatch per
+        frame run (ops/ingest.fused_ingest_step: unpack + segmented
+        revolution scatter + donated filter step in a single program),
+        ONE flat wire fetch per dispatched batch.
+
+    Reports bytes->output revolutions/s and per-run p99 for both arms,
+    plus the **ingest-overhead decomposition**: a calibration pass times
+    the shared chain step (``chain.process_raw`` over the pre-assembled
+    revolutions — identical bit-exact compute on both paths, the CPU
+    backend's dominant cost at the DenseBoost-64 geometry) and subtracts
+    it, leaving each arm's ingest overhead per revolution — the
+    decode/assembly/round-trip cost the fused path exists to kill.  On a
+    TPU device the step is ~30 µs (LAST_GOOD_DEVICE.json), so the e2e
+    speedup there approaches the overhead speedup reported here; on the
+    CPU backend the multi-ms step compresses the e2e ratio toward 1.
+    Arms are interleaved (two passes each, best-of) so the box's load
+    drift cancels instead of biasing one arm.
+
+    ``smoke`` shrinks geometry to a seconds-scale CPU run (the tier-1
+    regression gate, tests/test_fused_ingest.py) — same code path, same
+    metric name, ``"smoke": true`` in the artifact.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+    from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+    from rplidar_ros2_driver_tpu.driver.ingest import FusedIngest
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+    if smoke:
+        window, beams, grid = 8, 512, 64
+        points_per_rev, revs, capacity = 800, 10, 1024
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 40, CAPACITY
+    run = 32  # frames per pump delivery (engine caps runs at 64)
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    params = DriverParams(
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=window,
+        voxel_grid_size=grid,
+        voxel_cell_m=0.25,
+    )
+    frames = _denseboost_wire_frames(revs, points_per_rev)
+    # synthetic rx stamps at the 800 frames/s device pace; throughput is
+    # paced by the harness, not these (they only feed back-dating math)
+    batches = []
+    t = 1000.0
+    for i in range(0, len(frames), run):
+        batch = []
+        for f in frames[i : i + run]:
+            t += 1.25e-3
+            batch.append((f, t))
+        batches.append(batch)
+
+    def run_host() -> tuple[int, float, list[float], list[dict]]:
+        completed: list[dict] = []
+        asm = ScanAssembler(on_complete=lambda s: completed.append(dict(s)))
+        dec = BatchScanDecoder(asm)
+        chain = ScanFilterChain(params, beams=beams, capacity=capacity)
+        dec.precompile(ans)
+        # warm the chain step program outside the timed loop
+        z = np.zeros(0, np.int32)
+        np.asarray(chain.process_raw(z, z, z, z).ranges)
+        chain.reset()
+        outs = 0
+        done = 0
+        lat: list[float] = []
+        t0 = time.perf_counter()
+        for batch in batches:
+            tb = time.perf_counter()
+            dec.on_measurement_batch(ans, list(batch))
+            while done < len(completed):
+                s = completed[done]
+                done += 1
+                out = chain.process_raw(
+                    s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+                )
+                np.asarray(out.ranges)  # already host-side; keep it honest
+                outs += 1
+            lat.append(time.perf_counter() - tb)
+        dt = time.perf_counter() - t0
+        return outs, dt, lat, completed
+
+    def run_fused() -> tuple[int, float, list[float]]:
+        fused = FusedIngest(
+            params, beams=beams, capacity=capacity, max_revs=2,
+            buckets=(run,),
+        )
+        fused.precompile(ans)  # compile outside the timed loop
+        outs = 0
+        lat: list[float] = []
+        t0 = time.perf_counter()
+        for batch in batches:
+            tb = time.perf_counter()
+            fused.on_measurement_batch(ans, list(batch))
+            # pipelined collect: parse predecessors (whose results landed
+            # during earlier dispatch gaps) while the just-dispatched
+            # batch computes — the fused path's structural advantage, the
+            # synchronous host path cannot overlap these
+            outs += len(fused.collect_pipelined())
+            lat.append(time.perf_counter() - tb)
+        outs += len(fused.flush())
+        dt = time.perf_counter() - t0
+        return outs, dt, lat
+
+    def calibrate_step(completed: list[dict]) -> float:
+        """Median ms of the shared chain step over the SAME revolutions,
+        on a fresh chain, pre-assembled so no ingest cost leaks in: the
+        reference definition of the compute both ingest backends must
+        perform bit-exactly per revolution."""
+        chain = ScanFilterChain(params, beams=beams, capacity=capacity)
+        z = np.zeros(0, np.int32)
+        np.asarray(chain.process_raw(z, z, z, z).ranges)
+        chain.reset()
+        ts = []
+        for s in completed:
+            t0 = time.perf_counter()
+            out = chain.process_raw(
+                s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+            )
+            np.asarray(out.ranges)
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(ts, 50)) * 1e3 if ts else 0.0
+
+    # interleave the arms (host, calibration, fused) x2 and keep each
+    # arm's best pass and the MIN step calibration: this box's load
+    # drifts by 2x across seconds — alternation keeps the drift from
+    # biasing one arm, and a calibration taken in its own later window
+    # could exceed the timed arms' whole budget, clamping the overhead
+    # subtraction to zero (or inflating its ratio) purely from weather
+    host_best = fused_best = None
+    step_ms = float("inf")
+    for _ in range(2):
+        h = run_host()
+        if host_best is None or h[1] < host_best[1]:
+            host_best = h
+        step_ms = min(step_ms, calibrate_step(h[3]))
+        f = run_fused()
+        if fused_best is None or f[1] < fused_best[1]:
+            fused_best = f
+    host_revs, host_dt, host_lat, _ = host_best
+    fused_revs, fused_dt, fused_lat = fused_best
+    host_sps = host_revs / host_dt
+    fused_sps = fused_revs / fused_dt
+    host_oh = max(host_dt * 1e3 - host_revs * step_ms, 0.0) / max(host_revs, 1)
+    fused_oh = max(fused_dt * 1e3 - fused_revs * step_ms, 0.0) / max(
+        fused_revs, 1
+    )
+    # floor at 50 us/rev before the ratio: a clamped-to-zero arm must
+    # read as "no measurable overhead", not divide toward infinity
+    _EPS_OH = 0.05
+    oh_speedup = max(host_oh, _EPS_OH) / max(fused_oh, _EPS_OH)
+    return {
+        "metric": metric_name(9),
+        "value": round(fused_sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(fused_sps / BASELINE_SCANS_PER_SEC, 3),
+        "host_scans_per_sec": round(host_sps, 2),
+        "fused_vs_host_speedup": round(fused_sps / host_sps, 3)
+        if host_sps > 0 else None,
+        # the ingest-overhead decomposition (see docstring): per-rev cost
+        # beyond the shared calibrated chain step — the round-trip the
+        # fused path kills.  On TPU (step ~30 us) e2e approaches this.
+        "chain_step_ms_per_rev": round(step_ms, 3),
+        "host_ingest_overhead_ms_per_rev": round(host_oh, 3),
+        "fused_ingest_overhead_ms_per_rev": round(fused_oh, 3),
+        "ingest_overhead_speedup": round(oh_speedup, 3),
+        "overhead_clamped": host_oh <= _EPS_OH or fused_oh <= _EPS_OH,
+        "fused_run_p99_ms": round(float(np.percentile(fused_lat, 99)) * 1e3, 3),
+        "host_run_p99_ms": round(float(np.percentile(host_lat, 99)) * 1e3, 3),
+        "fused_run_p50_ms": round(float(np.percentile(fused_lat, 50)) * 1e3, 3),
+        "host_run_p50_ms": round(float(np.percentile(host_lat, 50)) * 1e3, 3),
+        "host_revolutions": host_revs,
+        "fused_revolutions": fused_revs,
+        "frames": len(frames),
+        "frames_per_run": run,
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def _run_chain(cfg: FilterConfig, points: int) -> tuple[float, float]:
     """Sustained scans/s + sync p99 (ms) for one FilterConfig."""
     runner = _ChainRunner(cfg, points)
@@ -807,6 +1027,7 @@ def metric_name(config: int) -> str:
         6: "e2e_decode_chain_scans_per_sec",
         7: "fused_replay_scans_per_sec",
         8: "fleet_fused_replay_scans_per_sec",
+        9: "fused_ingest_bytes_to_output_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -816,6 +1037,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
     kind, points, over = GRADED[config]
     if kind == "passthrough":
         return bench_passthrough(points)
+    if kind == "ingest":
+        return bench_ingest()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -1122,7 +1345,15 @@ if __name__ == "__main__":
         choices=sorted(GRADED),
         help="graded BASELINE config (1=A1M8 passthrough .. 5=64-scan voxel "
         "headline (default), 6=e2e with wire decode, 7=fused offline replay, "
-        "8=fleet replay on the mesh, 4 streams per stream-shard)",
+        "8=fleet replay on the mesh, 4 streams per stream-shard, "
+        "9=host-vs-fused ingest A/B, bytes to filter output)",
+    )
+    ap.add_argument(
+        "--smoke-ingest",
+        action="store_true",
+        help="seconds-scale CPU run of the config-9 ingest A/B (small "
+        "geometry, forced CPU backend, no tunnel probe) — the tier-1 "
+        "regression gate for the fused ingest path",
     )
     ap.add_argument(
         "--median",
@@ -1139,6 +1370,14 @@ if __name__ == "__main__":
         "into DIR (TensorBoard / Perfetto viewable)",
     )
     args = ap.parse_args()
+
+    if args.smoke_ingest:
+        # CPU-only smoke: win the platform-override race BEFORE any
+        # backend initializes (same move as tests/conftest.py) and skip
+        # the tunnel probe entirely — this gate must run anywhere
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_ingest(smoke=True)))
+        raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead
     # remote-attach tunnel makes jax.devices() block forever, and a

@@ -105,6 +105,18 @@ class DriverParams:
     # resolved per platform from the step-ablation evidence
     # (resolve_voxel_backend in filters/chain.py)
     voxel_backend: str = "auto"
+    # ingest backend seam: "host" = the golden path (CPU-pinned batch
+    # decode -> Python revolution assembly -> packed per-revolution
+    # upload into the chain); "fused" = device-resident single-dispatch
+    # ingest (raw frame bytes staged once, unpack + revolution
+    # segmentation + the donated filter step in ONE compiled program —
+    # ops/ingest.py / driver/ingest.FusedIngest; bit-exact vs host,
+    # tests/test_fused_ingest.py).  "auto" resolves per the standing
+    # decision procedure (filters/chain.resolve_ingest_backend —
+    # currently host).  Fused requires the filter chain and a wire-
+    # streaming driver (real/sim); it drops the RawNodeHolder interval
+    # tap and the chain checkpoint surface.
+    ingest_backend: str = "host"
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -157,6 +169,16 @@ class DriverParams:
             )
         if self.collect_timeout_s is not None and self.collect_timeout_s < 0:
             raise ValueError("collect_timeout_s must be >= 0 (or None)")
+        if self.ingest_backend not in ("auto", "host", "fused"):
+            raise ValueError(
+                "ingest_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.ingest_backend == "fused" and not self.filter_chain:
+            raise ValueError(
+                "ingest_backend='fused' requires filter_chain stages (the "
+                "fused program ends in the filter step; raw passthrough "
+                "has no device-side consumer)"
+            )
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

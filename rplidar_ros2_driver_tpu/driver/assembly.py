@@ -29,8 +29,14 @@ from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
 class ScanAssembler:
     """Accumulates flat node arrays, emits complete revolutions."""
 
-    def __init__(self, max_nodes: int = MAX_SCAN_NODES) -> None:
+    def __init__(self, max_nodes: int = MAX_SCAN_NODES, on_complete=None) -> None:
         self._max_nodes = max_nodes
+        # observer invoked (under the producer's push, lock held) with
+        # each closed revolution's scan dict the moment it completes —
+        # BEFORE newest-wins replacement can drop it.  The fused-ingest
+        # parity suite uses it as the lossless golden tap; the consumer
+        # contract (wait_and_grab*) is unchanged.
+        self._on_complete = on_complete
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._pending: Optional[dict] = None      # newest complete scan
@@ -148,6 +154,8 @@ class ScanAssembler:
         self._partial = []
         self._partial_ts_chunks = []
         self._partial_len = 0
+        if self._on_complete is not None:
+            self._on_complete(self._pending)
 
     # -- consumer side -----------------------------------------------------
 

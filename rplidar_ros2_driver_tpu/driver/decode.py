@@ -27,6 +27,13 @@ Per-node timestamps follow the reference's per-sample delay model exactly
 (protocol/timing.py): each frame is anchored at its own rx time and each
 sample back-dated by ``delay(idx)`` — exact through RPM transients, unlike
 a per-frame stamp (the round-1 design this replaces).
+
+Ingest seam: this engine (plus driver/assembly.ScanAssembler and the
+chain's packed upload) is the HOST ingest backend — and the golden
+reference the fused device-resident backend (ops/ingest.py +
+driver/ingest.FusedIngest, ``ingest_backend=fused``) is parity-tested
+against: same kernels, same carries, same revolution semantics, but one
+compiled program from bytes to filter output with no host round-trip.
 """
 
 from __future__ import annotations

@@ -1,0 +1,351 @@
+"""FusedIngest — the device-resident ingest engine (``ingest_backend=fused``).
+
+Drop-in producer twin of driver/decode.BatchScanDecoder (same
+``on_measurement_batch`` interface, so protocol/engine.py's pump feeds it
+unchanged) that replaces the decode -> host-assembly -> re-pack ->
+``device_put`` round-trip with ONE staged upload and ONE fused dispatch
+per frame run (ops/ingest.fused_ingest_step): unpack, revolution
+segmentation and the donated filter step all execute in a single compiled
+program on the filter device.  The consumer side replaces
+ScanAssembler.wait_and_grab_host + ScanFilterChain.process_raw with
+:meth:`wait_and_grab_outputs`, which collects a previously dispatched
+batch's single-fetch wire (its device->host copy started at dispatch
+time — the same pipelined-collect discipline as
+filters/chain.process_raw_pipelined) and returns the completed
+revolutions' FilterOutputs with their back-dated timestamps.
+
+The host path (decoder + assembler + chain) stays the golden reference;
+bit-exact parity between the two backends is pinned by
+tests/test_fused_ingest.py.
+
+What the fused backend does NOT do:
+  * feed a RawNodeHolder (interval grabs need host-side nodes — use the
+    host backend for ``grab_scan_data_with_interval`` consumers);
+  * expose the chain's snapshot/restore surface (the FilterState lives
+    inside the fused program's donated state; checkpointing the fused
+    path is future work, see ROADMAP).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
+from rplidar_ros2_driver_tpu.protocol import crc as crcmod
+from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+from rplidar_ros2_driver_tpu.protocol.constants import ANS_PAYLOAD_BYTES, Ans
+
+log = logging.getLogger("rplidar_tpu.ingest")
+
+# frame-run bucket sizes (padded up, like driver/decode._BUCKETS — fewer
+# buckets here: every extra bucket is one more compile of the big fused
+# program).  The engine caps runs at 64 (protocol/engine.py).
+_FUSED_BUCKETS = (4, 64)
+
+
+class FusedIngest:
+    """Producer/consumer engine around ops/ingest.fused_ingest_step."""
+
+    def __init__(
+        self,
+        params,
+        beams: Optional[int] = None,
+        *,
+        capacity: Optional[int] = None,
+        max_revs: int = 2,
+        max_queue: int = 32,
+        emit_nodes: bool = False,
+        buckets: tuple = _FUSED_BUCKETS,
+        slot_impl: str = "auto",
+    ) -> None:
+        import jax
+
+        from rplidar_ros2_driver_tpu.filters.chain import (
+            DEFAULT_BEAMS,
+            config_from_params,
+            pick_device,
+        )
+
+        self.device = pick_device(params.filter_backend)
+        self.cfg = config_from_params(
+            params, beams or DEFAULT_BEAMS, platform=self.device.platform
+        )
+        self.max_nodes = capacity or MAX_SCAN_NODES
+        self.max_revs = max_revs
+        self.emit_nodes = emit_nodes
+        # per-revolution slot lowering ("auto" | "cond" | "fori") —
+        # bit-exact either way, see ops/ingest._slot_impl_for
+        self.slot_impl = slot_impl
+        self._buckets = tuple(sorted(buckets))
+        self._jax = jax
+        # producer-facing decoder interface (driver/real.py wires these)
+        self.timing = timingmod.TimingDesc()
+        self.recorder = None
+        # streaming state
+        self._active_ans: Optional[int] = None
+        self._icfg = None
+        self._state = None
+        self._filter_state = None  # survives answer-type switches
+        self._lock = threading.Lock()
+        # timestamp base of the most recent dispatch (f64, host-side):
+        # every batch ships offsets from ITS OWN first rx stamp plus the
+        # base delta that re-bases the carried partial, so the f32
+        # on-device offsets stay bounded by one revolution's span no
+        # matter how long the session runs (a single session epoch
+        # drifts to ~ms f32 ulp after hours of streaming)
+        self._base: Optional[float] = None
+        # pipelined collect seam: dispatched-but-unfetched wires
+        self._pending: deque = deque()
+        self._max_queue = max_queue
+        self._event = threading.Event()
+        # statistics (host path parity: decode.py counters + assembler's)
+        self.frames_decoded = 0
+        self.nodes_decoded = 0
+        self.scans_completed = 0
+        self.revs_dropped = 0
+        self.wires_dropped = 0
+
+    # -- stream state ------------------------------------------------------
+
+    def _fresh_filter_state(self):
+        from rplidar_ros2_driver_tpu.ops.filters import FilterState
+
+        return self._jax.device_put(
+            FilterState.for_config(self.cfg), self.device
+        )
+
+    def _activate(self, ans_type: int) -> None:
+        """Answer type changed: new scan mode — reset decode/assembly
+        state, carry the filter window (the host path's chain survives a
+        mode switch too; only decoder + assembler reset)."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            create_ingest_state,
+            ingest_config_for,
+        )
+
+        self._active_ans = ans_type
+        self._icfg = ingest_config_for(
+            ans_type, self.timing, self.cfg,
+            max_nodes=self.max_nodes, max_revs=self.max_revs,
+            emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+        )
+        filt = (
+            self._state.filter if self._state is not None
+            else self._filter_state
+            if self._filter_state is not None
+            else self._fresh_filter_state()
+        )
+        self._state = self._jax.device_put(
+            create_ingest_state(self._icfg, filter_state=filt), self.device
+        )
+
+    def reset(self) -> None:
+        """Stream-state reset (scan stop/start, driver reconnect): clears
+        the partial revolution, carries and pending wires; the filter
+        window survives, like the host chain across _begin_streaming."""
+        with self._lock:
+            if self._state is not None:
+                self._filter_state = self._state.filter
+            self._state = None
+            self._active_ans = None
+            self._icfg = None
+            self._base = None
+            self._pending.clear()
+            self._event.clear()
+
+    def reset_filter(self) -> None:
+        """Cold filter reset (the chain.reset() analog)."""
+        with self._lock:
+            self._filter_state = self._fresh_filter_state()
+            if self._state is not None and self._active_ans is not None:
+                ans = self._active_ans
+                self._active_ans = None
+                self._state = None
+                self._activate(ans)
+
+    # -- producer side (the engine pump's callback) ------------------------
+
+    def on_measurement(self, ans_type: int, payload: bytes) -> None:
+        """Single-frame compatibility shim (tests / non-batching engines)."""
+        self.on_measurement_batch(ans_type, [(payload, time.monotonic())])
+
+    def on_measurement_batch(self, ans_type: int, items: list) -> None:
+        """Stage one run of ``(payload, rx_monotonic_ts)`` frames to the
+        device and dispatch the fused step — the whole decode+assemble+
+        filter pipeline is inside that one dispatch."""
+        rec = self.recorder
+        if rec is not None:
+            for data, ts in items:
+                rec.write(ans_type, data, ts)
+        expect = ANS_PAYLOAD_BYTES.get(ans_type)
+        if expect is None:
+            return
+        items = [it for it in items if len(it[0]) == expect]
+        if not items:
+            return
+        with self._lock:
+            if ans_type != self._active_ans:
+                self._activate(ans_type)
+            self.frames_decoded += len(items)
+            cap = self._buckets[-1]
+            for i in range(0, len(items), cap):
+                self._dispatch(ans_type, expect, items[i : i + cap])
+        self._event.set()
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _dispatch(self, ans_type: int, expect: int, chunk: list) -> None:
+        from rplidar_ros2_driver_tpu.ops.ingest import fused_ingest_step
+
+        m = len(chunk)
+        mb = self._bucket(m)
+        base = chunk[0][1]
+        buf = np.zeros((mb, expect), np.uint8)
+        buf[:m] = np.frombuffer(
+            b"".join(d for d, _ in chunk), np.uint8
+        ).reshape(m, expect)
+        aux = np.zeros((2 * mb + 2,), np.float32)
+        aux[:m] = [ts - base for _, ts in chunk]
+        if ans_type == Ans.MEASUREMENT_HQ:
+            aux[mb : mb + m] = [
+                float(
+                    crcmod.crc32_padded(d[:-4])
+                    == int.from_bytes(d[-4:], "little")
+                )
+                for d, _ in chunk
+            ]
+        aux[-2] = 0.0 if self._base is None else self._base - base
+        aux[-1] = m
+        self._base = base
+        # numpy args go straight into the dispatch: the jit places
+        # uncommitted arrays on the (committed, donated) state's device,
+        # and the explicit pytree device_put it replaces measured ~0.5 ms
+        # per call on the CPU backend — pure staging overhead
+        self._state, *res = fused_ingest_step(
+            self._state, buf, aux, cfg=self._icfg
+        )
+        for arr in res:
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # backend without async D2H: the later fetch blocks
+        self._pending.append((tuple(res), self._icfg, base))
+        while len(self._pending) > self._max_queue:
+            # consumer lagging: oldest result dropped (the assembler's
+            # newest-wins double buffer, at batch granularity)
+            self._pending.popleft()
+            self.wires_dropped += 1
+
+    def precompile(self, ans_type: int) -> None:
+        """Warm the jit cache for this format's buckets on a throwaway
+        state (motor-warmup analog of BatchScanDecoder.precompile)."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            create_ingest_state,
+            fused_ingest_step,
+            ingest_config_for,
+        )
+
+        expect = ANS_PAYLOAD_BYTES.get(ans_type)
+        if expect is None:
+            return
+        icfg = ingest_config_for(
+            ans_type, self.timing, self.cfg,
+            max_nodes=self.max_nodes, max_revs=self.max_revs,
+            emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+        )
+        for b in self._buckets:
+            st = self._jax.device_put(create_ingest_state(icfg), self.device)
+            # frames/aux stay numpy, matching the live _dispatch call
+            # exactly: a committed-device warmup arg compiles a separate
+            # executable, and the first live (numpy-arg) dispatch then
+            # pays a full in-loop recompile (~600 ms measured on CPU)
+            aux = np.zeros((2 * b + 2,), np.float32)
+            aux[-1] = 1.0
+            fused_ingest_step(
+                st, np.zeros((b, expect), np.uint8), aux, cfg=icfg
+            )
+
+    # -- consumer side -----------------------------------------------------
+
+    def _parse(self, entry) -> list:
+        from rplidar_ros2_driver_tpu.ops.ingest import unpack_ingest_result
+
+        arrays, icfg, base = entry
+        res = unpack_ingest_result(arrays, icfg)
+        self.nodes_decoded += res.nodes_appended
+        self.scans_completed += res.n_completed
+        self.revs_dropped += res.revs_dropped
+        out = []
+        for k in range(res.n_completed):
+            ts0 = base + float(res.ts0[k])
+            duration = max(float(res.end_ts[k]) - float(res.ts0[k]), 0.0)
+            out.append((res.outputs[k], ts0, duration))
+        return out
+
+    def _pop(self):
+        with self._lock:
+            if not self._pending:
+                self._event.clear()
+                return None
+            entry = self._pending.popleft()
+            if not self._pending:
+                self._event.clear()
+            return entry
+
+    def wait_and_grab_outputs(self, timeout_s: float = 2.0) -> Optional[list]:
+        """Block for the next dispatched batch's wire; returns its
+        completed revolutions as ``[(FilterOutput, ts0, duration), ...]``
+        (possibly empty — a mid-revolution batch), or None on timeout.
+        The fetch touches an already-dispatched step whose D2H copy
+        started at dispatch time, so in steady state it does not wait on
+        device compute."""
+        if not self._event.wait(timeout_s):
+            return None
+        entry = self._pop()
+        if entry is None:
+            return None
+        return self._parse(entry)
+
+    def collect_nowait(self) -> Optional[list]:
+        """Non-blocking variant of :meth:`wait_and_grab_outputs`."""
+        entry = self._pop()
+        if entry is None:
+            return None
+        return self._parse(entry)
+
+    def collect_pipelined(self) -> list:
+        """Drain every pending result EXCEPT the newest: the just-
+        dispatched batch keeps computing on the device while its
+        predecessors — whose results already landed during earlier
+        dispatch gaps — are parsed on the host.  This is the engine-level
+        mirror of ScanFilterChain.process_raw_pipelined's collect-before-
+        dispatch discipline (one batch of bounded staleness, no blocking
+        on in-flight device compute); pair with :meth:`flush` at stream
+        end to drain the last batch."""
+        out = []
+        while True:
+            with self._lock:
+                if len(self._pending) <= 1:
+                    return out
+                entry = self._pending.popleft()
+            out.extend(self._parse(entry))
+
+    def flush(self) -> list:
+        """Drain every pending wire (stream stop): flat list of
+        ``(FilterOutput, ts0, duration)`` in dispatch order."""
+        out = []
+        while True:
+            entry = self._pop()
+            if entry is None:
+                return out
+            out.extend(self._parse(entry))

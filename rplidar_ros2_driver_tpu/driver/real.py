@@ -117,6 +117,7 @@ class RealLidarDriver(LidarDriverInterface):
         transceiver_factory: Optional[Callable[..., TransceiverLike]] = None,
         motor_warmup_s: float = 1.0,   # ref waits 1 s after setMotorSpeed (:197)
         legacy_warmup_s: float = 0.2,  # ref waits 200 ms on OLD_TYPE (:264)
+        ingest_sink=None,
     ) -> None:
         self._channel_type = channel_type
         self._tcp = (tcp_host, tcp_port)
@@ -128,7 +129,17 @@ class RealLidarDriver(LidarDriverInterface):
         self._engine: Optional[CommandEngine] = None
         self._assembler = ScanAssembler()
         self._raw_holder = RawNodeHolder()
-        self._scan_decoder = BatchScanDecoder(self._assembler, self._raw_holder)
+        # the ingest seam: the measurement-frame consumer wired into the
+        # engine pump.  Default: the host golden path (BatchScanDecoder
+        # -> ScanAssembler -> grab_scan_host).  A fused sink
+        # (driver/ingest.FusedIngest, ingest_backend="fused") implements
+        # the same producer interface but runs decode + revolution
+        # assembly + the filter step device-resident; revolutions are
+        # then consumed via grab_filtered, not grab_scan_*.
+        self._scan_decoder = ingest_sink or BatchScanDecoder(
+            self._assembler, self._raw_holder
+        )
+        self._fused_ingest = ingest_sink
         self._lock = threading.RLock()
         self._connected = False
         self._scanning = False
@@ -667,6 +678,29 @@ class RealLidarDriver(LidarDriverInterface):
         from rplidar_ros2_driver_tpu.ops.ascend import apply_angle_compensation
 
         return apply_angle_compensation(batch, self._angle_compensate), ts0, duration
+
+    def set_ingest_sink(self, sink) -> None:
+        """Install a fused ingest sink BEFORE connect (the engine binds
+        the measurement callback at connect time).  The node's seam
+        wiring uses this so one FusedIngest (and its rolling filter
+        window) survives FSM driver recreation, like the chain does."""
+        with self._lock:
+            if self._connected:
+                raise RuntimeError("ingest sink must be set before connect")
+            self._scan_decoder = sink
+            self._fused_ingest = sink
+
+    def grab_filtered(self, timeout_s: float = 2.0) -> Optional[list]:
+        """Fused-ingest consumer: completed revolutions as
+        ``[(FilterOutput, ts0, duration), ...]`` from the next dispatched
+        batch (possibly empty — mid-revolution batch), or None on
+        timeout / when the host ingest backend is active."""
+        if not self.is_connected() or not self._scanning:
+            return None
+        sink = self._fused_ingest
+        if sink is None:
+            return None
+        return sink.wait_and_grab_outputs(timeout_s)
 
     def grab_scan_host(
         self, timeout_s: float = 2.0

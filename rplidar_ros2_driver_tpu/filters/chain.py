@@ -40,11 +40,13 @@ from rplidar_ros2_driver_tpu.ops.filters import (
 )
 
 
-def _pick_device(backend: str):
+def pick_device(backend: str):
     # local_devices, not devices: in a multi-controller job the global
     # list starts with process 0's devices, and device_put to another
     # process's device raises "Cannot copy array to non-addressable
-    # device" — the single-stream chain is a per-host object
+    # device" — the single-stream chain is a per-host object.  Shared
+    # with the fused ingest engine (driver/ingest.py) so both backends
+    # resolve the same device from the same parameter.
     if backend == "cpu":
         return jax.local_devices(backend="cpu")[0]
     # "tpu": first local accelerator if present, else fall back to host
@@ -52,6 +54,9 @@ def _pick_device(backend: str):
         if d.platform != "cpu":
             return d
     return jax.local_devices()[0]
+
+
+_pick_device = pick_device  # compatibility alias (pre-seam internal name)
 
 
 DEFAULT_BEAMS = 2048
@@ -90,6 +95,28 @@ def resolve_median_backend(
     if platform == "tpu":
         return "pallas"
     return "inc" if platform == "cpu" else "xla"
+
+
+def resolve_ingest_backend(requested: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` ingest backend (mirrors the sibling
+    resolvers; explicit requests pass through).
+
+    ``host`` is the golden path: BatchScanDecoder (CPU-pinned unpack) +
+    ScanAssembler + the chain's packed one-transfer upload.  ``fused``
+    is the device-resident single-dispatch path (ops/ingest.py +
+    driver/ingest.FusedIngest) — bit-exact against the host path
+    (tests/test_fused_ingest.py), with the ingest-overhead A/B recorded
+    per rig by ``bench.py --config 9`` (artifacts/ingest_ab_cpu.json,
+    docs/BENCHMARKS.md: on a linkless CPU rig the shared chain step
+    dominates both arms and the ratio sits near 1; the structural win is
+    per link round-trip, so it materializes on-device), but without the
+    RawNodeHolder interval tap or the chain's checkpoint surface.
+    ``auto`` stays host until an on-chip artifact clears the standing
+    decision bar for the TPU mapping."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "host"
 
 
 def resolve_resample_backend(requested: str, platform: Optional[str] = None) -> str:

@@ -78,9 +78,15 @@ class ScanLoopFsm:
         timings: Optional[FsmTimings] = None,
         on_state_change: Optional[Callable[[DriverState], None]] = None,
         on_connected: Optional[Callable[[LidarDriverInterface], None]] = None,
+        on_filtered: Optional[Callable] = None,
     ) -> None:
         self._factory = driver_factory
         self._on_scan = on_scan
+        # fused-ingest consumer (ingest_backend="fused"): called once per
+        # completed revolution with (FilterOutput, ts0, duration) — the
+        # revolution was decoded, assembled AND filtered on-device, so
+        # there is no host scan dict to hand to on_scan
+        self._on_filtered = on_filtered
         self._params = params
         self._t = timings or FsmTimings()
         self._on_state_change = on_state_change
@@ -233,6 +239,13 @@ class ScanLoopFsm:
             self._set_state(DriverState.RESETTING)
 
     def _do_running(self) -> None:
+        # on_filtered is only wired when the node resolved the fused
+        # ingest seam (node.on_configure via resolve_ingest_backend) —
+        # re-deriving the backend from the raw param string here would
+        # diverge the moment "auto" resolves to fused
+        if self._on_filtered is not None:
+            self._do_running_fused()
+            return
         start_time = time.monotonic()
         scan: Optional[dict] = None
         ts0 = duration = None
@@ -260,6 +273,33 @@ class ScanLoopFsm:
             ts0 = start_time
             duration = time.monotonic() - start_time
         self._on_scan(scan, ts0, duration)
+
+    def _do_running_fused(self) -> None:
+        """RUNNING step for the fused ingest backend: one dispatched
+        frame batch's completed revolutions per iteration, already
+        filtered on-device.  A timeout (None) walks the same
+        error-count -> RESETTING path as a failed host grab; an empty
+        list (mid-revolution batch) is healthy progress."""
+        outs = None
+        with self.driver_mutex:
+            if self.driver is not None and self.driver.is_connected():
+                grab = getattr(self.driver, "grab_filtered", None)
+                if grab is not None:
+                    outs = grab(self._t.grab_timeout_s)
+        if outs is None:
+            self.error_count += 1
+            if self.error_count > self._params.max_retries:
+                log.error(
+                    "[FSM] Hardware unresponsive (Over %d errors). Resetting...",
+                    self._params.max_retries,
+                )
+                self._set_state(DriverState.RESETTING)
+            else:
+                self._interruptible_sleep(self._t.grab_retry_s)
+            return
+        self.error_count = 0
+        for out, ts0, duration in outs:
+            self._on_filtered(out, ts0, duration)
 
     def _do_resetting(self) -> None:
         log.warning("[FSM] Performing hardware reset (recreating driver)...")

@@ -15,6 +15,13 @@ New capability (the north star): when ``filter_chain`` stages are
 configured, each revolution runs through the TPU ScanFilterChain between
 grab and publish; the LaserScan then carries the temporal-median ranges and
 a PointCloud + voxel grid are published alongside.
+
+Ingest seam (``ingest_backend``): ``host`` grabs assembled revolutions
+from the driver and runs the chain here (the golden path above);
+``fused`` hands the driver a FusedIngest sink instead — raw frame bytes
+decode, segment into revolutions and filter in ONE device dispatch
+(ops/ingest.py), and the FSM publishes the already-filtered outputs via
+:meth:`RPlidarNode._on_filtered_output`.
 """
 
 from __future__ import annotations
@@ -63,6 +70,10 @@ class RPlidarNode(LifecycleNode):
         self._fsm_timings = fsm_timings
         self.fsm: Optional[ScanLoopFsm] = None
         self.chain: Optional[ScanFilterChain] = None
+        # fused ingest engine (ingest_backend="fused"): owns the filter
+        # window in place of self.chain; survives FSM driver recreation
+        # (each recreated driver gets the same sink re-attached)
+        self.fused_ingest = None
         self.diagnostics: Optional[DiagnosticsUpdater] = None
         self.tracer = StageTimer()
         self._param_lock = threading.Lock()
@@ -88,6 +99,25 @@ class RPlidarNode(LifecycleNode):
             udp_port=self.params.udp_port,
         )
 
+    def _resolve_fused_ingest(self) -> bool:
+        """Whether this configure builds the fused ingest seam.  Fused
+        needs the filter chain AND a wire-streaming driver: the dummy
+        driver synthesizes host scans above the protocol layer, so it
+        falls back to the host backend with a notice."""
+        from rplidar_ros2_driver_tpu.filters.chain import resolve_ingest_backend
+
+        backend = resolve_ingest_backend(self.params.ingest_backend)
+        if backend != "fused" or not self.params.filter_chain:
+            return False
+        if self.params.dummy_mode and self._driver_factory is None:
+            log.warning(
+                "ingest_backend='fused' needs a wire-streaming driver; "
+                "dummy_mode synthesizes scans above the protocol layer — "
+                "falling back to the host ingest path"
+            )
+            return False
+        return True
+
     def on_configure(self) -> bool:
         log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
         if self._driver_factory is None and not self.params.dummy_mode:
@@ -102,14 +132,30 @@ class RPlidarNode(LifecycleNode):
                             "native/Makefile); real driver will use the "
                             "pure-Python transport fallback")
         factory = self._driver_factory or self._default_factory
+        fused = self._resolve_fused_ingest()
+        if fused:
+            from rplidar_ros2_driver_tpu.driver.ingest import FusedIngest
+
+            self.fused_ingest = FusedIngest(self.params)
+            base_factory = factory
+
+            def factory():  # noqa: F811 - deliberate seam wrapper
+                drv = base_factory()
+                # re-attach the one engine (and its rolling filter
+                # window) to every recreated driver, like the chain
+                # survives FSM resets on the host path
+                drv.set_ingest_sink(self.fused_ingest)
+                return drv
+
         self.fsm = ScanLoopFsm(
             factory,
             self._on_scan,
             params=self.params,
             timings=self._fsm_timings,
             on_state_change=self._on_fsm_state,
+            on_filtered=self._on_filtered_output if fused else None,
         )
-        if self.params.filter_chain:
+        if self.params.filter_chain and not fused:
             self.chain = ScanFilterChain(self.params)
             if self._chain_snapshot is not None:
                 if not self.chain.restore(self._chain_snapshot):
@@ -182,6 +228,7 @@ class RPlidarNode(LifecycleNode):
     def on_cleanup(self) -> bool:
         self.fsm = None
         self.chain = None
+        self.fused_ingest = None
         # _chain_snapshot intentionally survives cleanup: it is the
         # checkpoint/resume surface (SURVEY.md §5) — a later configure
         # restores the rolling window.  discard_checkpoint() drops it.
@@ -331,17 +378,28 @@ class RPlidarNode(LifecycleNode):
         with self.tracer.stage("publish"):
             self.publisher.publish_scan(msg)
 
+    def _on_filtered_output(self, out, ts0: float, duration: float) -> None:
+        """Fused-ingest publish hook (FSM RUNNING loop): the revolution
+        arrived decoded, assembled and filtered on-device — straight to
+        the shared chain-output publisher."""
+        with self.tracer.stage("filter"):
+            pass  # device work already done inside the fused dispatch
+        self._publish_chain_output(out, ts0, duration)
+
     def _publish_chain_output(
         self, out, stamp: float, duration: float, max_range: Optional[float] = None
     ) -> None:
         """Convert + publish one chain FilterOutput (shared by the
-        synchronous path, the pipelined path, and the deactivate-time
-        pipeline drain).  The output is already on the fixed angular grid."""
+        synchronous path, the pipelined path, the deactivate-time
+        pipeline drain, and the fused-ingest hook).  The output is
+        already on the fixed angular grid."""
         params = self.params
         if max_range is None:
             max_range = (self.fsm.cached_max_range if self.fsm else None) or 40.0
         with self.tracer.stage("convert"):
-            beams = self.chain.cfg.beams
+            # beams from the output itself: the fused path has no
+            # self.chain, and the grid width is intrinsic to the output
+            beams = int(np.asarray(out.ranges).shape[0])
             msg = LaserScanHost(
                 stamp=stamp,
                 frame_id=params.frame_id,

@@ -1,0 +1,611 @@
+"""Fused device-resident ingest: raw frame bytes -> filter output, ONE dispatch.
+
+The host ingest path (driver/decode.py + driver/assembly.py + the chain's
+packed upload) makes two device round-trips per capsule batch: the unpack
+kernels run pinned to the CPU backend, NumPy materializes on the host, a
+Python loop splits revolutions at sync positions, and the completed
+revolution is re-packed and ``device_put`` into the filter step.  The
+device-resident filter core sustains ~33k scans/s in-jit while the live
+end-to-end path manages ~780 scans/s — the gap IS that host assembly
+round-trip (the "caching-aware sweep reconstruction" bottleneck of
+SR-LIO++, arXiv:2503.22926; the FPGA 2-D SLAM accelerator of
+arXiv:2006.01050 fuses the same decode-to-map dataflow in hardware).
+
+This module closes it in XLA: one jitted program per answer type runs
+
+  1. **unpack** — the vectorized kernels of ops/unpack.py, NOT pinned to
+     the CPU backend, with the prev-frame / sync-edge / smoothing carries
+     threaded as device scalars (ops/unpack_ref.py stays the scalar golden
+     model; driver/decode.py stays the host golden path);
+  2. **validity compaction + revolution segmentation** — the flag-bit0
+     sync split of driver/assembly.ScanAssembler.push_nodes.  Formulated
+     WITHOUT element-wise scatters (XLA lowers those to a µs-per-element
+     loop on CPU, and they are no better on TPU): frame validity is
+     row-uniform in every wire format, so a stable 1-row-per-frame argsort
+     compacts valid frames to the front, two ``dynamic_update_slice`` ops
+     append the compacted nodes to the carried partial revolution in one
+     contiguous buffer, ``searchsorted`` over the sync-bit cumsum finds
+     each revolution's start offset, and each completed revolution is a
+     single contiguous ``dynamic_slice`` — wrap/overflow-cap semantics
+     identical to the assembler (data before the first sync dropped;
+     ``max_nodes`` overflow cap, head-keep; completed segments beyond
+     ``max_revs`` per batch dropped oldest-first, counted in
+     ``revs_dropped``);
+  3. **the filter step** — ``_filter_step_impl`` statically unrolled over
+     the ``max_revs`` revolution slots, each gated by a ``lax.cond`` on
+     ``slot < n_completed``, so a batch that completes no revolution
+     takes every false branch and pays no filter compute, and the donated
+     FilterState advances exactly one step per completed revolution (same
+     trajectory as the host chain).
+
+Node values are clamped exactly like the host wire pack
+(ops/filters._pack_compact_rows: dist 18 bits, quality 8, flag 6) so the
+filter sees bit-identical inputs on both paths; bit-exactness of the
+whole bytes->revolution pipeline against BatchScanDecoder+ScanAssembler
+is enforced by tests/test_fused_ingest.py.
+
+Timestamps ride as float32 offsets from a PER-DISPATCH base (the
+batch's first rx stamp, kept host-side in f64): each dispatch re-bases
+the carried partial revolution's offsets by the base delta (one scalar
+add over the partial plane), so on-device offsets stay bounded by the
+span of one revolution — microsecond-exact in f32 — for arbitrarily
+long sessions (a single session-epoch anchor would drift to ~ms ulp
+after hours).  The host adds the base back after the fetch.  The
+reference-exact per-sample back-dating (protocol/timing.py) is applied
+in-kernel — delay(0) and the per-sample slope are compile-time
+constants of the ingest config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
+from rplidar_ros2_driver_tpu.ops.filters import (
+    FilterConfig,
+    FilterOutput,
+    FilterState,
+    _filter_step_impl,
+    _pack_output_wire,
+    unpack_output_wire,
+    wire_output_len,
+)
+from rplidar_ros2_driver_tpu.driver.decode import _PAIRED_NODES
+from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+from rplidar_ros2_driver_tpu.protocol.constants import ANS_PAYLOAD_BYTES, Ans
+
+# nodes per decoded row (pair for the capsule formats, frame otherwise)
+# and the paired-format set come from the canonical tables
+# (protocol/timing.SAMPLES_PER_FRAME, driver/decode._PAIRED_NODES) —
+# the fused geometry must never drift from the host golden path's
+_NPTS = timingmod.SAMPLES_PER_FRAME
+_PAIRED = frozenset(_PAIRED_NODES)
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Static (compile-time) configuration of one fused ingest program."""
+
+    ans_type: int
+    frame_bytes: int
+    npts: int
+    paired: bool
+    grouped: bool            # per-sample grouping delay applies (timing)
+    sample_duration_us: int  # rounded, as the decode kernels take it
+    delay0_us: int           # back-dating of sample 0 (protocol/timing.py)
+    max_nodes: int           # revolution overflow cap (head-keep)
+    max_revs: int            # completed revolutions per dispatch (newest win)
+    emit_nodes: bool         # debug/parity: assembled node buffers returned
+    filter: FilterConfig
+    # per-revolution slot lowering: "auto" | "cond" | "fori" (bit-exact
+    # either way — see _slot_impl_for; pinnable for A/B and parity tests)
+    slot_impl: str = "auto"
+
+
+def ingest_config_for(
+    ans_type: int,
+    timing: timingmod.TimingDesc,
+    filter_cfg: FilterConfig,
+    *,
+    max_nodes: int = MAX_SCAN_NODES,
+    max_revs: int = 2,
+    emit_nodes: bool = False,
+    slot_impl: str = "auto",
+) -> IngestConfig:
+    """Build the static config for one (answer type, timing desc, chain)."""
+    at = Ans(ans_type)
+    return IngestConfig(
+        ans_type=int(at),
+        frame_bytes=ANS_PAYLOAD_BYTES[at],
+        npts=_NPTS[at],
+        paired=at in _PAIRED,
+        grouped=at in timingmod._GROUPED_FORMATS,
+        sample_duration_us=timing.sample_duration_int_us,
+        delay0_us=timingmod.sample_delay_us(at, timing, 0),
+        max_nodes=max_nodes,
+        max_revs=max_revs,
+        emit_nodes=emit_nodes,
+        filter=filter_cfg,
+        slot_impl=slot_impl,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class IngestState:
+    """Device-resident streaming state threaded through the fused step."""
+
+    filter: FilterState
+    partial: jax.Array        # (max_nodes, 4) int32 current partial revolution
+    partial_ts: jax.Array     # (max_nodes,) f32 offsets from the LAST base
+    partial_len: jax.Array    # int32 (capped at max_nodes, like the assembler)
+    seen_sync: jax.Array      # bool — data before the first sync is dropped
+    sync_carry: jax.Array     # int32 — dense/ultra-dense edge-filter carry
+    dist_carry: jax.Array     # int32 — ultra-dense smoothing carry
+    prev_frame: jax.Array     # (frame_bytes,) uint8 — paired-format prev
+    have_prev: jax.Array      # bool
+    scans_completed: jax.Array  # int32, cumulative
+    revs_dropped: jax.Array     # int32, cumulative (max_revs overflow drops)
+
+
+def create_ingest_state(
+    cfg: IngestConfig, filter_state: Optional[FilterState] = None
+) -> IngestState:
+    """Fresh stream state; ``filter_state`` carries the rolling window
+    across scan-mode switches (the host path's chain survives an answer-
+    type change too — only decode/assembly state resets)."""
+    return IngestState(
+        filter=filter_state
+        if filter_state is not None
+        else FilterState.for_config(cfg.filter),
+        partial=jnp.zeros((cfg.max_nodes, 4), jnp.int32),
+        partial_ts=jnp.zeros((cfg.max_nodes,), jnp.float32),
+        partial_len=jnp.asarray(0, jnp.int32),
+        seen_sync=jnp.asarray(False),
+        sync_carry=jnp.asarray(0, jnp.int32),
+        dist_carry=jnp.asarray(0, jnp.int32),
+        prev_frame=jnp.zeros((cfg.frame_bytes,), jnp.uint8),
+        have_prev=jnp.asarray(False),
+        scans_completed=jnp.asarray(0, jnp.int32),
+        revs_dropped=jnp.asarray(0, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# result layout (one small meta fetch per dispatched batch; the per-slot
+# filter-output wires ride as a separate (max_revs, wire_output_len) array
+# that the host only touches when meta says revolutions completed)
+# ---------------------------------------------------------------------------
+#
+#   meta (float32, _META + 3*max_revs):
+#     [0] n_completed  [1] revs_dropped_this_step  [2] syncs_in_batch
+#     [3] nodes_appended
+#     [4 : 4+R]        per-slot node counts          (R = max_revs)
+#     [.. : ..+R]      per-slot ts0 epoch offsets
+#     [.. : ..+R]      per-slot end_ts epoch offsets
+#   out_wires: (R, wire_output_len(filter)) float32
+#   (emit_nodes only) nodes (R, max_nodes, 4) f32 + node_ts (R, max_nodes)
+
+_META = 4
+
+
+def ingest_meta_len(cfg: IngestConfig) -> int:
+    return _META + 3 * cfg.max_revs
+
+
+@dataclasses.dataclass
+class IngestBatchResult:
+    """Host-side parse of one fused-step result."""
+
+    n_completed: int
+    revs_dropped: int
+    syncs: int
+    nodes_appended: int
+    counts: np.ndarray          # (n_completed,)
+    ts0: np.ndarray             # (n_completed,) epoch offsets (float32)
+    end_ts: np.ndarray          # (n_completed,)
+    outputs: list               # n_completed FilterOutput (numpy-backed)
+    nodes: Optional[np.ndarray] = None      # (n_completed, max_nodes, 4)
+    node_ts: Optional[np.ndarray] = None    # (n_completed, max_nodes)
+
+
+def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
+    """Host-side parse of the fused step's returned arrays (everything
+    after the advanced state): ``(meta, out_wires[, nodes, node_ts])``.
+
+    Only ``meta`` (a handful of floats) is always materialized; the
+    per-slot output wires are touched exclusively for slots that actually
+    completed, so a mid-revolution batch costs one tiny fetch.
+    """
+    meta = np.asarray(res[0])
+    if meta.size != ingest_meta_len(cfg):
+        raise ValueError(
+            f"ingest meta of {meta.size} floats does not match cfg "
+            f"(expected {ingest_meta_len(cfg)})"
+        )
+    r = cfg.max_revs
+    n = int(meta[0])
+    off = _META
+    counts = meta[off : off + r].astype(np.int32)
+    ts0 = meta[off + r : off + 2 * r].copy()
+    end_ts = meta[off + 2 * r : off + 3 * r].copy()
+    outputs = []
+    if n > 0:
+        w = np.asarray(res[1])
+        outputs = [unpack_output_wire(w[k], cfg.filter) for k in range(n)]
+    nodes = node_ts = None
+    if cfg.emit_nodes:
+        nodes = np.asarray(res[2]).astype(np.int32)[:n]
+        node_ts = np.asarray(res[3])[:n]
+    return IngestBatchResult(
+        n_completed=n,
+        revs_dropped=int(meta[1]),
+        syncs=int(meta[2]),
+        nodes_appended=int(meta[3]),
+        counts=counts[:n],
+        ts0=ts0[:n],
+        end_ts=end_ts[:n],
+        outputs=outputs,
+        nodes=nodes,
+        node_ts=node_ts,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the fused step
+# ---------------------------------------------------------------------------
+
+
+def _decode(cfg: IngestConfig, state: IngestState, frames, crc_ok):
+    """Dispatch to the right ops/unpack.py kernel, prev frame prepended for
+    the paired formats and the edge/smoothing carries threaded as traced
+    device scalars (driver/decode.py threads the same carries as host ints)."""
+    from rplidar_ros2_driver_tpu.ops import unpack
+
+    at = cfg.ans_type
+    if at == Ans.MEASUREMENT:
+        return unpack.unpack_normal_nodes(frames)
+    if at == Ans.MEASUREMENT_HQ:
+        return unpack.unpack_hq_capsules(frames, crc_ok)
+    fr = jnp.concatenate([state.prev_frame[None, :], frames], axis=0)
+    if at == Ans.MEASUREMENT_CAPSULED:
+        return unpack.unpack_capsules(fr)
+    if at == Ans.MEASUREMENT_CAPSULED_ULTRA:
+        return unpack.unpack_ultra_capsules(fr)
+    if at == Ans.MEASUREMENT_DENSE_CAPSULED:
+        return unpack.unpack_dense_capsules(
+            fr, state.sync_carry, sample_duration_us=cfg.sample_duration_us
+        )
+    if at == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+        return unpack.unpack_ultra_dense_capsules(
+            fr, state.sync_carry, state.dist_carry,
+            sample_duration_us=cfg.sample_duration_us,
+        )
+    raise ValueError(f"unsupported ans type {at:#x}")
+
+
+def _slot_impl_for(cfg: IngestConfig) -> str:
+    """Static choice of the per-revolution slot lowering (both are
+    bit-identical in output; the choice only moves XLA:CPU carry-copy
+    cost for skipped slots).  ``cond`` executes only the taken branch but
+    copies the FilterState through every conditional — right when the
+    state is small.  ``fori`` aliases its while-loop carries in place, so
+    skipped slots are free even with a multi-MB state, at the price of a
+    slightly less fusible loop body.  The crossover sits around a few
+    hundred KB of carried state; below we approximate the state footprint
+    by its dominant planes (median window + voxel accumulator)."""
+    if cfg.slot_impl != "auto":
+        return cfg.slot_impl
+    f = cfg.filter
+    state_elems = f.window * f.beams * 3 + f.grid * f.grid
+    return "cond" if state_elems <= (1 << 18) else "fori"
+
+
+def _wire_clamp(angle, dist, quality, flag):
+    """The host wire pack's exact clamps (ops/filters._pack_compact_rows:
+    dist saturates at 18 bits — a 'negative' int32 bit pattern from the HQ
+    u32 field saturates too, matching the uint32 host math — quality masks
+    to 8 bits, flag to 6, angle to u16), applied pre-segmentation so the
+    filter sees bit-identical node values on both ingest backends."""
+    angle = angle & 0xFFFF
+    dist = jnp.where(dist < 0, 0x3FFFF, jnp.minimum(dist, 0x3FFFF))
+    quality = quality & 0xFF
+    flag = flag & 0x3F
+    return angle, dist, quality, flag
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fused_ingest_step(
+    state: IngestState, frames: jax.Array, aux: jax.Array, cfg: IngestConfig
+) -> tuple:
+    """One frame batch through unpack -> segment -> filter, in one program.
+
+    ``frames`` is (M, frame_bytes) uint8, zero-padded past the live count;
+    ``aux`` is (2M+2,) float32: per-frame rx offsets from THIS batch's
+    base stamp, per-frame CRC verdicts (HQ only; CRC32 runs on the host
+    like the host path), the previous base minus this base (the re-base
+    shift applied to the carried partial's offsets), and the live frame
+    count in the last slot.  Returns
+    ``(state, meta, out_wires[, nodes, node_ts])`` — see the result-layout
+    note above.
+    """
+    mb = frames.shape[0]
+    rx = aux[:mb]
+    crc_ok = aux[mb : 2 * mb] > 0.5
+    base_shift = aux[-2]
+    m = aux[-1].astype(jnp.int32)
+
+    dec = _decode(cfg, state, frames, crc_ok)
+    npts = cfg.npts
+    mn = cfg.max_nodes
+    rows = jnp.arange(mb, dtype=jnp.int32)
+    if cfg.paired:
+        # pair i = (fr[i], fr[i+1]) with the prev frame at fr[0]: a zeroed
+        # prev fails the checksum, but the explicit mask also covers it
+        row_live = (rows < m) & (state.have_prev | (rows > 0))
+    else:
+        row_live = rows < m
+
+    angle = jnp.asarray(dec.angle_q14)[:mb]
+    dist = jnp.asarray(dec.dist_q2)[:mb]
+    quality = jnp.asarray(dec.quality)[:mb]
+    flag = jnp.asarray(dec.flag)[:mb]
+    # frame validity is row-uniform in every wire format (checksum / CRC /
+    # sync-nibble verdicts apply to whole frames) — the row mask is the
+    # whole story, which is what makes row-level compaction exact
+    valid_row = jnp.asarray(dec.node_valid)[:mb, 0] & row_live
+
+    # -- carries for the next batch (driver/decode.py:249-258 semantics) --
+    new_sync_carry = state.sync_carry
+    new_dist_carry = state.dist_carry
+    if cfg.ans_type in (
+        Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED
+    ):
+        last_row_flag = jax.lax.dynamic_index_in_dim(
+            flag, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        new_sync_carry = jnp.where(
+            m > 0, last_row_flag[-1] & 1, state.sync_carry
+        )
+    if cfg.ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+        d_flat = dist.reshape(-1)
+        v_flat = jnp.repeat(valid_row, npts)
+        vidx = jnp.where(v_flat, jnp.arange(d_flat.shape[0]), -1)
+        li = jnp.max(vidx)
+        new_dist_carry = jnp.where(
+            li >= 0, d_flat[jnp.maximum(li, 0)], state.dist_carry
+        )
+    if cfg.paired:
+        new_prev = jax.lax.dynamic_index_in_dim(
+            frames, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        new_have_prev = state.have_prev | (m > 0)
+    else:
+        new_prev = state.prev_frame
+        new_have_prev = state.have_prev
+
+    # -- per-node timestamps (protocol/timing.frame_sample_times, f32) --
+    first = rx - jnp.float32(cfg.delay0_us * 1e-6)
+    step = jnp.float32(cfg.sample_duration_us * 1e-6 if cfg.grouped else 0.0)
+    ts2 = first[:, None] + step * jnp.arange(npts, dtype=jnp.float32)[None, :]
+
+    angle, dist, quality, flag = _wire_clamp(angle, dist, quality, flag)
+
+    # -- validity compaction: stable row sort, valid frames first --
+    # (NO element-wise scatter anywhere below: XLA lowers scatters to a
+    # µs-per-element loop on CPU, which at production batch sizes cost
+    # more than the whole filter step)
+    order = jnp.argsort(jnp.logical_not(valid_row), stable=True)
+    nvr = jnp.sum(valid_row.astype(jnp.int32))
+    n = mb * npts
+    nv = nvr * npts
+    batch4 = jnp.stack(
+        [angle[order], dist[order], quality[order], flag[order]], axis=-1
+    ).reshape(n, 4)
+    ts_c = ts2[order].reshape(n)
+    flag_c = batch4[:, 3]
+
+    # -- append to the carried partial: one contiguous stream buffer,
+    # allocated ONCE at (2*mn + n): [0, mn) the carried partial zone, the
+    # batch appended at partial_len, and a trailing mn of zeros so every
+    # fixed-length revolution slice below stays in bounds (a concat-pad
+    # here cost two full-buffer copies per dispatch on the CPU backend)
+    z0 = jnp.asarray(0, jnp.int32)
+    full4 = jnp.zeros((2 * mn + n, 4), jnp.int32)
+    full4 = jax.lax.dynamic_update_slice(full4, state.partial, (z0, z0))
+    full4 = jax.lax.dynamic_update_slice(full4, batch4, (state.partial_len, z0))
+    fullts = jnp.zeros((2 * mn + n,), jnp.float32)
+    # the carried offsets were relative to the PREVIOUS dispatch's base:
+    # one scalar add re-bases them, so on-device stamps stay bounded by
+    # one revolution's span for arbitrarily long sessions (dead lanes
+    # pick up base_shift too, but every consumer below masks by count)
+    fullts = jax.lax.dynamic_update_slice(
+        fullts, state.partial_ts + base_shift, (z0,)
+    )
+    fullts = jax.lax.dynamic_update_slice(fullts, ts_c, (state.partial_len,))
+    total = state.partial_len + nv  # live stream length in full4/fullts
+
+    # -- revolution segmentation: sync-bit cumsum + searchsorted starts --
+    j = jnp.arange(n, dtype=jnp.int32)
+    s_c = (j < nv) & ((flag_c & 1) == 1)
+    psum = jnp.cumsum(s_c.astype(jnp.int32))  # syncs at-or-before node j
+    syncs = psum[-1]
+
+    seen = state.seen_sync
+    k0 = jnp.where(seen, 0, 1)           # first completable segment id
+    n_completed_raw = jnp.maximum(syncs - k0, 0)
+    drop_head = jnp.maximum(n_completed_raw - cfg.max_revs, 0)
+    n_completed = jnp.minimum(n_completed_raw, cfg.max_revs)
+
+    # segment q's start offset in the stream buffer: position of the q-th
+    # sync (which OPENS segment q); segment 0 starts at the stream head
+    q = k0 + drop_head + jnp.arange(cfg.max_revs + 1, dtype=jnp.int32)
+    qs = jnp.concatenate([q, syncs[None]])
+    jq = jnp.searchsorted(psum, qs, side="left").astype(jnp.int32)
+    starts = jnp.where(qs == 0, 0, state.partial_len + jq)
+    seg_start = starts[: cfg.max_revs + 1]   # slots 0..R-1 (+1 for ends)
+    open_start = starts[-1]                  # the still-open segment
+
+    slot = jnp.arange(cfg.max_revs, dtype=jnp.int32)
+    live_slot = slot < n_completed
+    counts = jnp.where(
+        live_slot, jnp.minimum(seg_start[1:] - seg_start[:-1], mn), 0
+    )
+    # ts0 = first node of the slot (0.0 for an empty revolution, matching
+    # an untouched buffer); end_ts = the sync node CLOSING the slot — the
+    # opening node of the next segment (assembler: _close_partial stamp)
+    ts0 = jnp.where(counts > 0, fullts[seg_start[: cfg.max_revs]], 0.0)
+    end_ts = jnp.where(live_slot, fullts[seg_start[1:]], 0.0)
+
+    # -- the carried partial: the open segment's head (max_nodes cap) --
+    keep_p = seen | (syncs > 0)          # pre-first-sync data is dropped
+    cnt_p = jnp.where(keep_p, jnp.minimum(total - open_start, mn), 0)
+    new_partial = jax.lax.dynamic_slice(full4, (open_start, z0), (mn, 4))
+    new_partial_ts = jax.lax.dynamic_slice(fullts, (open_start,), (mn,))
+    pmask = jnp.arange(mn, dtype=jnp.int32) < cnt_p
+    new_partial = jnp.where(pmask[:, None], new_partial, 0)
+    new_partial_ts = jnp.where(pmask, new_partial_ts, 0.0)
+
+    # nodes that actually landed (stat parity with the host decoder):
+    # valid, within the head-keep cap, in a kept segment
+    last_sync_j = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(s_c, j, -1)
+    )
+    seg_begin_j = jnp.where(last_sync_j >= 0, state.partial_len + last_sync_j, 0)
+    pos_j = state.partial_len + j - seg_begin_j
+    rel = psum - k0 - drop_head
+    kept = jnp.where(
+        psum == syncs, keep_p, (rel >= 0) & (rel < n_completed)
+    )
+    nodes_appended = jnp.sum(
+        ((j < nv) & (pos_j < mn) & kept).astype(jnp.int32)
+    )
+
+    # -- the filter: one donated step per completed revolution slot.
+    # Two lowerings, picked statically per filter geometry (see
+    # _slot_impl_for): cond-unrolled slots vs a fori_loop with traced
+    # trip count.  Identical math either way — the choice only moves
+    # where XLA:CPU pays carry copies for the skipped-slot case.
+    fcfg = cfg.filter
+    live_iota = jnp.arange(mn, dtype=jnp.int32)
+    wire_len = wire_output_len(fcfg)
+
+    def _slot_nodes(begin, cnt):
+        nodes_r = jax.lax.dynamic_slice(full4, (begin, z0), (mn, 4))
+        nts_r = jax.lax.dynamic_slice(fullts, (begin,), (mn,))
+        lv = live_iota < cnt
+        # zero the dead lanes: the host packed upload is zero-padded past
+        # count, so bit-exactness requires the same dead-lane values
+        return jnp.where(lv[:, None], nodes_r, 0), jnp.where(lv, nts_r, 0.0), lv
+
+    def _slot_step(r, fstate):
+        cnt = counts[r]
+        nodes_r, _, lv = _slot_nodes(seg_start[r], cnt)
+        batch = ScanBatch(
+            angle_q14=nodes_r[:, 0],
+            dist_q2=nodes_r[:, 1],
+            quality=nodes_r[:, 2],
+            flag=nodes_r[:, 3],
+            valid=lv,
+            count=cnt,
+        )
+        fstate, out = _filter_step_impl(fstate, batch, fcfg)
+        return fstate, _pack_output_wire(out)
+
+    def _slot_skip(fstate):
+        return fstate, jnp.zeros((wire_len,), jnp.float32)
+
+    if _slot_impl_for(cfg) == "cond":
+        # small filter state: per-slot lax.cond — only the taken branch
+        # executes, the pass-through copy of the small state is cheap,
+        # and a live slot runs the step inline with a static slot index
+        fstate = state.filter
+        wire_rows = []
+        for r in range(cfg.max_revs):
+            fstate, w = jax.lax.cond(
+                r < n_completed,
+                functools.partial(_slot_step, r),
+                _slot_skip,
+                fstate,
+            )
+            wire_rows.append(w)
+        out_wires = jnp.stack(wire_rows)
+    else:
+        # large filter state: fori_loop with traced trip count — XLA:CPU
+        # aliases while-loop carries in place, so a zero-trip batch skips
+        # the filter without round-tripping the multi-MB FilterState
+        # (conditionals copy their carried operands per branch on CPU,
+        # which measured ~3 ms/dispatch at the DenseBoost-64 geometry)
+        def _slot_step_dyn(r, fstate):
+            cnt = jax.lax.dynamic_index_in_dim(counts, r, 0, keepdims=False)
+            begin = jax.lax.dynamic_index_in_dim(
+                seg_start, r, 0, keepdims=False
+            )
+            nodes_r, _, lv = _slot_nodes(begin, cnt)
+            batch = ScanBatch(
+                angle_q14=nodes_r[:, 0],
+                dist_q2=nodes_r[:, 1],
+                quality=nodes_r[:, 2],
+                flag=nodes_r[:, 3],
+                valid=lv,
+                count=cnt,
+            )
+            fstate, out = _filter_step_impl(fstate, batch, fcfg)
+            return fstate, _pack_output_wire(out)
+
+        def _loop_body(r, carry):
+            fstate, wires = carry
+            fstate, w = _slot_step_dyn(r, fstate)
+            return fstate, jax.lax.dynamic_update_index_in_dim(wires, w, r, 0)
+
+        fstate, out_wires = jax.lax.fori_loop(
+            0,
+            n_completed,
+            _loop_body,
+            (state.filter, jnp.zeros((cfg.max_revs, wire_len), jnp.float32)),
+        )
+
+    meta = jnp.concatenate([
+        jnp.stack([
+            n_completed, drop_head, syncs, nodes_appended
+        ]).astype(jnp.float32),
+        counts.astype(jnp.float32),
+        ts0,
+        end_ts,
+    ])
+
+    new_state = IngestState(
+        filter=fstate,
+        partial=new_partial,
+        partial_ts=new_partial_ts,
+        partial_len=cnt_p,
+        seen_sync=seen | (syncs > 0),
+        sync_carry=new_sync_carry,
+        dist_carry=new_dist_carry,
+        prev_frame=new_prev,
+        have_prev=new_have_prev,
+        scans_completed=state.scans_completed + n_completed,
+        revs_dropped=state.revs_dropped + drop_head,
+    )
+    if not cfg.emit_nodes:
+        return new_state, meta, out_wires
+    # debug/parity surface: the assembled node buffers per completed slot
+    # (static unroll — max_revs slices of the contiguous stream buffer)
+    node_rows, ts_rows = [], []
+    for r in range(cfg.max_revs):
+        nodes_r, nts_r, _ = _slot_nodes(seg_start[r], counts[r])
+        node_rows.append(nodes_r)
+        ts_rows.append(nts_r)
+    return (
+        new_state,
+        meta,
+        out_wires,
+        jnp.stack(node_rows).astype(jnp.float32),
+        jnp.stack(ts_rows),
+    )

@@ -140,6 +140,33 @@ def analyze(records: list[dict]) -> dict:
                     "margin": MARGIN,
                     "source": "deep_window_ab",
                 })
+            elif crossings:
+                # no depth clears the bar: emit an explicit KEEP entry,
+                # so the strongest-evidence merge is symmetric — a
+                # healthier artifact showing no crossover can displace a
+                # degraded-link record's flip recommendation instead of
+                # leaving it unopposed (ADVICE r5 #2).  The entry's
+                # strength must come from evidence AGAINST the flip
+                # (ratios <= 1: inc losing); a sub-margin ratio > 1 still
+                # argues FOR inc, and using its magnitude would let a
+                # near-flip record decisively suppress a genuine flip.
+                # With no pro-keep ratio at all, carry the weakest ratio
+                # (closest to 1) — a deliberately feeble keep.
+                pro_keep = [v for v in crossings.values() if v <= 1.0]
+                best = (
+                    max(pro_keep, key=_strength)
+                    if pro_keep
+                    else min(crossings.values(), key=_strength)
+                )
+                recommend("median_backend.tpu.window_threshold", {
+                    "current": "pallas at every depth",
+                    "recommended": "pallas at every depth",
+                    "flip": False,
+                    "key": "deep_window inc_vs_best_sort_speedup",
+                    "value": best,
+                    "margin": MARGIN,
+                    "source": "deep_window_ab",
+                })
 
         # ablation: resample + voxel kernels
         derived = rec.get("derived")

@@ -24,7 +24,7 @@ def test_metric_names_stable():
 
 def test_graded_table_well_formed():
     for c, (kind, points, over) in bench.GRADED.items():
-        assert kind in ("passthrough", "chain", "e2e", "fused", "fleet")
+        assert kind in ("passthrough", "chain", "e2e", "fused", "fleet", "ingest")
         assert points > 0
         assert isinstance(over, dict)
 
@@ -310,7 +310,14 @@ def test_fleet_latency_smoke():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["metric"] == "fleet_live_pipelined_tick"
     assert out["streams"] == 2 and out["ticks"] > 0
-    assert out["value"] > 0 and 0 < out["keep_up"] <= 1.2
+    # keep_up (vs NOMINAL device pace) is recorded but not bounded here:
+    # on a throttled CI host the sim pacing threads get starved then
+    # released, bursting above nominal pace — load weather, not the
+    # harness.  keep_up_vs_input is the structural invariant (outputs
+    # can never exceed submitted revolutions).
+    assert out["value"] > 0 and out["keep_up"] > 0
+    assert 0 < out["keep_up_vs_input"] <= 1.0
+    assert out["measured_span_s"] >= out["nominal_seconds"] > 0
     assert out["tick_p99_ms"] > 0
     assert out["staleness_ticks"] == 1
     assert out["device"] == "cpu"
@@ -649,3 +656,118 @@ def test_config5_secondary_arm_failure_keeps_headline(monkeypatch):
     monkeypatch.setattr(bench, "_ChainRunner", FatalRunner)
     with pytest.raises(RuntimeError, match="headline arm died"):
         bench.main(5, "pallas")
+
+
+def test_decide_backends_keep_entry_displaces_degraded_flip():
+    """ADVICE r5 #2: when a record's deep-window crossings exist but none
+    clears the bar, an explicit flip=False keep entry (strongest ratio)
+    must be emitted — so a healthier artifact can displace an earlier
+    degraded-link record's flip under the strongest-evidence merge."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        sys.path.remove(scripts_dir)
+
+    degraded = {  # link weather: inc barely "wins" at depth
+        "device": "tpu",
+        "deep_window_ab": {"512": {"inc_vs_best_sort_speedup": 1.31}},
+    }
+    healthy = {  # healthier rig: inc decisively LOSES at every depth
+        "device": "tpu",
+        "deep_window_ab": {
+            "256": {"inc_vs_best_sort_speedup": 0.55},
+            "512": {"inc_vs_best_sort_speedup": 0.61},
+        },
+    }
+    # alone, the healthy record argues keep with its strongest ratio
+    solo = db.analyze([healthy])
+    thr = solo["recommendations"]["median_backend.tpu.window_threshold"]
+    assert thr["flip"] is False
+    assert thr["recommended"] == "pallas at every depth"
+    assert thr["value"] == 0.55  # |log 0.55| > |log 0.61|
+
+    # merged in either order, the healthy evidence (|log 0.55| > |log 1.31|)
+    # displaces the degraded flip
+    for records in ([degraded, healthy], [healthy, degraded]):
+        merged = db.analyze(records)
+        thr = merged["recommendations"]["median_backend.tpu.window_threshold"]
+        assert thr["flip"] is False, records
+
+    # a record with NO crossings at all still emits nothing
+    empty = db.analyze([{"device": "tpu", "deep_window_ab": {}}])
+    assert "median_backend.tpu.window_threshold" not in empty["recommendations"]
+
+    # keep-entry strength comes from pro-keep evidence ONLY: a near-flip
+    # record (1.40 at 256 but 0.98 at depth — fails the upward-closed
+    # suffix) must carry its weak pro-keep ratio (0.98), not |log 1.40|,
+    # so it can never decisively suppress a genuine flip elsewhere
+    near_flip = {
+        "device": "tpu",
+        "deep_window_ab": {
+            "256": {"inc_vs_best_sort_speedup": 1.40},
+            "512": {"inc_vs_best_sort_speedup": 0.98},
+        },
+    }
+    solo = db.analyze([near_flip])
+    thr = solo["recommendations"]["median_backend.tpu.window_threshold"]
+    assert thr["flip"] is False and thr["value"] == 0.98
+    genuine_flip = {
+        "device": "tpu",
+        "deep_window_ab": {"512": {"inc_vs_best_sort_speedup": 1.25}},
+    }
+    for records in ([near_flip, genuine_flip], [genuine_flip, near_flip]):
+        merged = db.analyze(records)
+        thr = merged["recommendations"]["median_backend.tpu.window_threshold"]
+        assert thr["flip"] is True, records
+
+    # all-above-1 but sub-margin: a feeble keep rides the weakest ratio
+    subm = db.analyze([
+        {"device": "tpu",
+         "deep_window_ab": {"512": {"inc_vs_best_sort_speedup": 1.03}}}
+    ])
+    thr = subm["recommendations"]["median_backend.tpu.window_threshold"]
+    assert thr["flip"] is False and thr["value"] == 1.03
+
+
+def test_bench_smoke_ingest():
+    """`bench.py --smoke-ingest` — the tier-1 regression gate for the
+    fused ingest path (config-9 A/B at seconds-scale CPU geometry): it
+    must run anywhere without a device link and emit a well-formed
+    artifact in which both ingest backends produced the same revolution
+    count.  This pins the harness and the seam's liveness, not the
+    speedup numbers — a 1.5-core CI container's timing is weather, and
+    the bit-exactness contract lives in tests/test_fused_ingest.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-ingest"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "fused_ingest_bytes_to_output_scans_per_sec"
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # both seams consumed identical bytes: identical revolution counts,
+    # and every revolution actually flowed bytes -> filter output
+    assert out["fused_revolutions"] == out["host_revolutions"] > 0
+    assert out["value"] > 0 and out["host_scans_per_sec"] > 0
+    # the overhead decomposition must be present and sane (the calibrated
+    # shared chain step can't be free, and overheads can't be negative)
+    assert out["chain_step_ms_per_rev"] > 0
+    assert out["host_ingest_overhead_ms_per_rev"] >= 0
+    assert out["fused_ingest_overhead_ms_per_rev"] >= 0
+    assert out["ingest_overhead_speedup"] > 0

@@ -1,0 +1,510 @@
+"""Fused device-resident ingest vs the host golden path — parity suite.
+
+The fused backend (ops/ingest.fused_ingest_step + driver/ingest.FusedIngest)
+replaces BatchScanDecoder -> ScanAssembler -> ScanFilterChain.process_raw
+with ONE compiled program per frame batch.  This suite pins the contract
+that makes it shippable: **bit-exact** node buffers and filter outputs
+against the host path on identical wire streams, across
+
+  * all six measurement wire formats,
+  * corrupt / resync streams (checksum + CRC faults),
+  * the revolution-overflow cap (head-keep truncation),
+  * carry continuity across arbitrary chunk boundaries (prev-frame,
+    sync-edge, smoothing carries as device scalars),
+  * max_revs batch overflow (the assembler's newest-wins drop),
+  * answer-type switches (decode state resets, filter window survives),
+  * the node/FSM end-to-end seam (``ingest_backend=fused``).
+
+Timestamps ride as f32 epoch offsets on the fused path (the host path is
+f64), so ts0/duration are compared to tolerance, not bit-exactly; node
+values and filter outputs ARE exact.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES
+from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+from rplidar_ros2_driver_tpu.driver.ingest import FusedIngest
+from rplidar_ros2_driver_tpu.filters.chain import (
+    ScanFilterChain,
+    resolve_ingest_backend,
+)
+from rplidar_ros2_driver_tpu.protocol import crc as crcmod
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+from rplidar_ros2_driver_tpu.protocol.timing import SAMPLES_PER_FRAME
+
+from test_live_decode import _make_stream, _rng
+
+ALL_FORMATS = sorted(SAMPLES_PER_FRAME, key=int)
+# paired capsule formats: checksum faults isolate to adjacent pairs
+CAPSULE_FORMATS = [
+    Ans.MEASUREMENT_CAPSULED,
+    Ans.MEASUREMENT_CAPSULED_ULTRA,
+    Ans.MEASUREMENT_DENSE_CAPSULED,
+    Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED,
+]
+# small chain geometry: the parity question is bit-exactness, not scale
+BEAMS = 256
+TS_TOL = 1e-4  # f32 epoch offsets vs the host's f64 (see module docstring)
+
+
+def _params(**over):
+    base = dict(
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=32,
+    )
+    base.update(over)
+    return DriverParams(**base)
+
+
+def _clamped_host_nodes(scan: dict) -> np.ndarray:
+    """The wire clamps (ops/filters._pack_compact_rows) applied to a host
+    assembler scan dict — what the filter step actually sees, and what the
+    fused path applies pre-scatter (ops/ingest._wire_clamp)."""
+    a = np.asarray(scan["angle_q14"]).astype(np.uint32) & 0xFFFF
+    d = np.minimum(
+        np.asarray(scan["dist_q2"], np.int64).astype(np.uint32),
+        np.uint32(0x3FFFF),
+    )
+    q = np.asarray(scan["quality"]).astype(np.uint32) & 0xFF
+    f = np.asarray(scan["flag"]).astype(np.uint32) & 0x3F
+    return np.stack([a, d, q, f], axis=1).astype(np.int32)
+
+
+def _feed_both(ans, frames, host_sinks, fused, chunk_rng, t0=100.0):
+    """Feed identical (frame, rx_ts) batches to the host decoder(s) and the
+    fused engine, in random chunk sizes (1..4 — the fused bucket)."""
+    t = t0
+    i = 0
+    while i < len(frames):
+        k = int(chunk_rng.integers(1, 5))
+        batch = []
+        for f in frames[i : i + k]:
+            t += 0.002
+            batch.append((f, t))
+        for sink in host_sinks:
+            sink.on_measurement_batch(int(ans), list(batch))
+        fused.on_measurement_batch(int(ans), list(batch))
+        i += k
+    return t
+
+
+def _run_host(ans, frames, chunk_seed=5, max_nodes=None, t0=100.0):
+    """Host golden: decoder + assembler tap; returns completed scan dicts."""
+    completed = []
+    asm = ScanAssembler(
+        max_nodes=max_nodes or MAX_SCAN_NODES,
+        on_complete=lambda s: completed.append(dict(s)),
+    )
+    dec = BatchScanDecoder(asm)
+    _feed_both(ans, frames, [dec], _NullSink(), np.random.default_rng(chunk_seed), t0)
+    return completed
+
+
+class _NullSink:
+    def on_measurement_batch(self, ans_type, items):
+        pass
+
+
+def _run_pair(ans, frames, *, chunk_seed=5, max_revs=6, max_nodes=None,
+              params=None, with_chain=True, t0=100.0):
+    """Feed one stream through BOTH backends; returns
+    (host scan dicts, host chain outputs, fused (out, ts0, dur) list, fused)."""
+    params = params or _params()
+    completed = []
+    asm = ScanAssembler(
+        max_nodes=max_nodes or MAX_SCAN_NODES,
+        on_complete=lambda s: completed.append(dict(s)),
+    )
+    dec = BatchScanDecoder(asm)
+    fused = FusedIngest(
+        params, beams=BEAMS, capacity=max_nodes, max_revs=max_revs,
+        emit_nodes=True, buckets=(4,),
+    )
+    _feed_both(ans, frames, [dec], fused, np.random.default_rng(chunk_seed), t0)
+    fused_outs = fused.flush()
+    host_outs = []
+    if with_chain:
+        chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+        for s in completed:
+            out = chain.process_raw(
+                s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+            )
+            host_outs.append((out, s["ts0"], s["duration"]))
+    return completed, host_outs, fused_outs, fused
+
+
+def _assert_outputs_equal(host_outs, fused_outs):
+    assert len(host_outs) == len(fused_outs)
+    for k, ((ho, hts0, hdur), (fo, fts0, fdur)) in enumerate(
+        zip(host_outs, fused_outs)
+    ):
+        for field in ("ranges", "intensities", "points_xy", "point_mask", "voxel"):
+            h = np.asarray(getattr(ho, field))
+            f = np.asarray(getattr(fo, field))
+            assert np.array_equal(h, f), f"rev {k}: {field} diverged"
+        assert abs(hts0 - fts0) < TS_TOL, (k, hts0, fts0)
+        assert abs(hdur - fdur) < TS_TOL, (k, hdur, fdur)
+
+
+class TestFusedParity:
+    """Bit-exact bytes -> filter-output parity on clean streams."""
+
+    @pytest.mark.parametrize("ans", ALL_FORMATS)
+    def test_all_formats_bit_exact(self, ans):
+        frames = _make_stream(ans, 60, _rng(), syncs=(0, 15, 30, 45))
+        completed, host_outs, fused_outs, fused = _run_pair(ans, frames)
+        assert len(completed) >= 2, "stream closed no revolutions — bad fixture"
+        assert fused.revs_dropped == 0 and fused.wires_dropped == 0
+        _assert_outputs_equal(host_outs, fused_outs)
+        assert fused.scans_completed == len(completed)
+
+    @pytest.mark.parametrize("ans", ALL_FORMATS)
+    def test_assembled_node_buffers_match(self, ans):
+        """The segmented-scatter revolution buffers equal the assembler's
+        (after the shared wire clamps), node for node."""
+        frames = _make_stream(ans, 60, _rng(), syncs=(0, 15, 30, 45))
+        params = _params()
+        completed = []
+        asm = ScanAssembler(on_complete=lambda s: completed.append(dict(s)))
+        dec = BatchScanDecoder(asm)
+        fused = FusedIngest(
+            params, beams=BEAMS, max_revs=6, emit_nodes=True, buckets=(4,)
+        )
+        _feed_both(ans, frames, [dec], fused, np.random.default_rng(5))
+        got = []
+        while True:
+            entry = fused._pop()
+            if entry is None:
+                break
+            from rplidar_ros2_driver_tpu.ops.ingest import unpack_ingest_result
+
+            res = unpack_ingest_result(entry[0], entry[1])
+            for k in range(res.n_completed):
+                got.append(
+                    (res.nodes[k][: res.counts[k]],
+                     res.node_ts[k][: res.counts[k]] + entry[2])
+                )
+        assert len(got) == len(completed)
+        for k, (s, (nodes, node_ts)) in enumerate(zip(completed, got)):
+            exp = _clamped_host_nodes(s)
+            assert np.array_equal(exp, nodes), f"rev {k}: node values diverged"
+            assert np.allclose(s["node_ts"], node_ts, atol=TS_TOL)
+
+
+class TestCorruptAndResync:
+    @pytest.mark.parametrize("ans", CAPSULE_FORMATS)
+    def test_checksum_faults_stay_bit_exact(self, ans):
+        frames = _make_stream(
+            ans, 60, _rng(), syncs=(0,), corrupt=(7, 8, 23, 40)
+        )
+        completed, host_outs, fused_outs, _ = _run_pair(ans, frames)
+        assert len(completed) >= 1
+        _assert_outputs_equal(host_outs, fused_outs)
+
+    def test_hq_crc_faults_stay_bit_exact(self):
+        frames = list(
+            _make_stream(Ans.MEASUREMENT_HQ, 40, _rng(), syncs=(0, 10, 20, 30))
+        )
+        for k in (5, 13):  # flip a payload byte: CRC32 fails on both paths
+            fr = bytearray(frames[k])
+            fr[40] ^= 0xFF
+            frames[k] = bytes(fr)
+        completed, host_outs, fused_outs, _ = _run_pair(
+            Ans.MEASUREMENT_HQ, frames
+        )
+        assert len(completed) >= 2
+        _assert_outputs_equal(host_outs, fused_outs)
+
+    def test_ans_type_switch_resets_stream_keeps_window(self):
+        """A scan-mode change resets decode/assembly state on both paths;
+        the rolling filter window must survive on both."""
+        a1, a2 = Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_HQ
+        f1 = _make_stream(a1, 40, _rng(), syncs=(0,))
+        f2 = _make_stream(a2, 30, _rng(), syncs=(0, 8, 16, 24))
+        params = _params()
+        completed = []
+        asm = ScanAssembler(on_complete=lambda s: completed.append(dict(s)))
+        dec = BatchScanDecoder(asm)
+        fused = FusedIngest(params, beams=BEAMS, max_revs=6, buckets=(4,))
+        chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+        rng = np.random.default_rng(11)
+        t = _feed_both(a1, f1, [dec], fused, rng)
+        # the host decoder resets the assembler on the type change itself
+        _feed_both(a2, f2, [dec], fused, rng, t0=t + 1.0)
+        fused_outs = fused.flush()
+        host_outs = [
+            (chain.process_raw(
+                s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+            ), s["ts0"], s["duration"])
+            for s in completed
+        ]
+        assert len(completed) >= 3  # revolutions from BOTH modes
+        _assert_outputs_equal(host_outs, fused_outs)
+
+    def test_reset_clears_partial_carries_window(self):
+        """reset() (scan stop/start) drops the partial revolution and
+        pending wires but carries the filter window — mirroring the host
+        path, where _begin_streaming resets decoder+assembler while the
+        node's chain object persists."""
+        ans = Ans.MEASUREMENT_DENSE_CAPSULED
+        frames = _make_stream(ans, 60, _rng(), syncs=(0,))
+        params = _params()
+        completed = []
+        asm = ScanAssembler(on_complete=lambda s: completed.append(dict(s)))
+        dec = BatchScanDecoder(asm)
+        fused = FusedIngest(params, beams=BEAMS, max_revs=6, buckets=(4,))
+        chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+        rng = np.random.default_rng(3)
+        t = _feed_both(ans, frames[:30], [dec], fused, rng)
+        fused_outs = fused.flush()
+        # stream restart on both paths
+        fused.reset()
+        dec.reset()
+        asm.reset()
+        _feed_both(ans, frames[30:], [dec], fused, rng, t0=t + 5.0)
+        fused_outs += fused.flush()
+        host_outs = [
+            (chain.process_raw(
+                s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+            ), s["ts0"], s["duration"])
+            for s in completed
+        ]
+        assert len(completed) >= 2
+        _assert_outputs_equal(host_outs, fused_outs)
+
+
+class TestOverflowSemantics:
+    def test_revolution_overflow_cap_head_keep(self):
+        """An oversized revolution truncates head-keep at max_nodes on
+        both paths (the assembler's 8192 cap, scaled down here)."""
+        ans = Ans.MEASUREMENT_DENSE_CAPSULED
+        frames = _make_stream(ans, 60, _rng(), syncs=(0,))
+        cap = 64  # << nodes per revolution in this stream
+        completed, host_outs, fused_outs, fused = _run_pair(
+            ans, frames, max_nodes=cap
+        )
+        assert len(completed) >= 1
+        for s in completed:
+            assert len(s["angle_q14"]) <= cap
+        _assert_outputs_equal(host_outs, fused_outs)
+
+    def test_max_revs_batch_overflow_drops_oldest(self):
+        """More completed revolutions in one dispatch than max_revs: the
+        oldest drop (the assembler's newest-wins double buffer), counted
+        in revs_dropped, and the survivor is the newest."""
+        ans = Ans.MEASUREMENT  # 1 node/frame: syncs land densely in a batch
+        frames = _make_stream(ans, 16, _rng(), syncs=tuple(range(0, 16, 2)))
+        params = _params()
+        fused = FusedIngest(params, beams=BEAMS, max_revs=1, buckets=(4,))
+        # one 4-frame batch holds 2 syncs -> up to 2 completions per dispatch
+        t = 50.0
+        batches = []
+        for i in range(0, len(frames), 4):
+            batch = []
+            for f in frames[i : i + 4]:
+                t += 0.002
+                batch.append((f, t))
+            batches.append(batch)
+        for b in batches:
+            fused.on_measurement_batch(int(ans), b)
+        outs = fused.flush()
+        host_completed = _run_host(ans, frames, t0=50.0)
+        assert fused.revs_dropped > 0
+        assert len(outs) == len(host_completed) - fused.revs_dropped
+        # survivors are the newest of each overflowing dispatch: every
+        # fused ts0 must appear in the host series (no synthesized revs)
+        host_ts0 = np.array([s["ts0"] for s in host_completed])
+        for _, ts0, _ in outs:
+            assert np.min(np.abs(host_ts0 - ts0)) < TS_TOL
+
+
+class TestLongSessionTimestamps:
+    def test_stamps_stay_exact_hours_into_a_session(self):
+        """Per-dispatch re-basing keeps on-device f32 offsets bounded by
+        one revolution's span: ts0/duration must hold TS_TOL with rx
+        stamps 10 hours up the monotonic clock (a single session-epoch
+        anchor drifts to ~4 ms f32 ulp there and fails this)."""
+        ans = Ans.MEASUREMENT_DENSE_CAPSULED
+        frames = _make_stream(ans, 60, _rng(), syncs=(0, 15, 30, 45))
+        completed, host_outs, fused_outs, _ = _run_pair(
+            ans, frames, t0=36_000.0
+        )
+        assert len(completed) >= 2
+        _assert_outputs_equal(host_outs, fused_outs)
+
+
+class TestSlotLoweringParity:
+    @pytest.mark.parametrize("impl", ["cond", "fori"])
+    def test_both_slot_lowerings_bit_exact_vs_host(self, impl):
+        """The per-revolution slot section has two lowerings (cond-gated
+        static unroll vs traced-trip fori_loop; picked per filter-state
+        size on the live path, see ops/ingest._slot_impl_for) — BOTH must
+        be bit-exact against the host golden path."""
+        ans = Ans.MEASUREMENT_DENSE_CAPSULED
+        frames = _make_stream(ans, 60, _rng(), syncs=(0, 15, 30, 45))
+        params = _params()
+        completed = []
+        asm = ScanAssembler(on_complete=lambda s: completed.append(dict(s)))
+        dec = BatchScanDecoder(asm)
+        fused = FusedIngest(
+            params, beams=BEAMS, max_revs=6, buckets=(4,), slot_impl=impl
+        )
+        _feed_both(int(ans), frames, [dec], fused, np.random.default_rng(5))
+        fused_outs = fused.flush()
+        chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+        host_outs = [
+            (chain.process_raw(
+                s["angle_q14"], s["dist_q2"], s["quality"], s["flag"]
+            ), s["ts0"], s["duration"])
+            for s in completed
+        ]
+        assert len(completed) >= 2
+        _assert_outputs_equal(host_outs, fused_outs)
+
+
+class TestCarryContinuity:
+    @pytest.mark.parametrize("ans", CAPSULE_FORMATS)
+    def test_chunk_boundaries_do_not_matter(self, ans):
+        """Two different random chunkings of one stream produce identical
+        filter outputs: the prev-frame / sync-edge / smoothing carries are
+        exact across every dispatch boundary."""
+        frames = _make_stream(ans, 48, _rng(), syncs=(0,))
+        params = _params()
+
+        def run(seed):
+            fused = FusedIngest(params, beams=BEAMS, max_revs=6, buckets=(4,))
+            _feed_both(
+                int(ans), frames, [], fused, np.random.default_rng(seed)
+            )
+            return fused.flush()
+
+        a, b = run(1), run(2)
+        assert len(a) == len(b) and len(a) >= 1
+        for (oa, ta, da), (ob, tb, db) in zip(a, b):
+            assert np.array_equal(np.asarray(oa.ranges), np.asarray(ob.ranges))
+            assert np.array_equal(np.asarray(oa.voxel), np.asarray(ob.voxel))
+            assert abs(ta - tb) < TS_TOL and abs(da - db) < TS_TOL
+
+
+class TestSeamPlumbing:
+    def test_resolve_ingest_backend(self):
+        assert resolve_ingest_backend("auto") == "host"
+        assert resolve_ingest_backend("host") == "host"
+        assert resolve_ingest_backend("fused") == "fused"
+
+    def test_params_validation(self):
+        with pytest.raises(ValueError):
+            DriverParams(ingest_backend="warp").validate()
+        with pytest.raises(ValueError):
+            DriverParams(ingest_backend="fused").validate()  # no filter_chain
+        _params(ingest_backend="fused").validate()
+
+    def test_meta_length_roundtrip(self):
+        from rplidar_ros2_driver_tpu.filters.chain import config_from_params
+        from rplidar_ros2_driver_tpu.ops.filters import wire_output_len
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            ingest_config_for,
+            ingest_meta_len,
+            unpack_ingest_result,
+        )
+        from rplidar_ros2_driver_tpu.protocol.timing import TimingDesc
+
+        fcfg = config_from_params(_params(), BEAMS, platform="cpu")
+        for ans in ALL_FORMATS:
+            for emit in (False, True):
+                icfg = ingest_config_for(
+                    int(ans), TimingDesc(), fcfg, max_nodes=128, max_revs=2,
+                    emit_nodes=emit,
+                )
+                zero = (
+                    np.zeros(ingest_meta_len(icfg), np.float32),
+                    np.zeros((2, wire_output_len(fcfg)), np.float32),
+                    np.zeros((2, 128, 4), np.float32),
+                    np.zeros((2, 128), np.float32),
+                )
+                res = unpack_ingest_result(zero, icfg)
+                assert res.n_completed == 0 and res.outputs == []
+                with pytest.raises(ValueError):
+                    unpack_ingest_result(
+                        (np.zeros(ingest_meta_len(icfg) + 1, np.float32),)
+                        + zero[1:],
+                        icfg,
+                    )
+
+    def test_single_frame_shim(self):
+        ans = Ans.MEASUREMENT_HQ
+        frames = _make_stream(ans, 12, _rng(), syncs=(0, 4, 8))
+        fused = FusedIngest(_params(), beams=BEAMS, max_revs=6, buckets=(4,))
+        for f in frames:
+            fused.on_measurement(int(ans), f)
+        outs = fused.flush()
+        assert len(outs) == 2  # 3 syncs -> 2 closed revolutions
+
+
+class TestFusedNodeE2E:
+    def test_node_publishes_through_fused_seam(self):
+        """ingest_backend=fused end to end: sim device wire frames ->
+        RealLidarDriver pump -> FusedIngest -> FSM -> publisher."""
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import (
+            SimConfig,
+            SimulatedDevice,
+        )
+        from rplidar_ros2_driver_tpu.node.fsm import FsmTimings
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode, launch
+        from rplidar_ros2_driver_tpu.node.publisher import CollectingPublisher
+
+        sim = SimulatedDevice(
+            SimConfig(points_per_rev=3200, frame_rate_hz=800.0)
+        ).start()
+        params = _params(
+            channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+            ingest_backend="fused", scan_mode="DenseBoost",
+        )
+        pub = CollectingPublisher()
+        node = RPlidarNode(
+            params, pub,
+            driver_factory=lambda: RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            ),
+            fsm_timings=FsmTimings.fast(),
+        )
+        try:
+            launch(node)
+            assert node.fused_ingest is not None
+            assert node.chain is None  # the fused engine owns the window
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and pub.scan_count < 3:
+                time.sleep(0.05)
+            assert pub.scan_count >= 3, "fused seam published no scans"
+            msg = pub.scans[-1]
+            assert np.isfinite(msg.ranges).any()
+        finally:
+            node.shutdown()
+            sim.stop()
+
+    def test_dummy_mode_falls_back_to_host(self):
+        """The dummy driver synthesizes scans above the protocol layer:
+        fused must fall back to the host path with the chain in place."""
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+        from rplidar_ros2_driver_tpu.node.publisher import CollectingPublisher
+
+        params = _params(dummy_mode=True, ingest_backend="fused")
+        node = RPlidarNode(params, CollectingPublisher())
+        try:
+            assert node.configure()
+            assert node.fused_ingest is None
+            assert node.chain is not None
+        finally:
+            node.shutdown()
