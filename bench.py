@@ -193,6 +193,7 @@ GRADED = {
     7: ("fused", POINTS, dict(window=WINDOW)),  # offline fused multi-scan replay
     8: ("fleet", POINTS, dict(window=WINDOW)),  # N-stream fused replay on the mesh
     9: ("ingest", POINTS, dict(window=WINDOW)),  # host vs fused ingest A/B
+    10: ("fleet_ingest", POINTS, dict(window=WINDOW)),  # fleet-tick bytes A/B
 }
 
 
@@ -912,6 +913,325 @@ def bench_ingest(smoke: bool = False) -> dict:
     }
 
 
+def bench_fleet_ingest(smoke: bool = False) -> dict:
+    """Config 10 — the FLEET ingest A/B: identical raw DenseBoost wire
+    frames for N streams, one fleet tick per revolution period, through
+    BOTH ``parallel/service.ShardedFilterService.submit_bytes`` backends:
+
+      * host  — per-stream BatchScanDecoder (CPU-pinned unpack) +
+        ScanAssembler here, newest revolution per stream into ONE batched
+        sharded filter dispatch: N decode kernel dispatches + a stacked
+        upload + one step dispatch per tick — O(N) host work/dispatches.
+      * fused — FleetFusedIngest: every stream's bytes staged into one
+        (N, M, frame_bytes) buffer, unpack + segmentation + per-stream
+        filter steps in ONE compiled vmapped dispatch per tick — O(1)
+        dispatches and host->device transfers, independent of N.
+
+    The STRUCTURAL claim is asserted, not inferred: the engines' dispatch
+    /transfer counters must be identical across the two fleet sizes for
+    the fused arm (and grow ~linearly for the host arm), else this bench
+    raises.  Wall-time context comes with the same calibrated
+    decomposition as config 9: a calibration pass times the shared
+    batched filter tick (``submit`` over pre-assembled revolutions — the
+    compute both arms must perform per tick) and subtracts it, leaving
+    per-arm ingest overhead per tick.  On this CPU rig the shared tick
+    dominates both arms and the wall-time ratio sits near 1 (XLA:CPU
+    per-op dispatch floors + 2x load drift — see the ceiling analysis in
+    the artifact); the wall-time headline needs the on-chip capture
+    queued in scripts/rig_recapture.sh.
+
+    ``smoke`` shrinks geometry to a seconds-scale CPU run — the tier-1
+    regression gate (tests/test_bench_meta.py), same code path, same
+    metric name, ``"smoke": true``.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils.backend import compilation_cache_status
+
+    if smoke:
+        window, beams, grid = 8, 512, 64
+        points_per_rev, revs, capacity = 800, 8, 1024
+        fleets = (2, 4)
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 20, CAPACITY
+        fleets = (2, 8)
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    run = points_per_rev // 40  # frames per tick per stream = 1 revolution
+    frames = _denseboost_wire_frames(revs, points_per_rev)
+
+    def make_ticks(n: int) -> list:
+        """Per-tick, per-stream byte runs at the 800 frames/s device
+        pace (stamps only feed back-dating math; the harness paces)."""
+        ticks = []
+        t = [1000.0 + 7.0 * s for s in range(n)]
+        for i in range(0, len(frames), run):
+            tick = []
+            for s in range(n):
+                batch = []
+                for f in frames[i : i + run]:
+                    t[s] += 1.25e-3
+                    batch.append((f, t[s]))
+                tick.append((ans, batch))
+            ticks.append(tick)
+        return ticks
+
+    params_host = DriverParams(
+        filter_chain=("clip", "median", "voxel"), filter_window=window,
+        voxel_grid_size=grid, voxel_cell_m=0.25,
+        fleet_ingest_backend="host",
+    )
+    params_fused = DriverParams(
+        filter_chain=("clip", "median", "voxel"), filter_window=window,
+        voxel_grid_size=grid, voxel_cell_m=0.25,
+        fleet_ingest_backend="fused",
+    )
+
+    setup_s = {"host": None, "fused": None}  # first pass per arm = coldest
+
+    def run_host(n: int):
+        t_setup = time.perf_counter()
+        svc = ShardedFilterService(
+            params_host, n, beams=beams, capacity=capacity
+        )
+        svc.precompile()
+        svc._ensure_byte_ingest()
+        decs, _ = svc._host_ingest
+        for d in decs:
+            d.precompile(ans)
+        if setup_s["host"] is None:
+            setup_s["host"] = time.perf_counter() - t_setup
+        ticks = make_ticks(n)
+        outs = 0
+        lat: list[float] = []
+        t0 = time.perf_counter()
+        for tick in ticks:
+            tb = time.perf_counter()
+            res = svc.submit_bytes(tick)
+            outs += sum(r is not None for r in res)
+            lat.append(time.perf_counter() - tb)
+        dt = time.perf_counter() - t0
+        decode_disp = sum(d.kernel_dispatches for d in decs)
+        return {
+            "revs": outs + svc.host_scans_dropped,
+            "published": outs,
+            "dt_s": dt,
+            "lat": lat,
+            # N decode kernel dispatches + 1 batched step per tick
+            "dispatches_per_tick": decode_disp / len(ticks) + 1,
+            # 1 stacked packed upload per tick (the N host decodes also
+            # each materialize through the CPU backend, host-side)
+            "h2d_per_tick": 1.0,
+            "ticks": len(ticks),
+        }
+
+    def run_fused(n: int):
+        t_setup = time.perf_counter()
+        svc = ShardedFilterService(
+            params_fused, n, beams=beams, capacity=capacity,
+            fleet_ingest_buckets=(run,),
+        )
+        svc._ensure_byte_ingest()
+        eng = svc.fleet_ingest
+        eng.precompile([ans])
+        if setup_s["fused"] is None:
+            setup_s["fused"] = time.perf_counter() - t_setup
+        ticks = make_ticks(n)
+        outs = 0
+        lat: list[float] = []
+        d0, h0 = eng.dispatch_count, eng.h2d_transfers
+        t0 = time.perf_counter()
+        for tick in ticks:
+            tb = time.perf_counter()
+            res = svc.submit_bytes(tick, pipelined=True)
+            outs += sum(r is not None for r in res)
+            lat.append(time.perf_counter() - tb)
+        for o in eng.flush():
+            outs += bool(o)
+        dt = time.perf_counter() - t0
+        return {
+            "revs": eng.scans_completed,
+            "published": outs,
+            "dt_s": dt,
+            "lat": lat,
+            "dispatches_per_tick": (eng.dispatch_count - d0) / len(ticks),
+            "h2d_per_tick": (eng.h2d_transfers - h0) / len(ticks),
+            "ticks": len(ticks),
+        }
+
+    def calibrate_tick(n: int) -> float:
+        """Median ms of the shared batched filter tick over the SAME
+        revolutions, pre-assembled (one decode pass outside the timing)
+        — the per-tick compute both ingest backends must perform."""
+        from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+        from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+
+        completed: list[dict] = []
+        asm = ScanAssembler(
+            max_nodes=capacity, on_complete=lambda s: completed.append(dict(s))
+        )
+        dec = BatchScanDecoder(asm)
+        for tick in make_ticks(1):
+            dec.on_measurement_batch(ans, list(tick[0][1]))
+        svc = ShardedFilterService(
+            params_host, n, beams=beams, capacity=capacity
+        )
+        svc.precompile()
+        ts = []
+        for s in completed:
+            t0 = time.perf_counter()
+            svc.submit([s] * n)
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(ts, 50)) * 1e3 if ts else 0.0
+
+    per_fleet: dict = {}
+    for n in fleets:
+        # interleave the arms x2 and keep each arm's best pass plus the
+        # MIN tick calibration: this box's load drifts ~2x across seconds
+        # (docs/BENCHMARKS.md config-9 discipline)
+        host_best = fused_best = None
+        tick_step_ms = float("inf")
+        for _ in range(2):
+            h = run_host(n)
+            if host_best is None or h["dt_s"] < host_best["dt_s"]:
+                host_best = h
+            tick_step_ms = min(tick_step_ms, calibrate_tick(n))
+            f = run_fused(n)
+            if fused_best is None or f["dt_s"] < fused_best["dt_s"]:
+                fused_best = f
+        if host_best["revs"] != fused_best["revs"] or host_best["revs"] == 0:
+            raise RuntimeError(
+                f"fleet-{n} ingest parity broke: host {host_best['revs']} "
+                f"vs fused {fused_best['revs']} revolutions"
+            )
+        ticks_n = host_best["ticks"]
+        host_oh = max(
+            host_best["dt_s"] * 1e3 - ticks_n * tick_step_ms, 0.0
+        ) / ticks_n
+        fused_oh = max(
+            fused_best["dt_s"] * 1e3 - ticks_n * tick_step_ms, 0.0
+        ) / ticks_n
+        _EPS = 0.05  # the config-9 clamp floor, per tick here
+        per_fleet[str(n)] = {
+            "host": {
+                "revolutions": host_best["revs"],
+                "scans_per_sec": round(host_best["revs"] / host_best["dt_s"], 2),
+                "tick_p50_ms": round(
+                    float(np.percentile(host_best["lat"], 50)) * 1e3, 3),
+                "tick_p99_ms": round(
+                    float(np.percentile(host_best["lat"], 99)) * 1e3, 3),
+                "dispatches_per_tick": round(host_best["dispatches_per_tick"], 2),
+                "h2d_per_tick": host_best["h2d_per_tick"],
+            },
+            "fused": {
+                "revolutions": fused_best["revs"],
+                "scans_per_sec": round(
+                    fused_best["revs"] / fused_best["dt_s"], 2),
+                "tick_p50_ms": round(
+                    float(np.percentile(fused_best["lat"], 50)) * 1e3, 3),
+                "tick_p99_ms": round(
+                    float(np.percentile(fused_best["lat"], 99)) * 1e3, 3),
+                "dispatches_per_tick": round(
+                    fused_best["dispatches_per_tick"], 2),
+                "h2d_per_tick": round(fused_best["h2d_per_tick"], 2),
+            },
+            "ticks": ticks_n,
+            "tick_step_ms": round(tick_step_ms, 3),
+            "host_ingest_overhead_ms_per_tick": round(host_oh, 3),
+            "fused_ingest_overhead_ms_per_tick": round(fused_oh, 3),
+            "ingest_overhead_speedup": round(
+                max(host_oh, _EPS) / max(fused_oh, _EPS), 3
+            ),
+            "overhead_clamped": host_oh <= _EPS or fused_oh <= _EPS,
+        }
+
+    # -- the structural O(N) -> O(1) assertion (the acceptance criterion;
+    # a violation is a bug, not weather, so it raises) --
+    small, large = (per_fleet[str(n)] for n in fleets)
+    if small["fused"]["dispatches_per_tick"] != large["fused"]["dispatches_per_tick"]:
+        raise RuntimeError(
+            "fused dispatches/tick grew with fleet size: "
+            f"{small['fused']['dispatches_per_tick']} -> "
+            f"{large['fused']['dispatches_per_tick']}"
+        )
+    if small["fused"]["h2d_per_tick"] != large["fused"]["h2d_per_tick"]:
+        raise RuntimeError(
+            "fused host->device transfers/tick grew with fleet size: "
+            f"{small['fused']['h2d_per_tick']} -> "
+            f"{large['fused']['h2d_per_tick']}"
+        )
+    if large["host"]["dispatches_per_tick"] <= small["host"]["dispatches_per_tick"]:
+        raise RuntimeError(
+            "host dispatches/tick did not grow with fleet size — the A/B "
+            "is not exercising the per-stream decode path"
+        )
+
+    n_big = fleets[-1]
+    big = per_fleet[str(n_big)]
+    big_speedup = big["fused"]["scans_per_sec"] / max(
+        big["host"]["scans_per_sec"], 1e-9
+    )
+    return {
+        "metric": metric_name(10),
+        "value": big["fused"]["scans_per_sec"],
+        "unit": "scans/s",
+        "vs_baseline": round(
+            big["fused"]["scans_per_sec"] / (n_big * BASELINE_SCANS_PER_SEC), 3
+        ),
+        "streams": n_big,
+        "fleets": per_fleet,
+        "structural": {
+            "fused_dispatches_per_tick": big["fused"]["dispatches_per_tick"],
+            "fused_h2d_per_tick": big["fused"]["h2d_per_tick"],
+            "host_dispatches_per_tick_by_fleet": {
+                str(n): per_fleet[str(n)]["host"]["dispatches_per_tick"]
+                for n in fleets
+            },
+            "o1_claim_holds": True,  # asserted above; reaching here proves it
+        },
+        # the decide_backends decision key for the fleet_ingest_backend
+        # auto mapping (TPU records only carry weight there)
+        "fleet_ingest_ab": {
+            "ingest_overhead_speedup": big["ingest_overhead_speedup"],
+            "fused_vs_host_tick_speedup": round(big_speedup, 3),
+            "overhead_clamped": big["overhead_clamped"],
+        },
+        "ceiling_analysis": (
+            "dispatch-count reduction is the structural claim (asserted "
+            "above: fused dispatches/tick constant across fleet sizes, "
+            "host's grow ~N); the wall-time ratio on a linkless CPU rig "
+            "is CEILING-BOUND near 1 because the shared batched filter "
+            "tick (tick_step_ms) dominates both arms and XLA:CPU per-op "
+            "dispatch (~10us/op) floors every program — and the overhead "
+            "ratio can sit BELOW 1 here: both arms' decode compute runs "
+            "on the same host silicon, while the fused arm additionally "
+            "pays the fleet lowering's node-level compaction sort per "
+            "stream, costs a real accelerator absorbs but a CPU rig "
+            "prices at face value.  What the fused path removes — N "
+            "per-stream host decodes + packing + a link round-trip per "
+            "tick — a linkless rig prices at ~zero, so the per-link win "
+            "needs the on-chip capture queued in scripts/rig_recapture.sh"
+        ),
+        # cold-vs-warm restart signal: each arm's FIRST setup+precompile
+        # span this process paid; compare across runs with
+        # compilation_cache.cold to read restart latency (a warm
+        # persistent cache turns these compiles into disk loads)
+        "startup": {
+            "host_setup_precompile_s": round(setup_s["host"], 3),
+            "fused_setup_precompile_s": round(setup_s["fused"], 3),
+            "compilation_cache": compilation_cache_status(),
+        },
+        "points_per_rev": points_per_rev,
+        "frames_per_tick": run,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def _run_chain(cfg: FilterConfig, points: int) -> tuple[float, float]:
     """Sustained scans/s + sync p99 (ms) for one FilterConfig."""
     runner = _ChainRunner(cfg, points)
@@ -1028,6 +1348,7 @@ def metric_name(config: int) -> str:
         7: "fused_replay_scans_per_sec",
         8: "fleet_fused_replay_scans_per_sec",
         9: "fused_ingest_bytes_to_output_scans_per_sec",
+        10: "fleet_fused_ingest_bytes_to_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -1039,6 +1360,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_passthrough(points)
     if kind == "ingest":
         return bench_ingest()
+    if kind == "fleet_ingest":
+        return bench_fleet_ingest()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -1346,7 +1669,8 @@ if __name__ == "__main__":
         help="graded BASELINE config (1=A1M8 passthrough .. 5=64-scan voxel "
         "headline (default), 6=e2e with wire decode, 7=fused offline replay, "
         "8=fleet replay on the mesh, 4 streams per stream-shard, "
-        "9=host-vs-fused ingest A/B, bytes to filter output)",
+        "9=host-vs-fused ingest A/B, bytes to filter output, "
+        "10=fleet-tick host-vs-fused ingest A/B, bytes to N scans)",
     )
     ap.add_argument(
         "--smoke-ingest",
@@ -1354,6 +1678,24 @@ if __name__ == "__main__":
         help="seconds-scale CPU run of the config-9 ingest A/B (small "
         "geometry, forced CPU backend, no tunnel probe) — the tier-1 "
         "regression gate for the fused ingest path",
+    )
+    ap.add_argument(
+        "--smoke-fleet-ingest",
+        action="store_true",
+        help="seconds-scale CPU run of the config-10 fleet ingest A/B "
+        "(small geometry, forced CPU backend, no tunnel probe): asserts "
+        "the O(N)->O(1) per-tick dispatch/transfer counts — the tier-1 "
+        "regression gate for the fleet-fused ingest path",
+    )
+    ap.add_argument(
+        "--xla-cache",
+        nargs="?",
+        const="artifacts/xla_cache",
+        default=None,
+        metavar="DIR",
+        help="enable the JAX persistent compilation cache at DIR (default "
+        "artifacts/xla_cache when the flag is given bare); the artifact's "
+        "startup meta records whether this run found it cold or warm",
     )
     ap.add_argument(
         "--median",
@@ -1371,12 +1713,26 @@ if __name__ == "__main__":
     )
     args = ap.parse_args()
 
+    if args.xla_cache:
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            enable_compilation_cache,
+        )
+
+        enable_compilation_cache(args.xla_cache)
+
     if args.smoke_ingest:
         # CPU-only smoke: win the platform-override race BEFORE any
         # backend initializes (same move as tests/conftest.py) and skip
         # the tunnel probe entirely — this gate must run anywhere
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_ingest(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_fleet_ingest:
+        # same CPU-only discipline as --smoke-ingest: the O(1) structural
+        # gate must run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_fleet_ingest(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

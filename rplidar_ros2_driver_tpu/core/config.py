@@ -117,6 +117,28 @@ class DriverParams:
     # streaming driver (real/sim); it drops the RawNodeHolder interval
     # tap and the chain checkpoint surface.
     ingest_backend: str = "host"
+    # fleet ingest backend seam (parallel/service.py submit_bytes*):
+    # "host" = per-stream host decode (BatchScanDecoder + ScanAssembler,
+    # newest revolution per stream) feeding the one batched sharded
+    # filter dispatch per tick — the golden fleet path; "fused" = the
+    # fleet-fused single-dispatch path (ops/ingest.fleet_fused_ingest_step
+    # via driver/ingest.FleetFusedIngest: every stream's raw frame bytes
+    # staged into ONE buffer, unpack + segmentation + per-stream filter
+    # steps in ONE compiled vmapped program per tick — O(1) dispatches
+    # and transfers per tick, independent of fleet size; bit-exact vs N
+    # independent host paths, tests/test_fleet_fused_ingest.py).  "auto"
+    # resolves per the standing decision procedure
+    # (filters/chain.resolve_fleet_ingest_backend — host until an
+    # on-chip artifact clears the bar; scripts/decide_backends.py flips
+    # it from `fleet_ingest_ab` evidence).
+    fleet_ingest_backend: str = "auto"
+    # persistent XLA compilation cache (utils/backend.
+    # enable_compilation_cache): a directory path enables it (the fused
+    # ingest programs cost seconds of compile per bucket x format set,
+    # paid on every restart; the cache turns warm restarts into disk
+    # loads — bench records cold-vs-warm startup in its meta).  None/""
+    # disables (default: process-lifetime jit cache only).
+    compilation_cache_dir: str | None = None
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -178,6 +200,16 @@ class DriverParams:
                 "ingest_backend='fused' requires filter_chain stages (the "
                 "fused program ends in the filter step; raw passthrough "
                 "has no device-side consumer)"
+            )
+        if self.fleet_ingest_backend not in ("auto", "host", "fused"):
+            raise ValueError(
+                "fleet_ingest_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.fleet_ingest_backend == "fused" and not self.filter_chain:
+            raise ValueError(
+                "fleet_ingest_backend='fused' requires filter_chain stages "
+                "(the fleet-fused program ends in the per-stream filter "
+                "steps; raw passthrough has no device-side consumer)"
             )
 
     @classmethod

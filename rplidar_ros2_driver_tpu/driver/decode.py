@@ -115,9 +115,14 @@ class BatchScanDecoder:
         self._prev: Optional[tuple[bytes, float]] = None
         self._sync_carry = 0
         self._dist_carry = 0
-        # decode statistics (bench/diagnostics)
+        # decode statistics (bench/diagnostics); kernel_dispatches counts
+        # CPU-backend unpack-kernel invocations — the per-stream decode
+        # cost the fleet-fused path collapses, so the fleet ingest A/B
+        # can assert its O(N) -> O(1) claim structurally instead of
+        # inferring it from wall time (bench.py --smoke-fleet-ingest)
         self.frames_decoded = 0
         self.nodes_decoded = 0
+        self.kernel_dispatches = 0
 
     def reset(self) -> None:
         self._active_ans = None
@@ -219,14 +224,11 @@ class BatchScanDecoder:
         arr[:m] = np.frombuffer(b"".join(frames), np.uint8).reshape(m, expect)
         from rplidar_ros2_driver_tpu.ops import unpack
 
+        self.kernel_dispatches += 1
         with _on_cpu():
             if ans_type == Ans.MEASUREMENT_HQ:
                 crc_ok = np.zeros(mb, bool)
-                crc_ok[:m] = [
-                    crcmod.crc32_padded(f[:-4])
-                    == int.from_bytes(f[-4:], "little")
-                    for f in frames
-                ]
+                crc_ok[:m] = [crcmod.frame_crc_ok(f) for f in frames]
                 dec = unpack.unpack_hq_capsules(arr, crc_ok)
             else:
                 dec = unpack.unpack_normal_nodes(arr)
@@ -251,6 +253,7 @@ class BatchScanDecoder:
         arr = np.zeros((mb, expect), np.uint8)
         arr[:n] = np.frombuffer(b"".join(frames), np.uint8).reshape(n, expect)
         kern = self._kernel_for(ans_type)
+        self.kernel_dispatches += 1
         with _on_cpu():
             dec = kern(arr)
         valid = np.asarray(dec.node_valid)[:npairs]

@@ -71,7 +71,13 @@ class FusedIngest:
             config_from_params,
             pick_device,
         )
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            maybe_enable_compilation_cache,
+        )
 
+        maybe_enable_compilation_cache(
+            getattr(params, "compilation_cache_dir", None)
+        )
         self.device = pick_device(params.filter_backend)
         self.cfg = config_from_params(
             params, beams or DEFAULT_BEAMS, platform=self.device.platform
@@ -218,11 +224,7 @@ class FusedIngest:
         aux[:m] = [ts - base for _, ts in chunk]
         if ans_type == Ans.MEASUREMENT_HQ:
             aux[mb : mb + m] = [
-                float(
-                    crcmod.crc32_padded(d[:-4])
-                    == int.from_bytes(d[-4:], "little")
-                )
-                for d, _ in chunk
+                float(crcmod.frame_crc_ok(d)) for d, _ in chunk
             ]
         aux[-2] = 0.0 if self._base is None else self._base - base
         aux[-1] = m
@@ -349,3 +351,477 @@ class FusedIngest:
             if entry is None:
                 return out
             out.extend(self._parse(entry))
+
+
+class FleetFusedIngest:
+    """Fleet-scale producer/consumer engine around
+    ops/ingest.fleet_fused_ingest_step: one staged upload and ONE fused
+    dispatch per fleet tick, whatever the fleet size.
+
+    Each tick the caller hands every stream's newest raw frame bytes
+    (``items[i] = (ans_type, [(payload, rx_monotonic_ts), ...])``, None
+    for an idle stream); the engine stacks them into one zero-padded
+    ``(streams, M, frame_bytes)`` buffer (M picked from the padding
+    ``buckets``), threads per-stream format branches / decode-state reset
+    flags / timestamp re-bases through ``aux``, and dispatches the one
+    vmapped program.  Per-stream decode carries live entirely on the
+    device; the host tracks only each stream's active format and
+    timestamp base.
+
+    Semantics per stream are EXACTLY the single-stream fused engine's
+    (bit-exact against N independent BatchScanDecoder + ScanAssembler +
+    ScanFilterChain paths — tests/test_fleet_fused_ingest.py): a stream
+    advances its rolling filter window only on its own completed
+    revolutions.  This differs from ShardedFilterService.submit's
+    lockstep contract, where an idle stream's window absorbs an
+    all-masked scan; the fleet-fused backend is the scale-out of N
+    independent chains, not of the lockstep tick.
+
+    Structural counters (``dispatch_count``, ``h2d_transfers``) exist so
+    the bench decomposition can assert the O(N) -> O(1) per-tick claim
+    rather than infer it from wall time.
+    """
+
+    def __init__(
+        self,
+        params,
+        streams: int,
+        *,
+        mesh=None,
+        beams: Optional[int] = None,
+        capacity: Optional[int] = None,
+        max_revs: int = 2,
+        max_queue: int = 32,
+        emit_nodes: bool = False,
+        buckets: tuple = _FUSED_BUCKETS,
+        slot_impl: str = "fori",
+    ) -> None:
+        import jax
+
+        from rplidar_ros2_driver_tpu.filters.chain import (
+            DEFAULT_BEAMS,
+            config_from_params,
+            pick_device,
+        )
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            create_fleet_ingest_state,
+            fleet_ingest_config_for,
+        )
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            maybe_enable_compilation_cache,
+        )
+
+        maybe_enable_compilation_cache(
+            getattr(params, "compilation_cache_dir", None)
+        )
+        if streams < 1:
+            raise ValueError("fleet ingest needs at least one stream")
+        self.streams = streams
+        self.mesh = mesh
+        if mesh is not None:
+            platform = mesh.devices.flat[0].platform
+            self.device = None
+        else:
+            self.device = pick_device(params.filter_backend)
+            platform = self.device.platform
+        self.cfg = config_from_params(
+            params, beams or DEFAULT_BEAMS, platform=platform
+        )
+        self.max_nodes = capacity or MAX_SCAN_NODES
+        self.max_revs = max_revs
+        self.emit_nodes = emit_nodes
+        self.slot_impl = slot_impl
+        self._buckets = tuple(sorted(buckets))
+        self._jax = jax
+        self.timing = timingmod.TimingDesc()
+        self.recorder = None
+        self._lock = threading.Lock()
+        # per-stream host trackers (everything else lives on device)
+        self._stream_fmt: list = [None] * streams   # active ans type
+        self._bases: list = [None] * streams        # f64 timestamp base
+        self._reset_next: list = [False] * streams  # decode-state reset flags
+        self._icfg = None                           # active FleetIngestConfig
+        # the carried state's SHAPE is format-independent (prev plane at
+        # the global max payload width), so it is created once here and
+        # survives every format-set recompile untouched
+        self._state = self._place(create_fleet_ingest_state(
+            fleet_ingest_config_for(
+                (Ans.MEASUREMENT,), self.timing, self.cfg,
+                max_nodes=self.max_nodes, max_revs=self.max_revs,
+            ),
+            streams,
+        ))
+        self._pending: deque = deque()
+        self._max_queue = max_queue
+        # structural counters (the bench decomposition's O(1) assertion)
+        self.ticks = 0
+        self.dispatch_count = 0
+        self.h2d_transfers = 0
+        # statistics, host-path parity
+        self.frames_decoded = 0
+        self.nodes_decoded = 0
+        self.scans_completed = 0
+        self.revs_dropped = 0
+        self.wires_dropped = 0
+
+    # -- placement ---------------------------------------------------------
+
+    def _place(self, state):
+        """Put a stream-batched pytree on the mesh (stream axis sharded,
+        everything else replicated per shard) or the single device."""
+        if self.mesh is None:
+            return self._jax.device_put(state, self.device)
+        from rplidar_ros2_driver_tpu.parallel.sharding import (
+            place_fleet_ingest_state,
+        )
+
+        return place_fleet_ingest_state(self.mesh, state)
+
+    # -- configuration -----------------------------------------------------
+
+    def _ensure_cfg(self, formats) -> None:
+        """(Re)build the static config when the needed format set is not
+        covered by the active one.  State is untouched — only the program
+        recompiles (format-set changes are scan-mode events, not per-tick
+        traffic)."""
+        from rplidar_ros2_driver_tpu.ops.ingest import fleet_ingest_config_for
+
+        need = tuple(sorted({int(f) for f in formats if f is not None}))
+        if not need:
+            return
+        if self._icfg is not None and set(need) <= set(self._icfg.formats):
+            return
+        have = set(self._icfg.formats) if self._icfg is not None else set()
+        self._icfg = fleet_ingest_config_for(
+            tuple(sorted(have | set(need))), self.timing, self.cfg,
+            max_nodes=self.max_nodes, max_revs=self.max_revs,
+            emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+        )
+
+    def precompile(self, formats, buckets: Optional[tuple] = None) -> None:
+        """Warm the jit cache for EVERY padding bucket of the given format
+        set on a throwaway state (motor-warmup analog of the single-stream
+        engine's precompile), so first contact with an off-bucket chunk —
+        or the first tick itself — never stalls the live loop on a
+        compile.  Frames/aux stay numpy, matching the live dispatch's arg
+        kinds exactly (a committed-arg warmup compiles a separate
+        executable — see FusedIngest.precompile)."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            create_fleet_ingest_state,
+            fleet_aux_len,
+            fleet_fused_ingest_step,
+        )
+
+        with self._lock:
+            self._ensure_cfg(formats)
+            icfg = self._icfg
+        if icfg is None:
+            return
+        for b in buckets or self._buckets:
+            st = self._place(create_fleet_ingest_state(icfg, self.streams))
+            aux = np.zeros((self.streams, fleet_aux_len(b)), np.float32)
+            aux[:, 2 * b + 1] = 1.0  # m=1: the live-lane trace
+            fleet_fused_ingest_step(
+                st,
+                np.zeros((self.streams, b, icfg.frame_bytes), np.uint8),
+                aux,
+                cfg=icfg,
+            )
+
+    # -- producer side -----------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _normalize_tick(self, items) -> list:
+        """Validate one tick's per-stream byte runs: payload-size filter
+        (the single-stream engine's), recorder tee, format bookkeeping
+        (a per-stream answer-type change resets THAT stream's decode
+        state, filter window carried — host-path semantics)."""
+        if len(items) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} per-stream byte runs, got {len(items)}"
+            )
+        rec = self.recorder
+        runs: list = [None] * self.streams
+        for i, item in enumerate(items):
+            if not item:
+                continue
+            ans, frames = item
+            expect = ANS_PAYLOAD_BYTES.get(ans)
+            if expect is None:
+                continue
+            if rec is not None:
+                for data, ts in frames:
+                    rec.write(ans, data, ts)
+            frames = [it for it in frames if len(it[0]) == expect]
+            if not frames:
+                continue
+            if self._stream_fmt[i] != ans:
+                self._stream_fmt[i] = ans
+                self._reset_next[i] = True
+                self._bases[i] = None
+            runs[i] = (int(ans), frames)
+            self.frames_decoded += len(frames)
+        return runs
+
+    def _dispatch_tick(self, items) -> None:
+        """Stage and dispatch one tick (possibly several lockstep slices
+        when a stream delivered more frames than the largest bucket)."""
+        runs = self._normalize_tick(items)
+        self._ensure_cfg([self._stream_fmt[i] for i in range(self.streams)])
+        if self._icfg is None:
+            return  # nothing ever streamed
+        longest = max((len(r[1]) for r in runs if r), default=0)
+        if longest == 0 and not any(self._reset_next):
+            return  # pure idle tick: nothing to stage, nothing to reset
+        self.ticks += 1
+        cap = self._buckets[-1]
+        off = 0
+        while True:
+            chunk = [
+                (r[0], r[1][off : off + cap]) if r else None for r in runs
+            ]
+            if off and not any(c and c[1] for c in chunk):
+                break
+            self._dispatch_slice(chunk)
+            off += cap
+            if off >= longest:
+                break
+
+    def _dispatch_slice(self, chunk) -> None:
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            fleet_aux_len,
+            fleet_fused_ingest_step,
+        )
+
+        icfg = self._icfg
+        mb = self._bucket(max(
+            (len(c[1]) for c in chunk if c), default=1
+        ))
+        fb = icfg.frame_bytes
+        buf = np.zeros((self.streams, mb, fb), np.uint8)
+        aux = np.zeros((self.streams, fleet_aux_len(mb)), np.float32)
+        for i, c in enumerate(chunk):
+            fmt = self._stream_fmt[i]
+            if fmt is not None:
+                aux[i, 2 * mb + 2] = icfg.formats.index(int(fmt))
+            if self._reset_next[i]:
+                aux[i, 2 * mb + 3] = 1.0
+                self._reset_next[i] = False
+            if not c or not c[1]:
+                continue  # idle this slice: m=0, carries pass through
+            ans, frames = c
+            m = len(frames)
+            ebytes = ANS_PAYLOAD_BYTES[Ans(ans)]
+            base = frames[0][1]
+            buf[i, :m, :ebytes] = np.frombuffer(
+                b"".join(d for d, _ in frames), np.uint8
+            ).reshape(m, ebytes)
+            aux[i, :m] = [ts - base for _, ts in frames]
+            if ans == Ans.MEASUREMENT_HQ:
+                aux[i, mb : mb + m] = [
+                    float(crcmod.frame_crc_ok(d)) for d, _ in frames
+                ]
+            aux[i, 2 * mb] = (
+                0.0 if self._bases[i] is None else self._bases[i] - base
+            )
+            aux[i, 2 * mb + 1] = m
+            self._bases[i] = base
+        # numpy args go straight into the dispatch (the jit stages them on
+        # the donated state's devices) — 2 host->device transfers per
+        # fleet tick slice, independent of fleet size
+        self._state, *res = fleet_fused_ingest_step(
+            self._state, buf, aux, cfg=icfg
+        )
+        self.dispatch_count += 1
+        self.h2d_transfers += 2
+        for arr in res:
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # backend without async D2H: the later fetch blocks
+        self._pending.append((tuple(res), icfg, list(self._bases)))
+        while len(self._pending) > self._max_queue:
+            self._pending.popleft()
+            self.wires_dropped += 1
+
+    # -- consumer side -----------------------------------------------------
+
+    def _parse_entries(self, entries) -> list:
+        """Per-stream accumulated ``(FilterOutput, ts0, duration)`` lists
+        across the given dispatch entries, in dispatch order."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            unpack_fleet_ingest_result,
+        )
+
+        out: list = [[] for _ in range(self.streams)]
+        for arrays, icfg, bases in entries:
+            results = unpack_fleet_ingest_result(arrays, icfg)
+            for i, res in enumerate(results):
+                self.nodes_decoded += res.nodes_appended
+                self.scans_completed += res.n_completed
+                self.revs_dropped += res.revs_dropped
+                base = bases[i]
+                for k in range(res.n_completed):
+                    ts0 = (base or 0.0) + float(res.ts0[k])
+                    dur = max(float(res.end_ts[k]) - float(res.ts0[k]), 0.0)
+                    out[i].append((res.outputs[k], ts0, dur))
+        return out
+
+    def submit(self, items) -> list:
+        """One blocking fleet tick: dispatch this tick's bytes and return
+        every pending revolution, as per-stream lists of
+        ``(FilterOutput, ts0, duration)`` (empty list = no revolution
+        completed for that stream).  Includes revolutions from earlier
+        pipelined ticks still in flight, in dispatch order."""
+        with self._lock:
+            self._dispatch_tick(items)
+            entries = list(self._pending)
+            self._pending.clear()
+            return self._parse_entries(entries)
+
+    def submit_pipelined(self, items) -> list:
+        """Pipelined fleet tick (the ShardedFilterService.submit_pipelined
+        discipline): collect the PREVIOUS ticks' landed wires first — their
+        device->host copies started at their own dispatch time — then
+        dispatch THIS tick's bytes and return the previous outputs.  One
+        tick of declared staleness; the publish never waits on this tick's
+        device compute.  Returns all-empty lists on the first tick;
+        :meth:`flush` drains the last tick when the fleet stops."""
+        with self._lock:
+            entries = list(self._pending)
+            self._pending.clear()
+            out = self._parse_entries(entries)
+            self._dispatch_tick(items)
+            return out
+
+    def flush(self) -> list:
+        """Drain every pending wire (fleet stop): per-stream lists of
+        ``(FilterOutput, ts0, duration)`` in dispatch order."""
+        with self._lock:
+            entries = list(self._pending)
+            self._pending.clear()
+            return self._parse_entries(entries)
+
+    def reset(self) -> None:
+        """Fleet stream-state reset (scan stop/start): every stream's
+        decode/assembly carries reset at the next dispatch, pending wires
+        dropped; the rolling filter windows survive (host-path
+        semantics: _begin_streaming resets decoder+assembler, the chain
+        persists)."""
+        with self._lock:
+            self._pending.clear()
+            self._stream_fmt = [None] * self.streams
+            self._bases = [None] * self.streams
+            self._reset_next = [True] * self.streams
+
+    # -- checkpoint surface ------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Host snapshot of the WHOLE per-stream ingest state — decode
+        carries, partial revolutions, rolling filter windows — plus the
+        host-side trackers (active formats, timestamp bases).  The
+        single-stream engine has no checkpoint surface (its FilterState
+        hides inside the donated program state); the fleet engine is the
+        one that restarts with a fleet attached, so it gets one.
+
+        Keys: ``ingest.*`` / ``filter.*`` device planes (stream-batched
+        numpy), ``formats`` (int32, -1 = never streamed), ``bases``
+        (f64, nan = none).  ``median_sorted`` is derived and excluded
+        (restore recomputes it), like every other snapshot format."""
+        jnp = self._jax.numpy
+        with self._lock:
+            state = self._jax.tree_util.tree_map(jnp.copy, self._state)
+            formats = np.asarray(
+                [-1 if f is None else int(f) for f in self._stream_fmt],
+                np.int32,
+            )
+            bases = np.asarray(
+                [np.nan if b is None else float(b) for b in self._bases],
+                np.float64,
+            )
+        snap = {
+            f"ingest.{k}": np.asarray(v)
+            for k, v in vars(state).items()
+            if k != "filter"
+        }
+        snap.update({
+            f"filter.{k}": np.asarray(v)
+            for k, v in vars(state.filter).items()
+            if v is not None and k != "median_sorted"
+        })
+        snap["formats"] = formats
+        snap["bases"] = bases
+        return snap
+
+    def restore(self, snap: dict) -> bool:
+        """Restore a :meth:`snapshot`.  Stream-count or geometry mismatch
+        is rejected with the current state untouched; pending wires are
+        dropped on success (pre-restore outputs must never publish)."""
+        from rplidar_ros2_driver_tpu.ops.filters import (
+            FilterState,
+            recompute_median_sorted,
+        )
+        from rplidar_ros2_driver_tpu.ops.ingest import IngestState
+
+        try:
+            formats = np.asarray(snap["formats"])
+            bases = np.asarray(snap["bases"])
+            ing = {
+                k[len("ingest."):]: np.asarray(v)
+                for k, v in snap.items() if k.startswith("ingest.")
+            }
+            filt = {
+                k[len("filter."):]: np.asarray(v)
+                for k, v in snap.items() if k.startswith("filter.")
+            }
+        except KeyError:
+            return False
+        if formats.shape != (self.streams,) or ing[
+            "partial"
+        ].shape != (self.streams, self.max_nodes, 4):
+            log.warning(
+                "rejecting incompatible fleet ingest snapshot "
+                "(streams/geometry mismatch)"
+            )
+            return False
+        # the filter planes must match this engine's chain geometry too —
+        # installing a mismatched window/beams/grid would crash (or
+        # silently recompile) the next dispatch AFTER the old state was
+        # already replaced (same pre-validation the chain's restore does)
+        expected_filter = {
+            k: (self.streams, *v)
+            for k, v in FilterState.shapes(
+                self.cfg.window, self.cfg.beams, self.cfg.grid
+            ).items()
+        }
+        got_filter = {k: tuple(v.shape) for k, v in filt.items()}
+        if expected_filter != got_filter:
+            log.warning(
+                "rejecting incompatible fleet ingest snapshot "
+                "(filter geometry %s != %s)", got_filter, expected_filter
+            )
+            return False
+        fstate = FilterState(
+            **filt,
+            median_sorted=(
+                recompute_median_sorted(filt["range_window"])
+                if self.cfg.median_backend.startswith("inc") else None
+            ),
+        )
+        state = self._place(IngestState(filter=fstate, **ing))
+        with self._lock:
+            self._state = state
+            self._stream_fmt = [
+                None if f < 0 else int(f) for f in formats
+            ]
+            self._bases = [
+                None if np.isnan(b) else float(b) for b in bases
+            ]
+            self._reset_next = [False] * self.streams
+            self._pending.clear()
+        return True

@@ -119,6 +119,32 @@ def resolve_ingest_backend(requested: str, platform: Optional[str] = None) -> st
     return "host"
 
 
+def resolve_fleet_ingest_backend(
+    requested: str, platform: Optional[str] = None
+) -> str:
+    """Resolve the ``auto`` FLEET ingest backend (mirrors
+    :func:`resolve_ingest_backend`; explicit requests pass through).
+
+    ``host`` is the golden fleet path: per-stream host decode + newest-
+    revolution stacking ahead of the one batched sharded filter dispatch
+    per tick — N host decodes per tick.  ``fused`` is the fleet-fused
+    single-dispatch path (driver/ingest.FleetFusedIngest): bytes from
+    every stream to N filter outputs in ONE compiled dispatch per tick,
+    O(1) dispatches/transfers independent of fleet size (bit-exact vs N
+    independent host paths, tests/test_fleet_fused_ingest.py; structural
+    counts asserted by ``bench.py --smoke-fleet-ingest``).  ``auto``
+    stays host until an on-chip `fleet_ingest_ab` artifact clears the
+    standing decision bar (docs/BENCHMARKS.md); scripts/decide_backends.py
+    reads that evidence and recommends the flip mechanically — on a
+    linkless CPU rig the shared batched filter tick dominates both arms
+    and the wall-time ratio sits near 1 (artifacts/fleet_ingest_ab_cpu
+    .json), so the CPU artifact can never clear the bar by itself."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "host"
+
+
 def resolve_resample_backend(requested: str, platform: Optional[str] = None) -> str:
     """Resolve the ``auto`` streaming-step resampler per device platform
     (mirrors :func:`resolve_median_backend`; explicit requests pass
@@ -209,6 +235,13 @@ class ScanFilterChain:
         warmup: bool = True,
         capacity: Optional[int] = None,
     ) -> None:
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            maybe_enable_compilation_cache,
+        )
+
+        maybe_enable_compilation_cache(
+            getattr(params, "compilation_cache_dir", None)
+        )
         self.device = _pick_device(params.filter_backend)
         self.cfg = config_from_params(params, beams, platform=self.device.platform)
         self.backend = params.filter_backend

@@ -120,6 +120,15 @@ class RPlidarNode(LifecycleNode):
 
     def on_configure(self) -> bool:
         log.info("%s: configuring (port=%s)", self.name, self.params.serial_port)
+        # persistent-compile-cache flag first, ahead of any engine/chain
+        # construction that compiles hot-path programs: a warm restart of
+        # a lifecycle node should load its programs from disk, not pay
+        # seconds of XLA compile while the device streams into a dead pump
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            maybe_enable_compilation_cache,
+        )
+
+        maybe_enable_compilation_cache(self.params.compilation_cache_dir)
         if self._driver_factory is None and not self.params.dummy_mode:
             # probe the native I/O library here, not inside the scan thread:
             # when it cannot be built/loaded the driver falls back to the
@@ -141,6 +150,17 @@ class RPlidarNode(LifecycleNode):
 
             def factory():  # noqa: F811 - deliberate seam wrapper
                 drv = base_factory()
+                if not hasattr(drv, "set_ingest_sink"):
+                    # a custom factory handed us a driver without the
+                    # ingest seam: surface ONE clear configuration error
+                    # instead of an AttributeError crash-looping the FSM
+                    # through RESETTING on every driver recreation
+                    raise RuntimeError(
+                        "ingest_backend='fused' requires a driver with "
+                        "set_ingest_sink (wire-streaming RealLidarDriver); "
+                        f"{type(drv).__name__} has none — use "
+                        "ingest_backend='host' with this driver factory"
+                    )
                 # re-attach the one engine (and its rolling filter
                 # window) to every recreated driver, like the chain
                 # survives FSM resets on the host path

@@ -60,7 +60,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -265,7 +265,13 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
 def _decode(cfg: IngestConfig, state: IngestState, frames, crc_ok):
     """Dispatch to the right ops/unpack.py kernel, prev frame prepended for
     the paired formats and the edge/smoothing carries threaded as traced
-    device scalars (driver/decode.py threads the same carries as host ints)."""
+    device scalars (driver/decode.py threads the same carries as host ints).
+
+    LOCKSTEP NOTE: the fleet lowering's :func:`_fleet_branch` carries this
+    same decode+carry logic at fleet input geometry (guarded for m==0
+    lanes, padded to the common sample width) — a semantic change here
+    must land there too; both are pinned bit-exact against the host
+    golden path by their parity suites."""
     from rplidar_ros2_driver_tpu.ops import unpack
 
     at = cfg.ans_type
@@ -320,96 +326,44 @@ def _wire_clamp(angle, dist, quality, flag):
     return angle, dist, quality, flag
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
-def fused_ingest_step(
-    state: IngestState, frames: jax.Array, aux: jax.Array, cfg: IngestConfig
-) -> tuple:
-    """One frame batch through unpack -> segment -> filter, in one program.
+class _CoreResult(NamedTuple):
+    """What the shared segmentation/filter tail hands back to its caller
+    (the single-stream step or one fleet lane): the advanced stream-state
+    planes, the per-dispatch counters, and the result arrays."""
 
-    ``frames`` is (M, frame_bytes) uint8, zero-padded past the live count;
-    ``aux`` is (2M+2,) float32: per-frame rx offsets from THIS batch's
-    base stamp, per-frame CRC verdicts (HQ only; CRC32 runs on the host
-    like the host path), the previous base minus this base (the re-base
-    shift applied to the carried partial's offsets), and the live frame
-    count in the last slot.  Returns
-    ``(state, meta, out_wires[, nodes, node_ts])`` — see the result-layout
-    note above.
+    filter: object            # advanced FilterState
+    partial: jax.Array
+    partial_ts: jax.Array
+    partial_len: jax.Array
+    seen_sync: jax.Array
+    n_completed: jax.Array
+    drop_head: jax.Array
+    meta: jax.Array
+    out_wires: jax.Array
+    nodes: Optional[jax.Array]
+    node_ts: Optional[jax.Array]
+
+
+def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResult:
+    """The shared tail of the fused ingest step: append the compacted
+    node stream to the carried partial revolution, segment at the sync
+    bits, and run the donated per-revolution filter slots.
+
+    ``batch4``/``ts_c`` are the validity-compacted (n, 4)/(n,) node
+    stream (valid nodes first, original order preserved — the callers'
+    stable sorts guarantee it) and ``nv`` the live node count.  Both the
+    single-stream step (row-compacted — validity is row-uniform in every
+    wire format) and the fleet lowering (node-compacted — the fleet's
+    common sample width pads narrower formats with dead columns) reduce
+    to this one formulation, so bytes->revolution bit-exactness against
+    the host assembler is pinned in exactly one place.
+
+    ``cfg`` needs only the shared fields (max_nodes/max_revs/filter/
+    slot_impl/emit_nodes): IngestConfig and FleetIngestConfig both
+    satisfy it.
     """
-    mb = frames.shape[0]
-    rx = aux[:mb]
-    crc_ok = aux[mb : 2 * mb] > 0.5
-    base_shift = aux[-2]
-    m = aux[-1].astype(jnp.int32)
-
-    dec = _decode(cfg, state, frames, crc_ok)
-    npts = cfg.npts
     mn = cfg.max_nodes
-    rows = jnp.arange(mb, dtype=jnp.int32)
-    if cfg.paired:
-        # pair i = (fr[i], fr[i+1]) with the prev frame at fr[0]: a zeroed
-        # prev fails the checksum, but the explicit mask also covers it
-        row_live = (rows < m) & (state.have_prev | (rows > 0))
-    else:
-        row_live = rows < m
-
-    angle = jnp.asarray(dec.angle_q14)[:mb]
-    dist = jnp.asarray(dec.dist_q2)[:mb]
-    quality = jnp.asarray(dec.quality)[:mb]
-    flag = jnp.asarray(dec.flag)[:mb]
-    # frame validity is row-uniform in every wire format (checksum / CRC /
-    # sync-nibble verdicts apply to whole frames) — the row mask is the
-    # whole story, which is what makes row-level compaction exact
-    valid_row = jnp.asarray(dec.node_valid)[:mb, 0] & row_live
-
-    # -- carries for the next batch (driver/decode.py:249-258 semantics) --
-    new_sync_carry = state.sync_carry
-    new_dist_carry = state.dist_carry
-    if cfg.ans_type in (
-        Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED
-    ):
-        last_row_flag = jax.lax.dynamic_index_in_dim(
-            flag, jnp.maximum(m - 1, 0), 0, keepdims=False
-        )
-        new_sync_carry = jnp.where(
-            m > 0, last_row_flag[-1] & 1, state.sync_carry
-        )
-    if cfg.ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
-        d_flat = dist.reshape(-1)
-        v_flat = jnp.repeat(valid_row, npts)
-        vidx = jnp.where(v_flat, jnp.arange(d_flat.shape[0]), -1)
-        li = jnp.max(vidx)
-        new_dist_carry = jnp.where(
-            li >= 0, d_flat[jnp.maximum(li, 0)], state.dist_carry
-        )
-    if cfg.paired:
-        new_prev = jax.lax.dynamic_index_in_dim(
-            frames, jnp.maximum(m - 1, 0), 0, keepdims=False
-        )
-        new_have_prev = state.have_prev | (m > 0)
-    else:
-        new_prev = state.prev_frame
-        new_have_prev = state.have_prev
-
-    # -- per-node timestamps (protocol/timing.frame_sample_times, f32) --
-    first = rx - jnp.float32(cfg.delay0_us * 1e-6)
-    step = jnp.float32(cfg.sample_duration_us * 1e-6 if cfg.grouped else 0.0)
-    ts2 = first[:, None] + step * jnp.arange(npts, dtype=jnp.float32)[None, :]
-
-    angle, dist, quality, flag = _wire_clamp(angle, dist, quality, flag)
-
-    # -- validity compaction: stable row sort, valid frames first --
-    # (NO element-wise scatter anywhere below: XLA lowers scatters to a
-    # µs-per-element loop on CPU, which at production batch sizes cost
-    # more than the whole filter step)
-    order = jnp.argsort(jnp.logical_not(valid_row), stable=True)
-    nvr = jnp.sum(valid_row.astype(jnp.int32))
-    n = mb * npts
-    nv = nvr * npts
-    batch4 = jnp.stack(
-        [angle[order], dist[order], quality[order], flag[order]], axis=-1
-    ).reshape(n, 4)
-    ts_c = ts2[order].reshape(n)
-    flag_c = batch4[:, 3]
+    n = batch4.shape[0]
 
     # -- append to the carried partial: one contiguous stream buffer,
     # allocated ONCE at (2*mn + n): [0, mn) the carried partial zone, the
@@ -430,6 +384,7 @@ def fused_ingest_step(
     )
     fullts = jax.lax.dynamic_update_slice(fullts, ts_c, (state.partial_len,))
     total = state.partial_len + nv  # live stream length in full4/fullts
+    flag_c = batch4[:, 3]
 
     # -- revolution segmentation: sync-bit cumsum + searchsorted starts --
     j = jnp.arange(n, dtype=jnp.int32)
@@ -525,6 +480,9 @@ def fused_ingest_step(
         # small filter state: per-slot lax.cond — only the taken branch
         # executes, the pass-through copy of the small state is cheap,
         # and a live slot runs the step inline with a static slot index
+        # (NOTE: under vmap — the fleet lowering — a batched predicate
+        # lowers to select-of-both-branches, so the fleet default is
+        # "fori"; cond stays available for parity pinning)
         fstate = state.filter
         wire_rows = []
         for r in range(cfg.max_revs):
@@ -580,32 +538,524 @@ def fused_ingest_step(
         end_ts,
     ])
 
-    new_state = IngestState(
+    nodes_arr = ts_arr = None
+    if cfg.emit_nodes:
+        # debug/parity surface: the assembled node buffers per completed
+        # slot (static unroll — max_revs slices of the stream buffer)
+        node_rows, ts_rows = [], []
+        for r in range(cfg.max_revs):
+            nodes_r, nts_r, _ = _slot_nodes(seg_start[r], counts[r])
+            node_rows.append(nodes_r)
+            ts_rows.append(nts_r)
+        nodes_arr = jnp.stack(node_rows).astype(jnp.float32)
+        ts_arr = jnp.stack(ts_rows)
+
+    return _CoreResult(
         filter=fstate,
         partial=new_partial,
         partial_ts=new_partial_ts,
         partial_len=cnt_p,
         seen_sync=seen | (syncs > 0),
+        n_completed=n_completed,
+        drop_head=drop_head,
+        meta=meta,
+        out_wires=out_wires,
+        nodes=nodes_arr,
+        node_ts=ts_arr,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fused_ingest_step(
+    state: IngestState, frames: jax.Array, aux: jax.Array, cfg: IngestConfig
+) -> tuple:
+    """One frame batch through unpack -> segment -> filter, in one program.
+
+    ``frames`` is (M, frame_bytes) uint8, zero-padded past the live count;
+    ``aux`` is (2M+2,) float32: per-frame rx offsets from THIS batch's
+    base stamp, per-frame CRC verdicts (HQ only; CRC32 runs on the host
+    like the host path), the previous base minus this base (the re-base
+    shift applied to the carried partial's offsets), and the live frame
+    count in the last slot.  Returns
+    ``(state, meta, out_wires[, nodes, node_ts])`` — see the result-layout
+    note above.
+    """
+    mb = frames.shape[0]
+    rx = aux[:mb]
+    crc_ok = aux[mb : 2 * mb] > 0.5
+    base_shift = aux[-2]
+    m = aux[-1].astype(jnp.int32)
+
+    dec = _decode(cfg, state, frames, crc_ok)
+    npts = cfg.npts
+    rows = jnp.arange(mb, dtype=jnp.int32)
+    if cfg.paired:
+        # pair i = (fr[i], fr[i+1]) with the prev frame at fr[0]: a zeroed
+        # prev fails the checksum, but the explicit mask also covers it
+        row_live = (rows < m) & (state.have_prev | (rows > 0))
+    else:
+        row_live = rows < m
+
+    angle = jnp.asarray(dec.angle_q14)[:mb]
+    dist = jnp.asarray(dec.dist_q2)[:mb]
+    quality = jnp.asarray(dec.quality)[:mb]
+    flag = jnp.asarray(dec.flag)[:mb]
+    # frame validity is row-uniform in every wire format (checksum / CRC /
+    # sync-nibble verdicts apply to whole frames) — the row mask is the
+    # whole story, which is what makes row-level compaction exact
+    valid_row = jnp.asarray(dec.node_valid)[:mb, 0] & row_live
+
+    # -- carries for the next batch (driver/decode.py:249-258 semantics) --
+    new_sync_carry = state.sync_carry
+    new_dist_carry = state.dist_carry
+    if cfg.ans_type in (
+        Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED
+    ):
+        last_row_flag = jax.lax.dynamic_index_in_dim(
+            flag, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        new_sync_carry = jnp.where(
+            m > 0, last_row_flag[-1] & 1, state.sync_carry
+        )
+    if cfg.ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+        d_flat = dist.reshape(-1)
+        v_flat = jnp.repeat(valid_row, npts)
+        vidx = jnp.where(v_flat, jnp.arange(d_flat.shape[0]), -1)
+        li = jnp.max(vidx)
+        new_dist_carry = jnp.where(
+            li >= 0, d_flat[jnp.maximum(li, 0)], state.dist_carry
+        )
+    if cfg.paired:
+        new_prev = jax.lax.dynamic_index_in_dim(
+            frames, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        new_have_prev = state.have_prev | (m > 0)
+    else:
+        new_prev = state.prev_frame
+        new_have_prev = state.have_prev
+
+    # -- per-node timestamps (protocol/timing.frame_sample_times, f32) --
+    first = rx - jnp.float32(cfg.delay0_us * 1e-6)
+    step = jnp.float32(cfg.sample_duration_us * 1e-6 if cfg.grouped else 0.0)
+    ts2 = first[:, None] + step * jnp.arange(npts, dtype=jnp.float32)[None, :]
+
+    angle, dist, quality, flag = _wire_clamp(angle, dist, quality, flag)
+
+    # -- validity compaction: stable row sort, valid frames first --
+    # (NO element-wise scatter anywhere below: XLA lowers scatters to a
+    # µs-per-element loop on CPU, which at production batch sizes cost
+    # more than the whole filter step)
+    order = jnp.argsort(jnp.logical_not(valid_row), stable=True)
+    nvr = jnp.sum(valid_row.astype(jnp.int32))
+    n = mb * npts
+    nv = nvr * npts
+    batch4 = jnp.stack(
+        [angle[order], dist[order], quality[order], flag[order]], axis=-1
+    ).reshape(n, 4)
+    ts_c = ts2[order].reshape(n)
+
+    core = _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift)
+    new_state = IngestState(
+        filter=core.filter,
+        partial=core.partial,
+        partial_ts=core.partial_ts,
+        partial_len=core.partial_len,
+        seen_sync=core.seen_sync,
         sync_carry=new_sync_carry,
         dist_carry=new_dist_carry,
         prev_frame=new_prev,
         have_prev=new_have_prev,
-        scans_completed=state.scans_completed + n_completed,
-        revs_dropped=state.revs_dropped + drop_head,
+        scans_completed=state.scans_completed + core.n_completed,
+        revs_dropped=state.revs_dropped + core.drop_head,
     )
     if not cfg.emit_nodes:
-        return new_state, meta, out_wires
-    # debug/parity surface: the assembled node buffers per completed slot
-    # (static unroll — max_revs slices of the contiguous stream buffer)
-    node_rows, ts_rows = [], []
-    for r in range(cfg.max_revs):
-        nodes_r, nts_r, _ = _slot_nodes(seg_start[r], counts[r])
-        node_rows.append(nodes_r)
-        ts_rows.append(nts_r)
-    return (
-        new_state,
-        meta,
-        out_wires,
-        jnp.stack(node_rows).astype(jnp.float32),
-        jnp.stack(ts_rows),
+        return new_state, core.meta, core.out_wires
+    return new_state, core.meta, core.out_wires, core.nodes, core.node_ts
+
+
+# ---------------------------------------------------------------------------
+# fleet-fused lowering: ONE dispatch per fleet tick, bytes in, N scans out
+# ---------------------------------------------------------------------------
+#
+# The fleet service's host path pays N host decodes plus per-stream packing
+# ahead of its one batched filter dispatch per tick.  This lowering stacks
+# every stream's raw frame bytes into one (N, M, frame_bytes) buffer and
+# runs the whole per-stream pipeline — unpack, validity compaction,
+# sync-split revolution segmentation, the donated filter slots — vmapped
+# over the stream axis inside ONE compiled program, with each stream's
+# decode carries (prev frame, sync edge, smoothing, partial revolution,
+# timestamp re-base) threaded as device state exactly like the
+# single-stream step above.  Per-stream answer types ride as device
+# scalars dispatched via ``lax.switch``, so a mixed fleet (or one stream
+# switching scan modes mid-session) shares the one program.
+
+# widest payload over every wire format: the per-stream prev-frame carry
+# plane is allocated at this width so the carried state's SHAPE never
+# depends on which formats a fleet happens to be streaming — a scan-mode
+# change recompiles the program but never re-stages device state
+_FLEET_PREV_BYTES = max(int(v) for v in ANS_PAYLOAD_BYTES.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetIngestConfig:
+    """Static (compile-time) configuration of one fleet-fused program.
+
+    ``formats`` is the tuple of answer types the program can decode; each
+    stream selects its branch per dispatch via a device scalar in ``aux``
+    (``lax.switch``), so per-stream format changes move an index, not the
+    program.  Input geometry (``frame_bytes``/``npts``) is the max over
+    ``formats``: a homogeneous fleet — the common case — compiles exactly
+    its own format's shapes and pays no switch at all (the single-branch
+    fast path in :func:`_fleet_stream_step`).
+    """
+
+    formats: tuple           # ans types, branch order
+    frame_bytes: int         # input row width = max payload over formats
+    npts: int                # common sample width = max over formats
+    sample_duration_us: int
+    delay0_us: tuple         # per-format back-dating of sample 0, formats order
+    max_nodes: int
+    max_revs: int
+    emit_nodes: bool
+    filter: FilterConfig
+    # per-revolution slot lowering: the fleet default is "fori" — under
+    # vmap a lax.cond slot's batched predicate lowers to select, which
+    # executes BOTH branches per stream and inverts the cond lowering's
+    # skip advantage; fori's batched while_loop runs max(n_completed)
+    # iterations across the fleet (1 in steady state).
+    slot_impl: str = "fori"
+
+
+def fleet_ingest_config_for(
+    formats,
+    timing: timingmod.TimingDesc,
+    filter_cfg: FilterConfig,
+    *,
+    max_nodes: int = MAX_SCAN_NODES,
+    max_revs: int = 2,
+    emit_nodes: bool = False,
+    slot_impl: str = "fori",
+) -> FleetIngestConfig:
+    """Build the static config for one (format set, timing desc, chain)."""
+    ats = tuple(Ans(a) for a in dict.fromkeys(formats))
+    if not ats:
+        raise ValueError("fleet ingest needs at least one wire format")
+    return FleetIngestConfig(
+        formats=tuple(int(a) for a in ats),
+        frame_bytes=max(ANS_PAYLOAD_BYTES[a] for a in ats),
+        npts=max(_NPTS[a] for a in ats),
+        sample_duration_us=timing.sample_duration_int_us,
+        delay0_us=tuple(timingmod.sample_delay_us(a, timing, 0) for a in ats),
+        max_nodes=max_nodes,
+        max_revs=max_revs,
+        emit_nodes=emit_nodes,
+        filter=filter_cfg,
+        slot_impl=slot_impl,
     )
+
+
+def create_fleet_ingest_state(
+    cfg: FleetIngestConfig, streams: int, filter_state=None
+) -> IngestState:
+    """Stream-batched :class:`IngestState` — a leading ``(streams,)`` axis
+    on every leaf (same pytree class; the fleet step vmaps over it).
+
+    ``filter_state`` (stream-batched) carries the rolling windows across
+    scan-mode switches, like the single-stream engine; the prev-frame
+    plane is allocated at the global max payload width so this state's
+    shape is independent of the config's format set.
+    """
+    if filter_state is None:
+        per = FilterState.for_config(cfg.filter)
+        filter_state = jax.tree_util.tree_map(
+            lambda x: jnp.tile(x[None], (streams,) + (1,) * x.ndim), per
+        )
+    return IngestState(
+        filter=filter_state,
+        partial=jnp.zeros((streams, cfg.max_nodes, 4), jnp.int32),
+        partial_ts=jnp.zeros((streams, cfg.max_nodes), jnp.float32),
+        partial_len=jnp.zeros((streams,), jnp.int32),
+        seen_sync=jnp.zeros((streams,), bool),
+        sync_carry=jnp.zeros((streams,), jnp.int32),
+        dist_carry=jnp.zeros((streams,), jnp.int32),
+        prev_frame=jnp.zeros((streams, _FLEET_PREV_BYTES), jnp.uint8),
+        have_prev=jnp.zeros((streams,), bool),
+        scans_completed=jnp.zeros((streams,), jnp.int32),
+        revs_dropped=jnp.zeros((streams,), jnp.int32),
+    )
+
+
+def fleet_aux_len(max_frames: int) -> int:
+    """Per-stream aux row length for a ``max_frames`` bucket: rx offsets,
+    CRC verdicts, then [base_shift, m, branch, reset]."""
+    return 2 * max_frames + 4
+
+
+def _reset_stream_decode(state: IngestState, reset) -> IngestState:
+    """Zero one stream's decode/assembly carries (scan-mode change or an
+    engine-level stream reset) while the rolling filter window — and the
+    cumulative stream stats — survive: the device-side analog of the
+    single-stream engine's ``_activate`` building a fresh ingest state
+    around the carried FilterState."""
+    def rz(a):
+        return jnp.where(reset, jnp.zeros_like(a), a)
+
+    return dataclasses.replace(
+        state,
+        partial=rz(state.partial),
+        partial_ts=rz(state.partial_ts),
+        partial_len=rz(state.partial_len),
+        seen_sync=state.seen_sync & ~reset,
+        sync_carry=rz(state.sync_carry),
+        dist_carry=rz(state.dist_carry),
+        prev_frame=rz(state.prev_frame),
+        have_prev=state.have_prev & ~reset,
+    )
+
+
+def _fleet_branch(cfg: FleetIngestConfig, k: int, state, frames, rx, crc_ok, m):
+    """One format's decode+carry step at fleet input geometry: slice the
+    stream's frame rows to this format's payload width, run the exact
+    single-stream decode (prev frame prepended for the paired formats,
+    edge/smoothing carries as traced scalars), back-date per-sample
+    stamps, and pad the per-frame sample planes to the fleet's common
+    width (pad columns are dead: valid=False, stamp 0).  An ``m == 0``
+    lane (idle stream, or a lane executing a non-selected switch branch)
+    passes every carry through unchanged — unlike the single-stream step,
+    which never dispatches empty batches."""
+    from rplidar_ros2_driver_tpu.ops import unpack
+
+    at = Ans(cfg.formats[k])
+    fb = ANS_PAYLOAD_BYTES[at]
+    npts = _NPTS[at]
+    paired = at in _PAIRED
+    mb = frames.shape[0]
+    fr = frames[:, :fb]
+    rows = jnp.arange(mb, dtype=jnp.int32)
+
+    if at == Ans.MEASUREMENT:
+        dec = unpack.unpack_normal_nodes(fr)
+    elif at == Ans.MEASUREMENT_HQ:
+        dec = unpack.unpack_hq_capsules(fr, crc_ok)
+    else:
+        frp = jnp.concatenate([state.prev_frame[None, :fb], fr], axis=0)
+        if at == Ans.MEASUREMENT_CAPSULED:
+            dec = unpack.unpack_capsules(frp)
+        elif at == Ans.MEASUREMENT_CAPSULED_ULTRA:
+            dec = unpack.unpack_ultra_capsules(frp)
+        elif at == Ans.MEASUREMENT_DENSE_CAPSULED:
+            dec = unpack.unpack_dense_capsules(
+                frp, state.sync_carry, sample_duration_us=cfg.sample_duration_us
+            )
+        elif at == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+            dec = unpack.unpack_ultra_dense_capsules(
+                frp, state.sync_carry, state.dist_carry,
+                sample_duration_us=cfg.sample_duration_us,
+            )
+        else:  # pragma: no cover - config_for validates formats
+            raise ValueError(f"unsupported ans type {int(at):#x}")
+
+    if paired:
+        # pair i = (fr[i], fr[i+1]) with the prev frame at fr[0]: a zeroed
+        # prev fails the checksum, but the explicit mask also covers it
+        row_live = (rows < m) & (state.have_prev | (rows > 0))
+    else:
+        row_live = rows < m
+    angle = jnp.asarray(dec.angle_q14)[:mb]
+    dist = jnp.asarray(dec.dist_q2)[:mb]
+    quality = jnp.asarray(dec.quality)[:mb]
+    flag = jnp.asarray(dec.flag)[:mb]
+    valid_row = jnp.asarray(dec.node_valid)[:mb, 0] & row_live
+
+    # -- carries for the next dispatch (single-stream step semantics,
+    # guarded so an empty lane cannot clobber them) --
+    new_sync = state.sync_carry
+    new_dist = state.dist_carry
+    if at in (
+        Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED
+    ):
+        last_row_flag = jax.lax.dynamic_index_in_dim(
+            flag, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        new_sync = jnp.where(m > 0, last_row_flag[-1] & 1, state.sync_carry)
+    if at == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
+        d_flat = dist.reshape(-1)
+        v_flat = jnp.repeat(valid_row, npts)
+        vidx = jnp.where(v_flat, jnp.arange(d_flat.shape[0]), -1)
+        li = jnp.max(vidx)
+        new_dist = jnp.where(
+            li >= 0, d_flat[jnp.maximum(li, 0)], state.dist_carry
+        )
+    if paired:
+        last = jax.lax.dynamic_index_in_dim(
+            frames, jnp.maximum(m - 1, 0), 0, keepdims=False
+        )
+        lastp = jnp.zeros((_FLEET_PREV_BYTES,), jnp.uint8)
+        lastp = jax.lax.dynamic_update_slice(lastp, last, (0,))
+        new_prev = jnp.where(m > 0, lastp, state.prev_frame)
+        new_have = state.have_prev | (m > 0)
+    else:
+        new_prev = state.prev_frame
+        new_have = state.have_prev
+
+    # -- per-node timestamps (protocol/timing.frame_sample_times, f32) --
+    first = rx - jnp.float32(cfg.delay0_us[k] * 1e-6)
+    step = jnp.float32(
+        cfg.sample_duration_us * 1e-6
+        if at in timingmod._GROUPED_FORMATS else 0.0
+    )
+    ts2 = first[:, None] + step * jnp.arange(npts, dtype=jnp.float32)[None, :]
+
+    P = cfg.npts
+    valid2 = valid_row[:, None] & (
+        jnp.arange(P, dtype=jnp.int32)[None, :] < npts
+    )
+
+    def pad(a):
+        if a.shape[1] == P:
+            return a
+        return jnp.pad(a, ((0, 0), (0, P - a.shape[1])))
+
+    return (
+        pad(angle), pad(dist), pad(quality), pad(flag),
+        valid2, pad(ts2),
+        new_sync, new_dist, new_prev, new_have,
+    )
+
+
+def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
+    """One stream's lane of the fleet step (vmapped over the stream axis):
+    branch-dispatched decode, node-level validity compaction, then the
+    shared segmentation/filter core."""
+    mb = frames.shape[0]
+    rx = aux[:mb]
+    crc_ok = aux[mb : 2 * mb] > 0.5
+    base_shift = aux[2 * mb]
+    m = aux[2 * mb + 1].astype(jnp.int32)
+    branch = aux[2 * mb + 2].astype(jnp.int32)
+    reset = aux[2 * mb + 3] > 0.5
+    state = _reset_stream_decode(state, reset)
+
+    if len(cfg.formats) == 1:
+        dec = _fleet_branch(cfg, 0, state, frames, rx, crc_ok, m)
+    else:
+        dec = jax.lax.switch(
+            jnp.clip(branch, 0, len(cfg.formats) - 1),
+            [
+                functools.partial(_fleet_branch, cfg, k)
+                for k in range(len(cfg.formats))
+            ],
+            state, frames, rx, crc_ok, m,
+        )
+    (angle, dist, quality, flag, valid2, ts2,
+     new_sync, new_dist, new_prev, new_have) = dec
+    angle, dist, quality, flag = _wire_clamp(angle, dist, quality, flag)
+
+    # -- node-level validity compaction: frame validity is row-uniform in
+    # every wire format, but at fleet width the narrower formats' padded
+    # sample columns break row uniformity — a stable flat argsort on the
+    # node mask reduces EXACTLY to the single-stream row compaction when
+    # rows are uniform (valid rows in order, each row's nodes contiguous),
+    # so the two paths stay bit-identical through the shared core
+    v = valid2.reshape(-1)
+    order = jnp.argsort(jnp.logical_not(v), stable=True)
+    nv = jnp.sum(v.astype(jnp.int32))
+    batch4 = jnp.stack(
+        [angle, dist, quality, flag], axis=-1
+    ).reshape(-1, 4)[order]
+    ts_c = ts2.reshape(-1)[order]
+
+    core = _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift)
+    new_state = IngestState(
+        filter=core.filter,
+        partial=core.partial,
+        partial_ts=core.partial_ts,
+        partial_len=core.partial_len,
+        seen_sync=core.seen_sync,
+        sync_carry=new_sync,
+        dist_carry=new_dist,
+        prev_frame=new_prev,
+        have_prev=new_have,
+        scans_completed=state.scans_completed + core.n_completed,
+        revs_dropped=state.revs_dropped + core.drop_head,
+    )
+    if not cfg.emit_nodes:
+        return new_state, core.meta, core.out_wires
+    return new_state, core.meta, core.out_wires, core.nodes, core.node_ts
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fleet_fused_ingest_step(
+    state: IngestState, frames: jax.Array, aux: jax.Array,
+    cfg: FleetIngestConfig,
+) -> tuple:
+    """One fleet tick through the whole ingest pipeline in ONE program.
+
+    ``state`` is the stream-batched :func:`create_fleet_ingest_state`
+    pytree (donated); ``frames`` is (streams, M, frame_bytes) uint8 —
+    every stream's raw frame bytes for this tick, zero-padded past each
+    stream's live count and past each narrower format's payload width;
+    ``aux`` is (streams, 2M+4) float32 per :func:`fleet_aux_len`:
+    per-frame rx offsets from the STREAM's own base stamp, per-frame CRC
+    verdicts (HQ only), then [previous-base-minus-base re-base shift,
+    live frame count, format branch index, decode-state reset flag].
+
+    Returns ``(state, meta, out_wires[, nodes, node_ts])`` with a leading
+    stream axis on every result — the single-stream result layout per
+    stream row (see the layout note above) — so a fleet tick is one
+    dispatch and at most one meta fetch + one wire fetch, independent of
+    fleet size.
+    """
+    return jax.vmap(functools.partial(_fleet_stream_step, cfg))(
+        state, frames, aux
+    )
+
+
+def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
+    """Host-side parse of one fleet step's result arrays: one
+    :class:`IngestBatchResult` per stream.  The meta plane (streams x a
+    handful of floats) is always materialized — ONE fetch per tick; the
+    stream-batched wire plane is touched once, and only when at least one
+    stream completed a revolution, so an all-mid-revolution tick costs
+    one tiny fetch regardless of fleet size."""
+    meta = np.asarray(res[0])
+    if meta.ndim != 2 or meta.shape[1] != ingest_meta_len(cfg):
+        raise ValueError(
+            f"fleet ingest meta of shape {meta.shape} does not match cfg "
+            f"(expected (streams, {ingest_meta_len(cfg)}))"
+        )
+    r = cfg.max_revs
+    wires = None
+    if (meta[:, 0] > 0).any():
+        wires = np.asarray(res[1])
+    nodes_all = ts_all = None
+    if cfg.emit_nodes:
+        nodes_all = np.asarray(res[2])
+        ts_all = np.asarray(res[3])
+    out = []
+    for i in range(meta.shape[0]):
+        mrow = meta[i]
+        n = int(mrow[0])
+        off = _META
+        counts = mrow[off : off + r].astype(np.int32)
+        ts0 = mrow[off + r : off + 2 * r].copy()
+        end_ts = mrow[off + 2 * r : off + 3 * r].copy()
+        outputs = [
+            unpack_output_wire(wires[i, k], cfg.filter) for k in range(n)
+        ]
+        out.append(IngestBatchResult(
+            n_completed=n,
+            revs_dropped=int(mrow[1]),
+            syncs=int(mrow[2]),
+            nodes_appended=int(mrow[3]),
+            counts=counts[:n],
+            ts0=ts0[:n],
+            end_ts=end_ts[:n],
+            outputs=outputs,
+            nodes=(
+                nodes_all[i].astype(np.int32)[:n]
+                if nodes_all is not None else None
+            ),
+            node_ts=ts_all[i][:n] if ts_all is not None else None,
+        ))
+    return out

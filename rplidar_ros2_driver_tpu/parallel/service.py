@@ -56,7 +56,15 @@ class ShardedFilterService:
         mesh=None,
         beams: int = DEFAULT_BEAMS,
         capacity: int = MAX_SCAN_NODES,
+        fleet_ingest_buckets: Optional[tuple] = None,
     ) -> None:
+        from rplidar_ros2_driver_tpu.utils.backend import (
+            maybe_enable_compilation_cache,
+        )
+
+        maybe_enable_compilation_cache(
+            getattr(params, "compilation_cache_dir", None)
+        )
         if mesh is None:
             # multi-process topology (coordinator env vars) joins the
             # process group first, so the default mesh spans the GLOBAL
@@ -66,6 +74,7 @@ class ShardedFilterService:
             multihost.initialize()
             mesh = make_mesh()
         self.mesh = mesh
+        self.params = params
         self.cfg = config_from_params(
             params, beams, platform=mesh.devices.flat[0].platform
         )
@@ -103,6 +112,150 @@ class ShardedFilterService:
         # load so a failed tick cannot re-stash pre-restore outputs
         self._pending = None
         self._epoch = 0
+        # raw-bytes tick seam (submit_bytes / submit_bytes_pipelined):
+        # resolved once, engines built lazily on first byte tick
+        from rplidar_ros2_driver_tpu.filters.chain import (
+            resolve_fleet_ingest_backend,
+        )
+
+        self.fleet_ingest_backend = resolve_fleet_ingest_backend(
+            getattr(params, "fleet_ingest_backend", "auto"),
+            mesh.devices.flat[0].platform,
+        )
+        self.fleet_ingest = None        # FleetFusedIngest (fused backend)
+        self._fleet_ingest_buckets = fleet_ingest_buckets
+        self._host_ingest = None        # per-stream (decoder, latest-slot)
+        self.host_scans_dropped = 0     # newest-wins drops on the host path
+
+    def precompile(self) -> None:
+        """Compile the batched tick program now (the fleet analog of
+        ScanFilterChain.precompile) so the first live tick doesn't stall
+        on it.  Zero-count-step + rollback like the chain: on a FRESH
+        state the all-idle tick writes only values the state already
+        holds and the cursor/filled advance is undone; a state that has
+        absorbed scans skips the warmup (the program is compiled by
+        then anyway)."""
+        with self._lock:
+            filled = np.asarray(
+                jax.device_get(self._state.filled)
+            )
+            if filled.any():
+                return
+        packed_np = self._stack([None] * self.streams)
+        packed = jax.device_put(packed_np, self._packed_sharding)
+        with self._lock:
+            self._state, _ = self._step(self._state, packed)
+            self._state = dataclasses.replace(
+                self._state,
+                cursor=self._state.cursor * 0,
+                filled=self._state.filled * 0,
+            )
+
+    # -- raw-bytes ingest seam ----------------------------------------------
+
+    def _ensure_byte_ingest(self):
+        """Build the resolved fleet ingest backend's engine(s) lazily."""
+        if self.fleet_ingest_backend == "fused":
+            if self.fleet_ingest is None:
+                from rplidar_ros2_driver_tpu.driver.ingest import (
+                    FleetFusedIngest,
+                )
+
+                kw = (
+                    {"buckets": self._fleet_ingest_buckets}
+                    if self._fleet_ingest_buckets else {}
+                )
+                self.fleet_ingest = FleetFusedIngest(
+                    self.params, self.streams, mesh=self.mesh,
+                    beams=self.cfg.beams, capacity=self.capacity, **kw,
+                )
+            return
+        if self._host_ingest is None:
+            from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+            from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+
+            latest: list = [None] * self.streams
+            decs = []
+            for i in range(self.streams):
+                def keep(scan, i=i):
+                    if latest[i] is not None:
+                        self.host_scans_dropped += 1
+                    latest[i] = dict(scan)
+
+                decs.append(BatchScanDecoder(ScanAssembler(
+                    max_nodes=self.capacity, on_complete=keep
+                )))
+            self._host_ingest = (decs, latest)
+
+    def _host_decode_tick(self, items) -> list:
+        """The golden fleet byte path: per-stream host decode + assembly,
+        newest completed revolution per stream (the assembler's
+        newest-wins double buffer at tick granularity — older completions
+        within one tick are counted in ``host_scans_dropped``)."""
+        decs, latest = self._host_ingest
+        for i, item in enumerate(items):
+            if not item:
+                continue
+            ans, frames = item
+            decs[i].on_measurement_batch(int(ans), list(frames))
+        scans = []
+        for i in range(self.streams):
+            scans.append(latest[i])
+            latest[i] = None
+        return scans
+
+    def submit_bytes(
+        self, items, *, pipelined: bool = False
+    ) -> list[Optional[FilterOutput]]:
+        """One fleet tick from RAW FRAME BYTES: ``items[i]`` is
+        ``(ans_type, [(payload, rx_monotonic_ts), ...])`` for stream i
+        (None = idle this tick).  Backend per ``fleet_ingest_backend``:
+
+          * host  — per-stream BatchScanDecoder + ScanAssembler here,
+            newest revolution per stream into the one batched
+            :meth:`submit` / :meth:`submit_pipelined` dispatch: N host
+            decodes + a batched upload + one filter dispatch per tick
+            (O(N) host work and dispatches).
+          * fused — driver/ingest.FleetFusedIngest: the whole tick in ONE
+            compiled dispatch, bytes in, N scans out (O(1) dispatches and
+            transfers, independent of fleet size).
+
+        Returns one Optional[FilterOutput] per stream — the NEWEST
+        completed revolution's output this tick (None when none
+        completed).  NOTE the backends' window semantics differ by
+        design: the host path is the service's lockstep tick (an idle
+        stream's window absorbs an all-masked scan), while the fused
+        path is N independent chains (a stream advances only on its own
+        completed revolutions — bit-exact vs N independent host
+        decode+assembly+chain paths, tests/test_fleet_fused_ingest.py).
+        The fused path bypasses this service's checkpoint surface; use
+        ``self.fleet_ingest.snapshot()/restore()``.
+        """
+        if len(items) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} per-stream byte runs, got {len(items)}"
+            )
+        self._ensure_byte_ingest()
+        if self.fleet_ingest_backend == "fused":
+            outs = (
+                self.fleet_ingest.submit_pipelined(items)
+                if pipelined else self.fleet_ingest.submit(items)
+            )
+            return [o[-1][0] if o else None for o in outs]
+        scans = self._host_decode_tick(items)
+        if pipelined:
+            return self.submit_pipelined(scans)
+        if all(s is None for s in scans):
+            # no stream completed a revolution: nothing to advance (the
+            # synchronous byte tick is edge-triggered, unlike submit's
+            # caller-paced lockstep tick)
+            return [None] * self.streams
+        return self.submit(scans)
+
+    def submit_bytes_pipelined(self, items) -> list[Optional[FilterOutput]]:
+        """Pipelined :meth:`submit_bytes` (one tick of declared
+        staleness; the publish never waits on this tick's compute)."""
+        return self.submit_bytes(items, pipelined=True)
 
     # -- ingest -------------------------------------------------------------
 

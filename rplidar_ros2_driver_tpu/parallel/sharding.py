@@ -444,6 +444,22 @@ def abstract_sharded_state(mesh: Mesh, cfg: FilterConfig, streams: int) -> Filte
     })
 
 
+def place_fleet_ingest_state(mesh: Mesh, state):
+    """Place a stream-batched fleet ingest state (ops/ingest.
+    create_fleet_ingest_state via driver/ingest.FleetFusedIngest) on the
+    mesh: the leading stream axis is data-parallel, every other axis
+    replicated per shard.  The fleet-fused program is a vmap over
+    independent per-stream pipelines — no cross-stream collective — so
+    stream sharding is the whole placement story; the beam axis stays
+    whole inside each stream's filter step (the beam-sharded formulation
+    belongs to the lockstep sharded step, not the ingest program)."""
+    def shard(x):
+        spec = P("stream", *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(shard, state)
+
+
 def shard_batch(mesh: Mesh, batch: ScanBatch) -> ScanBatch:
     """Place a stream-batched ScanBatch according to BATCH_SPEC."""
     return jax.device_put(

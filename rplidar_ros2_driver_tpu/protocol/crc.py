@@ -41,3 +41,12 @@ def crc32_padded(data: bytes | np.ndarray) -> int:
     for b in buf:
         crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> np.uint32(8))
     return int(crc ^ np.uint32(0xFFFFFFFF))
+
+
+def frame_crc_ok(payload: bytes) -> bool:
+    """Whole-frame HQ-capsule CRC verdict: the trailing little-endian u32
+    against :func:`crc32_padded` over everything before it.  The ONE
+    implementation of the wire CRC check — the host decoder and both
+    fused ingest engines (single-stream and fleet) call this, so the
+    framing can never drift between the parity-locked paths."""
+    return crc32_padded(payload[:-4]) == int.from_bytes(payload[-4:], "little")

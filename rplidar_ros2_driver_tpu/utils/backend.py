@@ -13,6 +13,86 @@ import subprocess
 import sys
 import time
 
+# ---------------------------------------------------------------------------
+# persistent compilation cache
+# ---------------------------------------------------------------------------
+
+_cache_enabled_dir: str | None = None
+_cache_was_cold: bool = True  # dir empty/missing when the cache was enabled
+
+
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Enable the JAX persistent compilation cache at ``cache_dir``.
+
+    Restart latency: the fused ingest programs cost hundreds of ms to
+    multiple seconds of XLA compile each (one per bucket x format set),
+    paid again on every process start — a fleet gateway restarting after
+    a crash pays it while lidars stream into a dead pump.  The
+    persistent cache turns every warm restart's compiles into disk
+    loads.  Thresholds are zeroed so even the small CPU programs cache
+    (the default 1 s floor would skip most of this framework's
+    programs).
+
+    Idempotent; safe to call after JAX is initialized (the cache is
+    consulted per compile).  Returns whether the cache is enabled —
+    False when this jax build lacks the config knobs (the knob set has
+    moved across versions; a missing threshold knob downgrades the
+    feature, never breaks the caller).
+    """
+    global _cache_enabled_dir, _cache_was_cold
+    import os
+
+    import jax
+
+    try:
+        was_cold = not os.path.isdir(cache_dir) or not os.listdir(cache_dir)
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:  # noqa: BLE001 - feature-gate, never break the caller
+        return False
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 - older jax: keep its defaults
+            pass
+    if _cache_enabled_dir != str(cache_dir):
+        _cache_was_cold = was_cold
+    _cache_enabled_dir = str(cache_dir)
+    return True
+
+
+def maybe_enable_compilation_cache(cache_dir: str | None) -> bool:
+    """Config-flag seam: enable the persistent cache when the parameter
+    (``DriverParams.compilation_cache_dir``) is set, no-op when None/empty.
+    Every engine that compiles hot-path programs calls this at init."""
+    if not cache_dir:
+        return False
+    return enable_compilation_cache(cache_dir)
+
+
+def compilation_cache_status() -> dict:
+    """What the bench meta records beside startup timings: whether the
+    persistent cache is on, where, and whether THIS run found it cold
+    (empty/missing dir at enable time tells warm restarts from first
+    ones when reading cold-vs-warm startup numbers)."""
+    import os
+
+    if _cache_enabled_dir is None:
+        return {"enabled": False}
+    try:
+        entries = len(os.listdir(_cache_enabled_dir))
+    except OSError:
+        entries = 0
+    return {
+        "enabled": True,
+        "dir": _cache_enabled_dir,
+        "entries": entries,
+        "cold": _cache_was_cold,
+    }
+
 
 def _nonpositive_timeout_detail(timeout_s: float) -> str | None:
     """Probe timeouts arrive via env vars (``BENCH_PROBE_TIMEOUT_S``),

@@ -44,7 +44,7 @@ def _records(paths: list[str]):
                     yield rec
 
 
-_DECISION_KEYS = ("median_ab", "deep_window_ab", "derived")
+_DECISION_KEYS = ("median_ab", "deep_window_ab", "derived", "fleet_ingest_ab")
 
 
 def _strength(value: float) -> float:
@@ -167,6 +167,29 @@ def analyze(records: list[dict]) -> dict:
                     "margin": MARGIN,
                     "source": "deep_window_ab",
                 })
+
+        # config 10: the fleet ingest A/B (fleet_ingest_backend mapping)
+        fab = rec.get("fleet_ingest_ab")
+        if isinstance(fab, dict):
+            v = fab.get("ingest_overhead_speedup")
+            if isinstance(v, (int, float)) and not fab.get(
+                "overhead_clamped"
+            ):
+                # a clamped decomposition (one arm below the 50 us/tick
+                # floor) records evidence but must never flip a mapping —
+                # the ratio's magnitude is the clamp's, not the rig's
+                recommend("fleet_ingest_backend.tpu", ratio_entry(
+                    "host", "fused",
+                    "config10 fleet ingest_overhead_speedup",
+                    float(v), "fleet_ingest_ab",
+                ))
+            out["evidence"].setdefault("fleet_ingest_ab", []).append({
+                k: fab[k] for k in (
+                    "ingest_overhead_speedup",
+                    "fused_vs_host_tick_speedup",
+                    "overhead_clamped",
+                ) if k in fab
+            })
 
         # ablation: resample + voxel kernels
         derived = rec.get("derived")

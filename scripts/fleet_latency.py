@@ -19,10 +19,20 @@ whole fleet's filter work in ONE sharded dispatch per tick.
 
     python scripts/fleet_latency.py [--streams 4] [--seconds 10]
                                     [--rate-mult 1.0] [--cpu]
+                                    [--fleet-ingest host|fused]
 
 Prints ONE JSON line (progress to stderr).  All the decode work runs on
 THIS host: on a 1-core box N streams at 1x pace contend for the core,
 so the artifact records host_cpus alongside the keep-up ratio.
+
+``--fleet-ingest fused`` is the A/B arm of the fleet-fused ingest
+backend (driver/ingest.FleetFusedIngest): the drivers' decode sinks are
+replaced with byte taps, and each fixed-period tick submits every
+stream's RAW frame bytes in ONE pipelined fused dispatch — no host
+decode at all.  Publish-tick pairing matches the host arm's ADVICE-r5
+discipline by construction: the fused outputs carry their own back-dated
+revolution end (ts0 + duration), so each publish latency is anchored to
+ITS OWN revolution's measurement end, one tick of declared staleness.
 """
 
 from __future__ import annotations
@@ -39,6 +49,58 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402 - safe pre-init (no device use at import)
 
 
+class _ByteTap:
+    """Decoder-interface byte collector: installed as a driver's ingest
+    sink (RealLidarDriver.set_ingest_sink) so the engine pump delivers
+    raw measurement-frame runs here instead of decoding them.  The tick
+    loop drains per-stream runs and feeds them to the fleet-fused
+    engine — the driver's protocol layer (framing, mode negotiation)
+    still runs; only decode+assembly move into the fused dispatch."""
+
+    def __init__(self) -> None:
+        import threading
+
+        from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+
+        self.timing = timingmod.TimingDesc()
+        self.recorder = None
+        self._lock = threading.Lock()
+        self._runs: list = []
+
+    # -- the decoder interface the driver drives --
+    def on_measurement_batch(self, ans_type: int, items: list) -> None:
+        with self._lock:
+            self._runs.append((int(ans_type), list(items)))
+
+    def on_measurement(self, ans_type: int, payload: bytes) -> None:
+        import time as _t
+
+        self.on_measurement_batch(ans_type, [(payload, _t.monotonic())])
+
+    def reset(self) -> None:
+        with self._lock:
+            self._runs.clear()
+
+    def precompile(self, ans_type: int) -> None:
+        pass  # the fleet engine precompiles; the tap has no kernels
+
+    # -- the tick loop's drain --
+    def drain(self):
+        """One merged (ans_type, frames) run of everything pending, or
+        None.  Mixed-type runs keep only the newest type's frames (a
+        mode switch mid-tick; the older mode's tail is stale)."""
+        with self._lock:
+            runs, self._runs = self._runs, []
+        if not runs:
+            return None
+        ans = runs[-1][0]
+        frames: list = []
+        for a, items in runs:
+            if a == ans:
+                frames.extend(items)
+        return (ans, frames) if frames else None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--streams", type=int, default=4)
@@ -49,6 +111,12 @@ def main() -> int:
     ap.add_argument("--window", type=int, default=None,
                     help="override the headline 64-scan window")
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument("--fleet-ingest", choices=("host", "fused"),
+                    default="host",
+                    help="ingest arm: host (drivers decode, one batched "
+                    "sharded tick — the series default) or fused (byte "
+                    "taps, one fleet-fused dispatch per tick — the A/B "
+                    "arm of fleet_ingest_backend)")
     args = ap.parse_args()
 
     if args.cpu:
@@ -80,6 +148,9 @@ def main() -> int:
         exit_skipping_destructors,
         run_with_deadline,
     )
+
+    if args.fleet_ingest == "fused":
+        return _fused_main(args)
 
     n = args.streams
     window = args.window or bench.WINDOW
@@ -314,6 +385,206 @@ def main() -> int:
         running.clear()
         for t in threads:
             t.join(timeout=2.0)
+        for drv in drvs:
+            try:
+                drv.stop_motor()
+                drv.disconnect()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        for sim in sims:
+            sim.stop()
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def _fused_main(args) -> int:
+    """The ``--fleet-ingest fused`` arm: N SimulatedDevices stream
+    DenseBoost wire frames through their drivers' protocol pumps into
+    per-stream byte taps; a fixed-period tick drains every tap and
+    submits the raw bytes in ONE pipelined fleet-fused dispatch
+    (driver/ingest.FleetFusedIngest.submit_pipelined).  Publish latency
+    anchors on each revolution's own back-dated measurement end
+    (ts0 + duration from the fused result) at collect time — the same
+    per-revolution pairing as the host arm, one tick of declared
+    staleness, with the tick-boundary wait honestly included (the fused
+    arm has no all-live trigger: bytes, not revolutions, arrive)."""
+    import jax
+    import numpy as np
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+    from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+    from rplidar_ros2_driver_tpu.driver.sim_device import (
+        SimConfig,
+        SimulatedDevice,
+    )
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils.backend import (
+        MeasurementWedgedError,
+        exit_skipping_destructors,
+        run_with_deadline,
+    )
+
+    n = args.streams
+    window = args.window or bench.WINDOW
+    period_s = 0.1 / args.rate_mult
+    params = DriverParams(
+        filter_backend="cpu" if args.cpu else "tpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=window,
+        voxel_grid_size=bench.GRID,
+        voxel_cell_m=0.25,
+        fleet_ingest_backend="fused",
+    )
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+    sims = []
+    drvs = []
+    taps = [_ByteTap() for _ in range(n)]
+    result = {}
+    try:
+        # ~80 frames/stream/tick at 1x: one bucket holding a whole tick
+        # keeps the dispatch count at exactly 1 per tick
+        bucket = max(int(800.0 * args.rate_mult * period_s * 1.5), 8)
+        fleet = FleetFusedIngest(
+            params, n, beams=bench.BEAMS, capacity=bench.CAPACITY,
+            buckets=(bucket,),
+        )
+        for i in range(n):
+            sim = SimulatedDevice(SimConfig(
+                points_per_rev=bench.POINTS,
+                frame_rate_hz=800.0 * args.rate_mult,
+            )).start()
+            sims.append(sim)
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1",
+                tcp_port=sim.port, motor_warmup_s=0.0,
+                ingest_sink=taps[i],
+            )
+            assert drv.connect("sim", 0, False)
+            drv.detect_and_init_strategy()
+            assert drv.start_motor("DenseBoost", 600)
+            drvs.append(drv)
+        # the drivers wrote the negotiated timing desc onto their taps;
+        # the fused programs are compiled against it (homogeneous fleet —
+        # one timing desc per config, like the single-stream engine)
+        fleet.timing = taps[0].timing
+        fleet.precompile([ans])
+
+        tick_s: list[float] = []
+        pub_lat_s: list[float] = []
+        published = 0
+        ticks = 0
+        live_in = 0
+        measured_span_s = args.seconds
+
+        def _measured_run() -> None:
+            nonlocal published, ticks, live_in, measured_span_s
+            t_start = time.monotonic()
+            t_end = t_start + args.seconds
+            next_tick = t_start + period_s
+            while time.monotonic() < t_end:
+                now = time.monotonic()
+                if now < next_tick:
+                    time.sleep(min(next_tick - now, period_s))
+                    continue
+                next_tick += period_s
+                items = [tap.drain() for tap in taps]
+                if not any(items):
+                    continue
+                t0 = time.monotonic()
+                outs = fleet.submit_pipelined(items)
+                t1 = time.monotonic()
+                ticks += 1
+                live_in += sum(it is not None for it in items)
+                tick_s.append(t1 - t0)
+                for o in outs:
+                    for _out, ts0, dur in o:
+                        published += 1
+                        # anchor: THIS revolution's back-dated
+                        # measurement end (rx-derived, monotonic clock)
+                        pub_lat_s.append(t1 - (ts0 + dur))
+            measured_span_s = time.monotonic() - t_start
+            for o in fleet.flush():
+                published += len(o)
+
+        deadline_s = float(os.environ.get("BENCH_RUN_DEADLINE_S", 900))
+        try:
+            run_with_deadline(
+                _measured_run, deadline_s,
+                what="fleet-fused latency measurement",
+            )
+        except MeasurementWedgedError as e:
+            print(json.dumps({
+                "metric": "fleet_live_pipelined_tick",
+                "fleet_ingest": "fused",
+                "error": f"{type(e).__name__}: {e}",
+                "ticks_completed": ticks,
+            }), flush=True)
+            exit_skipping_destructors(0)
+
+        if ticks == 0 or published == 0:
+            raise RuntimeError(
+                f"fused fleet produced no output (ticks={ticks}, "
+                f"published={published}) — sim streams broken?"
+            )
+        for drv in drvs:
+            try:
+                drv.stop_motor()
+                drv.disconnect()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        drvs.clear()
+        for sim in sims:
+            sim.stop()
+        sims.clear()
+        rtt_ms = None
+        try:
+            rtt_ms = run_with_deadline(
+                lambda: bench._barrier_rtt_ms(jax.devices()[0]),
+                60.0, what="RTT calibration probe",
+            )
+        except Exception:  # noqa: BLE001 - calibration is context, not data
+            print("RTT calibration probe failed; artifact goes out "
+                  "without it", file=sys.stderr, flush=True)
+        elapsed = measured_span_s
+        pace = 10.0 * args.rate_mult
+        result = {
+            "metric": "fleet_live_pipelined_tick",
+            "fleet_ingest": "fused",
+            "value": round(published / elapsed, 2),
+            "unit": "scans/s",
+            "vs_baseline": round(
+                published / elapsed / (n * bench.BASELINE_SCANS_PER_SEC), 3
+            ),
+            "streams": n,
+            "rate_mult": args.rate_mult,
+            "nominal_seconds": args.seconds,
+            "measured_span_s": round(elapsed, 3),
+            "ticks": ticks,
+            "live_inputs": live_in,
+            "keep_up": round(published / (pace * n * elapsed), 3),
+            "dispatches_per_tick": round(fleet.dispatch_count / ticks, 2),
+            "h2d_per_tick": round(fleet.h2d_transfers / ticks, 2),
+            "tick_p50_ms": round(float(np.percentile(tick_s, 50)) * 1e3, 3),
+            "tick_p99_ms": round(float(np.percentile(tick_s, 99)) * 1e3, 3),
+            "publish_p50_ms": round(
+                float(np.percentile(pub_lat_s, 50)) * 1e3, 3
+            ) if pub_lat_s else None,
+            "publish_p99_ms": round(
+                float(np.percentile(pub_lat_s, 99)) * 1e3, 3
+            ) if pub_lat_s else None,
+            "staleness_ticks": 1,
+            "tick_policy": "fixed_period",
+            **({"barrier_rtt_ms": round(rtt_ms, 3)}
+               if rtt_ms is not None else {}),
+            "points_per_scan": bench.POINTS,
+            "window": window,
+            "median_backend": fleet.cfg.median_backend,
+            "host_cpus": os.cpu_count() or 1,
+            "device": str(jax.devices()[0].platform),
+        }
+    finally:
         for drv in drvs:
             try:
                 drv.stop_motor()

@@ -13,6 +13,9 @@
 #   4. streaming-step ablation (--iters auto: unbiased absolutes,
 #      post-fold clip confirmation, voxel matmul arm)
 #   5. live multi-stream pipelined fleet latency artifact
+#   6. fleet ingest A/B (config 10: host-decode-then-batch vs fleet-fused
+#      per tick — the fleet_ingest_backend decision key)
+#   7. live fleet latency, fleet-fused arm (same publish-tick pairing)
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -56,7 +59,9 @@ if [ $# -eq 0 ]; then
     "python bench.py --config 6" \
     "python scripts/deep_window_ab.py --windows 256 512" \
     "python scripts/step_ablation.py" \
-    "python scripts/fleet_latency.py"
+    "python scripts/fleet_latency.py" \
+    "python bench.py --config 10" \
+    "python scripts/fleet_latency.py --fleet-ingest fused"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass
