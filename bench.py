@@ -194,6 +194,7 @@ GRADED = {
     8: ("fleet", POINTS, dict(window=WINDOW)),  # N-stream fused replay on the mesh
     9: ("ingest", POINTS, dict(window=WINDOW)),  # host vs fused ingest A/B
     10: ("fleet_ingest", POINTS, dict(window=WINDOW)),  # fleet-tick bytes A/B
+    11: ("super_tick", POINTS, dict(window=WINDOW)),  # T-tick super-step drain A/B
 }
 
 
@@ -1232,6 +1233,252 @@ def bench_fleet_ingest(smoke: bool = False) -> dict:
     }
 
 
+def bench_super_tick(smoke: bool = False) -> dict:
+    """Config 11 — the T-tick SUPER-STEP drain A/B: an identical backlog
+    of queued fleet byte ticks (a link stall's worth of DenseBoost wire
+    frames, one revolution per stream per tick) drained through the
+    fleet-fused engine two ways:
+
+      * per_tick — one compiled fleet dispatch per tick
+        (``super_tick_max=1``): T ticks cost T dispatches, each paying
+        the dispatch/staging/fetch round trip.
+      * super — the T-tick super-step lowering
+        (ops/ingest.super_fleet_ingest_step via
+        ``ShardedFilterService.submit_bytes_backlog``): ``lax.scan``
+        threads the whole per-stream state through T ticks inside ONE
+        compiled program — ``ceil(ticks/T)`` dispatches for the same
+        backlog, bit-exact (tests/test_super_tick.py).
+
+    The STRUCTURAL claim is asserted, not inferred: the engines'
+    dispatch/transfer counters must show 1 dispatch (2 staged
+    transfers) per T-tick super-step vs T (2T) for the per-tick arm,
+    and both arms must complete identical revolution counts, else this
+    bench raises.  Wall-time context comes with a calibrated
+    decomposition: ``dispatch_floor_ms`` times idle (zero-payload)
+    per-tick dispatches — the pure dispatch+staging+fetch round trip
+    the super-step amortizes — so the artifact separates the structural
+    (T-1) x floor saving from measured wall-time delta.  On this CPU
+    rig both arms run the same kernels on the same silicon and the
+    floor is ~XLA:CPU dispatch overhead; the per-link win needs the
+    on-chip capture queued in scripts/rig_recapture.sh.
+
+    ``smoke`` shrinks geometry to a seconds-scale CPU run — the tier-1
+    regression gate (tests/test_bench_meta.py), same code path, same
+    metric name, ``"smoke": true``.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+    if smoke:
+        window, beams, grid = 8, 512, 64
+        points_per_rev, revs, capacity = 800, 6, 1024
+        streams, super_t = 2, 4
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 20, CAPACITY
+        streams, super_t = 4, 8
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    run = points_per_rev // 40  # frames per tick per stream = 1 revolution
+    frames = _denseboost_wire_frames(revs, points_per_rev)
+
+    def make_ticks() -> list:
+        ticks = []
+        t = [1000.0 + 7.0 * s for s in range(streams)]
+        for i in range(0, len(frames), run):
+            tick = []
+            for s in range(streams):
+                batch = []
+                for f in frames[i : i + run]:
+                    t[s] += 1.25e-3
+                    batch.append((f, t[s]))
+                tick.append((ans, batch))
+            ticks.append(tick)
+        return ticks
+
+    def make_params(t_max: int) -> DriverParams:
+        return DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused", super_tick_max=t_max,
+        )
+
+    def make_service(t_max: int):
+        svc = ShardedFilterService(
+            make_params(t_max), streams, beams=beams, capacity=capacity,
+            fleet_ingest_buckets=(run,),
+        )
+        svc._ensure_byte_ingest()
+        svc.fleet_ingest.precompile([ans])  # per-tick AND (T, bucket) warm
+        return svc
+
+    def run_per_tick():
+        svc = make_service(1)
+        eng = svc.fleet_ingest
+        ticks = make_ticks()
+        t0 = time.perf_counter()
+        for tick in ticks:
+            svc.submit_bytes(tick, pipelined=True)
+        eng.flush()
+        dt = time.perf_counter() - t0
+        return {
+            "revs": eng.scans_completed, "dt_s": dt,
+            "dispatches": eng.dispatch_count,
+            "h2d": eng.h2d_transfers, "ticks": len(ticks),
+        }
+
+    def run_super():
+        svc = make_service(super_t)
+        eng = svc.fleet_ingest
+        ticks = make_ticks()
+        t0 = time.perf_counter()
+        outs = svc.submit_bytes_backlog(ticks)
+        dt = time.perf_counter() - t0
+        assert sum(len(o) for o in outs) == eng.scans_completed
+        return {
+            "revs": eng.scans_completed, "dt_s": dt,
+            "dispatches": eng.dispatch_count,
+            "h2d": eng.h2d_transfers, "ticks": len(ticks),
+            "super_dispatches": eng.super_dispatches,
+        }
+
+    def calibrate_dispatch_floor(n: int = 12) -> float:
+        """Median ms of an IDLE (zero-payload) per-tick fleet dispatch +
+        its result parse: the pure dispatch/staging/fetch round trip
+        each per-tick dispatch pays and the super-step amortizes."""
+        svc = make_service(1)
+        eng = svc.fleet_ingest
+        # one live tick activates the format/config, outside the timing
+        eng.submit(make_ticks()[0])
+        idle = ([None] * streams, list(eng._stream_fmt), [False] * streams)
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            with eng._lock:
+                eng._dispatch_slice(idle)
+            eng.flush()  # parse forces the meta fetch (the D2H barrier)
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(ts, 50)) * 1e3
+
+    # interleave the arms x2, best-of + MIN floor calibration (this box's
+    # load drifts ~2x across seconds — docs/BENCHMARKS.md discipline)
+    per_tick_best = super_best = None
+    floor_ms = float("inf")
+    for _ in range(2):
+        a = run_per_tick()
+        if per_tick_best is None or a["dt_s"] < per_tick_best["dt_s"]:
+            per_tick_best = a
+        floor_ms = min(floor_ms, calibrate_dispatch_floor())
+        b = run_super()
+        if super_best is None or b["dt_s"] < super_best["dt_s"]:
+            super_best = b
+
+    # -- the structural T -> 1 assertion (the acceptance criterion; a
+    # violation is a bug, not weather, so it raises) --
+    ticks_n = per_tick_best["ticks"]
+    import math
+
+    want_super = math.ceil(ticks_n / super_t)
+    if per_tick_best["dispatches"] != ticks_n:
+        raise RuntimeError(
+            f"per-tick arm dispatched {per_tick_best['dispatches']} times "
+            f"for {ticks_n} ticks (expected one per tick)"
+        )
+    if super_best["dispatches"] != want_super:
+        raise RuntimeError(
+            f"super arm dispatched {super_best['dispatches']} times for "
+            f"{ticks_n} ticks at T={super_t} (expected ceil = {want_super})"
+        )
+    for arm in (per_tick_best, super_best):
+        if arm["h2d"] != 2 * arm["dispatches"]:
+            raise RuntimeError(
+                f"staged transfers {arm['h2d']} != 2 x {arm['dispatches']} "
+                "dispatches"
+            )
+    if per_tick_best["revs"] != super_best["revs"] or super_best["revs"] == 0:
+        raise RuntimeError(
+            f"super-tick parity broke: per-tick {per_tick_best['revs']} vs "
+            f"super {super_best['revs']} revolutions"
+        )
+
+    per_tick_sps = per_tick_best["revs"] / per_tick_best["dt_s"]
+    super_sps = super_best["revs"] / super_best["dt_s"]
+    saved_dispatches = per_tick_best["dispatches"] - super_best["dispatches"]
+    measured_saving_ms = (per_tick_best["dt_s"] - super_best["dt_s"]) * 1e3
+    drain_speedup = per_tick_best["dt_s"] / max(super_best["dt_s"], 1e-9)
+    # clamp like configs 9/10: a negative measured saving on a drifting
+    # CPU rig is weather, and the decision key must say so
+    clamped = measured_saving_ms <= 0
+    return {
+        "metric": metric_name(11),
+        "value": round(super_sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(super_sps / (streams * BASELINE_SCANS_PER_SEC), 3),
+        "streams": streams,
+        "super_tick": super_t,
+        "ticks": ticks_n,
+        "per_tick": {
+            "scans_per_sec": round(per_tick_sps, 2),
+            "dispatches": per_tick_best["dispatches"],
+            "h2d_transfers": per_tick_best["h2d"],
+            "revolutions": per_tick_best["revs"],
+            "drain_ms": round(per_tick_best["dt_s"] * 1e3, 3),
+        },
+        "super": {
+            "scans_per_sec": round(super_sps, 2),
+            "dispatches": super_best["dispatches"],
+            "h2d_transfers": super_best["h2d"],
+            "revolutions": super_best["revs"],
+            "drain_ms": round(super_best["dt_s"] * 1e3, 3),
+        },
+        "structural": {
+            "per_tick_dispatches_per_t_ticks": super_t,
+            "super_dispatches_per_t_ticks": round(
+                super_best["dispatches"] * super_t / ticks_n, 2
+            ),
+            "t_to_1_claim_holds": True,  # asserted above
+        },
+        # the calibrated decomposition: (T-1) x dispatch floor is the
+        # structural per-super-step saving; the measured delta says what
+        # this rig actually returned of it
+        "dispatch_floor_ms": round(floor_ms, 3),
+        "predicted_saving_ms": round(saved_dispatches * floor_ms, 3),
+        "measured_saving_ms": round(measured_saving_ms, 3),
+        # the decide_backends decision key for the super_tick_max auto
+        # recommendation (TPU records only carry weight there)
+        "super_tick_ab": {
+            "drain_speedup": round(drain_speedup, 3),
+            "per_dispatch_floor_ms": round(floor_ms, 3),
+            "overhead_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "dispatch-count reduction is the structural claim (asserted "
+            "above: 1 dispatch per T-tick super-step vs T for the "
+            "per-tick path).  What a linkless CPU rig amortizes is the "
+            "per-dispatch floor itself — XLA:CPU program dispatch, numpy "
+            "staging, and the per-entry meta fetch/parse "
+            "(dispatch_floor_ms; compare predicted_saving_ms = saved "
+            "dispatches x floor against measured_saving_ms — the excess "
+            "is per-tick engine bookkeeping the backlog drain also "
+            "skips).  Both arms run the same scanned tick body on the "
+            "same silicon, so the compute term cancels and the ratio is "
+            "bounded by floor/(floor + tick compute); through a "
+            "remote-attach link every per-tick dispatch instead pays a "
+            "1-18 ms round trip (observed), which multiplies the floor "
+            "and is the cost the super-step removes (T-1)/T of.  The "
+            "on-chip capture queued in scripts/rig_recapture.sh is "
+            "where the headline lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "frames_per_tick": run,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def _run_chain(cfg: FilterConfig, points: int) -> tuple[float, float]:
     """Sustained scans/s + sync p99 (ms) for one FilterConfig."""
     runner = _ChainRunner(cfg, points)
@@ -1349,6 +1596,7 @@ def metric_name(config: int) -> str:
         8: "fleet_fused_replay_scans_per_sec",
         9: "fused_ingest_bytes_to_output_scans_per_sec",
         10: "fleet_fused_ingest_bytes_to_scans_per_sec",
+        11: "super_tick_drain_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -1362,6 +1610,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_ingest()
     if kind == "fleet_ingest":
         return bench_fleet_ingest()
+    if kind == "super_tick":
+        return bench_super_tick()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -1670,7 +1920,9 @@ if __name__ == "__main__":
         "headline (default), 6=e2e with wire decode, 7=fused offline replay, "
         "8=fleet replay on the mesh, 4 streams per stream-shard, "
         "9=host-vs-fused ingest A/B, bytes to filter output, "
-        "10=fleet-tick host-vs-fused ingest A/B, bytes to N scans)",
+        "10=fleet-tick host-vs-fused ingest A/B, bytes to N scans, "
+        "11=T-tick super-step drain A/B, backlog in ceil(T/super) "
+        "dispatches)",
     )
     ap.add_argument(
         "--smoke-ingest",
@@ -1686,6 +1938,14 @@ if __name__ == "__main__":
         "(small geometry, forced CPU backend, no tunnel probe): asserts "
         "the O(N)->O(1) per-tick dispatch/transfer counts — the tier-1 "
         "regression gate for the fleet-fused ingest path",
+    )
+    ap.add_argument(
+        "--smoke-super-tick",
+        action="store_true",
+        help="seconds-scale CPU run of the config-11 super-tick drain A/B "
+        "(small geometry, forced CPU backend, no tunnel probe): asserts "
+        "the T-ticks->1 per-super-step dispatch/transfer counts — the "
+        "tier-1 regression gate for the super-step lowering",
     )
     ap.add_argument(
         "--xla-cache",
@@ -1733,6 +1993,13 @@ if __name__ == "__main__":
         # gate must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_fleet_ingest(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_super_tick:
+        # same CPU-only discipline: the T->1 structural gate must run
+        # anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_super_tick(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -132,6 +132,17 @@ class DriverParams:
     # on-chip artifact clears the bar; scripts/decide_backends.py flips
     # it from `fleet_ingest_ab` evidence).
     fleet_ingest_backend: str = "auto"
+    # T-tick super-step lowering (ops/ingest.super_fleet_ingest_step via
+    # driver/ingest.FleetFusedIngest): when a backlog of fleet ticks is
+    # queued (link stall, slow consumer — submit_backlog /
+    # ShardedFilterService.submit_bytes_backlog) or one tick splits
+    # across bucket slices, up to this many ticks drain in ONE compiled
+    # dispatch instead of T (lax.scan over the fleet tick, carries as
+    # donated scan state — bit-exact vs T sequential ticks,
+    # tests/test_super_tick.py).  1 disables the lowering (per-tick
+    # dispatches only); each (T, bucket) pair costs one extra program
+    # compile, warmed by FleetFusedIngest.precompile.
+    super_tick_max: int = 1
     # persistent XLA compilation cache (utils/backend.
     # enable_compilation_cache): a directory path enables it (the fused
     # ingest programs cost seconds of compile per bucket x format set,
@@ -211,6 +222,8 @@ class DriverParams:
                 "(the fleet-fused program ends in the per-stream filter "
                 "steps; raw passthrough has no device-side consumer)"
             )
+        if self.super_tick_max < 1:
+            raise ValueError("super_tick_max must be >= 1 (1 disables)")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

@@ -380,6 +380,16 @@ class FleetFusedIngest:
     Structural counters (``dispatch_count``, ``h2d_transfers``) exist so
     the bench decomposition can assert the O(N) -> O(1) per-tick claim
     rather than infer it from wall time.
+
+    ``super_tick_max`` (default from ``params.super_tick_max``, 1 =
+    disabled) enables the T-tick super-step lowering
+    (ops/ingest.super_fleet_ingest_step): whenever more than one tick
+    slice is queued — a backlog handed to :meth:`submit_backlog` after a
+    link stall, or one oversized tick split across bucket slices — up to
+    ``super_tick_max`` slices are staged as one (T, N, M, frame_bytes)
+    plane and drained in ONE compiled dispatch instead of T.  Short
+    groups are padded with all-idle tick planes (carries pass through
+    untouched) so each (T, bucket) pair compiles exactly once.
     """
 
     def __init__(
@@ -395,6 +405,7 @@ class FleetFusedIngest:
         emit_nodes: bool = False,
         buckets: tuple = _FUSED_BUCKETS,
         slot_impl: str = "fori",
+        super_tick_max: Optional[int] = None,
     ) -> None:
         import jax
 
@@ -431,11 +442,27 @@ class FleetFusedIngest:
         self.max_revs = max_revs
         self.emit_nodes = emit_nodes
         self.slot_impl = slot_impl
+        if super_tick_max is None:
+            super_tick_max = getattr(params, "super_tick_max", 1)
+        if super_tick_max < 1:
+            raise ValueError("super_tick_max must be >= 1")
+        self.super_tick_max = int(super_tick_max)
         self._buckets = tuple(sorted(buckets))
         self._jax = jax
         self.timing = timingmod.TimingDesc()
         self.recorder = None
         self._lock = threading.Lock()
+        # recycled staging planes per (kind, bucket): each dispatch takes
+        # a (frames, aux) numpy pair from this free list instead of
+        # allocating fresh, and the pair rides its pending entry until
+        # that dispatch's RESULTS have been fetched — the fetch is the
+        # completion barrier proving the device consumed the inputs, so
+        # reuse can never race an in-flight dispatch even on a PJRT
+        # client with zero-copy host-buffer semantics.  Entries dropped
+        # unfetched (queue overflow, reset) just release their pair to
+        # the GC.  Steady state (pipelined depth ~2) holds two pairs per
+        # bucket and allocates nothing per tick.
+        self._staging_free: dict = {}
         # per-stream host trackers (everything else lives on device)
         self._stream_fmt: list = [None] * streams   # active ans type
         self._bases: list = [None] * streams        # f64 timestamp base
@@ -457,6 +484,10 @@ class FleetFusedIngest:
         self.ticks = 0
         self.dispatch_count = 0
         self.h2d_transfers = 0
+        # super-step lowering counters: compiled super dispatches issued
+        # and how many real (un-padded) tick slices they drained
+        self.super_dispatches = 0
+        self.ticks_super_fused = 0
         # statistics, host-path parity
         self.frames_decoded = 0
         self.nodes_decoded = 0
@@ -510,6 +541,7 @@ class FleetFusedIngest:
             create_fleet_ingest_state,
             fleet_aux_len,
             fleet_fused_ingest_step,
+            super_fleet_ingest_step,
         )
 
         with self._lock:
@@ -527,6 +559,24 @@ class FleetFusedIngest:
                 aux,
                 cfg=icfg,
             )
+            if self.super_tick_max > 1:
+                # the backlog-drain program: one compile per (T, bucket)
+                T = self.super_tick_max
+                st = self._place(
+                    create_fleet_ingest_state(icfg, self.streams)
+                )
+                saux = np.zeros(
+                    (T, self.streams, fleet_aux_len(b)), np.float32
+                )
+                saux[:, :, 2 * b + 1] = 1.0
+                super_fleet_ingest_step(
+                    st,
+                    np.zeros(
+                        (T, self.streams, b, icfg.frame_bytes), np.uint8
+                    ),
+                    saux,
+                    cfg=icfg,
+                )
 
     # -- producer side -----------------------------------------------------
 
@@ -562,24 +612,44 @@ class FleetFusedIngest:
                 continue
             if self._stream_fmt[i] != ans:
                 self._stream_fmt[i] = ans
+                # the timestamp base is NOT cleared here: normalize runs
+                # for every backlog tick before any is staged, and a
+                # later tick's switch must not corrupt an earlier tick's
+                # re-base.  The reset travels in the slice snapshot and
+                # _stage_slice clears the base when it lands, in slice
+                # order (per-tick mode is equivalent: nothing reads the
+                # base between normalize and stage).
                 self._reset_next[i] = True
-                self._bases[i] = None
             runs[i] = (int(ans), frames)
             self.frames_decoded += len(frames)
         return runs
 
-    def _dispatch_tick(self, items) -> None:
-        """Stage and dispatch one tick (possibly several lockstep slices
-        when a stream delivered more frames than the largest bucket)."""
+    def _tick_slices(self, items) -> list:
+        """Normalize one tick into its bucket-capped lockstep slices
+        (several when a stream delivered more frames than the largest
+        bucket), advancing the per-tick counters; [] for a pure idle
+        tick with no pending resets.
+
+        Each slice is ``(chunk, fmts, resets)`` with the per-stream
+        format snapshot and (first slice only) the consumed decode-reset
+        flags BAKED IN at normalize time: a backlog normalizes every
+        tick before any is staged, so stage-time engine state (a later
+        tick's format switch) must never leak into an earlier tick's
+        staging planes."""
         runs = self._normalize_tick(items)
         self._ensure_cfg([self._stream_fmt[i] for i in range(self.streams)])
         if self._icfg is None:
-            return  # nothing ever streamed
+            return []  # nothing ever streamed
         longest = max((len(r[1]) for r in runs if r), default=0)
         if longest == 0 and not any(self._reset_next):
-            return  # pure idle tick: nothing to stage, nothing to reset
+            return []  # pure idle tick: nothing to stage, nothing to reset
         self.ticks += 1
+        fmts = list(self._stream_fmt)
+        resets = self._reset_next
+        self._reset_next = [False] * self.streams
+        no_reset = [False] * self.streams
         cap = self._buckets[-1]
+        slices = []
         off = 0
         while True:
             chunk = [
@@ -587,31 +657,84 @@ class FleetFusedIngest:
             ]
             if off and not any(c and c[1] for c in chunk):
                 break
-            self._dispatch_slice(chunk)
+            slices.append((chunk, fmts, resets if off == 0 else no_reset))
             off += cap
             if off >= longest:
                 break
+        return slices
 
-    def _dispatch_slice(self, chunk) -> None:
-        from rplidar_ros2_driver_tpu.ops.ingest import (
-            fleet_aux_len,
-            fleet_fused_ingest_step,
-        )
+    def _dispatch_tick(self, items) -> None:
+        """Stage and dispatch one tick (its slices grouped into T-tick
+        super-steps whenever more than one is queued and the super-step
+        lowering is enabled)."""
+        self._dispatch_slices(self._tick_slices(items))
 
+    def _dispatch_slices(self, slices) -> None:
+        """Dispatch a queue of tick slices: one per-tick program each
+        when the super-step is disabled (or a single slice is queued),
+        else groups of up to ``super_tick_max`` slices per ONE compiled
+        super-step dispatch."""
+        if self.super_tick_max <= 1:
+            for sl in slices:
+                self._dispatch_slice(sl)
+            return
+        off = 0
+        while off < len(slices):
+            group = slices[off : off + self.super_tick_max]
+            if len(group) == 1:
+                self._dispatch_slice(group[0])
+            else:
+                self._dispatch_super(group)
+            off += len(group)
+
+    def _staging_buffers(self, kind: str, mb: int) -> tuple:
+        """A (frames, aux) staging pair for one padding bucket: recycled
+        from the free list when a fetched dispatch has returned one of
+        the right shape (zeroed for reuse), freshly allocated otherwise
+        — shapes go stale when the active format set's payload width
+        moves, and stale pairs are simply not reused."""
+        from rplidar_ros2_driver_tpu.ops.ingest import fleet_aux_len
+
+        fb = self._icfg.frame_bytes
+        al = fleet_aux_len(mb)
+        if kind == "super":
+            shape_b = (self.super_tick_max, self.streams, mb, fb)
+            shape_a = (self.super_tick_max, self.streams, al)
+        else:
+            shape_b = (self.streams, mb, fb)
+            shape_a = (self.streams, al)
+        free = self._staging_free.setdefault((kind, mb), [])
+        while free:
+            entry = free.pop()
+            if entry[0].shape == shape_b:
+                entry[0].fill(0)
+                entry[1].fill(0)
+                return entry
+        return (np.zeros(shape_b, np.uint8), np.zeros(shape_a, np.float32))
+
+    def _recycle_staging(self, kind: str, mb: int, pair) -> None:
+        """Return a fetched entry's staging pair to the free list (its
+        dispatch's results are host-side, so the inputs are provably
+        consumed)."""
+        self._staging_free.setdefault((kind, mb), []).append(pair)
+
+    def _stage_slice(self, sl, mb: int, buf, aux) -> None:
+        """Fill one tick slice's staging planes (``buf``: (streams, mb,
+        frame_bytes) uint8, ``aux``: (streams, 2mb+4) f32, both
+        pre-zeroed) from the slice's baked-in format/reset snapshots,
+        advancing the per-stream timestamp bases."""
         icfg = self._icfg
-        mb = self._bucket(max(
-            (len(c[1]) for c in chunk if c), default=1
-        ))
-        fb = icfg.frame_bytes
-        buf = np.zeros((self.streams, mb, fb), np.uint8)
-        aux = np.zeros((self.streams, fleet_aux_len(mb)), np.float32)
+        chunk, fmts, resets = sl
         for i, c in enumerate(chunk):
-            fmt = self._stream_fmt[i]
+            fmt = fmts[i]
             if fmt is not None:
                 aux[i, 2 * mb + 2] = icfg.formats.index(int(fmt))
-            if self._reset_next[i]:
+            if resets[i]:
                 aux[i, 2 * mb + 3] = 1.0
-                self._reset_next[i] = False
+                # decode reset => fresh timestamp base for this stream,
+                # applied HERE so it lands at its own slice (see
+                # _normalize_tick)
+                self._bases[i] = None
             if not c or not c[1]:
                 continue  # idle this slice: m=0, carries pass through
             ans, frames = c
@@ -631,6 +754,28 @@ class FleetFusedIngest:
             )
             aux[i, 2 * mb + 1] = m
             self._bases[i] = base
+
+    def _append_pending(self, res, entry) -> None:
+        for arr in res:
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass  # backend without async D2H: the later fetch blocks
+        self._pending.append(entry)
+        while len(self._pending) > self._max_queue:
+            self._pending.popleft()
+            self.wires_dropped += 1
+
+    def _dispatch_slice(self, sl) -> None:
+        from rplidar_ros2_driver_tpu.ops.ingest import fleet_fused_ingest_step
+
+        icfg = self._icfg
+        mb = self._bucket(max(
+            (len(c[1]) for c in sl[0] if c), default=1
+        ))
+        pair = self._staging_buffers("tick", mb)
+        buf, aux = pair
+        self._stage_slice(sl, mb, buf, aux)
         # numpy args go straight into the dispatch (the jit stages them on
         # the donated state's devices) — 2 host->device transfers per
         # fleet tick slice, independent of fleet size
@@ -639,28 +784,59 @@ class FleetFusedIngest:
         )
         self.dispatch_count += 1
         self.h2d_transfers += 2
-        for arr in res:
-            try:
-                arr.copy_to_host_async()
-            except Exception:
-                pass  # backend without async D2H: the later fetch blocks
-        self._pending.append((tuple(res), icfg, list(self._bases)))
-        while len(self._pending) > self._max_queue:
-            self._pending.popleft()
-            self.wires_dropped += 1
+        self._append_pending(
+            res, ("tick", tuple(res), icfg, list(self._bases), mb, pair)
+        )
+
+    def _dispatch_super(self, group) -> None:
+        """Stage up to ``super_tick_max`` tick slices as one
+        (T, streams, M, frame_bytes) plane and drain them in ONE
+        compiled super-step dispatch (ops/ingest.super_fleet_ingest_step).
+        The group is padded to the full T with all-idle tick planes —
+        zeroed staging rows are exactly the idle-lane encoding (m=0,
+        base_shift=0, no reset), which pass every carry through — so each
+        (T, bucket) pair compiles once, whatever the backlog length."""
+        from rplidar_ros2_driver_tpu.ops.ingest import super_fleet_ingest_step
+
+        icfg = self._icfg
+        mb = self._bucket(max(
+            (len(c[1]) for sl in group for c in sl[0] if c), default=1
+        ))
+        pair = self._staging_buffers("super", mb)
+        buf, aux = pair
+        bases_per_tick = []
+        for t, sl in enumerate(group):
+            self._stage_slice(sl, mb, buf[t], aux[t])
+            bases_per_tick.append(list(self._bases))
+        # the idle pad ticks (t >= len(group)) stay all-zero; their meta
+        # rows come back all-zero and the parse skips them
+        self._state, *res = super_fleet_ingest_step(
+            self._state, buf, aux, cfg=icfg
+        )
+        self.dispatch_count += 1
+        self.super_dispatches += 1
+        self.ticks_super_fused += len(group)
+        self.h2d_transfers += 2
+        self._append_pending(
+            res, ("super", tuple(res), icfg, bases_per_tick, mb, pair)
+        )
 
     # -- consumer side -----------------------------------------------------
 
     def _parse_entries(self, entries) -> list:
         """Per-stream accumulated ``(FilterOutput, ts0, duration)`` lists
-        across the given dispatch entries, in dispatch order."""
+        across the given dispatch entries, in dispatch order.  A "tick"
+        entry carries one tick's result planes and per-stream bases; a
+        "super" entry carries T stacked tick planes with per-tick base
+        snapshots (the idle pad ticks parse to all-zero rows)."""
         from rplidar_ros2_driver_tpu.ops.ingest import (
             unpack_fleet_ingest_result,
+            unpack_super_fleet_ingest_result,
         )
 
         out: list = [[] for _ in range(self.streams)]
-        for arrays, icfg, bases in entries:
-            results = unpack_fleet_ingest_result(arrays, icfg)
+
+        def absorb(results, bases):
             for i, res in enumerate(results):
                 self.nodes_decoded += res.nodes_appended
                 self.scans_completed += res.n_completed
@@ -670,6 +846,19 @@ class FleetFusedIngest:
                     ts0 = (base or 0.0) + float(res.ts0[k])
                     dur = max(float(res.end_ts[k]) - float(res.ts0[k]), 0.0)
                     out[i].append((res.outputs[k], ts0, dur))
+
+        for kind, arrays, icfg, bases, mb, pair in entries:
+            if kind == "super":
+                ticks = unpack_super_fleet_ingest_result(arrays, icfg)
+                for t, results in enumerate(ticks):
+                    # bases beyond the staged group are pad ticks: no
+                    # completions there, the last snapshot covers them
+                    absorb(results, bases[min(t, len(bases) - 1)])
+            else:
+                absorb(unpack_fleet_ingest_result(arrays, icfg), bases)
+            # the unpack above fetched this dispatch's results, proving
+            # its staged inputs consumed: the pair is safe to reuse
+            self._recycle_staging(kind, mb, pair)
         return out
 
     def submit(self, items) -> list:
@@ -680,6 +869,27 @@ class FleetFusedIngest:
         pipelined ticks still in flight, in dispatch order."""
         with self._lock:
             self._dispatch_tick(items)
+            entries = list(self._pending)
+            self._pending.clear()
+            return self._parse_entries(entries)
+
+    def submit_backlog(self, ticks) -> list:
+        """Drain a BACKLOG of queued fleet ticks — frames that piled up
+        behind a link stall or a slow consumer — in
+        ``ceil(len(ticks)/super_tick_max)`` compiled dispatches instead
+        of one per tick (one per tick when the super-step is disabled).
+        ``ticks`` is a list of per-tick item lists, each with the
+        :meth:`submit` layout; ticks are normalized IN ORDER (recorder
+        tee, per-stream format switches and resets land at their own
+        tick) and the whole queue is staged into T-tick super-step
+        planes.  Returns every pending revolution as per-stream
+        ``(FilterOutput, ts0, duration)`` lists, in tick order —
+        bit-exact against submitting the same ticks one by one."""
+        with self._lock:
+            slices = []
+            for items in ticks:
+                slices.extend(self._tick_slices(items))
+            self._dispatch_slices(slices)
             entries = list(self._pending)
             self._pending.clear()
             return self._parse_entries(entries)

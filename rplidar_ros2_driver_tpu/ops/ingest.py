@@ -984,6 +984,17 @@ def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
     return new_state, core.meta, core.out_wires, core.nodes, core.node_ts
 
 
+def _fleet_tick(cfg: FleetIngestConfig, state: IngestState, frames, aux):
+    """The un-jitted fleet-tick body (every stream's lane vmapped over
+    the stream axis) — shared verbatim by the per-tick program
+    (:func:`fleet_fused_ingest_step`) and the T-tick super-step
+    (:func:`super_fleet_ingest_step`'s ``lax.scan`` body), so the two
+    lowerings can never drift semantically."""
+    return jax.vmap(functools.partial(_fleet_stream_step, cfg))(
+        state, frames, aux
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
 def fleet_fused_ingest_step(
     state: IngestState, frames: jax.Array, aux: jax.Array,
@@ -1006,32 +1017,56 @@ def fleet_fused_ingest_step(
     dispatch and at most one meta fetch + one wire fetch, independent of
     fleet size.
     """
-    return jax.vmap(functools.partial(_fleet_stream_step, cfg))(
-        state, frames, aux
-    )
+    return _fleet_tick(cfg, state, frames, aux)
 
 
-def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
-    """Host-side parse of one fleet step's result arrays: one
-    :class:`IngestBatchResult` per stream.  The meta plane (streams x a
-    handful of floats) is always materialized — ONE fetch per tick; the
-    stream-batched wire plane is touched once, and only when at least one
-    stream completed a revolution, so an all-mid-revolution tick costs
-    one tiny fetch regardless of fleet size."""
-    meta = np.asarray(res[0])
-    if meta.ndim != 2 or meta.shape[1] != ingest_meta_len(cfg):
-        raise ValueError(
-            f"fleet ingest meta of shape {meta.shape} does not match cfg "
-            f"(expected (streams, {ingest_meta_len(cfg)}))"
-        )
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def super_fleet_ingest_step(
+    state: IngestState, frames: jax.Array, aux: jax.Array,
+    cfg: FleetIngestConfig,
+) -> tuple:
+    """T fleet ticks through the whole ingest pipeline in ONE program —
+    the temporal counterpart of the fleet lowering's spatial fusion
+    (chunk -> fleet tick -> T ticks, the third rung of the
+    dispatch-amortization ladder).
+
+    ``frames`` is (T, streams, M, frame_bytes) uint8 and ``aux``
+    (T, streams, 2M+4) float32 — T per-tick staging planes with the
+    per-tick layout of :func:`fleet_fused_ingest_step` — and the whole
+    stream state (decode carries, partial revolutions, timestamp
+    re-bases, rolling filter windows) threads through a ``lax.scan``
+    over the tick axis as donated scan carries.  The scan body IS
+    :func:`_fleet_tick`, so a T-step super-tick is bit-exact against T
+    sequential per-tick dispatches (pinned by tests/test_super_tick.py);
+    the per-revolution slot lowering stays the fleet default ``fori``,
+    whose while-loop carries alias in place — no cond-induced copies of
+    the FilterState ride the scan.
+
+    An all-idle tick plane (every stream m=0, reset=0, base_shift=0)
+    passes every carry through unchanged and emits an all-zero meta row,
+    so callers can pad a short backlog up to a fixed T and keep ONE
+    compiled executable per (T, bucket) instead of one per backlog
+    length.
+
+    Returns ``(state, meta, out_wires[, nodes, node_ts])`` with a
+    leading (T, streams) axis pair on every result — one dispatch and
+    one meta fetch per T ticks, independent of both T and fleet size.
+    """
+
+    def body(st, xs):
+        fr, ax = xs
+        res = _fleet_tick(cfg, st, fr, ax)
+        return res[0], tuple(res[1:])
+
+    state, stacked = jax.lax.scan(body, state, (frames, aux))
+    return (state,) + tuple(stacked)
+
+
+def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
+    """One :class:`IngestBatchResult` per stream row of one tick's
+    materialized result planes (the shared tail of the fleet and
+    super-step unpackers)."""
     r = cfg.max_revs
-    wires = None
-    if (meta[:, 0] > 0).any():
-        wires = np.asarray(res[1])
-    nodes_all = ts_all = None
-    if cfg.emit_nodes:
-        nodes_all = np.asarray(res[2])
-        ts_all = np.asarray(res[3])
     out = []
     for i in range(meta.shape[0]):
         mrow = meta[i]
@@ -1059,3 +1094,58 @@ def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
             node_ts=ts_all[i][:n] if ts_all is not None else None,
         ))
     return out
+
+
+def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
+    """Host-side parse of one fleet step's result arrays: one
+    :class:`IngestBatchResult` per stream.  The meta plane (streams x a
+    handful of floats) is always materialized — ONE fetch per tick; the
+    stream-batched wire plane is touched once, and only when at least one
+    stream completed a revolution, so an all-mid-revolution tick costs
+    one tiny fetch regardless of fleet size."""
+    meta = np.asarray(res[0])
+    if meta.ndim != 2 or meta.shape[1] != ingest_meta_len(cfg):
+        raise ValueError(
+            f"fleet ingest meta of shape {meta.shape} does not match cfg "
+            f"(expected (streams, {ingest_meta_len(cfg)}))"
+        )
+    wires = None
+    if (meta[:, 0] > 0).any():
+        wires = np.asarray(res[1])
+    nodes_all = ts_all = None
+    if cfg.emit_nodes:
+        nodes_all = np.asarray(res[2])
+        ts_all = np.asarray(res[3])
+    return _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg)
+
+
+def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
+    """Host-side parse of one super-step's result arrays: a list over
+    the T tick planes, each a list of per-stream
+    :class:`IngestBatchResult` (the :func:`unpack_fleet_ingest_result`
+    layout per tick).  The (T, streams) meta plane is ONE fetch per
+    super-step; the stacked wire plane is touched once, and only when
+    at least one revolution completed anywhere in the super-step."""
+    meta = np.asarray(res[0])
+    if meta.ndim != 3 or meta.shape[2] != ingest_meta_len(cfg):
+        raise ValueError(
+            f"super-tick ingest meta of shape {meta.shape} does not match "
+            f"cfg (expected (T, streams, {ingest_meta_len(cfg)}))"
+        )
+    wires = None
+    if (meta[:, :, 0] > 0).any():
+        wires = np.asarray(res[1])
+    nodes_all = ts_all = None
+    if cfg.emit_nodes:
+        nodes_all = np.asarray(res[2])
+        ts_all = np.asarray(res[3])
+    return [
+        _parse_fleet_rows(
+            meta[t],
+            wires[t] if wires is not None else None,
+            nodes_all[t] if nodes_all is not None else None,
+            ts_all[t] if ts_all is not None else None,
+            cfg,
+        )
+        for t in range(meta.shape[0])
+    ]

@@ -257,6 +257,48 @@ class ShardedFilterService:
         staleness; the publish never waits on this tick's compute)."""
         return self.submit_bytes(items, pipelined=True)
 
+    def submit_bytes_backlog(self, ticks) -> list[list[FilterOutput]]:
+        """The catch-up seam: drain a BACKLOG of queued fleet byte ticks
+        (frames that piled up behind a link stall or a slow consumer) in
+        one call.  ``ticks`` is a list of per-tick item lists, each with
+        the :meth:`submit_bytes` layout.  Backend per
+        ``fleet_ingest_backend``:
+
+          * fused — driver/ingest.FleetFusedIngest.submit_backlog: up to
+            ``super_tick_max`` ticks per ONE compiled super-step
+            dispatch (ops/ingest.super_fleet_ingest_step), i.e.
+            ``ceil(len(ticks)/T)`` dispatches for the whole backlog —
+            bit-exact against submitting the ticks one by one.
+          * host — the golden reference: each tick through the per-stream
+            host decode + the one batched lockstep dispatch, exactly as
+            :meth:`submit_bytes` would have, one dispatch per tick.
+
+        Returns one list per stream holding EVERY completed revolution's
+        FilterOutput across the backlog, in tick order (unlike the
+        per-tick seam's newest-only contract — a drain must not discard
+        the queue it just caught up on).  The backends' window semantics
+        differ exactly as documented on :meth:`submit_bytes`."""
+        self._ensure_byte_ingest()
+        if self.fleet_ingest_backend == "fused":
+            outs = self.fleet_ingest.submit_backlog(ticks)
+            return [[o for (o, _ts0, _dur) in s] for s in outs]
+        results: list[list[FilterOutput]] = [
+            [] for _ in range(self.streams)
+        ]
+        for items in ticks:
+            if len(items) != self.streams:
+                raise ValueError(
+                    f"expected {self.streams} per-stream byte runs, "
+                    f"got {len(items)}"
+                )
+            scans = self._host_decode_tick(items)
+            if all(s is None for s in scans):
+                continue  # edge-triggered, like submit_bytes
+            for i, out in enumerate(self.submit(scans)):
+                if out is not None:
+                    results[i].append(out)
+        return results
+
     # -- ingest -------------------------------------------------------------
 
     def _stack(

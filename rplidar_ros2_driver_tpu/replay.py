@@ -256,6 +256,108 @@ def replay_fleet(
     )
 
 
+def replay_raw_fused(
+    path: str,
+    params,
+    *,
+    beams: int | None = None,
+    capacity: int = 4096,
+    frames_per_tick: int = 64,
+    super_ticks: int = 8,
+    max_revs: int = 8,
+):
+    """Offline max-throughput replay of a RAW capture: frame bytes ->
+    filtered range images end-to-end ON DEVICE, in
+    ``ceil(ticks/super_ticks)`` compiled dispatches.
+
+    The host replay path (:func:`decode_recording` ->
+    :meth:`DecodedRecording.revolutions` -> :func:`replay_through_chain`)
+    unpacks and segments on the host before the fused K-scan chain; this
+    path instead feeds the capture's raw frames, ``frames_per_tick`` per
+    tick, through the fleet-fused ingest engine
+    (driver/ingest.FleetFusedIngest, one stream) with the T-tick
+    super-step lowering (ops/ingest.super_fleet_ingest_step) draining
+    the whole capture as one backlog — unpack, revolution segmentation
+    and the donated filter steps all inside the scanned program, so the
+    per-dispatch overhead amortizes over ``super_ticks`` ticks of
+    frames.
+
+    Output parity: for a single-scan-mode capture the range images and
+    the final FilterState are identical to the host path's
+    (``tests/test_replay.py``; timestamps differ only by the fused
+    path's f32 epoch offsets).  A capture that switches scan modes
+    replays with the LIVE engine's semantics instead — the partial
+    revolution bridging the switch is dropped at the decode reset,
+    where the host batch decode splices runs together.
+
+    Raises if any revolution was dropped to the ``max_revs``
+    per-dispatch cap (raise ``max_revs`` or lower ``frames_per_tick``)
+    — a silent drop would break the parity contract.
+
+    Returns ``(ranges, state, stats)``: per-scan (K, beams) float32
+    median range images, the final FilterState (stream axis squeezed —
+    comparable to :func:`replay_through_chain`'s), and a stats dict
+    with ``ticks`` / ``dispatches`` / ``super_tick`` / ``frames`` /
+    ``scans``.
+    """
+    import jax
+
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+
+    # group the capture into per-tick byte runs (consecutive same-type
+    # frames, frames_per_tick per tick — run boundaries close a tick so
+    # one tick never mixes formats)
+    ticks: list = []
+    cur_ans: int | None = None
+    cur: list = []
+
+    def close_run() -> None:
+        for i in range(0, len(cur), frames_per_tick):
+            ticks.append([(cur_ans, cur[i : i + frames_per_tick])])
+        cur.clear()
+
+    n_frames = 0
+    for ans_type, ts, payload in read_frames(path):
+        expect = ANS_PAYLOAD_BYTES.get(ans_type)
+        if expect is None or len(payload) != expect:
+            continue  # non-measurement or malformed record
+        if cur_ans != ans_type:
+            close_run()
+            cur_ans = ans_type
+        cur.append((payload, ts))
+        n_frames += 1
+    close_run()
+
+    eng = FleetFusedIngest(
+        params, 1, beams=beams, capacity=capacity, max_revs=max_revs,
+        max_queue=1 << 30,  # offline: every wire must survive to the drain
+        buckets=(frames_per_tick,), super_tick_max=super_ticks,
+    )
+    outs = eng.submit_backlog(ticks)[0] if ticks else []
+    if eng.revs_dropped:
+        raise ValueError(
+            f"{eng.revs_dropped} revolutions dropped to the max_revs="
+            f"{max_revs} per-dispatch cap — raise max_revs or lower "
+            f"frames_per_tick to keep the host-path parity contract"
+        )
+    ranges = (
+        np.stack([np.asarray(o.ranges) for o, _, _ in outs])
+        if outs else np.zeros((0, eng.cfg.beams), np.float32)
+    )
+    state = jax.device_get(
+        jax.tree_util.tree_map(lambda x: x[0], eng._state.filter)
+    )
+    stats = {
+        "ticks": eng.ticks,
+        "dispatches": eng.dispatch_count,
+        "super_dispatches": eng.super_dispatches,
+        "super_tick": super_ticks,
+        "frames": n_frames,
+        "scans": len(outs),
+    }
+    return ranges, state, stats
+
+
 def decode_recording(path: str) -> DecodedRecording:
     """Batch-decode a capture: consecutive same-type frames become ONE
     kernel invocation over a (M, frame_bytes) uint8 array."""

@@ -44,7 +44,10 @@ def _records(paths: list[str]):
                     yield rec
 
 
-_DECISION_KEYS = ("median_ab", "deep_window_ab", "derived", "fleet_ingest_ab")
+_DECISION_KEYS = (
+    "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
+    "super_tick_ab",
+)
 
 
 def _strength(value: float) -> float:
@@ -189,6 +192,33 @@ def analyze(records: list[dict]) -> dict:
                     "fused_vs_host_tick_speedup",
                     "overhead_clamped",
                 ) if k in fab
+            })
+
+        # config 11: the T-tick super-step drain A/B (super_tick_max
+        # default recommendation)
+        sab = rec.get("super_tick_ab")
+        if isinstance(sab, dict):
+            v = sab.get("drain_speedup")
+            if isinstance(v, (int, float)) and not sab.get(
+                "overhead_clamped"
+            ):
+                # a clamped decomposition (negative measured saving —
+                # load weather on a drifting rig) records evidence but
+                # must never move the default.  The recommended T is the
+                # one the record actually measured (the artifact's
+                # top-level super_tick), not a hardcoded constant.
+                t_measured = rec.get("super_tick")
+                recommend("super_tick_max.tpu", ratio_entry(
+                    "1",
+                    str(t_measured) if isinstance(t_measured, int) else "8",
+                    "config11 super_tick drain_speedup",
+                    float(v), "super_tick_ab",
+                ))
+            out["evidence"].setdefault("super_tick_ab", []).append({
+                k: sab[k] for k in (
+                    "drain_speedup", "per_dispatch_floor_ms",
+                    "overhead_clamped",
+                ) if k in sab
             })
 
         # ablation: resample + voxel kernels

@@ -16,6 +16,9 @@
 #   6. fleet ingest A/B (config 10: host-decode-then-batch vs fleet-fused
 #      per tick — the fleet_ingest_backend decision key)
 #   7. live fleet latency, fleet-fused arm (same publish-tick pairing)
+#   8. super-tick drain A/B (config 11: T fleet ticks per compiled
+#      dispatch vs one each — the super_tick_max decision key; on-chip
+#      every amortized dispatch is a link round trip)
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -61,7 +64,8 @@ if [ $# -eq 0 ]; then
     "python scripts/step_ablation.py" \
     "python scripts/fleet_latency.py" \
     "python bench.py --config 10" \
-    "python scripts/fleet_latency.py --fleet-ingest fused"
+    "python scripts/fleet_latency.py --fleet-ingest fused" \
+    "python bench.py --config 11"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

@@ -21,13 +21,14 @@ def test_metric_names_stable():
     assert bench.metric_name(4) == "graded_config4_scans_per_sec"
     assert bench.metric_name(8) == "fleet_fused_replay_scans_per_sec"
     assert bench.metric_name(10) == "fleet_fused_ingest_bytes_to_scans_per_sec"
+    assert bench.metric_name(11) == "super_tick_drain_scans_per_sec"
 
 
 def test_graded_table_well_formed():
     for c, (kind, points, over) in bench.GRADED.items():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
-            "fleet_ingest",
+            "fleet_ingest", "super_tick",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -823,6 +824,112 @@ def test_bench_smoke_fleet_ingest():
     assert out["startup"]["host_setup_precompile_s"] > 0
     assert out["startup"]["fused_setup_precompile_s"] > 0
     assert "ceiling_analysis" in out
+
+
+def test_bench_smoke_super_tick():
+    """`bench.py --smoke-super-tick` — the tier-1 gate for the T-tick
+    SUPER-STEP lowering (config-11 drain A/B at seconds-scale CPU
+    geometry).  The structural T -> 1 claim is the assertion that
+    matters: the super arm must drain the backlog in ceil(ticks/T)
+    compiled dispatches (2 staged transfers each) vs one per tick for
+    the per-tick arm, at identical revolution counts (the bench itself
+    raises on violation; this gate pins that the asserted artifact
+    lands).  Wall-time numbers are 1.5-core-CI weather and only
+    sanity-bounded; bit-exactness lives in tests/test_super_tick.py."""
+    import json
+    import math
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-super-tick"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "super_tick_drain_scans_per_sec"
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claim, re-checked from the artifact
+    t = out["super_tick"]
+    ticks = out["ticks"]
+    assert out["per_tick"]["dispatches"] == ticks
+    assert out["super"]["dispatches"] == math.ceil(ticks / t)
+    for arm in ("per_tick", "super"):
+        assert out[arm]["h2d_transfers"] == 2 * out[arm]["dispatches"]
+    assert out["structural"]["t_to_1_claim_holds"] is True
+    # parity and liveness: both arms completed the same nonzero revs
+    assert out["per_tick"]["revolutions"] == out["super"]["revolutions"] > 0
+    assert out["value"] > 0 and out["per_tick"]["scans_per_sec"] > 0
+    # the calibrated decomposition must be present and sane
+    assert out["dispatch_floor_ms"] > 0
+    assert out["predicted_saving_ms"] >= 0
+    # the decide_backends decision key rides with its clamp flag
+    assert out["super_tick_ab"]["drain_speedup"] > 0
+    assert isinstance(out["super_tick_ab"]["overhead_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_super_tick_key():
+    """The super_tick_max recommendation flips from config-11 evidence
+    alone: TPU records past the bar recommend the T=8 default, CPU
+    records and clamped decompositions never flip."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        sys.path.remove(scripts_dir)
+
+    out = db.analyze([
+        {"device": "tpu", "super_tick": 8,
+         "super_tick_ab": {"drain_speedup": 2.4,
+                           "per_dispatch_floor_ms": 4.0,
+                           "overhead_clamped": False}},
+        {"device": "cpu",  # CPU record: no decision weight
+         "super_tick_ab": {"drain_speedup": 9.0,
+                           "overhead_clamped": False}},
+    ])
+    rec = out["recommendations"]["super_tick_max.tpu"]
+    assert rec["flip"] is True and rec["recommended"] == "8"
+    assert rec["value"] == 2.4  # the TPU record, not the CPU 9.0
+    assert out["evidence"]["super_tick_ab"]
+
+    # the recommended T is the record's measured super_tick, not a
+    # hardcoded constant (a rig override running T=4 must recommend 4)
+    t4 = db.analyze([
+        {"device": "tpu", "super_tick": 4,
+         "super_tick_ab": {"drain_speedup": 3.0,
+                           "overhead_clamped": False}},
+    ])
+    assert t4["recommendations"]["super_tick_max.tpu"]["recommended"] == "4"
+
+    # a clamped decomposition records evidence but cannot flip
+    clamped = db.analyze([
+        {"device": "tpu",
+         "super_tick_ab": {"drain_speedup": 50.0,
+                           "overhead_clamped": True}},
+    ])
+    assert "super_tick_max.tpu" not in clamped["recommendations"]
+    assert clamped["evidence"]["super_tick_ab"]
+
+    # sub-margin TPU evidence keeps the disabled default
+    keep = db.analyze([
+        {"device": "tpu",
+         "super_tick_ab": {"drain_speedup": 1.01,
+                           "overhead_clamped": False}},
+    ])
+    rec = keep["recommendations"]["super_tick_max.tpu"]
+    assert rec["flip"] is False and rec["recommended"] == "1"
 
 
 def test_decide_backends_fleet_ingest_key():

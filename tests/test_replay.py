@@ -55,6 +55,64 @@ class TestFormat:
         assert list(read_frames(str(p))) == []
 
 
+class TestTailTruncation:
+    """The format's claimed tail-truncation safety, pinned case by case:
+    a capture cut ANYWHERE (crash mid-write, full disk) must yield clean
+    partial iteration up to the last whole record — never raise, never
+    yield a torn record."""
+
+    def _capture_bytes(self, tmp_path) -> bytes:
+        p = str(tmp_path / "full.rplr")
+        with FrameRecorder(p) as rec:
+            rec.write(0x81, b"\x01" * 5, 1.0)
+            rec.write(0x85, b"\x02" * 84, 2.0)
+        return open(p, "rb").read()
+
+    def _cut(self, tmp_path, raw: bytes, n: int) -> list:
+        p = str(tmp_path / f"cut{n}.rplr")
+        with open(p, "wb") as f:
+            f.write(raw[:n])
+        return list(read_frames(p))
+
+    def test_zero_length_capture(self, tmp_path):
+        p = tmp_path / "zero.rplr"
+        p.write_bytes(b"")
+        assert list(read_frames(str(p))) == []
+
+    def test_truncated_file_header(self, tmp_path):
+        """A cut inside the 8-byte file header (even mid-magic) is a
+        clean empty iteration, not a struct error or a magic raise."""
+        raw = self._capture_bytes(tmp_path)
+        from rplidar_ros2_driver_tpu import replay as R
+
+        for n in range(R._HEADER.size):
+            assert self._cut(tmp_path, raw, n) == [], n
+
+    def test_truncated_record_header(self, tmp_path):
+        """A cut inside the SECOND record's 12-byte header keeps the
+        first record and stops cleanly."""
+        raw = self._capture_bytes(tmp_path)
+        from rplidar_ros2_driver_tpu import replay as R
+
+        first_end = R._HEADER.size + R._REC.size + 5
+        for n in range(first_end, first_end + R._REC.size):
+            got = self._cut(tmp_path, raw, n)
+            assert got == [(0x81, 1.0, b"\x01" * 5)], n
+
+    def test_truncated_payload(self, tmp_path):
+        """A cut inside the second record's payload (any prefix of it,
+        including zero bytes present) likewise keeps only the first."""
+        raw = self._capture_bytes(tmp_path)
+        from rplidar_ros2_driver_tpu import replay as R
+
+        second_payload = R._HEADER.size + 2 * R._REC.size + 5
+        for n in range(second_payload, len(raw)):  # incl. one-byte-short
+            got = self._cut(tmp_path, raw, n)
+            assert got == [(0x81, 1.0, b"\x01" * 5)], n
+        # the uncut file yields both, proving the cuts above did the work
+        assert len(self._cut(tmp_path, raw, len(raw))) == 2
+
+
 def _capture_from_sim(tmp_path, seconds=1.2, name="sim.rplr"):
     from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
     from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
@@ -248,6 +306,142 @@ def test_ultra_mode_geometry_matches_standard(tmp_path):
     std = median_range_m("Standard")
     ultra = median_range_m("UltraBoost")
     assert abs(ultra - std) / std < 0.05, (std, ultra)
+
+
+class TestReplayRawFused:
+    """replay_raw_fused: raw capture bytes -> filtered scans on device
+    via the T-tick super-step drain, against the host decode ->
+    replay_through_chain golden path (the acceptance contract: same
+    range images, same final filter state, <= ceil(ticks/T)
+    dispatches)."""
+
+    def _params(self):
+        from rplidar_ros2_driver_tpu.core.config import DriverParams
+
+        return DriverParams(
+            filter_backend="cpu",
+            filter_chain=("clip", "median", "voxel"),
+            filter_window=4,
+            voxel_grid_size=32,
+        )
+
+    @pytest.mark.parametrize("mode_name", ["DenseBoost", "Sensitivity"])
+    def test_matches_host_replay_path(self, tmp_path, mode_name):
+        """Dense (unpaired) and express (prev-frame-paired) captures:
+        identical range images and final FilterState, in the promised
+        dispatch budget."""
+        import math
+
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+        from rplidar_ros2_driver_tpu.replay import (
+            replay_raw_fused,
+            replay_through_chain,
+        )
+
+        path = str(tmp_path / f"{mode_name}.rplr")
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, False)
+            drv.detect_and_init_strategy()
+            drv.start_recording(path)
+            assert drv.start_motor(mode_name, 600)
+            got = 0
+            deadline = time.monotonic() + 20
+            while got < 3 and time.monotonic() < deadline:
+                if drv.grab_scan_host(2.0) is not None:
+                    got += 1
+            assert drv.stop_recording() > 0
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            sim.stop()
+
+        params = self._params()
+        revs = decode_recording(path).revolutions()
+        assert revs
+        ranges_h, state_h = replay_through_chain(
+            revs, params, beams=256, capacity=4096
+        )
+        ranges_f, state_f, stats = replay_raw_fused(
+            path, params, beams=256, capacity=4096,
+            frames_per_tick=8, super_ticks=4,
+        )
+        np.testing.assert_array_equal(ranges_f, ranges_h)
+        np.testing.assert_array_equal(
+            np.asarray(state_f.voxel_acc), np.asarray(state_h.voxel_acc)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state_f.range_window),
+            np.asarray(state_h.range_window),
+        )
+        # the acceptance budget, and the super path actually engaged
+        assert stats["dispatches"] <= math.ceil(stats["ticks"] / 4)
+        assert stats["ticks"] > 1 and stats["super_dispatches"] >= 1
+        assert stats["scans"] == ranges_h.shape[0]
+
+    def test_empty_capture(self, tmp_path):
+        from rplidar_ros2_driver_tpu.replay import replay_raw_fused
+
+        p = str(tmp_path / "empty.rplr")
+        with FrameRecorder(p):
+            pass
+        ranges, state, stats = replay_raw_fused(p, self._params(), beams=256)
+        assert ranges.shape == (0, 256)
+        assert stats["dispatches"] == 0 and stats["scans"] == 0
+
+    def test_max_revs_drop_raises(self, tmp_path):
+        """A frames_per_tick/max_revs pairing that would silently drop
+        revolutions must raise instead (the parity contract)."""
+        from rplidar_ros2_driver_tpu.driver.real import RealLidarDriver
+        from rplidar_ros2_driver_tpu.driver.sim_device import SimulatedDevice
+        from rplidar_ros2_driver_tpu.replay import replay_raw_fused
+
+        path = str(tmp_path / "c.rplr")
+        sim = SimulatedDevice().start()
+        try:
+            drv = RealLidarDriver(
+                channel_type="tcp", tcp_host="127.0.0.1", tcp_port=sim.port,
+                motor_warmup_s=0.0,
+            )
+            assert drv.connect("sim", 0, False)
+            drv.detect_and_init_strategy()
+            drv.start_recording(path)
+            assert drv.start_motor("DenseBoost", 600)
+            got = 0
+            deadline = time.monotonic() + 20
+            while got < 4 and time.monotonic() < deadline:
+                if drv.grab_scan_host(2.0) is not None:
+                    got += 1
+            drv.stop_recording()
+            drv.stop_motor()
+            drv.disconnect()
+        finally:
+            sim.stop()
+        with pytest.raises(ValueError, match="max_revs"):
+            # the whole capture in one tick, one completion slot
+            replay_raw_fused(
+                path, self._params(), beams=256,
+                frames_per_tick=4096, super_ticks=1, max_revs=1,
+            )
+
+    def test_cli_replay_fused(self, tmp_path):
+        path, _ = _capture_from_sim(tmp_path, seconds=0.5)
+        out = subprocess.run(
+            [sys.executable, "-m", "rplidar_ros2_driver_tpu", "replay", path,
+             "--cpu", "--fused"],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "fused raw replay" in out.stdout
+        assert "parity OK" in out.stdout
+        assert "scans/s" in out.stdout
 
 
 def test_replay_fleet_matches_per_stream_replay():

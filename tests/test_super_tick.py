@@ -1,0 +1,445 @@
+"""T-tick super-step lowering vs T sequential fleet ticks — parity suite.
+
+The super-step (ops/ingest.super_fleet_ingest_step) runs T fleet ticks
+inside ONE compiled program: a ``lax.scan`` over the exact fleet-tick
+body, every per-stream carry (decode state, partial revolution, filter
+window, timestamp re-base) threaded as donated scan state.  This suite
+pins the contract that makes the backlog drain shippable: **bit-exact**
+outputs against the same ticks dispatched one per program, across
+
+  * T in {1, 2, 8} (T=1 degenerates to the per-tick path: the engine
+    must never regress when the lowering is disabled),
+  * mixed answer types within one super-step (per-stream lax.switch),
+  * corrupt/resync frames in the middle of a super-step,
+  * carries surviving across super-step boundaries (a backlog longer
+    than T splits into several super dispatches),
+  * snapshot/restore mid-backlog,
+  * the ShardedFilterService.submit_bytes_backlog drain seam (host
+    backend as golden reference),
+  * the structural dispatch claim: ceil(ticks/T) compiled dispatches,
+    2 staged transfers each.
+
+Bit-exactness here means the filter outputs and node-derived values are
+identical.  Timestamps ride as f32 epoch offsets on both arms, but XLA
+may contract their mul+add chains to FMA differently inside the scanned
+program than in the standalone tick (1-ulp drift observed on CPU), so
+ts0/duration compare to the host-parity suites' tolerance.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+from test_fused_ingest import BEAMS, TS_TOL, _params
+from test_fleet_fused_ingest import _host_reference, _mk_ticks
+from test_live_decode import _make_stream, _rng
+
+DENSE = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+
+def _run_sequential(ticks, s, params=None, **kw):
+    """The reference arm: the same engine, one dispatch per tick."""
+    kw.setdefault("max_revs", 6)
+    kw.setdefault("buckets", (4,))
+    fleet = FleetFusedIngest(
+        params or _params(), s, beams=BEAMS, super_tick_max=1, **kw
+    )
+    outs = [[] for _ in range(s)]
+    for tick in ticks:
+        for i, o in enumerate(fleet.submit(tick)):
+            outs[i].extend(o)
+    for i, o in enumerate(fleet.flush()):
+        outs[i].extend(o)
+    return outs, fleet
+
+
+def _run_backlog(ticks, s, params=None, *, super_tick_max, **kw):
+    kw.setdefault("max_revs", 6)
+    kw.setdefault("buckets", (4,))
+    fleet = FleetFusedIngest(
+        params or _params(), s, beams=BEAMS,
+        super_tick_max=super_tick_max, **kw
+    )
+    outs = fleet.submit_backlog(ticks)
+    return outs, fleet
+
+
+def _assert_identical(seq, sup):
+    """Fused-vs-fused: node values and filter outputs must be EXACTLY
+    equal.  Timestamps are f32 arithmetic whose mul+add XLA may contract
+    to FMA differently in the scanned program than in the standalone
+    tick (1-ulp drift observed on CPU), so stamps compare to the same
+    tolerance the host-parity suites use."""
+    assert len(seq) == len(sup)
+    for i, (a_outs, b_outs) in enumerate(zip(seq, sup)):
+        assert len(a_outs) == len(b_outs), (
+            f"stream {i}: sequential {len(a_outs)} revs vs super {len(b_outs)}"
+        )
+        for k, ((oa, ta, da), (ob, tb, db)) in enumerate(zip(a_outs, b_outs)):
+            for field in (
+                "ranges", "intensities", "points_xy", "point_mask", "voxel"
+            ):
+                assert np.array_equal(
+                    np.asarray(getattr(oa, field)),
+                    np.asarray(getattr(ob, field)),
+                ), f"stream {i} rev {k}: {field}"
+            assert abs(ta - tb) < TS_TOL and abs(da - db) < TS_TOL, (
+                i, k, ta, tb, da, db,
+            )
+
+
+class TestSuperTickParity:
+    @pytest.mark.parametrize("super_t", [1, 2, 8])
+    def test_t_values_bit_exact(self, super_t):
+        """The acceptance matrix: T in {1, 2, 8} super-steps vs the same
+        ticks dispatched sequentially, plus the ceil(ticks/T) dispatch
+        count and the 2-transfers-per-dispatch staging claim."""
+        sf = [
+            (DENSE, _make_stream(
+                Ans.MEASUREMENT_DENSE_CAPSULED, 40, _rng(),
+                syncs=(0, 10 + i, 25),
+            ))
+            for i in range(2)
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(super_t))
+        seq, _ = _run_sequential(ticks, 2)
+        sup, fleet = _run_backlog(ticks, 2, super_tick_max=super_t)
+        _assert_identical(seq, sup)
+        assert sum(len(s) for s in sup) >= 2, "fixture closed no revs"
+        # every tick is one slice at this bucket size, so the structural
+        # claim is exact: ceil(ticks/T) dispatches, 2 transfers each
+        assert fleet.dispatch_count == math.ceil(len(ticks) / super_t)
+        assert fleet.h2d_transfers == 2 * fleet.dispatch_count
+        if super_t > 1:
+            assert fleet.ticks_super_fused >= 2
+        else:
+            assert fleet.super_dispatches == 0
+
+    def test_host_golden_reference(self):
+        """The super drain is also bit-exact against N independent HOST
+        decode+assembly+chain paths (the transitive anchor: per-tick
+        fused is pinned to host by test_fleet_fused_ingest; this pins
+        super -> host directly so a drift in either hop surfaces)."""
+        sf = [
+            (DENSE, _make_stream(
+                Ans.MEASUREMENT_DENSE_CAPSULED, 40, _rng(), syncs=(0, 10, 25)
+            ))
+            for _ in range(3)
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(31))
+        host = _host_reference(ticks, 3)
+        sup, _ = _run_backlog(ticks, 3, super_tick_max=4)
+        for i in range(3):
+            assert len(host[i]) == len(sup[i])
+            for (ho, hts0, hdur), (fo, fts0, fdur) in zip(host[i], sup[i]):
+                for field in ("ranges", "voxel"):
+                    assert np.array_equal(
+                        np.asarray(getattr(ho, field)),
+                        np.asarray(getattr(fo, field)),
+                    ), (i, field)
+                assert abs(hts0 - fts0) < TS_TOL
+                assert abs(hdur - fdur) < TS_TOL
+
+    def test_mixed_ans_types_in_super_step(self):
+        """Three formats live inside ONE super-step: per-stream
+        lax.switch dispatch under the scan."""
+        sf = [
+            (int(a), _make_stream(a, 36, _rng(), syncs=(0, 9, 18, 27)))
+            for a in (
+                Ans.MEASUREMENT_DENSE_CAPSULED,
+                Ans.MEASUREMENT_HQ,
+                Ans.MEASUREMENT,
+            )
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(11))
+        seq, _ = _run_sequential(ticks, 3)
+        sup, _ = _run_backlog(ticks, 3, super_tick_max=4)
+        _assert_identical(seq, sup)
+
+    def test_all_six_formats_one_fleet(self):
+        """Every measurement wire format rides one six-stream fleet
+        through the super drain — the acceptance matrix's format axis,
+        paired prev-frame carries and smoothing carries included."""
+        from test_fused_ingest import ALL_FORMATS
+
+        assert len(ALL_FORMATS) == 6
+        sf = [
+            (int(a), _make_stream(a, 60, _rng(), syncs=(0, 15, 30, 45)))
+            for a in ALL_FORMATS
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(29))
+        seq, _ = _run_sequential(ticks, 6)
+        sup, _ = _run_backlog(ticks, 6, super_tick_max=4)
+        _assert_identical(seq, sup)
+        assert all(len(s) >= 1 for s in sup), [len(s) for s in sup]
+
+    def test_corrupt_resync_inside_super_step(self):
+        """Checksum faults (and the resync they force) land mid-backlog
+        on one stream: fault isolation must survive the scan carries."""
+        a = Ans.MEASUREMENT_DENSE_CAPSULED
+        healthy = _make_stream(a, 40, _rng(), syncs=(0, 10, 25))
+        corrupt = _make_stream(
+            a, 40, _rng(), syncs=(0,), corrupt=(7, 8, 19, 30)
+        )
+        sf = [(DENSE, healthy), (DENSE, corrupt), (DENSE, healthy)]
+        ticks = _mk_ticks(sf, np.random.default_rng(9))
+        seq, _ = _run_sequential(ticks, 3)
+        sup, _ = _run_backlog(ticks, 3, super_tick_max=8)
+        _assert_identical(seq, sup)
+
+    def test_carries_across_super_step_boundaries(self):
+        """A backlog longer than T splits into several super dispatches:
+        every carry (partial revolution, prev frame, sync edge,
+        timestamp re-base) must survive the boundary between two scanned
+        programs exactly as it survives a per-tick boundary."""
+        sf = [
+            (DENSE, _make_stream(
+                Ans.MEASUREMENT_DENSE_CAPSULED, 48, _rng(), syncs=(0,)
+            ))
+            for _ in range(2)
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(17))
+        assert len(ticks) > 3  # several T=3 groups + a ragged tail
+        seq, _ = _run_sequential(ticks, 2)
+        sup, fleet = _run_backlog(ticks, 2, super_tick_max=3)
+        _assert_identical(seq, sup)
+        assert fleet.dispatch_count == math.ceil(len(ticks) / 3)
+
+    def test_format_switch_mid_backlog(self):
+        """One stream switches scan modes in the middle of the backlog:
+        the decode-state reset must land at ITS tick inside the scan
+        (the baked-in per-slice snapshots), not at the drain head."""
+        a1, a2 = Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_HQ
+        s0_first = _make_stream(a1, 24, _rng(), syncs=(0, 8, 16))
+        s0_second = _make_stream(a2, 20, _rng(), syncs=(0, 5, 10, 15))
+        s1 = _make_stream(a1, 44, _rng(), syncs=(0, 11, 22, 33))
+        rng = np.random.default_rng(13)
+        t1 = _mk_ticks([(int(a1), s0_first), (DENSE, s1[:22])], rng)
+        t2 = _mk_ticks([(int(a2), s0_second), (DENSE, s1[22:])], rng)
+        ticks = t1 + t2
+        seq, _ = _run_sequential(ticks, 2)
+        sup, _ = _run_backlog(ticks, 2, super_tick_max=4)
+        _assert_identical(seq, sup)
+        assert sum(len(s) for s in sup) >= 4
+
+
+    def test_format_switch_mid_backlog_with_prior_traffic(self):
+        """The case that actually bites: the engine already has
+        per-stream timestamp bases from LIVE traffic when a backlog
+        containing a format switch arrives.  Normalizing every backlog
+        tick up front must not clear a base that an earlier tick's
+        staging still needs — the reset (and its fresh base) must land
+        at its own tick inside the drain, or every pre-switch
+        revolution's ts0 shifts by the stall gap."""
+        a1, a2 = Ans.MEASUREMENT_DENSE_CAPSULED, Ans.MEASUREMENT_HQ
+        s0_first = _make_stream(a1, 24, _rng(), syncs=(0, 8, 16))
+        s0_second = _make_stream(a2, 20, _rng(), syncs=(0, 5, 10, 15))
+        s1 = _make_stream(a1, 44, _rng(), syncs=(0, 11, 22, 33))
+        rng = np.random.default_rng(37)
+        ticks = (
+            _mk_ticks([(int(a1), s0_first), (DENSE, s1[:22])], rng)
+            + _mk_ticks([(int(a2), s0_second), (DENSE, s1[22:])], rng)
+        )
+        live = 3  # ticks submitted live before the stall
+        params = _params()
+
+        def run(backlog: bool):
+            eng = FleetFusedIngest(
+                params, 2, beams=BEAMS, max_revs=6, buckets=(4,),
+                super_tick_max=4,
+            )
+            outs = [[] for _ in range(2)]
+            for tick in ticks[:live]:  # live traffic establishes bases
+                for i, o in enumerate(eng.submit(tick)):
+                    outs[i].extend(o)
+            if backlog:
+                for i, o in enumerate(eng.submit_backlog(ticks[live:])):
+                    outs[i].extend(o)
+            else:
+                for tick in ticks[live:]:
+                    for i, o in enumerate(eng.submit(tick)):
+                        outs[i].extend(o)
+                for i, o in enumerate(eng.flush()):
+                    outs[i].extend(o)
+            return outs
+
+        _assert_identical(run(backlog=False), run(backlog=True))
+
+
+class TestSnapshotRestoreMidBacklog:
+    def test_snapshot_restore_between_super_steps(self):
+        """Drain half the backlog, snapshot, restore into a FRESH
+        engine, drain the rest: identical outputs to the uninterrupted
+        super drain — the scanned carries round-trip through the
+        checkpoint surface."""
+        sf = [
+            (DENSE, _make_stream(
+                Ans.MEASUREMENT_DENSE_CAPSULED, 40, _rng(), syncs=(0,)
+            ))
+            for _ in range(2)
+        ]
+        ticks = _mk_ticks(sf, np.random.default_rng(19))
+        cut = len(ticks) // 2
+        params = _params()
+
+        ref, _ = _run_backlog(ticks, 2, params, super_tick_max=3)
+
+        a = FleetFusedIngest(
+            params, 2, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=3,
+        )
+        outs = [list(o) for o in a.submit_backlog(ticks[:cut])]
+        snap = a.snapshot()
+        b = FleetFusedIngest(
+            params, 2, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=3,
+        )
+        assert b.restore(snap)
+        for i, o in enumerate(b.submit_backlog(ticks[cut:])):
+            outs[i].extend(o)
+        _assert_identical(ref, outs)
+        assert sum(len(o) for o in outs) >= 1
+
+
+class TestEngineSemantics:
+    def test_oversized_tick_splits_into_super_step(self):
+        """A single tick whose frame run exceeds the largest bucket
+        splits into slices — with the lowering enabled those slices
+        drain as ONE super dispatch instead of one each."""
+        frames = _make_stream(
+            Ans.MEASUREMENT_DENSE_CAPSULED, 36, _rng(), syncs=(0, 9, 18)
+        )
+        t = 50.0
+        batch = []
+        for f in frames:
+            t += 0.002
+            batch.append((f, t))
+        tick = [(DENSE, batch)]
+
+        seq_eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=1,
+        )
+        seq = seq_eng.submit(tick)
+        seq_disp = seq_eng.dispatch_count
+        assert seq_disp == 9  # 36 frames / bucket 4
+
+        sup_eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=16,
+        )
+        sup = sup_eng.submit(tick)
+        assert sup_eng.dispatch_count == 1
+        assert sup_eng.super_dispatches == 1
+        _assert_identical(seq, sup)
+
+    def test_super_tick_param_flows_from_driver_params(self):
+        p = _params(super_tick_max=5)
+        eng = FleetFusedIngest(p, 1, beams=BEAMS, buckets=(4,))
+        assert eng.super_tick_max == 5
+        eng = FleetFusedIngest(p, 1, beams=BEAMS, buckets=(4,),
+                               super_tick_max=2)
+        assert eng.super_tick_max == 2  # explicit kwarg wins
+        with pytest.raises(ValueError):
+            FleetFusedIngest(p, 1, beams=BEAMS, super_tick_max=0)
+        with pytest.raises(ValueError):
+            DriverParams(super_tick_max=0).validate()
+
+    def test_staging_buffers_are_recycled(self):
+        """The per-bucket staging planes must recycle through the free
+        list instead of allocating fresh each tick (the alloc-churn
+        satellite) — and a pair is only recycled AFTER its dispatch's
+        results were fetched, so reuse can never alias an in-flight
+        dispatch's input even under zero-copy host-buffer semantics."""
+        sf = [(DENSE, _make_stream(
+            Ans.MEASUREMENT_DENSE_CAPSULED, 24, _rng(), syncs=(0, 8)
+        ))]
+        ticks = _mk_ticks(sf, np.random.default_rng(7), idle_prob=0.0)
+        eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=1,
+        )
+        # the blocking submit fetches its own tick's results, so each
+        # tick's pair lands back on the free list before the next tick
+        eng.submit(ticks[0])
+        free = eng._staging_free[("tick", 4)]
+        assert len(free) == 1
+        buf0, aux0 = free[0]
+        for tick in ticks[1:]:
+            eng.submit(tick)
+        free = eng._staging_free[("tick", 4)]
+        assert len(free) == 1  # steady state: one pair, recycled forever
+        assert free[0][0] is buf0 and free[0][1] is aux0
+        assert eng.dispatch_count >= len(ticks)
+        # while a dispatch is UNFETCHED its pair must stay off the free
+        # list (submit_pipelined defers the fetch by one tick)
+        eng2 = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, max_revs=6, buckets=(4,),
+            super_tick_max=1,
+        )
+        eng2.submit_pipelined(ticks[0])
+        assert len(eng2._staging_free.get(("tick", 4), [])) == 0
+        eng2.flush()
+        assert len(eng2._staging_free[("tick", 4)]) == 1
+
+
+class TestServiceBacklogSeam:
+    def test_submit_bytes_backlog_both_backends(self):
+        """The service's catch-up seam: the fused backend drains the
+        backlog through the super-step (all completions returned, in
+        tick order, bit-exact vs the per-tick fused engine); the host
+        backend replays the same ticks through the lockstep golden
+        path and publishes through the same seam."""
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ShardedFilterService,
+        )
+
+        frames = _make_stream(
+            Ans.MEASUREMENT_DENSE_CAPSULED, 40, _rng(), syncs=(0, 10, 25)
+        )
+        sf = [(DENSE, frames), (DENSE, frames)]
+        ticks = _mk_ticks(sf, np.random.default_rng(23), idle_prob=0.0)
+
+        svc = ShardedFilterService(
+            _params(fleet_ingest_backend="fused", super_tick_max=4), 2,
+            beams=BEAMS, fleet_ingest_buckets=(4,),
+        )
+        got = svc.submit_bytes_backlog(ticks)
+        assert svc.fleet_ingest is not None
+        assert svc.fleet_ingest.super_dispatches >= 1
+        assert svc.fleet_ingest.dispatch_count < len(
+            [t for t in ticks if any(t)]
+        )
+
+        ref, _ = _run_sequential(ticks, 2)
+        for i in range(2):
+            assert len(got[i]) == len(ref[i]) >= 1
+            for out, (ho, _, _) in zip(got[i], ref[i]):
+                assert np.array_equal(
+                    np.asarray(out.ranges), np.asarray(ho.ranges)
+                )
+
+        svc_h = ShardedFilterService(
+            _params(fleet_ingest_backend="host"), 2, beams=BEAMS
+        )
+        svc_h.precompile()
+        got_h = svc_h.submit_bytes_backlog(ticks)
+        assert all(len(s) >= 1 for s in got_h)
+
+    def test_backlog_validates_stream_count(self):
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ShardedFilterService,
+        )
+
+        svc = ShardedFilterService(
+            _params(fleet_ingest_backend="host"), 2, beams=BEAMS
+        )
+        with pytest.raises(ValueError):
+            svc.submit_bytes_backlog([[None]])  # 1 run for a 2-stream fleet
