@@ -195,6 +195,7 @@ GRADED = {
     9: ("ingest", POINTS, dict(window=WINDOW)),  # host vs fused ingest A/B
     10: ("fleet_ingest", POINTS, dict(window=WINDOW)),  # fleet-tick bytes A/B
     11: ("super_tick", POINTS, dict(window=WINDOW)),  # T-tick super-step drain A/B
+    12: ("mapping", POINTS, dict(window=WINDOW)),  # SLAM front-end host-vs-fused A/B
 }
 
 
@@ -1479,6 +1480,221 @@ def bench_super_tick(smoke: bool = False) -> dict:
     }
 
 
+def bench_mapping(smoke: bool = False) -> dict:
+    """Config 12 — the SLAM front-end A/B: identical synthetic-room
+    fleets through the mapper (mapping/mapper.FleetMapper — correlative
+    scan-to-map match + log-odds update per revolution) two ways:
+
+      * host  — the NumPy golden reference, one per-stream step on the
+        host per tick (N steps/tick).
+      * fused — ops/scan_match.fleet_map_match_step: N streams match N
+        maps in ONE compiled vmapped dispatch per fleet tick.
+
+    Three claims are asserted, not inferred (a violation raises):
+
+      1. STRUCTURAL — the fused arm issues exactly one dispatch per
+         fleet tick, independent of fleet size (the engine's
+         ``dispatch_count`` counter).
+      2. PARITY — both arms produce byte-identical pose trajectories
+         and final map states (the integer datapath's bit-exactness
+         contract, re-checked here at bench geometry).
+      3. ACCURACY — the matcher tracks the synthetic ground-truth
+         drift to within the coarse lattice pitch (mean |error| below
+         ``2 * coarse`` cells).
+
+    Wall-time context comes with the calibrated decomposition the other
+    A/Bs use: ``dispatch_floor_ms`` (an idle fused dispatch round trip)
+    separates the structural per-dispatch saving from rig weather; the
+    ``mapping_ab`` decision key rides with its clamp flag
+    (scripts/decide_backends.py recommends ``map_backend`` from TPU
+    records only).  ``smoke`` shrinks geometry to a seconds-scale CPU
+    run — the tier-1 gate (tests/test_bench_meta.py).
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+    if smoke:
+        grid, cell, beams, streams, ticks_n = 64, 0.1, 512, 3, 6
+    else:
+        grid, cell, beams, streams, ticks_n = 256, 0.05, BEAMS, 4, 20
+
+    def make_params(backend: str) -> DriverParams:
+        return DriverParams(
+            filter_chain=("clip", "median", "voxel"),
+            map_enable=True, map_backend=backend,
+            map_grid=grid, map_cell_m=cell, map_match_window=0.4,
+        )
+
+    # synthetic 5x5 m square room observed from a drifting pose: B beam
+    # rays cast to the walls, expressed in the sensor frame — the same
+    # (N, B, 2) planes feed both arms, so backend choice cannot change
+    # the inputs (the mapper's own input contract)
+    half_room = 2.5
+    t = np.linspace(0, 2 * np.pi, beams, endpoint=False)
+    dx, dy = np.cos(t), np.sin(t)
+    with np.errstate(divide="ignore"):
+        r_wall = np.minimum(
+            np.where(np.abs(dx) > 1e-12, half_room / np.abs(dx), np.inf),
+            np.where(np.abs(dy) > 1e-12, half_room / np.abs(dy), np.inf),
+        )
+    wx, wy = dx * r_wall, dy * r_wall
+
+    def truth_pose(s: int, k: int) -> tuple:
+        # per-stream drift, one-to-two cells per tick — inside the
+        # matcher's search window, outside its quantization noise
+        return (
+            0.03 * k * (1 + 0.1 * s),
+            -0.02 * k * (1 + 0.2 * s),
+            0.004 * k,
+        )
+
+    tick_inputs = []
+    for k in range(ticks_n):
+        pts = np.zeros((streams, beams, 2), np.float32)
+        for s in range(streams):
+            x0, y0, th = truth_pose(s, k)
+            c, si = np.cos(-th), np.sin(-th)
+            pts[s, :, 0] = c * (wx - x0) - si * (wy - y0)
+            pts[s, :, 1] = si * (wx - x0) + c * (wy - y0)
+        tick_inputs.append(pts)
+    masks = np.ones((streams, beams), bool)
+    live = np.ones((streams,), np.int32)
+
+    def run_arm(backend: str):
+        mapper = FleetMapper(make_params(backend), streams, beams=beams)
+        mapper.precompile()
+        traj = np.zeros((ticks_n, streams, 3), np.int32)
+        t0 = time.perf_counter()
+        for k, pts in enumerate(tick_inputs):
+            ests = mapper.submit_points(pts, masks, live)
+            for s, est in enumerate(ests):
+                traj[k, s] = est.pose_q
+        dt = time.perf_counter() - t0
+        return {
+            "dt_s": dt, "traj": traj, "snap": mapper.snapshot(),
+            "dispatches": mapper.dispatch_count, "ticks": mapper.ticks,
+            "cfg": mapper.cfg,
+        }
+
+    def calibrate_dispatch_floor(n: int = 8) -> float:
+        """Median ms of an all-idle fused dispatch + wire fetch: the
+        pure dispatch/staging/fetch round trip each fleet tick pays."""
+        mapper = FleetMapper(make_params("fused"), streams, beams=beams)
+        mapper.precompile()
+        idle = np.zeros((streams,), np.int32)
+        zeros = np.zeros((streams, beams, 2), np.float32)
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            mapper.submit_points(zeros, masks, idle)
+            ts.append(time.perf_counter() - t0)
+        return float(np.percentile(ts, 50)) * 1e3
+
+    # interleave the arms x2, best-of + MIN floor (1.5-core load drifts
+    # ~2x across seconds — docs/BENCHMARKS.md discipline).  The smoke
+    # gate is structural (parity + dispatch counts), not a timing
+    # record, so it runs one round to respect the tier-1 budget.
+    host_best = fused_best = None
+    floor_ms = float("inf")
+    for _ in range(1 if smoke else 2):
+        a = run_arm("host")
+        if host_best is None or a["dt_s"] < host_best["dt_s"]:
+            host_best = a
+        floor_ms = min(
+            floor_ms, calibrate_dispatch_floor(4 if smoke else 8)
+        )
+        b = run_arm("fused")
+        if fused_best is None or b["dt_s"] < fused_best["dt_s"]:
+            fused_best = b
+
+    # -- claim 1: one dispatch per fleet tick, independent of N --
+    if fused_best["dispatches"] != ticks_n:
+        raise RuntimeError(
+            f"fused mapper dispatched {fused_best['dispatches']} times "
+            f"for {ticks_n} fleet ticks (expected one per tick)"
+        )
+    # -- claim 2: bit-exact host/fused parity --
+    if not np.array_equal(host_best["traj"], fused_best["traj"]):
+        raise RuntimeError("mapping parity broke: trajectories differ")
+    for k in host_best["snap"]:
+        if not np.array_equal(host_best["snap"][k], fused_best["snap"][k]):
+            raise RuntimeError(f"mapping parity broke: map state {k!r}")
+    # -- claim 3: the matcher actually tracked the drift --
+    cfg = fused_best["cfg"]
+    sub_per_cell = 32.0
+    errs = []
+    for s in range(streams):
+        x0, y0, _ = truth_pose(s, ticks_n - 1)
+        got = fused_best["traj"][-1, s].astype(np.float64)
+        errs.append(abs(got[0] / sub_per_cell - x0 / cell))
+        errs.append(abs(got[1] / sub_per_cell - y0 / cell))
+    pose_err_cells = float(np.mean(errs))
+    if pose_err_cells > 2.0 * cfg.coarse:
+        raise RuntimeError(
+            f"matcher lost the synthetic drift: mean |pose error| "
+            f"{pose_err_cells:.2f} cells > {2 * cfg.coarse}"
+        )
+
+    scans = ticks_n * streams
+    host_sps = scans / host_best["dt_s"]
+    fused_sps = scans / fused_best["dt_s"]
+    measured_saving_ms = (host_best["dt_s"] - fused_best["dt_s"]) * 1e3
+    clamped = measured_saving_ms <= 0
+    return {
+        "metric": metric_name(12),
+        "value": round(fused_sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(fused_sps / (streams * BASELINE_SCANS_PER_SEC), 3),
+        "streams": streams,
+        "ticks": ticks_n,
+        "host": {
+            "scans_per_sec": round(host_sps, 2),
+            "steps": ticks_n * streams,
+            "drain_ms": round(host_best["dt_s"] * 1e3, 3),
+        },
+        "fused": {
+            "scans_per_sec": round(fused_sps, 2),
+            "dispatches": fused_best["dispatches"],
+            "drain_ms": round(fused_best["dt_s"] * 1e3, 3),
+        },
+        "structural": {
+            "fused_dispatches_per_tick": 1,
+            "one_dispatch_claim_holds": True,  # asserted above
+            "bit_exact_parity_holds": True,    # asserted above
+        },
+        "pose_err_cells": round(pose_err_cells, 3),
+        "dispatch_floor_ms": round(floor_ms, 3),
+        "measured_saving_ms": round(measured_saving_ms, 3),
+        # the decide_backends decision key for the map_backend auto
+        # recommendation (TPU records only carry weight there)
+        "mapping_ab": {
+            "match_speedup": round(
+                host_best["dt_s"] / max(fused_best["dt_s"], 1e-9), 3
+            ),
+            "per_dispatch_floor_ms": round(floor_ms, 3),
+            "overhead_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "both arms run the same integer matcher math, so on a "
+            "linkless CPU rig the ratio measures XLA-vs-numpy kernel "
+            "throughput plus the per-dispatch floor, not the "
+            "architectural win.  The structural claims are what a chip "
+            "inherits: one compiled vmapped dispatch per FLEET tick "
+            "(asserted) means per-tick host<->device traffic is O(1) in "
+            "fleet size, and on a remote-attached device each avoided "
+            "per-stream round trip is 1-18 ms (observed) — N-1 of which "
+            "the fused arm removes per tick.  The on-chip capture "
+            "queued in scripts/rig_recapture.sh is where the headline "
+            "lands."
+        ),
+        "grid": grid,
+        "cell_m": cell,
+        "beams": beams,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def _run_chain(cfg: FilterConfig, points: int) -> tuple[float, float]:
     """Sustained scans/s + sync p99 (ms) for one FilterConfig."""
     runner = _ChainRunner(cfg, points)
@@ -1597,6 +1813,7 @@ def metric_name(config: int) -> str:
         9: "fused_ingest_bytes_to_output_scans_per_sec",
         10: "fleet_fused_ingest_bytes_to_scans_per_sec",
         11: "super_tick_drain_scans_per_sec",
+        12: "mapping_match_update_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -1612,6 +1829,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_fleet_ingest()
     if kind == "super_tick":
         return bench_super_tick()
+    if kind == "mapping":
+        return bench_mapping()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -1948,6 +2167,15 @@ if __name__ == "__main__":
         "tier-1 regression gate for the super-step lowering",
     )
     ap.add_argument(
+        "--smoke-mapping",
+        action="store_true",
+        help="seconds-scale CPU run of the config-12 SLAM front-end A/B "
+        "(small geometry, forced CPU backend, no tunnel probe): asserts "
+        "one fused dispatch per fleet tick, bit-exact host/fused parity "
+        "and drift tracking — the tier-1 regression gate for the "
+        "mapping subsystem",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -2000,6 +2228,13 @@ if __name__ == "__main__":
         # anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_super_tick(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_mapping:
+        # same CPU-only discipline: the mapping structural/parity gate
+        # must run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_mapping(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

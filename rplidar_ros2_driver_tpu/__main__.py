@@ -187,7 +187,63 @@ def _cmd_replay(args) -> int:
         )
     if args.fused:
         _replay_fused_report(args, per_stream, runs_per_path)
+    if args.map:
+        _replay_map_report(args, per_stream)
     return 0
+
+
+def _replay_map_report(args, per_stream) -> None:
+    """The `replay --map` arm: each recording's revolutions through the
+    chain + SLAM front-end (replay.replay_with_map) — trajectory + final
+    log-odds map, inspectable without ROS (ASCII preview by default,
+    PGM via --map-pgm)."""
+    import numpy as np
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.replay import replay_with_map
+    from rplidar_ros2_driver_tpu.tools.viz import (
+        ascii_preview,
+        draw_trajectory,
+        map_to_image,
+        save_pgm,
+    )
+
+    params = DriverParams(
+        filter_backend="cpu" if args.cpu else "tpu",
+        filter_chain=("clip", "median", "voxel"),
+        map_enable=True,
+        map_backend=args.map_backend,
+    )
+    for i, (path, revs) in enumerate(zip(args.recordings, per_stream)):
+        if not revs:
+            print(f"{path}: --map skipped (no complete revolutions)")
+            continue
+        traj, scores, mapper = replay_with_map(revs, params)
+        snap = mapper.snapshot()
+        occupied = int(np.sum(snap["log_odds"][0] > 0))
+        matched = int(np.sum(scores > 0))
+        x, y, th = traj[-1]
+        print(
+            f"{path}: mapped {len(revs)} revolutions "
+            f"({mapper.backend} backend): {matched} matched, "
+            f"{occupied} occupied cells, final pose "
+            f"({x:+.3f} m, {y:+.3f} m, {np.degrees(th):+.2f} deg)"
+        )
+        img = draw_trajectory(
+            map_to_image(snap["log_odds"][0], mapper.cfg.clamp_q),
+            traj[:, :2], mapper.cfg.cell_m,
+        )
+        if args.map_pgm:
+            out = (
+                args.map_pgm if len(per_stream) == 1
+                else f"{args.map_pgm}.{i}"
+            )
+            save_pgm(img, out)
+            print(f"  wrote {out}")
+        else:
+            # threshold: occupied evidence past half clamp (or the
+            # trajectory overlay) shows as '#', unknown/free as '.'
+            print(ascii_preview((img >= 192).astype(np.uint8), width=64))
 
 
 def _replay_fused_report(args, per_stream, runs_per_path) -> None:
@@ -420,6 +476,28 @@ def main(argv=None) -> int:
         "(replay_raw_fused: T-tick super-step drain, "
         "ceil(ticks/T) dispatches) and report scans/s vs the host "
         "decode path, parity-checked",
+    )
+    replay.add_argument(
+        "--map",
+        action="store_true",
+        help="also run the decoded revolutions through the SLAM "
+        "front-end (correlative scan-to-map matching + log-odds map, "
+        "replay.replay_with_map): prints trajectory + map summary and "
+        "an ASCII map preview",
+    )
+    replay.add_argument(
+        "--map-pgm",
+        default=None,
+        metavar="PATH",
+        help="write the --map log-odds map (trajectory overlaid) as a "
+        "PGM instead of the ASCII preview",
+    )
+    replay.add_argument(
+        "--map-backend",
+        choices=("auto", "host", "fused"),
+        default="auto",
+        help="mapper backend for --map (auto resolves per the standing "
+        "decision procedure; host is the NumPy golden reference)",
     )
 
     args = ap.parse_args(argv)

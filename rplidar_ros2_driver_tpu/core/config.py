@@ -150,6 +150,30 @@ class DriverParams:
     # loads — bench records cold-vs-warm startup in its meta).  None/""
     # disables (default: process-lifetime jit cache only).
     compilation_cache_dir: str | None = None
+    # -- SLAM front-end (mapping/mapper.FleetMapper + ops/scan_match) --
+    # enable the per-stream log-odds mapper + correlative scan matcher:
+    # each revolution's chain output is matched against a persistent
+    # occupancy map and the estimated pose published alongside the scan.
+    # Requires filter_chain stages (the mapper consumes the chain's
+    # Cartesian endpoint output).
+    map_enable: bool = False
+    # mapper backend seam: "host" = the NumPy golden reference (one
+    # per-stream step on the host — the bit-exact oracle); "fused" = the
+    # device path (N streams match N maps in ONE compiled vmapped
+    # dispatch per fleet tick, ops/scan_match.fleet_map_match_step —
+    # bit-exact vs N host steps, tests/test_mapping.py); "auto" resolves
+    # per the standing decision procedure (mapping/mapper.
+    # resolve_map_backend — host until an on-chip config-12 artifact
+    # clears the bar; scripts/decide_backends.py reads `mapping_ab`).
+    map_backend: str = "auto"
+    map_grid: int = 256               # cells per side of the log-odds map
+    map_cell_m: float = 0.05          # metres per map cell
+    map_match_window: float = 0.4     # translation search radius (m)
+    # log-odds parameters (probability units; quantized to Q10 fixed
+    # point once, in mapping/mapper.map_config_from_params)
+    map_log_odds_hit: float = 0.9     # increment per endpoint hit
+    map_log_odds_miss: float = -0.4   # decrement per free-space pass
+    map_log_odds_clamp: float = 8.0   # saturation bound (±)
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -224,6 +248,33 @@ class DriverParams:
             )
         if self.super_tick_max < 1:
             raise ValueError("super_tick_max must be >= 1 (1 disables)")
+        if self.map_backend not in ("auto", "host", "fused"):
+            raise ValueError(
+                "map_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.map_enable and not self.filter_chain:
+            raise ValueError(
+                "map_enable requires filter_chain stages (the mapper "
+                "consumes the chain's Cartesian endpoint output)"
+            )
+        if not (8 <= self.map_grid <= 1024) or self.map_grid % 4:
+            raise ValueError(
+                "map_grid must be within [8, 1024] and divide by 4 "
+                "(the matcher's coarse pyramid factor)"
+            )
+        if self.map_cell_m <= 0:
+            raise ValueError("map_cell_m must be positive")
+        if self.map_match_window <= 0:
+            raise ValueError("map_match_window must be positive")
+        if self.map_log_odds_hit <= 0:
+            raise ValueError("map_log_odds_hit must be positive")
+        if self.map_log_odds_miss >= 0:
+            raise ValueError("map_log_odds_miss must be negative")
+        if self.map_log_odds_clamp < self.map_log_odds_hit:
+            raise ValueError(
+                "map_log_odds_clamp must be >= map_log_odds_hit (a clamp "
+                "below one hit increment can never mark a cell occupied)"
+            )
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DriverParams":

@@ -1,0 +1,6 @@
+from rplidar_ros2_driver_tpu.mapping.mapper import (  # noqa: F401
+    FleetMapper,
+    PoseEstimate,
+    map_config_from_params,
+    resolve_map_backend,
+)

@@ -1,0 +1,430 @@
+"""FleetMapper — the SLAM front-end driver (``map_backend`` seam).
+
+Subscribes to the filter chain's outputs (single-stream ScanFilterChain,
+ShardedFilterService fleet ticks, or FleetFusedIngest revolutions — all
+deliver FilterOutput) and keeps one device-resident :class:`MapState`
+per stream: each revolution is correlatively matched against that
+stream's log-odds map, the accepted pose delta composed in, and the map
+updated from the scan endpoints (ops/scan_match.py).
+
+Backends, resolved like every other seam in this framework:
+
+  * ``host``  — the NumPy golden reference (ops/scan_match_ref.py), one
+    per-stream step on the host.  The bit-exact oracle and the CPU
+    default.
+  * ``fused`` — the device path: N streams match N maps in ONE compiled
+    vmapped dispatch per fleet tick (ops/scan_match.fleet_map_match_step,
+    stream-stacked MapState donated in place).  Bit-exact against N
+    independent host steps (integer datapath; tests/test_mapping.py pins
+    fleet sizes 1/3/8 byte-for-byte).
+  * ``auto``  — host until an on-chip ``mapping_ab`` artifact clears the
+    standing decision bar (docs/BENCHMARKS.md); scripts/decide_backends.py
+    reads the config-12 evidence and recommends the flip mechanically.
+
+Checkpoint surface mirrors ScanFilterChain's: snapshot/restore with
+shape pre-validation (``snapshot_compatible``), identical snapshot
+format across backends, plus a schema version key so a mapper survives
+node restarts across format revisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    LO_SCALE,
+    MAP_STATE_VERSION,
+    MapConfig,
+    MapState,
+    min_quant_shift,
+    pose_to_metric,
+)
+
+log = logging.getLogger("rplidar_tpu.mapper")
+
+
+def resolve_map_backend(requested: str, platform: Optional[str] = None) -> str:
+    """Resolve the ``auto`` map backend (mirrors the chain's sibling
+    resolvers; explicit requests pass through).  ``host`` is the NumPy
+    golden reference; ``fused`` is the one-dispatch-per-fleet-tick
+    device path.  ``auto`` stays host until an on-chip ``mapping_ab``
+    artifact (bench.py --config 12) clears the standing decision bar —
+    on a linkless CPU rig both arms run the same integer math and the
+    wall-time ratio is dispatch-overhead weather
+    (artifacts/mapping_ab_cpu.json), so CPU evidence can never flip it."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "host"
+
+
+def map_config_from_params(
+    params, beams: int = 2048, platform: Optional[str] = None
+) -> MapConfig:
+    """The one params -> MapConfig mapping (the mapping analog of
+    filters/chain.config_from_params), so the node, the fleet service,
+    replay and the bench cannot drift on geometry or fixed-point
+    scaling.  The Q10 quantization of the float log-odds params happens
+    HERE and only here."""
+    from rplidar_ros2_driver_tpu.filters.chain import resolve_voxel_backend
+
+    cell = float(params.map_cell_m)
+    coarse = 4
+    clamp_q = int(round(params.map_log_odds_clamp * LO_SCALE))
+    return MapConfig(
+        grid=int(params.map_grid),
+        cell_m=cell,
+        beams=beams,
+        hit_q=int(round(params.map_log_odds_hit * LO_SCALE)),
+        miss_q=int(round(params.map_log_odds_miss * LO_SCALE)),
+        clamp_q=clamp_q,
+        coarse=coarse,
+        window_cells=max(
+            1, int(math.ceil(params.map_match_window / (cell * coarse)))
+        ),
+        fine_radius=coarse,
+        quant_shift=min_quant_shift(clamp_q, beams),
+        voxel_backend=resolve_voxel_backend(
+            getattr(params, "voxel_backend", "auto"), platform
+        ),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class PoseEstimate:
+    """One stream's per-revolution match result (host numpy/floats)."""
+
+    x_m: float
+    y_m: float
+    theta_rad: float
+    score: int            # raw integer correlation score (0 = rejected)
+    matched_points: int   # valid endpoints that entered the match
+    revision: int         # map revisions absorbed so far
+    pose_q: np.ndarray    # (3,) int32 raw fixed-point pose
+
+
+class FleetMapper:
+    """Per-stream log-odds mapper + correlative matcher driver.
+
+    Thread-safety follows ScanFilterChain: the fused step DONATES the
+    stacked state, so every state access serializes on one lock.
+    Structural counters (``dispatch_count``, ``ticks``) exist so the
+    bench decomposition can assert the one-dispatch-per-fleet-tick claim
+    rather than infer it from wall time."""
+
+    def __init__(
+        self,
+        params,
+        streams: int = 1,
+        *,
+        beams: Optional[int] = None,
+        device=None,
+    ) -> None:
+        from rplidar_ros2_driver_tpu.filters.chain import (
+            DEFAULT_BEAMS,
+            pick_device,
+        )
+
+        if streams < 1:
+            raise ValueError("mapper needs at least one stream")
+        self.streams = streams
+        self.backend = resolve_map_backend(
+            getattr(params, "map_backend", "auto")
+        )
+        if self.backend == "fused":
+            import jax
+
+            self._jax = jax
+            self.device = device if device is not None else pick_device(
+                params.filter_backend
+            )
+            platform = self.device.platform
+        else:
+            self._jax = None
+            self.device = None
+            platform = None
+        self.cfg = map_config_from_params(
+            params, beams or DEFAULT_BEAMS, platform=platform
+        )
+        self._lock = threading.Lock()
+        self._states = None        # fused: stacked device MapState
+        self._states_np = None     # host: stacked numpy snapshot-dict
+        self.reset()
+        # structural counters (the config-12 O(1) assertion)
+        self.ticks = 0
+        self.dispatch_count = 0
+        self.matches = 0
+        self.last_estimates: list[Optional[PoseEstimate]] = [None] * streams
+
+    # -- state construction -------------------------------------------------
+
+    def _fresh_states(self):
+        if self.backend == "fused":
+            jnp = self._jax.numpy
+            one = MapState.create(self.cfg)
+            stacked = self._jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(
+                    x, (self.streams,) + x.shape
+                ).copy(),
+                one,
+            )
+            return self._jax.device_put(stacked, self.device)
+        g = self.cfg.grid
+        return {
+            "log_odds": np.zeros((self.streams, g, g), np.int32),
+            "pose": np.zeros((self.streams, 3), np.int32),
+            "origin_xy": np.zeros((self.streams, 2), np.float32),
+            "revision": np.zeros((self.streams,), np.int32),
+        }
+
+    def reset(self) -> None:
+        """Cold reset of every stream's map and pose."""
+        fresh = self._fresh_states()
+        with self._lock:
+            if self.backend == "fused":
+                self._states = fresh
+            else:
+                self._states_np = fresh
+
+    def precompile(self) -> None:
+        """Warm the fused program on a throwaway state (the mapper's
+        analog of the chain/ingest precompiles) so the first live tick
+        never stalls on an XLA compile.  No-op on the host backend."""
+        if self.backend != "fused":
+            return
+        from rplidar_ros2_driver_tpu.ops.scan_match import (
+            fleet_map_match_step,
+        )
+
+        throwaway = self._fresh_states()
+        b = self.cfg.beams
+        # numpy args, matching the live submit exactly (a committed-arg
+        # warmup compiles a separate executable — driver/ingest note)
+        fleet_map_match_step(
+            throwaway,
+            np.zeros((self.streams, b, 2), np.float32),
+            np.zeros((self.streams, b), bool),
+            np.zeros((self.streams,), np.int32),
+            cfg=self.cfg,
+        )
+
+    # -- hot path -----------------------------------------------------------
+
+    def submit(self, outputs: Sequence) -> list[Optional[PoseEstimate]]:
+        """One fleet tick from chain outputs: ``outputs[i]`` is stream
+        i's newest FilterOutput (None = idle — that stream's map and
+        pose pass through untouched).  Returns one Optional[PoseEstimate]
+        per stream."""
+        if len(outputs) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} outputs, got {len(outputs)}"
+            )
+        b = self.cfg.beams
+        points = np.zeros((self.streams, b, 2), np.float32)
+        masks = np.zeros((self.streams, b), bool)
+        live = np.zeros((self.streams,), np.int32)
+        for i, out in enumerate(outputs):
+            if out is None:
+                continue
+            xy = np.asarray(out.points_xy, np.float32)
+            if xy.shape != (b, 2):
+                raise ValueError(
+                    f"stream {i}: points {xy.shape} != beam grid ({b}, 2)"
+                )
+            points[i] = xy
+            masks[i] = np.asarray(out.point_mask, bool)
+            live[i] = 1
+        return self.submit_points(points, masks, live)
+
+    def submit_points(
+        self, points: np.ndarray, masks: np.ndarray, live: np.ndarray
+    ) -> list[Optional[PoseEstimate]]:
+        """Lower-level tick: stream-stacked (N, B, 2) f32 Cartesian
+        endpoints + (N, B) validity + (N,) live flags.  One fused
+        dispatch (or N host-reference steps) per call."""
+        live = np.asarray(live, np.int32)
+        with self._lock:
+            self.ticks += 1
+            if self.backend == "fused":
+                from rplidar_ros2_driver_tpu.ops.scan_match import (
+                    fleet_map_match_step,
+                )
+
+                self._states, wires = fleet_map_match_step(
+                    self._states, points, masks, live, cfg=self.cfg
+                )
+                self.dispatch_count += 1
+                wires = np.asarray(wires)
+                revs = np.asarray(self._states.revision)
+            else:
+                from rplidar_ros2_driver_tpu.ops.scan_match_ref import (
+                    map_match_step_np,
+                )
+
+                st = self._states_np
+                wires = np.zeros((self.streams, 5), np.int32)
+                for i in range(self.streams):
+                    stream_state = {
+                        k: st[k][i] for k in
+                        ("log_odds", "pose", "origin_xy", "revision")
+                    }
+                    new_state, wires[i] = map_match_step_np(
+                        stream_state, points[i], masks[i], int(live[i]),
+                        self.cfg,
+                    )
+                    for k in ("log_odds", "pose", "origin_xy"):
+                        st[k][i] = new_state[k]
+                    st["revision"][i] = new_state["revision"]
+                revs = st["revision"]
+        estimates: list[Optional[PoseEstimate]] = []
+        for i in range(self.streams):
+            if not live[i]:
+                estimates.append(None)
+                continue
+            pose_q = wires[i, :3].astype(np.int32)
+            x, y, th = pose_to_metric(pose_q, self.cfg)
+            est = PoseEstimate(
+                x_m=x, y_m=y, theta_rad=th,
+                score=int(wires[i, 3]),
+                matched_points=int(wires[i, 4]),
+                revision=int(revs[i]),
+                pose_q=pose_q,
+            )
+            estimates.append(est)
+            self.last_estimates[i] = est
+            if est.score > 0:
+                self.matches += 1
+        return estimates
+
+    # -- checkpoint surface (mirrors ScanFilterChain's) ---------------------
+
+    def snapshot(self) -> dict[str, np.ndarray]:
+        """Host copy of every stream's MapState, identical format across
+        backends, plus the schema ``version`` key (the mapping analog of
+        utils/checkpoint's format fingerprint — restore rejects a future
+        format instead of misreading it)."""
+        with self._lock:
+            if self.backend == "fused":
+                jnp = self._jax.numpy
+                state = self._jax.tree_util.tree_map(jnp.copy, self._states)
+                snap = {
+                    k: np.asarray(v) for k, v in vars(state).items()
+                }
+            else:
+                snap = {k: v.copy() for k, v in self._states_np.items()}
+        snap["version"] = np.asarray(MAP_STATE_VERSION, np.int32)
+        return snap
+
+    @staticmethod
+    def _shape_mismatch(
+        snap: dict, streams: int, grid: int
+    ) -> Optional[tuple[dict, dict]]:
+        expected = {
+            k: (streams, *v) for k, v in MapState.shapes(grid).items()
+        }
+        got = {
+            k: tuple(np.asarray(v).shape)
+            for k, v in snap.items() if k != "version"
+        }
+        return None if expected == got else (got, expected)
+
+    @classmethod
+    def snapshot_compatible(
+        cls, params, snap: dict, streams: int = 1
+    ) -> bool:
+        """Would a mapper built from ``params`` accept this snapshot?
+        Host-side, no device work (node.load_checkpoint pre-validation,
+        like ScanFilterChain.snapshot_compatible)."""
+        if int(np.asarray(snap.get("version", -1))) != MAP_STATE_VERSION:
+            return False
+        return cls._shape_mismatch(snap, streams, int(params.map_grid)) is None
+
+    def restore(self, snap: Optional[dict]) -> bool:
+        """Restore a snapshot, or cold-reset when None.  Version or
+        geometry mismatch is rejected with the live state untouched
+        (returns False), the chain's reject-don't-crash contract."""
+        if snap is None:
+            self.reset()
+            return False
+        if int(np.asarray(snap.get("version", -1))) != MAP_STATE_VERSION:
+            log.warning(
+                "rejecting map snapshot with schema version %s (want %d)",
+                snap.get("version"), MAP_STATE_VERSION,
+            )
+            return False
+        mismatch = self._shape_mismatch(snap, self.streams, self.cfg.grid)
+        if mismatch is not None:
+            got, expected = mismatch
+            log.warning(
+                "rejecting incompatible map snapshot (%s != %s)",
+                got, expected,
+            )
+            return False
+        core = {
+            k: np.asarray(snap[k])
+            for k in ("log_odds", "pose", "origin_xy", "revision")
+        }
+        if self.backend == "fused":
+            restored = self._jax.device_put(
+                MapState(
+                    log_odds=core["log_odds"].astype(np.int32),
+                    pose=core["pose"].astype(np.int32),
+                    origin_xy=core["origin_xy"].astype(np.float32),
+                    revision=core["revision"].astype(np.int32),
+                ),
+                self.device,
+            )
+            with self._lock:
+                self._states = restored
+        else:
+            with self._lock:
+                self._states_np = {
+                    "log_odds": core["log_odds"].astype(np.int32).copy(),
+                    "pose": core["pose"].astype(np.int32).copy(),
+                    "origin_xy": core["origin_xy"].astype(np.float32).copy(),
+                    "revision": core["revision"].astype(np.int32).copy(),
+                }
+        return True
+
+    # -- sharded (Orbax) checkpointing --------------------------------------
+
+    def save_sharded(self, path: str) -> None:
+        """Persist the fused backend's stacked MapState with Orbax
+        (utils/checkpoint_orbax — the pytree checkpointer is schema-
+        agnostic, so MapState rides the same save/rotate machinery as
+        FilterState).  Host-backend states go through snapshot()+npz."""
+        if self.backend != "fused":
+            raise RuntimeError(
+                "save_sharded needs the fused backend (host states "
+                "checkpoint via snapshot() + utils/checkpoint)"
+            )
+        from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
+
+        with self._lock:
+            jnp = self._jax.numpy
+            state = self._jax.tree_util.tree_map(jnp.copy, self._states)
+        checkpoint_orbax.save_sharded(path, state)
+
+    def load_sharded(self, path: str) -> bool:
+        if self.backend != "fused":
+            raise RuntimeError("load_sharded needs the fused backend")
+        import jax
+
+        from rplidar_ros2_driver_tpu.utils import checkpoint_orbax
+
+        template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self._fresh_states(),
+        )
+        got = checkpoint_orbax.restore_sharded(path, template)
+        if got is None:
+            return False
+        with self._lock:
+            self._states = self._jax.device_put(got, self.device)
+        return True

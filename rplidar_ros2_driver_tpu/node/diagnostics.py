@@ -59,6 +59,7 @@ class DiagnosticsUpdater:
         device_info: str,
         latency_p99_ms: Optional[dict[str, float]] = None,
         rx_scheduling: Optional[int] = None,
+        map_status: Optional[dict] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -76,6 +77,17 @@ class DiagnosticsUpdater:
         if latency_p99_ms:
             for stage, ms in sorted(latency_p99_ms.items()):
                 values[f"p99 {stage} (ms)"] = f"{ms:.3f}"
+        # SLAM front-end observability (mapping/mapper.FleetMapper): the
+        # matcher's pose/score/map-revision, mirroring how latencies ride
+        # the same status message
+        if map_status:
+            values["Map Backend"] = str(map_status.get("backend", "?"))
+            pose = map_status.get("pose")
+            if pose is not None:
+                x, y, th = pose
+                values["Map Pose"] = f"{x:+.3f} {y:+.3f} {th:+.4f}"
+                values["Map Match Score"] = str(map_status.get("score", 0))
+                values["Map Revision"] = str(map_status.get("revision", 0))
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

@@ -40,6 +40,23 @@ class PointCloudHost:
 
 
 @dataclasses.dataclass
+class PoseHost:
+    """2-D pose estimate from the SLAM front-end — the array analog of
+    ``geometry_msgs/PoseStamped`` (yaw-only; a rclpy bridge maps theta
+    to a z-axis quaternion)."""
+
+    stamp: float
+    frame_id: str          # the map frame ("map")
+    child_frame_id: str    # the sensor frame (params.frame_id)
+    x_m: float
+    y_m: float
+    theta_rad: float
+    score: int = 0         # raw correlation score (0 = match rejected)
+    matched_points: int = 0
+    map_revision: int = 0  # revolutions absorbed into the map
+
+
+@dataclasses.dataclass
 class StaticTransform:
     """base_link -> frame_id identity transform
     (src/rplidar_node.cpp:177-201)."""

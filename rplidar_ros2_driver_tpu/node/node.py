@@ -74,6 +74,12 @@ class RPlidarNode(LifecycleNode):
         # window in place of self.chain; survives FSM driver recreation
         # (each recreated driver gets the same sink re-attached)
         self.fused_ingest = None
+        # SLAM front-end (map_enable): per-stream log-odds map +
+        # correlative matcher fed from _publish_chain_output — the hook
+        # every chain path (sync, pipelined, fused-ingest) funnels
+        # through, so the mapper sees each revolution exactly once
+        self.mapper = None
+        self._mapper_snapshot = None
         self.diagnostics: Optional[DiagnosticsUpdater] = None
         self.tracer = StageTimer()
         self._param_lock = threading.Lock()
@@ -182,6 +188,16 @@ class RPlidarNode(LifecycleNode):
                     # geometry changed since the snapshot: drop it rather
                     # than re-trying (and re-warning) every configure
                     self._chain_snapshot = None
+        if self.params.map_enable and self.params.filter_chain:
+            from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+            self.mapper = FleetMapper(self.params, 1)
+            if self._mapper_snapshot is not None:
+                if not self.mapper.restore(self._mapper_snapshot):
+                    # geometry/schema changed since the snapshot: drop it
+                    # rather than re-warning every configure (the chain's
+                    # stale-snapshot policy)
+                    self._mapper_snapshot = None
         self.diagnostics = DiagnosticsUpdater(
             hardware_id=f"rplidar-{self.params.serial_port}",
             publisher=self.publisher,
@@ -242,6 +258,8 @@ class RPlidarNode(LifecycleNode):
         # framework's checkpoint surface (SURVEY.md §5)
         if self.chain is not None:
             self._chain_snapshot = self.chain.snapshot()
+        if self.mapper is not None:
+            self._mapper_snapshot = self.mapper.snapshot()
         self._update_diagnostics()
         return True
 
@@ -249,20 +267,40 @@ class RPlidarNode(LifecycleNode):
         self.fsm = None
         self.chain = None
         self.fused_ingest = None
-        # _chain_snapshot intentionally survives cleanup: it is the
-        # checkpoint/resume surface (SURVEY.md §5) — a later configure
-        # restores the rolling window.  discard_checkpoint() drops it.
+        self.mapper = None
+        # _chain_snapshot / _mapper_snapshot intentionally survive
+        # cleanup: they are the checkpoint/resume surface (SURVEY.md §5)
+        # — a later configure restores the rolling window and the map.
+        # discard_checkpoint() drops them.
         return True
 
     def discard_checkpoint(self) -> None:
-        """Forget the saved filter-window snapshot (next configure starts cold)."""
+        """Forget the saved filter-window + map snapshots (next configure
+        starts cold)."""
         self._chain_snapshot = None
+        self._mapper_snapshot = None
+
+    # keys of the mapper's MapState inside the combined node checkpoint:
+    # "mapper." prefixed, schema-versioned by the mapper's own "version"
+    # entry (ops/scan_match.MAP_STATE_VERSION) so a mapper survives node
+    # restarts across format revisions — a future-format checkpoint is
+    # rejected at restore, never misread
+    _MAPPER_KEY_PREFIX = "mapper."
+
+    def _split_checkpoint(self, snap: dict) -> tuple[dict, Optional[dict]]:
+        """(chain keys, mapper keys or None) of a combined checkpoint."""
+        p = self._MAPPER_KEY_PREFIX
+        chain = {k: v for k, v in snap.items() if not k.startswith(p)}
+        mapper = {k[len(p):]: v for k, v in snap.items() if k.startswith(p)}
+        return chain, (mapper or None)
 
     def save_checkpoint(self, path: str) -> bool:
-        """Persist the filter-chain state to disk (utils/checkpoint.py).
+        """Persist the filter-chain state — and, when the mapper is
+        enabled, its MapState under versioned ``mapper.*`` keys — to one
+        atomic file (utils/checkpoint.py).
 
-        Uses the live chain state when active/inactive-with-chain, else the
-        last deactivate-time snapshot.  Returns False when there is nothing
+        Uses the live state when active/inactive, else the last
+        deactivate-time snapshots.  Returns False when there is nothing
         to save (no chain configured and no snapshot held).
         """
         from rplidar_ros2_driver_tpu.utils.checkpoint import save_checkpoint
@@ -270,17 +308,29 @@ class RPlidarNode(LifecycleNode):
         snap = self.chain.snapshot() if self.chain is not None else self._chain_snapshot
         if snap is None:
             return False
+        snap = dict(snap)
+        mapper_snap = (
+            self.mapper.snapshot() if self.mapper is not None
+            else self._mapper_snapshot
+        )
+        if mapper_snap is not None:
+            for k, v in mapper_snap.items():
+                snap[self._MAPPER_KEY_PREFIX + k] = v
         save_checkpoint(path, snap, extra={"node": self.name})
         return True
 
     def load_checkpoint(self, path: str) -> bool:
         """Stage an on-disk checkpoint for the next configure (or restore it
-        immediately into an already-configured chain).
+        immediately into an already-configured chain and mapper).
 
         Returns False — touching nothing — when the file is absent/torn
         or its geometry doesn't match the current chain parameters, so a
         True return means the state genuinely resumed (or will on the next
-        configure)."""
+        configure).  Mapper keys are restored when present and compatible;
+        an incompatible map (changed geometry/schema) is dropped with the
+        chain still restored — the map is derived state, the window is
+        not."""
+        from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
         from rplidar_ros2_driver_tpu.utils.checkpoint import load_checkpoint
 
         if not self.params.filter_chain:
@@ -289,16 +339,29 @@ class RPlidarNode(LifecycleNode):
         if loaded is None:
             return False
         snap, _meta = loaded
+        snap, mapper_snap = self._split_checkpoint(snap)
+
+        def stage_mapper() -> None:
+            if mapper_snap is None:
+                return
+            if self.mapper is not None:
+                if self.mapper.restore(mapper_snap):
+                    self._mapper_snapshot = mapper_snap
+            elif FleetMapper.snapshot_compatible(self.params, mapper_snap):
+                self._mapper_snapshot = mapper_snap
+
         if self.chain is not None:
             if not self.chain.restore(snap):  # rejects mismatch untouched
                 return False
             self._chain_snapshot = snap
+            stage_mapper()
             return True
         # no live chain yet: validate host-side against the geometry the
         # next configure will build (no device transfers)
         if not ScanFilterChain.snapshot_compatible(self.params, snap):
             return False
         self._chain_snapshot = snap
+        stage_mapper()
         return True
 
     def on_shutdown(self) -> bool:
@@ -443,6 +506,23 @@ class RPlidarNode(LifecycleNode):
                     voxel=np.asarray(out.voxel),
                 )
             )
+        if self.mapper is not None:
+            with self.tracer.stage("map"):
+                est = self.mapper.submit([out])[0]
+            if est is not None:
+                from rplidar_ros2_driver_tpu.node.messages import PoseHost
+
+                self.publisher.publish_pose(PoseHost(
+                    stamp=stamp,
+                    frame_id="map",
+                    child_frame_id=params.frame_id,
+                    x_m=est.x_m,
+                    y_m=est.y_m,
+                    theta_rad=est.theta_rad,
+                    score=est.score,
+                    matched_points=est.matched_points,
+                    map_revision=est.revision,
+                ))
 
     # ------------------------------------------------------------------
     # diagnostics (src/rplidar_node.cpp:490-545)
@@ -454,12 +534,22 @@ class RPlidarNode(LifecycleNode):
         lc = self.lifecycle_state
         fsm_state = self.fsm.state if self.fsm else None
         lat = {}
-        for stage in ("filter", "convert", "publish"):
+        for stage in ("filter", "convert", "publish", "map"):
             p = self.tracer.percentile(stage, 99.0)
             if p > 0:
                 lat[stage] = 1e3 * p
         driver = self.fsm.driver if self.fsm else None
         rx_sched = driver.rx_scheduling_class() if driver is not None else None
+        map_status = None
+        if self.mapper is not None:
+            est = self.mapper.last_estimates[0]
+            map_status = {"backend": self.mapper.backend}
+            if est is not None:
+                map_status.update(
+                    pose=(est.x_m, est.y_m, est.theta_rad),
+                    score=est.score,
+                    revision=est.revision,
+                )
         self.diagnostics.update(
             lifecycle=lc,
             fsm_state=fsm_state,
@@ -468,6 +558,7 @@ class RPlidarNode(LifecycleNode):
             device_info=self.fsm.cached_device_info if self.fsm else "",
             latency_p99_ms=lat or None,
             rx_scheduling=rx_sched,
+            map_status=map_status,
         )
 
     # ------------------------------------------------------------------

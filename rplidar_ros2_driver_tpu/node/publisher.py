@@ -19,6 +19,7 @@ from rplidar_ros2_driver_tpu.node.messages import (
     DiagnosticStatus,
     LaserScanHost,
     PointCloudHost,
+    PoseHost,
     StaticTransform,
 )
 
@@ -27,6 +28,8 @@ class PublisherBase:
     def publish_scan(self, msg: LaserScanHost) -> None: ...
 
     def publish_cloud(self, msg: PointCloudHost) -> None: ...
+
+    def publish_pose(self, msg: PoseHost) -> None: ...
 
     def publish_tf_static(self, tf: StaticTransform) -> None: ...
 
@@ -41,6 +44,7 @@ class CollectingPublisher(PublisherBase):
         self.reliable = reliable
         self.scans: collections.deque = collections.deque(maxlen=None if reliable else maxlen)
         self.clouds: collections.deque = collections.deque(maxlen=None if reliable else maxlen)
+        self.poses: collections.deque = collections.deque(maxlen=None if reliable else maxlen)
         self.tf_static: list[StaticTransform] = []
         self.diagnostics: collections.deque = collections.deque(maxlen=256)
         self.scan_count = 0
@@ -53,6 +57,10 @@ class CollectingPublisher(PublisherBase):
     def publish_cloud(self, msg: PointCloudHost) -> None:
         with self._lock:
             self.clouds.append(msg)
+
+    def publish_pose(self, msg: PoseHost) -> None:
+        with self._lock:
+            self.poses.append(msg)
 
     def publish_tf_static(self, tf: StaticTransform) -> None:
         with self._lock:
@@ -72,11 +80,13 @@ class CallbackPublisher(PublisherBase):
         on_cloud: Optional[Callable[[PointCloudHost], Any]] = None,
         on_tf: Optional[Callable[[StaticTransform], Any]] = None,
         on_diag: Optional[Callable[[DiagnosticStatus], Any]] = None,
+        on_pose: Optional[Callable[[PoseHost], Any]] = None,
     ) -> None:
         self._on_scan = on_scan
         self._on_cloud = on_cloud
         self._on_tf = on_tf
         self._on_diag = on_diag
+        self._on_pose = on_pose
 
     def publish_scan(self, msg: LaserScanHost) -> None:
         if self._on_scan:
@@ -85,6 +95,10 @@ class CallbackPublisher(PublisherBase):
     def publish_cloud(self, msg: PointCloudHost) -> None:
         if self._on_cloud:
             self._on_cloud(msg)
+
+    def publish_pose(self, msg: PoseHost) -> None:
+        if self._on_pose:
+            self._on_pose(msg)
 
     def publish_tf_static(self, tf: StaticTransform) -> None:
         if self._on_tf:

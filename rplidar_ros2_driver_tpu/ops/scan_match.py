@@ -1,0 +1,523 @@
+"""Correlative scan-to-map matching + log-odds occupancy mapping kernels.
+
+The SLAM front-end the FPGA accelerator papers build custom hardware for
+(arxiv 2103.09523, 2006.01050): dense multi-resolution correlative scan
+matching against a persistent occupancy grid.  On TPU the same workload
+is a natural ``jit``+``vmap`` dense-scoring problem: rotate/translate the
+scan's Cartesian endpoints over a (dθ, dx, dy) pose lattice, gather
+bilinear map lookups, argmax — one compiled program per revolution, with
+a vmapped fleet lowering so N streams match against N maps in ONE
+dispatch (mapping/mapper.FleetMapper).
+
+EXACTNESS CONTRACT (the reason everything here is integer):
+
+The mapper ships two backends — a NumPy host reference (the golden path,
+ops/scan_match_ref.py) and this fused device path — and the fleet parity
+suite pins them BIT-EXACT (tests/test_mapping.py, fleet sizes 1/3/8).
+Float scoring cannot honor that bar: XLA and NumPy order reductions
+differently and XLA:CPU fuses mul+add into FMA, so f32 scores drift by
+ulps and argmax ties flip.  Instead the whole matcher datapath is
+fixed-point — exactly the move the FPGA accelerator papers make for
+their hardware scoring pipelines:
+
+  * endpoints quantize to int32 SUBCELL coordinates (SUB=32 subcells per
+    map cell; ONE f32 multiply + round-half-even, deterministic on every
+    backend because a single IEEE op cannot be re-associated or fused);
+  * rotations use a precomputed int32 cos/sin table at 2^14 scale
+    (numpy-built once per config, shared verbatim by both backends — no
+    in-kernel transcendentals to diverge between libms);
+  * the "bilinear map lookup" is 4 integer gathers with 5-bit fractional
+    weights (Σw = 1024), summed in int32;
+  * the log-odds grid itself is int32 in Q10 (1/1024) units with integer
+    hit/miss increments and clamping;
+  * argmax over int32 scores, first-max-wins in C order (jnp.argmax and
+    np.argmax agree).
+
+Arithmetic bounds (so int32 never overflows): subcell coords are clamped
+to ±(2^15 - 1) before rotation (|c·x - s·y| ≤ 2·2^15·2^14 = 2^30); map
+values are clipped to [0, clamp_q] and right-shifted by ``quant_shift``,
+chosen per config so (clamp_q >> quant_shift)·1024·beams < 2^31.
+
+The occupancy update reuses the voxel-accumulation machinery's two
+kernel shapes — a scatter-add histogram and the one-hot bf16 einsum with
+f32 accumulation that rides the MXU (ops/filters.voxel_hits /
+voxel_hits_matmul) — re-derived for integer cell indices, because the
+float entry points would double-round the cell index the matcher's
+fixed-point gathers use.  Both lowerings are exact and parity-tested;
+``MapConfig.voxel_backend`` selects, via the same resolver as the filter
+chain's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fixed-point geometry (see module docstring for the overflow analysis)
+SUB_BITS = 5
+SUB = 1 << SUB_BITS            # subcells per map cell
+ANG_BITS = 14
+ANG = 1 << ANG_BITS            # rotation-table scale
+LO_SCALE = 1024                # log-odds Q10 fixed point (1/1024 units)
+W_SCALE = SUB * SUB            # bilinear weight denominator (Σw)
+PQ_LIMIT = (1 << 15) - 1       # subcell clamp ahead of the int32 rotation
+
+MAP_STATE_VERSION = 1          # checkpoint schema version of MapState
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class MapState:
+    """Device-resident per-stream SLAM state, threaded functionally like
+    FilterState.  ``log_odds`` is Q10 fixed point (int32, 1/1024 units);
+    ``pose`` is (tx_sub, ty_sub, theta_idx) int32 — translation in
+    subcells, heading as an index into the ``theta_divisions``-entry
+    rotation table (so heading composition stays exact integer math and
+    never needs an in-kernel transcendental)."""
+
+    log_odds: jax.Array   # (G, G) int32, Q10 log-odds, [ix, iy] layout
+    pose: jax.Array       # (3,) int32: tx_sub, ty_sub, theta_idx
+    origin_xy: jax.Array  # (2,) float32 world coords of the grid centre
+    revision: jax.Array   # () int32, revolutions absorbed
+
+    @staticmethod
+    def shapes(grid: int) -> dict[str, tuple[int, ...]]:
+        """Array shapes for a map of this geometry — host-side, no
+        allocation (checkpoint pre-validation, like FilterState.shapes)."""
+        return {
+            "log_odds": (grid, grid),
+            "pose": (3,),
+            "origin_xy": (2,),
+            "revision": (),
+        }
+
+    @classmethod
+    def create(cls, cfg: "MapConfig") -> "MapState":
+        return cls(
+            log_odds=jnp.zeros((cfg.grid, cfg.grid), jnp.int32),
+            pose=jnp.zeros((3,), jnp.int32),
+            origin_xy=jnp.zeros((2,), jnp.float32),
+            revision=jnp.asarray(0, jnp.int32),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class MapConfig:
+    """Static (compile-time) mapping + matcher configuration."""
+
+    grid: int = 256            # cells per side of the log-odds grid
+    cell_m: float = 0.05       # metres per cell
+    beams: int = 2048          # points per scan (the chain's beam grid)
+    hit_q: int = 922           # Q10 log-odds increment per endpoint hit
+    miss_q: int = -410         # Q10 decrement per free-space pass
+    clamp_q: int = 8192        # Q10 clamp (±) on the log-odds grid
+    theta_divisions: int = 720 # rotation-table entries over a full turn
+    theta_window: int = 6      # match search: ± table steps
+    coarse: int = 4            # pyramid pool factor (power of two)
+    window_cells: int = 2      # coarse translation radius (coarse cells)
+    fine_radius: int = 4       # fine translation radius (cells)
+    free_samples: int = 4      # ray samples for the free-space miss pass
+    quant_shift: int = 4       # match-map right shift (int32 score bound)
+    voxel_backend: str = "scatter"  # endpoint histogram: scatter | matmul
+
+    def __post_init__(self):
+        if self.grid < 8 or self.grid > 1024:
+            raise ValueError("map grid must be within [8, 1024]")
+        if self.coarse < 1 or self.coarse & (self.coarse - 1):
+            raise ValueError("coarse pool factor must be a power of two")
+        if self.grid % self.coarse:
+            raise ValueError("map grid must divide by the coarse factor")
+        if self.cell_m <= 0:
+            raise ValueError("map cell size must be positive")
+        if self.hit_q <= 0 or self.miss_q >= 0 or self.clamp_q <= 0:
+            raise ValueError(
+                "log-odds increments must satisfy hit > 0 > miss, clamp > 0"
+            )
+        if self.clamp_q < self.hit_q:
+            raise ValueError("log-odds clamp must be >= the hit increment")
+        if self.theta_window >= self.theta_divisions // 2:
+            raise ValueError("theta window exceeds half a turn")
+        # int32 score bound: per-point ≤ (clamp>>shift)·1024, summed over
+        # beams — must stay under 2^31 (module docstring)
+        if (self.clamp_q >> self.quant_shift) * W_SCALE * self.beams >= 2**31:
+            raise ValueError(
+                "match score can overflow int32: raise quant_shift "
+                f"(clamp_q={self.clamp_q}, beams={self.beams})"
+            )
+
+    @property
+    def sub_per_m(self) -> float:
+        """The ONE metres -> subcells constant, materialized identically
+        (f32) by both backends so the single quantizing multiply agrees."""
+        return float(np.float32(SUB / self.cell_m))
+
+    @property
+    def t_limit_sub(self) -> int:
+        """Pose translation clamp: the sensor stays inside the map."""
+        return (self.grid // 2) * SUB
+
+
+def min_quant_shift(clamp_q: int, beams: int) -> int:
+    """Smallest match-map shift keeping the int32 score bound (shared by
+    the config factory so defaults can't silently overflow)."""
+    s = 0
+    while (clamp_q >> s) * W_SCALE * beams >= 2**31:
+        s += 1
+    return s
+
+
+@functools.lru_cache(maxsize=8)
+def rotation_table(divisions: int) -> np.ndarray:
+    """(divisions, 2) int32 [cos, sin] at ANG scale — numpy-built once
+    and shared VERBATIM by the numpy reference and the jitted kernels
+    (where it bakes in as a constant), so no backend ever evaluates a
+    transcendental inside the parity-critical datapath."""
+    k = np.arange(divisions, dtype=np.float64) * (2.0 * np.pi / divisions)
+    return np.stack(
+        [np.rint(np.cos(k) * ANG), np.rint(np.sin(k) * ANG)], axis=1
+    ).astype(np.int32)
+
+
+def theta_offsets(cfg: MapConfig) -> np.ndarray:
+    """(T,) int32 search offsets in rotation-table steps."""
+    w = cfg.theta_window
+    return np.arange(-w, w + 1, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# fixed-point building blocks (each has a literal numpy mirror in
+# ops/scan_match_ref.py — keep the two in lockstep, the parity suite
+# pins them bit-exact)
+# ---------------------------------------------------------------------------
+
+
+def quantize_points(xy: jax.Array, mask: jax.Array, cfg: MapConfig):
+    """f32 metres -> int32 subcell coords + validity.  The one f32 op of
+    the datapath: a single multiply (deterministic — nothing to fuse or
+    re-associate) then round-half-even.
+
+    Range and finiteness are policed IN FLOAT SPACE, before the int
+    cast: converting an out-of-range/NaN/inf f32 to int32 is
+    implementation-defined and NumPy and XLA disagree on it, which
+    would break the bit-exactness contract through the back door.  The
+    cast only ever sees values clamped into ±PQ_LIMIT; points beyond
+    that window (≥ 1023 cells from the sensor — off any permitted map)
+    are invalidated (a NaN coordinate fails the <= compare on both
+    backends)."""
+    s = xy * jnp.float32(cfg.sub_per_m)
+    lim = jnp.float32(PQ_LIMIT)
+    ok = (
+        mask
+        & (jnp.abs(s[:, 0]) <= lim)
+        & (jnp.abs(s[:, 1]) <= lim)
+    )
+    s = jnp.where(jnp.isfinite(s), s, 0.0)
+    pq = jnp.round(jnp.clip(s, -lim, lim)).astype(jnp.int32)
+    return pq, ok
+
+
+def rotate_points(pq: jax.Array, cos_q, sin_q):
+    """Fixed-point rotation: (c·x - s·y) at ANG scale, rounded back to
+    subcells.  Broadcasts over leading axes of cos_q/sin_q."""
+    x, y = pq[..., 0], pq[..., 1]
+    half = 1 << (ANG_BITS - 1)
+    xr = (cos_q * x - sin_q * y + half) >> ANG_BITS
+    yr = (sin_q * x + cos_q * y + half) >> ANG_BITS
+    return xr, yr
+
+
+def _bilinear_gather(mf: jax.Array, gdim: int, ix, iy, fx, fy):
+    """Integer bilinear lookup on a flattened [ix, iy] map: 4 gathers
+    with 5-bit fractional weights (Σw = 1024); out-of-bounds corners
+    contribute 0.  ``ix/iy`` are cell indices (any broadcastable int32
+    shape), ``fx/fy`` the subcell fractions in [0, SUB)."""
+    total = jnp.zeros(jnp.broadcast_shapes(ix.shape, fx.shape), jnp.int32)
+    for dx_c, dy_c in ((0, 0), (1, 0), (0, 1), (1, 1)):
+        cx, cy = ix + dx_c, iy + dy_c
+        ok = (cx >= 0) & (cx < gdim) & (cy >= 0) & (cy < gdim)
+        idx = jnp.clip(cx, 0, gdim - 1) * gdim + jnp.clip(cy, 0, gdim - 1)
+        val = jnp.where(ok, jnp.take(mf, idx), 0)
+        wx = SUB - fx if dx_c == 0 else fx
+        wy = SUB - fy if dy_c == 0 else fy
+        total = total + wx * wy * val
+    return total
+
+
+def cell_hits(cells_x, cells_y, inb, grid: int) -> jax.Array:
+    """(G, G) int32 endpoint counts from integer cell indices — the
+    scatter-add twin of ops/filters.voxel_hits (same flat-index drop
+    trick), taking the fixed-point datapath's cells directly so the
+    histogram and the matcher's gathers share ONE cell convention."""
+    flat = jnp.where(inb, cells_x * grid + cells_y, grid * grid)
+    counts = jnp.zeros((grid * grid,), jnp.int32).at[flat].add(1, mode="drop")
+    return counts.reshape(grid, grid)
+
+
+def cell_hits_matmul(cells_x, cells_y, inb, grid: int) -> jax.Array:
+    """The MXU-riding twin (ops/filters.voxel_hits_matmul restated for
+    integer cells): one-hot bf16 outer-product accumulation in f32 —
+    exact to 2^24 hits per cell, bit-identical to :func:`cell_hits`."""
+    cells = jnp.arange(grid, dtype=jnp.int32)
+    ohx = ((cells_x[:, None] == cells[None, :]) & inb[:, None]).astype(
+        jnp.bfloat16
+    )
+    ohy = (cells_y[:, None] == cells[None, :]).astype(jnp.bfloat16)
+    counts = jnp.einsum(
+        "bi,bj->ij", ohx, ohy, preferred_element_type=jnp.float32
+    )
+    return counts.astype(jnp.int32)
+
+
+def select_cell_hits(backend: str):
+    """voxel_backend -> integer-cell histogram kernel (strict, like
+    ops/filters.select_voxel_hits — a typo must fail loudly)."""
+    try:
+        return {"scatter": cell_hits, "matmul": cell_hits_matmul}[backend]
+    except KeyError:
+        raise ValueError(
+            f"voxel_backend must be 'scatter' or 'matmul' once resolved, "
+            f"got {backend!r}"
+        ) from None
+
+
+# ---------------------------------------------------------------------------
+# matcher + map update
+# ---------------------------------------------------------------------------
+
+
+def match_scan(
+    log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
+    cfg: MapConfig,
+):
+    """Dense multi-resolution correlative match of one quantized scan
+    against the map, searching a (dθ, dx, dy) lattice around ``pose``.
+
+    Coarse stage — TRANSLATION-ONLY at the predicted heading: the match
+    map (positive log-odds, quantized) is max-pooled by ``cfg.coarse``
+    and every coarse (dx, dy) candidate scored with bilinear gathers.
+    The pooled map upper-bounds the fine map (the standard correlative
+    pyramid), and rotation deliberately stays OUT of this stage: inside
+    the search window a dθ of a few table steps displaces endpoints by
+    well under one coarse cell, so a pooled map cannot discriminate θ —
+    it can only mis-seed the refinement (a hazard the golden rotation
+    tests pin).
+
+    Fine stage — JOINT (dθ, dx, dy) at full resolution around the
+    coarse winner: every θ candidate re-rotates the scan and scores a
+    ±fine_radius cell window; the subcell bilinear fractions resolve
+    the sub-cell endpoint shifts a single θ step causes.  Greedy
+    single-seed refinement rather than the papers' full
+    branch-and-bound — sufficient to recover lattice-resolution offsets
+    (golden tests) at a fraction of the search.
+
+    Returns (dpose (3,) int32 [dx_sub, dy_sub, dθ_steps], score, n_valid).
+    An empty or informationless window (best score ≤ 0 — e.g. a fresh
+    map, or an all-invalid scan) yields the identity delta.
+    """
+    g, c = cfg.grid, cfg.coarse
+    gc = g // c
+    clog = int(math.log2(c))
+    center = (g // 2) * SUB
+
+    mq = jnp.clip(log_odds, 0, cfg.clamp_q) >> cfg.quant_shift
+    mc = mq.reshape(gc, c, gc, c).max(axis=(1, 3))
+    mq_f, mc_f = mq.reshape(-1), mc.reshape(-1)
+
+    table = jnp.asarray(rotation_table(cfg.theta_divisions))
+    dth = jnp.asarray(theta_offsets(cfg))                       # (T,)
+    th_idx = jnp.mod(pose[2] + dth, cfg.theta_divisions)
+    cos_q = jnp.take(table[:, 0], th_idx)[:, None]              # (T, 1)
+    sin_q = jnp.take(table[:, 1], th_idx)[:, None]
+    rx, ry = rotate_points(pq[None, :, :], cos_q, sin_q)        # (T, B)
+    bx = rx + pose[0] + center                                  # world subcells
+    by = ry + pose[1] + center
+    t_mid = cfg.theta_window                                    # the dθ=0 row
+
+    # -- coarse: predicted heading only; subcell coords at coarse scale
+    # (SUB subcells per coarse cell), translations = whole coarse cells
+    # so only the cell index shifts and the bilinear fraction is shared
+    # across candidates
+    scx, scy = bx[t_mid] >> clog, by[t_mid] >> clog             # (B,)
+    ccx, ccy = scx >> SUB_BITS, scy >> SUB_BITS
+    cfx, cfy = scx & (SUB - 1), scy & (SUB - 1)
+    w = cfg.window_cells
+    shifts = jnp.arange(-w, w + 1, dtype=jnp.int32)             # (U,)
+    ix = ccx[:, None, None] + shifts[None, :, None]             # (B, U, 1)
+    iy = ccy[:, None, None] + shifts[None, None, :]             # (B, 1, V)
+    vals = _bilinear_gather(
+        mc_f, gc, ix, iy, cfx[:, None, None], cfy[:, None, None]
+    )                                                           # (B, U, V)
+    score_c = jnp.sum(
+        jnp.where(ok[:, None, None], vals, 0), axis=0
+    )                                                           # (U, V)
+
+    nu = 2 * w + 1
+    kbest = jnp.argmax(score_c.reshape(-1)).astype(jnp.int32)
+    u_best = kbest // nu - w                                    # coarse cells
+    v_best = kbest % nu - w
+
+    # -- fine: joint (θ, dx, dy) at full resolution around the winner
+    fbx = bx + u_best * (c * SUB)                               # (T, B)
+    fby = by + v_best * (c * SUB)
+    fcx, fcy = fbx >> SUB_BITS, fby >> SUB_BITS
+    ffx, ffy = fbx & (SUB - 1), fby & (SUB - 1)
+    r = cfg.fine_radius
+    fsh = jnp.arange(-r, r + 1, dtype=jnp.int32)
+    fix = fcx[:, :, None, None] + fsh[None, None, :, None]      # (T, B, F, 1)
+    fiy = fcy[:, :, None, None] + fsh[None, None, None, :]      # (T, B, 1, F)
+    fvals = _bilinear_gather(
+        mq_f, g, fix, fiy,
+        ffx[:, :, None, None], ffy[:, :, None, None],
+    )                                                           # (T, B, F, F)
+    score_f = jnp.sum(
+        jnp.where(ok[None, :, None, None], fvals, 0), axis=1
+    )                                                           # (T, F, F)
+
+    nf = 2 * r + 1
+    fbest = jnp.argmax(score_f.reshape(-1)).astype(jnp.int32)
+    t_best = fbest // (nf * nf)
+    du = (fbest // nf) % nf - r
+    dv = fbest % nf - r
+    best = jnp.max(score_f)
+
+    accept = best > 0
+    dpose = jnp.where(
+        accept,
+        jnp.stack([
+            (u_best * c + du) * SUB,
+            (v_best * c + dv) * SUB,
+            jnp.take(dth, t_best),
+        ]),
+        jnp.zeros((3,), jnp.int32),
+    )
+    n_valid = jnp.sum(ok.astype(jnp.int32))
+    return dpose, jnp.where(accept, best, 0), n_valid
+
+
+def update_map(
+    log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
+    cfg: MapConfig,
+):
+    """Log-odds occupancy update from one scan at ``pose``: endpoint
+    cells get ``hit_q``, ray-sampled free cells ``miss_q`` (unless also
+    hit this revolution), clamped to ±clamp_q.  The free pass samples
+    each ray at integer fractions k/S (k < S, endpoint excluded) —
+    the dense-sampling stand-in for exact ray tracing, one histogram per
+    sample index, all inside the fused program."""
+    g = cfg.grid
+    center = (g // 2) * SUB
+    table = jnp.asarray(rotation_table(cfg.theta_divisions))
+    cos_q = jnp.take(table[:, 0], pose[2])
+    sin_q = jnp.take(table[:, 1], pose[2])
+    wx, wy = rotate_points(pq, cos_q, sin_q)
+    wx, wy = wx + pose[0] + center, wy + pose[1] + center       # (B,)
+
+    hits_fn = select_cell_hits(cfg.voxel_backend)
+    cx, cy = wx >> SUB_BITS, wy >> SUB_BITS
+    inb = ok & (cx >= 0) & (cx < g) & (cy >= 0) & (cy < g)
+    hits = hits_fn(cx, cy, inb, g)
+
+    if cfg.free_samples > 0:
+        ox, oy = pose[0] + center, pose[1] + center             # sensor
+        free = jnp.zeros((g, g), jnp.int32)
+        for k in range(cfg.free_samples):
+            sx = ox + ((wx - ox) * k) // cfg.free_samples
+            sy = oy + ((wy - oy) * k) // cfg.free_samples
+            fx_c, fy_c = sx >> SUB_BITS, sy >> SUB_BITS
+            finb = ok & (fx_c >= 0) & (fx_c < g) & (fy_c >= 0) & (fy_c < g)
+            free = free + hits_fn(fx_c, fy_c, finb, g)
+        i_miss = (free > 0) & ~(hits > 0)
+    else:
+        i_miss = jnp.zeros((g, g), bool)
+
+    delta = (
+        jnp.where(hits > 0, cfg.hit_q, 0)
+        + jnp.where(i_miss, cfg.miss_q, 0)
+    )
+    return jnp.clip(log_odds + delta, -cfg.clamp_q, cfg.clamp_q)
+
+
+def _map_match_step_impl(
+    state: MapState, points_xy: jax.Array, mask: jax.Array, live: jax.Array,
+    cfg: MapConfig,
+):
+    """One revolution: match against the map built so far, compose the
+    accepted delta into the pose, then absorb the scan at the new pose.
+    ``live`` (int32 0/1) gates everything — an idle stream's state
+    passes through untouched, which is what lets the fleet lowering run
+    ragged fleets in lockstep."""
+    pq, ok = quantize_points(points_xy, mask, cfg)
+    ok = ok & (live > 0)
+    dpose, score, n_valid = match_scan(state.log_odds, state.pose, pq, ok, cfg)
+    lim = cfg.t_limit_sub
+    pose = jnp.stack([
+        jnp.clip(state.pose[0] + dpose[0], -lim, lim),
+        jnp.clip(state.pose[1] + dpose[1], -lim, lim),
+        jnp.mod(state.pose[2] + dpose[2], cfg.theta_divisions),
+    ])
+    log_odds = update_map(state.log_odds, pose, pq, ok, cfg)
+    alive = live > 0
+    new_state = MapState(
+        log_odds=jnp.where(alive, log_odds, state.log_odds),
+        pose=jnp.where(alive, pose, state.pose),
+        origin_xy=state.origin_xy,
+        revision=state.revision + live,
+    )
+    # single-fetch wire: pose + score + matched-point count, one int32 row
+    wire = jnp.concatenate([
+        new_state.pose, score[None], n_valid[None]
+    ]).astype(jnp.int32)
+    return new_state, wire
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def map_match_step(
+    state: MapState, points_xy: jax.Array, mask: jax.Array, live: jax.Array,
+    cfg: MapConfig,
+):
+    """Single-stream fused match+update: one donated dispatch per
+    revolution, one (5,) int32 wire out [tx_sub, ty_sub, th_idx, score,
+    n_valid]."""
+    return _map_match_step_impl(state, points_xy, mask, live, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fleet_map_match_step(
+    states: MapState, points_xy: jax.Array, masks: jax.Array,
+    live: jax.Array, cfg: MapConfig,
+):
+    """The fleet lowering: N streams match against N maps in ONE
+    compiled vmapped dispatch (stream-stacked MapState donated in
+    place).  Bit-exact vs N independent host-reference steps — integer
+    datapath end to end, so vmap cannot perturb a single bit."""
+
+    def one(st, p, m, lv):
+        return _map_match_step_impl(st, p, m, lv, cfg)
+
+    return jax.vmap(one)(states, points_xy, masks, live)
+
+
+def unpack_wire(wire: np.ndarray) -> dict:
+    """Host-side view of one stream's (5,) int32 wire row."""
+    w = np.asarray(wire)
+    return {
+        "pose_q": w[:3].astype(np.int32),
+        "score": int(w[3]),
+        "n_valid": int(w[4]),
+    }
+
+
+def pose_to_metric(pose_q: np.ndarray, cfg: MapConfig) -> tuple:
+    """(x_m, y_m, theta_rad) floats from the integer pose — reporting
+    only, never part of the parity-critical datapath."""
+    x = float(pose_q[0]) * (cfg.cell_m / SUB)
+    y = float(pose_q[1]) * (cfg.cell_m / SUB)
+    th = float(pose_q[2]) * (2.0 * np.pi / cfg.theta_divisions)
+    if th > np.pi:
+        th -= 2.0 * np.pi
+    return x, y, th

@@ -126,6 +126,12 @@ class ShardedFilterService:
         self._fleet_ingest_buckets = fleet_ingest_buckets
         self._host_ingest = None        # per-stream (decoder, latest-slot)
         self.host_scans_dropped = 0     # newest-wins drops on the host path
+        # SLAM front-end seam (mapping/mapper.FleetMapper): when
+        # attached, every materialized tick's outputs feed one mapper
+        # tick (a single vmapped dispatch on the fused map backend) and
+        # the per-stream pose estimates land in ``last_poses``
+        self.mapper = None
+        self.last_poses: list = [None] * streams
 
     def precompile(self) -> None:
         """Compile the batched tick program now (the fleet analog of
@@ -150,6 +156,35 @@ class ShardedFilterService:
                 cursor=self._state.cursor * 0,
                 filled=self._state.filled * 0,
             )
+
+    def attach_mapper(self, mapper=None) -> "object":
+        """Attach a FleetMapper (built here from this service's params
+        when not given) so each tick's outputs run the SLAM front-end:
+        per-stream correlative scan-to-map match + log-odds map update,
+        one mapper tick per filter tick.  Idle streams pass through.
+        Returns the attached mapper (its snapshot/restore surface is the
+        caller's to drive, like ``fleet_ingest``'s)."""
+        if mapper is None:
+            from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+            mapper = FleetMapper(
+                self.params, self.streams, beams=self.cfg.beams
+            )
+        if mapper.streams != self.streams:
+            raise ValueError(
+                f"mapper has {mapper.streams} streams, service has "
+                f"{self.streams}"
+            )
+        self.mapper = mapper
+        return mapper
+
+    def _map_tick(self, outs: list) -> list:
+        """Feed one materialized tick to the attached mapper (no-op
+        without one); stashes and returns the per-stream estimates."""
+        if self.mapper is None or outs is None:
+            return outs
+        self.last_poses = self.mapper.submit(outs)
+        return outs
 
     # -- raw-bytes ingest seam ----------------------------------------------
 
@@ -241,7 +276,7 @@ class ShardedFilterService:
                 self.fleet_ingest.submit_pipelined(items)
                 if pipelined else self.fleet_ingest.submit(items)
             )
-            return [o[-1][0] if o else None for o in outs]
+            return self._map_tick([o[-1][0] if o else None for o in outs])
         scans = self._host_decode_tick(items)
         if pipelined:
             return self.submit_pipelined(scans)
@@ -281,7 +316,20 @@ class ShardedFilterService:
         self._ensure_byte_ingest()
         if self.fleet_ingest_backend == "fused":
             outs = self.fleet_ingest.submit_backlog(ticks)
-            return [[o for (o, _ts0, _dur) in s] for s in outs]
+            results = [[o for (o, _ts0, _dur) in s] for s in outs]
+            if self.mapper is not None:
+                # feed the drained revolutions to the mapper in
+                # per-stream order.  Grouping by index rather than by
+                # the original wall tick is equivalent: mapper streams
+                # are independent (an idle slot passes through), so
+                # each stream's map sees exactly its own revolution
+                # sequence — the same final state the host branch's
+                # per-tick submit() path produces
+                for k in range(max((len(s) for s in results), default=0)):
+                    self._map_tick([
+                        s[k] if len(s) > k else None for s in results
+                    ])
+            return results
         results: list[list[FilterOutput]] = [
             [] for _ in range(self.streams)
         ]
@@ -383,11 +431,11 @@ class ShardedFilterService:
         # bounded like the pipelined collect: the synchronous tick is the
         # fleet analog of the chain's process_raw (reference timed grab)
         live = [s is not None for s in scans]
-        return bounded_fetch(
+        return self._map_tick(bounded_fetch(
             lambda: self._materialize(out, live),
             self.collect_timeout_s,
             "fleet tick materialize (device->host)",
-        )
+        ))
 
     def _materialize(
         self, out: FilterOutput, live: Sequence[bool]
@@ -472,7 +520,9 @@ class ShardedFilterService:
                 # a restore/load raced in after the pop: the popped tick
                 # is pre-restore and must not be published
                 prev = None
-        return prev if prev is not None else [None] * self.streams
+        if prev is not None:
+            return self._map_tick(prev)
+        return [None] * self.streams
 
     def _restash_pending(self, pending, epoch: int) -> None:
         """Put a popped-but-unpublished tick back for the drain — unless a
@@ -520,10 +570,19 @@ class ShardedFilterService:
         if pending is None:
             return None
         try:
-            return self._collect_pending(pending)
+            outs = self._collect_pending(pending)
         except Exception:
             self._restash_pending(pending, epoch)
             raise
+        if pending[2] == "_materialize":
+            # the run's final in-flight tick feeds the mapper like every
+            # steady-state tick did — else the map would end one
+            # revolution short of a non-pipelined run over the same
+            # input.  Local (multi-controller) ticks are skipped: the
+            # mapper seam is single-controller (attach_mapper) and a
+            # local block's length would not match its stream count.
+            return self._map_tick(outs)
+        return outs
 
     def submit_local(
         self, local_scans: Sequence[Optional[dict]]

@@ -256,6 +256,54 @@ def replay_fleet(
     )
 
 
+def replay_with_map(
+    revolutions: list[dict],
+    params,
+    *,
+    beams: int | None = None,
+    capacity: int = 4096,
+    chunk: int = 256,
+):
+    """Offline SLAM replay: a capture's revolutions through the fused
+    filter chain (:func:`replay_through_chain`), then every median range
+    image through the mapping subsystem (mapping/mapper.FleetMapper) —
+    correlative scan-to-map matching + log-odds occupancy accumulation —
+    yielding the estimated trajectory and the final map.
+
+    The per-scan Cartesian endpoints are derived ONCE (numpy beam-grid
+    projection, the host mirror of ops/filters.polar_to_cartesian) and
+    fed to whichever map backend ``params.map_backend`` resolves to, so
+    backend choice cannot change the mapper's inputs.
+
+    Returns ``(trajectory, scores, mapper)``: (K, 3) float64 [x_m, y_m,
+    theta_rad] per-scan pose estimates, (K,) int32 match scores, and the
+    mapper (whose ``snapshot()`` is the final map; render it with
+    tools/viz.map_to_image).
+    """
+    from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+    b = beams or DEFAULT_BEAMS
+    ranges, _state = replay_through_chain(
+        revolutions, params, beams=b, capacity=capacity, chunk=chunk
+    )
+    theta = ((np.arange(b) + 0.5) * (2.0 * np.pi / b)).astype(np.float32)
+    cos_t, sin_t = np.cos(theta), np.sin(theta)
+    mapper = FleetMapper(params, 1, beams=b)
+    traj = np.zeros((ranges.shape[0], 3), np.float64)
+    scores = np.zeros((ranges.shape[0],), np.int32)
+    for k in range(ranges.shape[0]):
+        finite = np.isfinite(ranges[k])
+        r = np.where(finite, ranges[k], 0.0).astype(np.float32)
+        pts = np.stack([r * cos_t, r * sin_t], axis=1).astype(np.float32)
+        est = mapper.submit_points(
+            pts[None], finite[None], np.ones((1,), np.int32)
+        )[0]
+        traj[k] = (est.x_m, est.y_m, est.theta_rad)
+        scores[k] = est.score
+    return traj, scores, mapper
+
+
 def replay_raw_fused(
     path: str,
     params,

@@ -45,6 +45,49 @@ def scan_to_image(
     return img
 
 
+def map_to_image(
+    log_odds: np.ndarray, clamp_q: int, *, flip_y: bool = True
+) -> np.ndarray:
+    """Render a Q10 log-odds occupancy grid (ops/scan_match.MapState) to
+    a uint8 image: 0 = certainly free, 255 = certainly occupied, 128 =
+    unknown.  The map's [ix, iy] layout becomes the usual image
+    orientation (+x right, +y up) so it matches :func:`scan_to_image`.
+    """
+    lo = np.asarray(log_odds, np.int64)
+    img = np.clip(
+        (lo + clamp_q) * 255 // (2 * clamp_q), 0, 255
+    ).astype(np.uint8)
+    img = img.T  # [ix, iy] -> [row=y, col=x]
+    return img[::-1] if flip_y else img
+
+
+def draw_trajectory(
+    img: np.ndarray,
+    traj_xy_m,
+    cell_m: float,
+    *,
+    value: int = 255,
+    flip_y: bool = True,
+) -> np.ndarray:
+    """Overlay an (K, 2) metric trajectory onto a map image from
+    :func:`map_to_image` (same grid/orientation conventions).  Returns a
+    copy; out-of-map poses are clipped to the border."""
+    out = np.asarray(img).copy()
+    size = out.shape[0]
+    half = size // 2
+    traj = np.asarray(traj_xy_m, np.float64).reshape(-1, 2)
+    if traj.size == 0:
+        return out
+    col = np.clip(np.floor(traj[:, 0] / cell_m).astype(np.int64) + half,
+                  0, size - 1)
+    row = np.clip(np.floor(traj[:, 1] / cell_m).astype(np.int64) + half,
+                  0, size - 1)
+    if flip_y:
+        row = size - 1 - row
+    out[row, col] = value
+    return out
+
+
 def save_pgm(img: np.ndarray, path: str) -> None:
     """Write a binary PGM (viewable everywhere, zero dependencies)."""
     h, w = img.shape

@@ -32,11 +32,8 @@ import functools
 import logging
 import os
 import shutil
-from typing import Optional
 
 import jax
-
-from rplidar_ros2_driver_tpu.ops.filters import FilterState
 
 log = logging.getLogger("rplidar_tpu.checkpoint")
 
@@ -62,8 +59,12 @@ def _barrier(tag: str) -> None:
         multihost_utils.sync_global_devices(f"rpl_ckpt:{tag}")
 
 
-def save_sharded(path: str, state: FilterState) -> None:
-    """Write the (possibly sharded) FilterState pytree under ``path``.
+def save_sharded(path: str, state) -> None:
+    """Write a (possibly sharded) state pytree under ``path`` — a
+    FilterState, a stream-stacked MapState (the SLAM front-end's
+    checkpoint schema, mapping/mapper.FleetMapper.save_sharded), or any
+    registered pytree of device arrays; the save/rotate machinery is
+    schema-agnostic.
 
     Blocks until the write is finalized and rotated in, so on return the
     checkpoint at ``path`` is durable and a reader always finds either
@@ -92,8 +93,10 @@ def save_sharded(path: str, state: FilterState) -> None:
     _barrier("post-rotate")
 
 
-def restore_sharded(path: str, like: FilterState) -> Optional[FilterState]:
-    """Restore a FilterState shaped-and-sharded like ``like``.
+def restore_sharded(path: str, like):
+    """Restore a state pytree shaped-and-sharded like ``like`` (same
+    schema-agnostic contract as :func:`save_sharded` — FilterState,
+    MapState, ...).
 
     ``like`` supplies the target geometry AND target shardings — pass
     :func:`~rplidar_ros2_driver_tpu.parallel.sharding.abstract_sharded_state`

@@ -46,7 +46,7 @@ def _records(paths: list[str]):
 
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
-    "super_tick_ab",
+    "super_tick_ab", "mapping_ab",
 )
 
 
@@ -219,6 +219,27 @@ def analyze(records: list[dict]) -> dict:
                     "drain_speedup", "per_dispatch_floor_ms",
                     "overhead_clamped",
                 ) if k in sab
+            })
+
+        # config 12: the SLAM front-end A/B (map_backend mapping)
+        mab = rec.get("mapping_ab")
+        if isinstance(mab, dict):
+            v = mab.get("match_speedup")
+            if isinstance(v, (int, float)) and not mab.get(
+                "overhead_clamped"
+            ):
+                # a clamped decomposition (negative measured saving —
+                # load weather) records evidence but never flips
+                recommend("map_backend.tpu", ratio_entry(
+                    "host", "fused",
+                    "config12 mapping match_speedup",
+                    float(v), "mapping_ab",
+                ))
+            out["evidence"].setdefault("mapping_ab", []).append({
+                k: mab[k] for k in (
+                    "match_speedup", "per_dispatch_floor_ms",
+                    "overhead_clamped",
+                ) if k in mab
             })
 
         # ablation: resample + voxel kernels

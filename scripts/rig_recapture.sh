@@ -19,6 +19,9 @@
 #   8. super-tick drain A/B (config 11: T fleet ticks per compiled
 #      dispatch vs one each — the super_tick_max decision key; on-chip
 #      every amortized dispatch is a link round trip)
+#   9. SLAM front-end A/B (config 12: N-stream correlative match +
+#      log-odds update, host reference vs one vmapped dispatch per
+#      fleet tick — the map_backend decision key)
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -65,7 +68,8 @@ if [ $# -eq 0 ]; then
     "python scripts/fleet_latency.py" \
     "python bench.py --config 10" \
     "python scripts/fleet_latency.py --fleet-ingest fused" \
-    "python bench.py --config 11"
+    "python bench.py --config 11" \
+    "python bench.py --config 12"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

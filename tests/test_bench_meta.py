@@ -22,13 +22,14 @@ def test_metric_names_stable():
     assert bench.metric_name(8) == "fleet_fused_replay_scans_per_sec"
     assert bench.metric_name(10) == "fleet_fused_ingest_bytes_to_scans_per_sec"
     assert bench.metric_name(11) == "super_tick_drain_scans_per_sec"
+    assert bench.metric_name(12) == "mapping_match_update_scans_per_sec"
 
 
 def test_graded_table_well_formed():
     for c, (kind, points, over) in bench.GRADED.items():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
-            "fleet_ingest", "super_tick",
+            "fleet_ingest", "super_tick", "mapping",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -930,6 +931,96 @@ def test_decide_backends_super_tick_key():
     ])
     rec = keep["recommendations"]["super_tick_max.tpu"]
     assert rec["flip"] is False and rec["recommended"] == "1"
+
+
+def test_bench_smoke_mapping():
+    """`bench.py --smoke-mapping` — the tier-1 gate for the SLAM
+    front-end (config-12 A/B at seconds-scale CPU geometry).  The
+    structural claims are what matters: ONE fused dispatch per fleet
+    tick independent of fleet size, bit-exact host/fused parity, and
+    the matcher tracking the synthetic drift (the bench itself raises
+    on violation; this gate pins that the asserted artifact lands).
+    Wall-time numbers are 1.5-core-CI weather and only sanity-bounded;
+    kernel-level bit-exactness lives in tests/test_mapping.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-mapping"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "mapping_match_update_scans_per_sec"
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claims, re-checked from the artifact
+    assert out["fused"]["dispatches"] == out["ticks"]
+    assert out["structural"]["one_dispatch_claim_holds"] is True
+    assert out["structural"]["bit_exact_parity_holds"] is True
+    # accuracy: the matcher held onto the synthetic drift
+    assert 0 <= out["pose_err_cells"] <= 8.0
+    # liveness + calibrated decomposition present and sane
+    assert out["value"] > 0 and out["host"]["scans_per_sec"] > 0
+    assert out["dispatch_floor_ms"] > 0
+    # the decide_backends decision key rides with its clamp flag
+    assert out["mapping_ab"]["match_speedup"] > 0
+    assert isinstance(out["mapping_ab"]["overhead_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_mapping_key():
+    """The map_backend recommendation flips from config-12 evidence
+    alone: TPU records past the bar recommend fused, CPU records and
+    clamped decompositions never flip."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        sys.path.remove(scripts_dir)
+
+    out = db.analyze([
+        {"device": "tpu",
+         "mapping_ab": {"match_speedup": 4.1,
+                        "per_dispatch_floor_ms": 2.0,
+                        "overhead_clamped": False}},
+        {"device": "cpu",  # CPU record: no decision weight
+         "mapping_ab": {"match_speedup": 9.0,
+                        "overhead_clamped": False}},
+    ])
+    rec = out["recommendations"]["map_backend.tpu"]
+    assert rec["flip"] is True and rec["recommended"] == "fused"
+    assert rec["value"] == 4.1  # the TPU record, not the CPU 9.0
+    assert out["evidence"]["mapping_ab"]
+
+    # a clamped decomposition records evidence but cannot flip
+    clamped = db.analyze([
+        {"device": "tpu",
+         "mapping_ab": {"match_speedup": 50.0,
+                        "overhead_clamped": True}},
+    ])
+    assert "map_backend.tpu" not in clamped["recommendations"]
+    assert clamped["evidence"]["mapping_ab"]
+
+    # sub-margin TPU evidence keeps host
+    keep = db.analyze([
+        {"device": "tpu",
+         "mapping_ab": {"match_speedup": 1.01,
+                        "overhead_clamped": False}},
+    ])
+    rec = keep["recommendations"]["map_backend.tpu"]
+    assert rec["flip"] is False and rec["recommended"] == "host"
 
 
 def test_decide_backends_fleet_ingest_key():

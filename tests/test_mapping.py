@@ -1,0 +1,650 @@
+"""SLAM front-end suite (mapping/mapper + ops/scan_match).
+
+The contracts under test:
+
+  * GOLDEN — the host-reference matcher recovers known synthetic pose
+    offsets (translation and rotation) to lattice resolution on a
+    synthetic room.
+  * PARITY — the fused vmapped fleet lowering is BIT-EXACT against N
+    independent host-reference steps (fleet sizes 1/3/8, both voxel
+    kernel lowerings) — not "close", byte-equal.
+  * ROBUSTNESS — degenerate scans (all-invalid, single-beam) and idle
+    streams never corrupt the map or the pose.
+  * CHECKPOINT — snapshot/restore mid-run resumes bit-exactly, the
+    versioned schema rejects mismatches, and the node-level combined
+    checkpoint (chain + ``mapper.*`` keys) round-trips through disk.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.mapping.mapper import (
+    FleetMapper,
+    map_config_from_params,
+)
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    SUB,
+    MapConfig,
+    min_quant_shift,
+    rotation_table,
+)
+
+BEAMS = 256
+
+
+def _params(**kw) -> DriverParams:
+    base = dict(
+        dummy_mode=True,
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        map_enable=True,
+        map_backend="host",
+        map_grid=64,
+        map_cell_m=0.1,
+    )
+    base.update(kw)
+    return DriverParams(**base)
+
+
+def _room_points(pose_xyt, n: int = BEAMS, half: float = 2.5):
+    """A 5x5 m square room observed from ``pose_xyt``: n beam rays cast
+    to the walls, returned in the sensor frame (f32 points + mask)."""
+    t = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    dx, dy = np.cos(t), np.sin(t)
+    with np.errstate(divide="ignore"):
+        r = np.minimum(
+            np.where(np.abs(dx) > 1e-12, half / np.abs(dx), np.inf),
+            np.where(np.abs(dy) > 1e-12, half / np.abs(dy), np.inf),
+        )
+    wx, wy = dx * r, dy * r
+    x0, y0, th = pose_xyt
+    c, s = np.cos(-th), np.sin(-th)
+    px = c * (wx - x0) - s * (wy - y0)
+    py = s * (wx - x0) + c * (wy - y0)
+    return np.stack([px, py], 1).astype(np.float32), np.ones(n, bool)
+
+
+def _submit_one(mapper: FleetMapper, pts, mask):
+    return mapper.submit_points(
+        pts[None], mask[None], np.ones((1,), np.int32)
+    )[0]
+
+
+# ---------------------------------------------------------------------------
+# config / params
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_quant_shift_bound(self):
+        for clamp_q, beams in ((8192, 2048), (8192, 256), (16384, 4096)):
+            s = min_quant_shift(clamp_q, beams)
+            assert (clamp_q >> s) * SUB * SUB * beams < 2**31
+            if s > 0:  # minimality: one less shift would overflow
+                assert (clamp_q >> (s - 1)) * SUB * SUB * beams >= 2**31
+
+    def test_config_rejects_overflowing_score(self):
+        with pytest.raises(ValueError, match="int32"):
+            MapConfig(beams=4096, clamp_q=16384, quant_shift=0)
+
+    def test_config_rejects_bad_geometry(self):
+        with pytest.raises(ValueError):
+            MapConfig(grid=60, coarse=8)  # not divisible
+        with pytest.raises(ValueError):
+            MapConfig(coarse=3)  # not a power of two
+        with pytest.raises(ValueError):
+            MapConfig(cell_m=0.0)
+
+    def test_param_validation(self):
+        def validate(**kw):
+            # direct construction skips validation (the node / from_dict
+            # call it); exercise the validator explicitly
+            _params(**kw).validate()
+
+        validate()  # the baseline params are sane
+        with pytest.raises(ValueError, match="map_backend"):
+            validate(map_backend="gpu")
+        with pytest.raises(ValueError, match="filter_chain"):
+            DriverParams(map_enable=True).validate()  # mapper needs the chain
+        with pytest.raises(ValueError, match="map_grid"):
+            validate(map_grid=6)
+        with pytest.raises(ValueError, match="map_grid"):
+            validate(map_grid=258)  # not a multiple of 4
+        with pytest.raises(ValueError, match="map_cell_m"):
+            validate(map_cell_m=-0.1)
+        with pytest.raises(ValueError, match="map_match_window"):
+            validate(map_match_window=0.0)
+        with pytest.raises(ValueError, match="map_log_odds_hit"):
+            validate(map_log_odds_hit=-0.5)
+        with pytest.raises(ValueError, match="map_log_odds_miss"):
+            validate(map_log_odds_miss=0.2)
+        with pytest.raises(ValueError, match="map_log_odds_clamp"):
+            validate(map_log_odds_clamp=0.1, map_log_odds_hit=0.9)
+
+    def test_config_from_params_window(self):
+        cfg = map_config_from_params(_params(map_match_window=0.8), BEAMS)
+        # 0.8 m at 0.1 m/cell, coarse 4 -> 2 coarse cells
+        assert cfg.window_cells == 2
+        assert cfg.hit_q == 922 and cfg.miss_q == -410
+
+    def test_rotation_table_anchors(self):
+        t = rotation_table(720)
+        assert t.shape == (720, 2)
+        assert t[0, 0] == 1 << 14 and t[0, 1] == 0        # cos 0, sin 0
+        assert t[180, 0] == 0 and t[180, 1] == 1 << 14    # 90 deg
+
+
+# ---------------------------------------------------------------------------
+# golden: known offsets recovered to lattice resolution
+# ---------------------------------------------------------------------------
+
+
+class TestGolden:
+    def test_empty_map_yields_identity(self):
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        pts, m = _room_points((0, 0, 0))
+        est = _submit_one(mapper, pts, m)
+        assert est.score == 0  # nothing to match against yet
+        assert tuple(est.pose_q) == (0, 0, 0)
+        assert est.revision == 1 and est.matched_points == BEAMS
+
+    @pytest.mark.parametrize("offset_cells", [(2, -1), (-3, 2), (0, 4)])
+    def test_translation_recovered_to_lattice(self, offset_cells):
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        cfg = mapper.cfg
+        pts, m = _room_points((0, 0, 0))
+        _submit_one(mapper, pts, m)  # seed the map at the origin
+        dx = offset_cells[0] * cfg.cell_m
+        dy = offset_cells[1] * cfg.cell_m
+        pts2, m2 = _room_points((dx, dy, 0.0))
+        est = _submit_one(mapper, pts2, m2)
+        assert est.score > 0
+        # recovered to the fine lattice pitch (one cell)
+        assert abs(est.pose_q[0] / SUB - offset_cells[0]) <= 1
+        assert abs(est.pose_q[1] / SUB - offset_cells[1]) <= 1
+
+    @pytest.mark.parametrize("theta_steps", [2, -3, 5])
+    def test_rotation_recovered_to_lattice(self, theta_steps):
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        cfg = mapper.cfg
+        step = 2 * np.pi / cfg.theta_divisions
+        pts, m = _room_points((0, 0, 0))
+        _submit_one(mapper, pts, m)
+        pts2, m2 = _room_points((0, 0, theta_steps * step))
+        est = _submit_one(mapper, pts2, m2)
+        assert est.score > 0
+        got = int(est.pose_q[2])
+        if got > cfg.theta_divisions // 2:
+            got -= cfg.theta_divisions
+        assert abs(got - theta_steps) <= 1
+
+    def test_drift_tracked_over_sequence(self):
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        cfg = mapper.cfg
+        step = 2 * np.pi / cfg.theta_divisions
+        true = None
+        for k in range(8):
+            true = (0.05 * k, -0.03 * k, 2 * k * step)
+            pts, m = _room_points(true)
+            est = _submit_one(mapper, pts, m)
+        assert abs(est.x_m - true[0]) <= 2 * cfg.cell_m
+        assert abs(est.y_m - true[1]) <= 2 * cfg.cell_m
+        assert abs(est.theta_rad - true[2]) <= 2 * step
+
+
+# ---------------------------------------------------------------------------
+# parity: fused (vmapped) vs host reference, bit-exact
+# ---------------------------------------------------------------------------
+
+
+def _fleet_inputs(streams: int, tick: int, beams: int = BEAMS):
+    """Per-tick fleet inputs with per-stream pose drift and a rotating
+    idle pattern (every stream skips some ticks)."""
+    pts = np.zeros((streams, beams, 2), np.float32)
+    masks = np.zeros((streams, beams), bool)
+    live = np.zeros((streams,), np.int32)
+    for s in range(streams):
+        if (tick + s) % 4 == 3:
+            continue  # idle this tick
+        pose = (0.04 * tick * (1 + 0.3 * s), -0.03 * tick, 0.003 * tick)
+        p, m = _room_points(pose, beams)
+        # per-stream beam dropouts so masks differ across the fleet
+        rng = np.random.default_rng(100 * s + tick)
+        m &= rng.uniform(size=beams) > 0.1
+        pts[s], masks[s] = p, m
+        live[s] = 1
+    return pts, masks, live
+
+
+class TestParity:
+    @pytest.mark.parametrize("streams", [1, 3, 8])
+    def test_fused_bit_exact_vs_host(self, streams):
+        host = FleetMapper(_params(), streams, beams=BEAMS)
+        fused = FleetMapper(
+            _params(map_backend="fused"), streams, beams=BEAMS
+        )
+        assert host.backend == "host" and fused.backend == "fused"
+        for tick in range(6):
+            pts, masks, live = _fleet_inputs(streams, tick)
+            eh = host.submit_points(pts, masks, live)
+            ef = fused.submit_points(pts, masks, live)
+            for s in range(streams):
+                if eh[s] is None:
+                    assert ef[s] is None
+                    continue
+                np.testing.assert_array_equal(eh[s].pose_q, ef[s].pose_q)
+                assert eh[s].score == ef[s].score
+                assert eh[s].matched_points == ef[s].matched_points
+                assert eh[s].revision == ef[s].revision
+        sh, sf = host.snapshot(), fused.snapshot()
+        assert set(sh) == set(sf)
+        for k in sh:
+            np.testing.assert_array_equal(sh[k], sf[k])
+        # structural: one dispatch per fleet tick, whatever the size
+        assert fused.dispatch_count == 6
+
+    def test_fused_matmul_voxel_backend_bit_exact(self):
+        """The MXU-riding endpoint histogram (one-hot einsum) must land
+        the exact same map as the host reference's scatter."""
+        host = FleetMapper(_params(), 2, beams=BEAMS)
+        fused = FleetMapper(
+            _params(map_backend="fused", voxel_backend="matmul"),
+            2, beams=BEAMS,
+        )
+        assert fused.cfg.voxel_backend == "matmul"
+        for tick in range(4):
+            pts, masks, live = _fleet_inputs(2, tick)
+            host.submit_points(pts, masks, live)
+            fused.submit_points(pts, masks, live)
+        sh, sf = host.snapshot(), fused.snapshot()
+        for k in sh:
+            np.testing.assert_array_equal(sh[k], sf[k])
+
+    def test_single_stream_jit_matches_host(self):
+        """The non-vmapped single-stream program (ops/scan_match.
+        map_match_step) is the same impl the fleet lowering vmaps —
+        pin it against the host reference directly."""
+        import jax
+
+        from rplidar_ros2_driver_tpu.ops.scan_match import (
+            MapState,
+            map_match_step,
+        )
+        from rplidar_ros2_driver_tpu.ops.scan_match_ref import (
+            create_map_state_np,
+            map_match_step_np,
+        )
+
+        cfg = map_config_from_params(_params(), BEAMS)
+        st_j = MapState.create(cfg)
+        st_n = create_map_state_np(cfg)
+        for tick in range(4):
+            pts, m = _room_points((0.05 * tick, -0.02 * tick, 0.004 * tick))
+            st_j, wire_j = map_match_step(
+                st_j, pts, m, np.int32(1), cfg=cfg
+            )
+            st_n, wire_n = map_match_step_np(st_n, pts, m, 1, cfg)
+            np.testing.assert_array_equal(np.asarray(wire_j), wire_n)
+        got = jax.device_get(st_j)
+        np.testing.assert_array_equal(
+            np.asarray(got.log_odds), st_n["log_odds"]
+        )
+        np.testing.assert_array_equal(np.asarray(got.pose), st_n["pose"])
+
+
+# ---------------------------------------------------------------------------
+# robustness: degenerate inputs
+# ---------------------------------------------------------------------------
+
+
+class TestDegenerate:
+    @pytest.mark.parametrize("backend", ["host", "fused"])
+    def test_all_invalid_scan_keeps_map(self, backend):
+        mapper = FleetMapper(_params(map_backend=backend), 1, beams=BEAMS)
+        pts, m = _room_points((0, 0, 0))
+        _submit_one(mapper, pts, m)
+        before = mapper.snapshot()
+        est = _submit_one(mapper, pts, np.zeros(BEAMS, bool))
+        after = mapper.snapshot()
+        assert est.score == 0 and est.matched_points == 0
+        np.testing.assert_array_equal(
+            before["log_odds"], after["log_odds"]
+        )
+        np.testing.assert_array_equal(before["pose"], after["pose"])
+        # the revolution still counts (an observation happened)
+        assert int(after["revision"][0]) == int(before["revision"][0]) + 1
+
+    @pytest.mark.parametrize("backend", ["host", "fused"])
+    def test_single_beam_scan_is_bounded(self, backend):
+        mapper = FleetMapper(_params(map_backend=backend), 1, beams=BEAMS)
+        pts = np.zeros((BEAMS, 2), np.float32)
+        pts[0] = (1.0, 0.5)
+        mask = np.zeros(BEAMS, bool)
+        mask[0] = True
+        est = _submit_one(mapper, pts, mask)
+        assert est.matched_points == 1
+        snap = mapper.snapshot()
+        lo = snap["log_odds"][0]
+        cfg = mapper.cfg
+        # one endpoint + its ray samples: a handful of touched cells,
+        # all within the clamp
+        assert 0 < np.count_nonzero(lo) <= cfg.free_samples + 1
+        assert np.abs(lo).max() <= cfg.clamp_q
+
+    def test_idle_stream_passes_through(self):
+        mapper = FleetMapper(_params(), 2, beams=BEAMS)
+        pts, m = _room_points((0, 0, 0))
+        stacked = np.stack([pts, pts])
+        masks = np.stack([m, m])
+        mapper.submit_points(stacked, masks, np.asarray([1, 1], np.int32))
+        before = mapper.snapshot()
+        ests = mapper.submit_points(
+            stacked, masks, np.asarray([1, 0], np.int32)
+        )
+        assert ests[1] is None
+        after = mapper.snapshot()
+        np.testing.assert_array_equal(
+            before["log_odds"][1], after["log_odds"][1]
+        )
+        assert int(after["revision"][1]) == int(before["revision"][1])
+        assert int(after["revision"][0]) == int(before["revision"][0]) + 1
+
+    @pytest.mark.parametrize(
+        "value", [1.0e6, 3.0e18, np.inf, -np.inf, np.nan]
+    )
+    def test_far_or_nonfinite_points_dropped_not_wrapped(self, value):
+        """Points beyond the fixed-point window — or outright
+        non-finite — must be invalidated, never cast to int32 (the
+        cast of an out-of-range f32 is implementation-defined and
+        NumPy/XLA disagree, which would poison the parity contract)."""
+        for backend in ("host", "fused"):
+            mapper = FleetMapper(
+                _params(map_backend=backend), 1, beams=BEAMS
+            )
+            pts = np.full((BEAMS, 2), value, np.float32)
+            mask = np.ones(BEAMS, bool)
+            est = _submit_one(mapper, pts, mask)
+            assert est.matched_points == 0
+            assert np.count_nonzero(mapper.snapshot()["log_odds"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint surface
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpoint:
+    @pytest.mark.parametrize("backend", ["host", "fused"])
+    def test_snapshot_restore_mid_run_resumes_bit_exact(self, backend):
+        p = _params(map_backend=backend)
+        mapper = FleetMapper(p, 2, beams=BEAMS)
+        for tick in range(3):
+            pts, masks, live = _fleet_inputs(2, tick)
+            mapper.submit_points(pts, masks, live)
+        snap = mapper.snapshot()
+        ref_tail = []
+        for tick in range(3, 5):
+            pts, masks, live = _fleet_inputs(2, tick)
+            ref_tail.append(mapper.submit_points(pts, masks, live))
+        ref_final = mapper.snapshot()
+
+        resumed = FleetMapper(p, 2, beams=BEAMS)
+        assert resumed.restore(snap) is True
+        for tick, ref in zip(range(3, 5), ref_tail):
+            pts, masks, live = _fleet_inputs(2, tick)
+            got = resumed.submit_points(pts, masks, live)
+            for s in range(2):
+                if ref[s] is None:
+                    assert got[s] is None
+                else:
+                    np.testing.assert_array_equal(
+                        ref[s].pose_q, got[s].pose_q
+                    )
+        got_final = resumed.snapshot()
+        for k in ref_final:
+            np.testing.assert_array_equal(ref_final[k], got_final[k])
+
+    def test_cross_backend_restore(self):
+        """A host snapshot restores into a fused mapper (and back) —
+        the snapshot format is backend-independent."""
+        host = FleetMapper(_params(), 1, beams=BEAMS)
+        pts, m = _room_points((0.1, 0, 0))
+        _submit_one(host, pts, m)
+        snap = host.snapshot()
+        fused = FleetMapper(_params(map_backend="fused"), 1, beams=BEAMS)
+        assert fused.restore(snap) is True
+        back = fused.snapshot()
+        for k in snap:
+            np.testing.assert_array_equal(snap[k], back[k])
+
+    def test_restore_rejects_mismatch_untouched(self):
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        pts, m = _room_points((0, 0, 0))
+        _submit_one(mapper, pts, m)
+        before = mapper.snapshot()
+        other = FleetMapper(_params(map_grid=32), 1, beams=BEAMS)
+        assert other.restore(before) is False  # wrong geometry
+        bad_version = dict(before)
+        bad_version["version"] = np.asarray(99, np.int32)
+        assert mapper.restore(bad_version) is False  # future schema
+        after = mapper.snapshot()
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k])
+
+    def test_npz_roundtrip(self, tmp_path):
+        from rplidar_ros2_driver_tpu.utils.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        mapper = FleetMapper(_params(), 1, beams=BEAMS)
+        pts, m = _room_points((0.2, -0.1, 0.01))
+        _submit_one(mapper, pts, m)
+        snap = mapper.snapshot()
+        path = str(tmp_path / "map.npz")
+        save_checkpoint(path, snap)
+        loaded, _meta = load_checkpoint(path)
+        resumed = FleetMapper(_params(), 1, beams=BEAMS)
+        assert resumed.restore(loaded) is True
+        got = resumed.snapshot()
+        for k in snap:
+            np.testing.assert_array_equal(snap[k], got[k])
+
+
+class TestNodeWiring:
+    def _fake_output(self, beams=2048):
+        from rplidar_ros2_driver_tpu.ops.filters import FilterOutput
+
+        pts, m = _room_points((0, 0, 0), n=beams)
+        return FilterOutput(
+            ranges=np.linalg.norm(pts, axis=1).astype(np.float32),
+            intensities=np.full(beams, 47.0, np.float32),
+            points_xy=pts,
+            point_mask=m,
+            voxel=np.zeros((32, 32), np.int32),
+        )
+
+    def _node_params(self):
+        return _params(voxel_grid_size=32, filter_window=2)
+
+    def test_node_publishes_pose_and_diagnostics(self):
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+
+        node = RPlidarNode(self._node_params())
+        assert node.configure()
+        assert node.mapper is not None
+        node._publish_chain_output(self._fake_output(), 1.0, 0.1, 8.0)
+        assert node.publisher.poses
+        pose = node.publisher.poses[-1]
+        assert pose.frame_id == "map" and pose.map_revision == 1
+        node._update_diagnostics()
+        values = node.publisher.diagnostics[-1].values
+        assert values.get("Map Backend") == node.mapper.backend
+        assert "Map Pose" in values
+
+    def test_node_checkpoint_roundtrips_map(self, tmp_path):
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+
+        node = RPlidarNode(self._node_params())
+        assert node.configure()
+        node._publish_chain_output(self._fake_output(), 1.0, 0.1, 8.0)
+        want = node.mapper.snapshot()
+        path = str(tmp_path / "node_ckpt.npz")
+        assert node.save_checkpoint(path) is True
+
+        fresh = RPlidarNode(self._node_params())
+        assert fresh.load_checkpoint(path) is True
+        assert fresh.configure()
+        got = fresh.mapper.snapshot()
+        for k in want:
+            np.testing.assert_array_equal(want[k], got[k])
+
+    def test_node_checkpoint_without_mapper_still_loads_chain(self, tmp_path):
+        """A checkpoint saved without map keys (mapper off) loads into a
+        map-enabled node: chain restored, mapper starts cold."""
+        from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+
+        plain = _params(voxel_grid_size=32, filter_window=2, map_enable=False)
+        node = RPlidarNode(plain)
+        assert node.configure()
+        path = str(tmp_path / "plain.npz")
+        assert node.save_checkpoint(path) is True
+
+        mapped = RPlidarNode(self._node_params())
+        assert mapped.load_checkpoint(path) is True
+        assert mapped.configure()
+        assert int(mapped.mapper.snapshot()["revision"][0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet service seam + viz + replay
+# ---------------------------------------------------------------------------
+
+
+def _scan(k: int, points: int = 300) -> dict:
+    rng = np.random.default_rng(k)
+    return {
+        "angle_q14": ((np.arange(points) * 65536) // points).astype(np.int32),
+        "dist_q2": (rng.uniform(0.3, 8.0, points) * 4000).astype(np.int32),
+        "quality": np.full(points, 180, np.int32),
+        "flag": None,
+    }
+
+
+def test_service_attach_mapper():
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    svc = ShardedFilterService(
+        _params(filter_window=2, voxel_grid_size=32),
+        streams=2, mesh=make_mesh(2), beams=128,
+    )
+    mapper = svc.attach_mapper()
+    assert mapper.streams == 2
+    svc.submit([_scan(1), _scan(2)])
+    assert all(p is not None for p in svc.last_poses)
+    assert mapper.ticks == 1
+    svc.submit([_scan(3), None])  # idle stream rides through
+    assert svc.last_poses[1] is None
+
+
+def test_service_pipelined_flush_feeds_mapper():
+    """The run's FINAL in-flight pipelined tick must reach the mapper at
+    flush time, or the map ends one revolution short of a non-pipelined
+    run over the same input (code-review finding)."""
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    svc = ShardedFilterService(
+        _params(filter_window=2, voxel_grid_size=32),
+        streams=2, mesh=make_mesh(2), beams=128,
+    )
+    mapper = svc.attach_mapper()
+    svc.submit_pipelined([_scan(1), _scan(2)])  # dispatched, nothing back yet
+    svc.submit_pipelined([_scan(3), _scan(4)])  # returns + maps tick 1
+    assert mapper.ticks == 1
+    svc.flush_pipelined()                       # drains + maps tick 2
+    assert mapper.ticks == 2
+    assert int(mapper.snapshot()["revision"][0]) == 2
+
+
+def test_service_fused_backlog_feeds_mapper_like_host():
+    """A backlog drained through the FUSED fleet ingest must leave the
+    attached mapper in the same state as the host golden path over the
+    same ticks (code-review finding: the fused branch used to bypass
+    the mapper entirely, making mapper state backend-dependent)."""
+    import bench
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    frames = bench._denseboost_wire_frames(4, 400)  # 4 revs, 10 frames each
+    run = 10
+
+    def make_ticks():
+        t = [100.0, 200.0]
+        ticks = []
+        for i in range(0, len(frames), run):
+            tick = []
+            for s in range(2):
+                batch = []
+                for f in frames[i : i + run]:
+                    t[s] += 1e-3
+                    batch.append((f, t[s]))
+                tick.append((ans, batch))
+            ticks.append(tick)
+        return ticks
+
+    def run_backend(backend):
+        svc = ShardedFilterService(
+            _params(
+                filter_window=2, voxel_grid_size=32,
+                fleet_ingest_backend=backend,
+            ),
+            streams=2, beams=128, capacity=512,
+            fleet_ingest_buckets=(run,),
+        )
+        m = svc.attach_mapper()
+        svc.submit_bytes_backlog(make_ticks())
+        return m
+
+    mh, mf = run_backend("host"), run_backend("fused")
+    sh, sf = mh.snapshot(), mf.snapshot()
+    assert (np.asarray(sh["revision"]) > 0).all()  # revolutions absorbed
+    for k in sh:
+        np.testing.assert_array_equal(sh[k], sf[k])
+    assert all(e is not None for e in mf.last_estimates)
+
+
+def test_viz_map_render_and_trajectory():
+    from rplidar_ros2_driver_tpu.tools.viz import draw_trajectory, map_to_image
+
+    mapper = FleetMapper(_params(), 1, beams=BEAMS)
+    pts, m = _room_points((0, 0, 0))
+    _submit_one(mapper, pts, m)
+    snap = mapper.snapshot()
+    img = map_to_image(snap["log_odds"][0], mapper.cfg.clamp_q)
+    assert img.shape == (64, 64) and img.dtype == np.uint8
+    assert (img > 128).any()   # occupied walls
+    assert (img < 128).any()   # freed interior
+    over = draw_trajectory(
+        img, [(0.0, 0.0), (0.5, 0.5)], mapper.cfg.cell_m, value=255
+    )
+    assert (over == 255).sum() >= 1
+    assert img.shape == over.shape
+
+
+def test_replay_with_map():
+    from rplidar_ros2_driver_tpu.replay import replay_with_map
+
+    revs = [_scan(k, points=600) for k in range(5)]
+    traj, scores, mapper = replay_with_map(
+        revs, _params(filter_window=2, voxel_grid_size=32), beams=256
+    )
+    assert traj.shape == (5, 3) and np.isfinite(traj).all()
+    assert scores.shape == (5,)
+    assert int(mapper.snapshot()["revision"][0]) == 5
+    assert np.count_nonzero(mapper.snapshot()["log_odds"]) > 0

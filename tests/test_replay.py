@@ -215,6 +215,27 @@ class TestEndToEnd:
         assert "sharded fleet replay (2 streams)" in out.stdout
         assert "voxel occupancy" in out.stdout
 
+    @pytest.mark.slow  # tier-1 covers replay_with_map + viz directly
+    # (tests/test_mapping.py); this subprocess arm costs ~15 s of a
+    # budget the suite already crowds
+    def test_cli_replay_map(self, tmp_path):
+        """`replay --map`: capture -> chain -> SLAM front-end, map as a
+        PGM artifact with the trajectory overlay — inspectable with no
+        ROS anywhere in the loop."""
+        path, _ = _capture_from_sim(tmp_path, seconds=0.5)
+        pgm = str(tmp_path / "map.pgm")
+        out = subprocess.run(
+            [sys.executable, "-m", "rplidar_ros2_driver_tpu", "replay", path,
+             "--cpu", "--map", "--map-pgm", pgm],
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "mapped" in out.stdout and "final pose" in out.stdout
+        with open(pgm, "rb") as f:
+            assert f.read(2) == b"P5"
+
 
 def test_write_after_close_is_noop(tmp_path):
     rec = FrameRecorder(str(tmp_path / "c.rplr"))
