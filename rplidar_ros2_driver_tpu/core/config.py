@@ -191,6 +191,19 @@ class DriverParams:
     def validate(self) -> None:
         if self.qos_reliability not in VALID_QOS:
             raise ValueError(f"qos_reliability must be one of {VALID_QOS}")
+        if self.serial_baudrate <= 0:
+            raise ValueError("serial_baudrate must be positive")
+        if not (0 < self.tcp_port <= 0xFFFF) or not (0 < self.udp_port <= 0xFFFF):
+            raise ValueError("tcp_port/udp_port must be within [1, 65535]")
+        if self.max_distance < 0:
+            raise ValueError("max_distance must be >= 0 (0 = hardware limit)")
+        if not (0 <= self.range_clip_min_m < self.range_clip_max_m):
+            raise ValueError(
+                "range clip must satisfy 0 <= range_clip_min_m < "
+                "range_clip_max_m"
+            )
+        if self.intensity_min < 0:
+            raise ValueError("intensity_min must be >= 0")
         if self.filter_backend not in VALID_BACKENDS:
             raise ValueError(f"filter_backend must be one of {VALID_BACKENDS}")
         if self.channel_type not in VALID_CHANNELS:

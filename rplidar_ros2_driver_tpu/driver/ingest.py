@@ -106,6 +106,16 @@ class FusedIngest:
         # matter how long the session runs (a single session epoch
         # drifts to ~ms f32 ulp after hours of streaming)
         self._base: Optional[float] = None
+        # recycled staging pairs per (bucket, frame_bytes): each dispatch
+        # takes a (frames, aux) numpy pair from this free list (zeroed —
+        # the fused program's contract is zero-padding past the live
+        # count) and the pair rides its pending entry until that
+        # dispatch's results are fetched: the fetch is the completion
+        # barrier proving the device consumed the inputs, so reuse can
+        # never race an in-flight dispatch even on a PJRT client with
+        # zero-copy host-buffer semantics (FleetFusedIngest discipline).
+        # Entries dropped unfetched just release their pair to the GC.
+        self._staging_free: dict = {}
         # pipelined collect seam: dispatched-but-unfetched wires
         self._pending: deque = deque()
         self._max_queue = max_queue
@@ -210,17 +220,34 @@ class FusedIngest:
                 return b
         return self._buckets[-1]
 
+    def _staging_buffers(self, mb: int, expect: int) -> tuple:
+        """A recycled (frames, aux) staging pair, zeroed for reuse;
+        freshly allocated on first contact with a (bucket, payload
+        width).  Unlike the fleet engine's free list, the key pins BOTH
+        dimensions, so any pooled pair already has the right shape."""
+        free = self._staging_free.setdefault((mb, expect), [])
+        if free:
+            entry = free.pop()
+            entry[0].fill(0)
+            entry[1].fill(0)
+            return entry
+        return (
+            np.zeros((mb, expect), np.uint8),
+            np.zeros((2 * mb + 2,), np.float32),
+        )
+
+    # graftlint: hot-loop
     def _dispatch(self, ans_type: int, expect: int, chunk: list) -> None:
         from rplidar_ros2_driver_tpu.ops.ingest import fused_ingest_step
 
         m = len(chunk)
         mb = self._bucket(m)
         base = chunk[0][1]
-        buf = np.zeros((mb, expect), np.uint8)
+        pair = self._staging_buffers(mb, expect)
+        buf, aux = pair
         buf[:m] = np.frombuffer(
             b"".join(d for d, _ in chunk), np.uint8
         ).reshape(m, expect)
-        aux = np.zeros((2 * mb + 2,), np.float32)
         aux[:m] = [ts - base for _, ts in chunk]
         if ans_type == Ans.MEASUREMENT_HQ:
             aux[mb : mb + m] = [
@@ -229,19 +256,25 @@ class FusedIngest:
         aux[-2] = 0.0 if self._base is None else self._base - base
         aux[-1] = m
         self._base = base
-        # numpy args go straight into the dispatch: the jit places
-        # uncommitted arrays on the (committed, donated) state's device,
-        # and the explicit pytree device_put it replaces measured ~0.5 ms
-        # per call on the CPU backend — pure staging overhead
+        # EXPLICIT H2D staging (device_put), not numpy args into the
+        # jit: under the runtime transfer sentinel
+        # (utils/guards.no_implicit_transfers — jax_transfer_guard=
+        # "disallow") an implicit numpy->jit transfer raises, so the
+        # steady-state hot loop performs exactly two declared puts per
+        # dispatch; precompile commits its warmup args the same way so
+        # the executable is shared (a committed-vs-uncommitted arg
+        # mismatch compiles twice and recompiles in-loop, ~600 ms
+        # measured on CPU)
+        dbuf, daux = self._jax.device_put((buf, aux), self.device)
         self._state, *res = fused_ingest_step(
-            self._state, buf, aux, cfg=self._icfg
+            self._state, dbuf, daux, cfg=self._icfg
         )
         for arr in res:
             try:
                 arr.copy_to_host_async()
             except Exception:
                 pass  # backend without async D2H: the later fetch blocks
-        self._pending.append((tuple(res), self._icfg, base))
+        self._pending.append((tuple(res), self._icfg, base, (mb, expect), pair))
         while len(self._pending) > self._max_queue:
             # consumer lagging: oldest result dropped (the assembler's
             # newest-wins double buffer, at batch granularity)
@@ -267,23 +300,27 @@ class FusedIngest:
         )
         for b in self._buckets:
             st = self._jax.device_put(create_ingest_state(icfg), self.device)
-            # frames/aux stay numpy, matching the live _dispatch call
-            # exactly: a committed-device warmup arg compiles a separate
-            # executable, and the first live (numpy-arg) dispatch then
-            # pays a full in-loop recompile (~600 ms measured on CPU)
+            # frames/aux committed via device_put, matching the live
+            # _dispatch call exactly: warmup and live args must share a
+            # commit pattern or the first live dispatch pays a full
+            # in-loop recompile (~600 ms measured on CPU)
             aux = np.zeros((2 * b + 2,), np.float32)
             aux[-1] = 1.0
-            fused_ingest_step(
-                st, np.zeros((b, expect), np.uint8), aux, cfg=icfg
+            dbuf, daux = self._jax.device_put(
+                (np.zeros((b, expect), np.uint8), aux), self.device
             )
+            fused_ingest_step(st, dbuf, daux, cfg=icfg)
 
     # -- consumer side -----------------------------------------------------
 
     def _parse(self, entry) -> list:
         from rplidar_ros2_driver_tpu.ops.ingest import unpack_ingest_result
 
-        arrays, icfg, base = entry
+        arrays, icfg, base, skey, pair = entry
         res = unpack_ingest_result(arrays, icfg)
+        # the unpack fetched this dispatch's results, proving its staged
+        # inputs consumed: the staging pair is safe to recycle
+        self._staging_free.setdefault(skey, []).append(pair)
         self.nodes_decoded += res.nodes_appended
         self.scans_completed += res.n_completed
         self.revs_dropped += res.revs_dropped
@@ -508,6 +545,30 @@ class FleetFusedIngest:
 
         return place_fleet_ingest_state(self.mesh, state)
 
+    def _put_staging(self, buf, aux, *, super_step: bool = False) -> tuple:
+        """EXPLICIT H2D staging of one dispatch's input planes — the
+        declared transfers the runtime sentinel counts (utils/guards.
+        no_implicit_transfers disallows implicit numpy->jit staging).
+        Stream-sharded on a mesh (the state's own layout: each stream's
+        bytes land on the shard holding its carries), device-committed
+        otherwise; ``super_step`` shifts the stream axis behind the
+        leading tick axis.  Warmup (precompile) and the live dispatch
+        both route through here so they share one commit pattern — and
+        therefore one compiled executable."""
+        if self.mesh is None:
+            return self._jax.device_put((buf, aux), self.device)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        lead = (None,) if super_step else ()
+        return (
+            self._jax.device_put(buf, NamedSharding(
+                self.mesh, P(*lead, "stream", None, None)
+            )),
+            self._jax.device_put(aux, NamedSharding(
+                self.mesh, P(*lead, "stream", None)
+            )),
+        )
+
     # -- configuration -----------------------------------------------------
 
     def _ensure_cfg(self, formats) -> None:
@@ -534,9 +595,11 @@ class FleetFusedIngest:
         set on a throwaway state (motor-warmup analog of the single-stream
         engine's precompile), so first contact with an off-bucket chunk —
         or the first tick itself — never stalls the live loop on a
-        compile.  Frames/aux stay numpy, matching the live dispatch's arg
-        kinds exactly (a committed-arg warmup compiles a separate
-        executable — see FusedIngest.precompile)."""
+        compile.  Frames/aux are committed through the same
+        ``_put_staging`` path as the live dispatch: warmup and live args
+        must share one commit pattern or the first live tick pays an
+        in-loop recompile (see FusedIngest.precompile; pinned by the
+        tests/test_guards.py steady-state sentinels)."""
         from rplidar_ros2_driver_tpu.ops.ingest import (
             create_fleet_ingest_state,
             fleet_aux_len,
@@ -553,12 +616,11 @@ class FleetFusedIngest:
             st = self._place(create_fleet_ingest_state(icfg, self.streams))
             aux = np.zeros((self.streams, fleet_aux_len(b)), np.float32)
             aux[:, 2 * b + 1] = 1.0  # m=1: the live-lane trace
-            fleet_fused_ingest_step(
-                st,
+            dbuf, daux = self._put_staging(
                 np.zeros((self.streams, b, icfg.frame_bytes), np.uint8),
                 aux,
-                cfg=icfg,
             )
+            fleet_fused_ingest_step(st, dbuf, daux, cfg=icfg)
             if self.super_tick_max > 1:
                 # the backlog-drain program: one compile per (T, bucket)
                 T = self.super_tick_max
@@ -569,14 +631,14 @@ class FleetFusedIngest:
                     (T, self.streams, fleet_aux_len(b)), np.float32
                 )
                 saux[:, :, 2 * b + 1] = 1.0
-                super_fleet_ingest_step(
-                    st,
+                dbuf, daux = self._put_staging(
                     np.zeros(
                         (T, self.streams, b, icfg.frame_bytes), np.uint8
                     ),
                     saux,
-                    cfg=icfg,
+                    super_step=True,
                 )
+                super_fleet_ingest_step(st, dbuf, daux, cfg=icfg)
 
     # -- producer side -----------------------------------------------------
 
@@ -718,6 +780,7 @@ class FleetFusedIngest:
         consumed)."""
         self._staging_free.setdefault((kind, mb), []).append(pair)
 
+    # graftlint: hot-loop
     def _stage_slice(self, sl, mb: int, buf, aux) -> None:
         """Fill one tick slice's staging planes (``buf``: (streams, mb,
         frame_bytes) uint8, ``aux``: (streams, 2mb+4) f32, both
@@ -766,6 +829,7 @@ class FleetFusedIngest:
             self._pending.popleft()
             self.wires_dropped += 1
 
+    # graftlint: hot-loop
     def _dispatch_slice(self, sl) -> None:
         from rplidar_ros2_driver_tpu.ops.ingest import fleet_fused_ingest_step
 
@@ -776,11 +840,13 @@ class FleetFusedIngest:
         pair = self._staging_buffers("tick", mb)
         buf, aux = pair
         self._stage_slice(sl, mb, buf, aux)
-        # numpy args go straight into the dispatch (the jit stages them on
-        # the donated state's devices) — 2 host->device transfers per
-        # fleet tick slice, independent of fleet size
+        # explicit device_put staging (_put_staging) — 2 DECLARED
+        # host->device transfers per fleet tick slice, independent of
+        # fleet size; the runtime transfer sentinel forbids the implicit
+        # numpy->jit alternative
+        dbuf, daux = self._put_staging(buf, aux)
         self._state, *res = fleet_fused_ingest_step(
-            self._state, buf, aux, cfg=icfg
+            self._state, dbuf, daux, cfg=icfg
         )
         self.dispatch_count += 1
         self.h2d_transfers += 2
@@ -788,6 +854,7 @@ class FleetFusedIngest:
             res, ("tick", tuple(res), icfg, list(self._bases), mb, pair)
         )
 
+    # graftlint: hot-loop
     def _dispatch_super(self, group) -> None:
         """Stage up to ``super_tick_max`` tick slices as one
         (T, streams, M, frame_bytes) plane and drain them in ONE
@@ -809,9 +876,11 @@ class FleetFusedIngest:
             self._stage_slice(sl, mb, buf[t], aux[t])
             bases_per_tick.append(list(self._bases))
         # the idle pad ticks (t >= len(group)) stay all-zero; their meta
-        # rows come back all-zero and the parse skips them
+        # rows come back all-zero and the parse skips them.  Staging is
+        # an explicit device_put, like the per-tick path.
+        dbuf, daux = self._put_staging(buf, aux, super_step=True)
         self._state, *res = super_fleet_ingest_step(
-            self._state, buf, aux, cfg=icfg
+            self._state, dbuf, daux, cfg=icfg
         )
         self.dispatch_count += 1
         self.super_dispatches += 1

@@ -73,6 +73,10 @@ DEFAULT_MODES = [
 ]
 
 
+# graftlint: disable=GL006 — NOT a jit static: SimConfig is the mutable
+# firmware-state holder of the simulated device (tests flip
+# health_status live, SET_LIDAR_CONF writes ip_conf); it never crosses
+# a jit boundary
 @dataclass
 class SimConfig:
     model_id: int = 0x71           # S2M1 -> NEW_TYPE

@@ -204,15 +204,18 @@ class FleetMapper:
 
         throwaway = self._fresh_states()
         b = self.cfg.beams
-        # numpy args, matching the live submit exactly (a committed-arg
-        # warmup compiles a separate executable — driver/ingest note)
-        fleet_map_match_step(
-            throwaway,
-            np.zeros((self.streams, b, 2), np.float32),
-            np.zeros((self.streams, b), bool),
-            np.zeros((self.streams,), np.int32),
-            cfg=self.cfg,
+        # args committed via device_put, matching the live submit_points
+        # exactly (warmup and live must share one commit pattern or the
+        # first live tick recompiles — driver/ingest note)
+        args = self._jax.device_put(
+            (
+                np.zeros((self.streams, b, 2), np.float32),
+                np.zeros((self.streams, b), bool),
+                np.zeros((self.streams,), np.int32),
+            ),
+            self.device,
         )
+        fleet_map_match_step(throwaway, *args, cfg=self.cfg)
 
     # -- hot path -----------------------------------------------------------
 
@@ -256,8 +259,14 @@ class FleetMapper:
                     fleet_map_match_step,
                 )
 
+                # explicit H2D staging: under the runtime transfer
+                # sentinel (utils/guards) the mapper tick performs one
+                # declared put + one donated dispatch, nothing implicit
+                dpoints, dmasks, dlive = self._jax.device_put(
+                    (points, np.asarray(masks, bool), live), self.device
+                )
                 self._states, wires = fleet_map_match_step(
-                    self._states, points, masks, live, cfg=self.cfg
+                    self._states, dpoints, dmasks, dlive, cfg=self.cfg
                 )
                 self.dispatch_count += 1
                 wires = np.asarray(wires)

@@ -188,6 +188,11 @@ class RPlidarNode(LifecycleNode):
                     # geometry changed since the snapshot: drop it rather
                     # than re-trying (and re-warning) every configure
                     self._chain_snapshot = None
+        if self.chain is None and not fused:
+            # raw publish path: warm its jitted kernels now (the
+            # publish-path analog of the chain/decoder precompiles) so
+            # the first live revolution doesn't stall on an XLA compile
+            self.precompile_publish_kernels()
         if self.params.map_enable and self.params.filter_chain:
             from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
 
@@ -208,6 +213,35 @@ class RPlidarNode(LifecycleNode):
             )
         self._update_diagnostics()
         return True
+
+    def precompile_publish_kernels(self) -> None:
+        """Warm the RAW publish path's jitted kernels — ascend_scan (via
+        apply_angle_compensation) and to_laserscan — on a throwaway
+        all-masked batch, both is_new_type lowerings.  Chain-path
+        configs never reach these kernels (the chain publishes its own
+        output), so this runs only when the raw path is live; the dummy
+        batch is shape-identical to a live one (from_numpy pads to
+        MAX_SCAN_NODES), so the first real revolution hits a warm jit
+        cache."""
+        import numpy as np
+
+        from rplidar_ros2_driver_tpu.ops.ascend import (
+            apply_angle_compensation,
+        )
+
+        z = np.zeros((0,), np.int32)
+        batch = apply_angle_compensation(
+            ScanBatch.from_numpy(z, z, z), self.params.angle_compensate
+        )
+        for is_new in (False, True):
+            to_laserscan(
+                batch,
+                0.1,
+                40.0,
+                scan_processing=self.params.scan_processing,
+                inverted=self.params.inverted,
+                is_new_type=is_new,
+            )
 
     def on_activate(self) -> bool:
         assert self.fsm is not None

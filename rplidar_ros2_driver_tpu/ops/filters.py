@@ -163,7 +163,7 @@ def _clip_ok(batch: ScanBatch, cfg: FilterConfig) -> jax.Array:
     """The ONE clip predicate (returns inside [range_min, range_max] and
     at/above intensity_min), shared by the standalone clip_filter and
     the fused resample-key paths so the two cannot drift."""
-    dist_m = batch.dist_q2.astype(jnp.float32) * (1.0 / 4000.0)
+    dist_m = batch.dist_q2.astype(jnp.float32) * jnp.float32(1.0 / 4000.0)
     return (
         (dist_m >= cfg.range_min_m)
         & (dist_m <= cfg.range_max_m)
@@ -207,7 +207,10 @@ def _resample_keys(batch: ScanBatch, beams: int, cfg: Optional[FilterConfig] = N
 def _grid_decode(grid: jax.Array):
     """Per-beam packed min -> (ranges, intensities) with +inf / 0 misses."""
     hit = grid != _INT_INF
-    ranges = jnp.where(hit, (grid >> 8).astype(jnp.float32) * (1.0 / 4000.0), jnp.inf)
+    ranges = jnp.where(
+        hit, (grid >> 8).astype(jnp.float32) * jnp.float32(1.0 / 4000.0),
+        jnp.inf,
+    )
     inten = jnp.where(hit, (grid & 0xFF).astype(jnp.float32), 0.0)
     return ranges, inten
 
@@ -372,7 +375,9 @@ def median_from_sorted(sorted_w: jax.Array) -> jax.Array:
 
 def polar_to_cartesian(ranges: jax.Array, beams: int):
     """Beam-grid ranges -> (B, 2) XY metres + finite mask."""
-    theta = (jnp.arange(beams, dtype=jnp.float32) + 0.5) * (TWO_PI / beams)
+    theta = (
+        jnp.arange(beams, dtype=jnp.float32) + jnp.float32(0.5)
+    ) * jnp.float32(TWO_PI / beams)
     finite = jnp.isfinite(ranges)
     r = jnp.where(finite, ranges, 0.0)
     xy = jnp.stack([r * jnp.cos(theta), r * jnp.sin(theta)], axis=-1)
@@ -387,6 +392,9 @@ def _voxel_cells(
     semantics) lives, shared by both voxel kernels so their bit-parity
     contract cannot drift."""
     half = grid // 2
+    # graftlint: policed — xy comes from the masked polar projection
+    # (non-finite ranges project to r=0) and is bounded by range_max_m,
+    # so the cast never sees NaN/inf/out-of-int32 values
     ij = jnp.floor(xy / cell_m).astype(jnp.int32) + half
     gx, gy = ij[:, 0], ij[:, 1]
     inb = mask & (gx >= 0) & (gx < grid) & (gy >= 0) & (gy < grid)
@@ -421,9 +429,13 @@ def voxel_hits_matmul(
     # mask folded into one side only: a dead/out-of-grid point is all-zero
     ohx = ((gx[:, None] == cells[None, :]) & inb[:, None]).astype(jnp.bfloat16)
     ohy = (gy[:, None] == cells[None, :]).astype(jnp.bfloat16)
+    # graftlint: disable=GL004 — the one sanctioned float accumulation:
+    # 0/1 one-hot products are exact in bf16 and the f32 accumulation is
+    # exact for counts < 2^24, so order of reduction cannot matter
     counts = jnp.einsum(
         "bi,bj->ij", ohx, ohy, preferred_element_type=jnp.float32
     )
+    # graftlint: policed — exact small integers in f32 (see above)
     return counts.astype(jnp.int32)
 
 
@@ -445,6 +457,10 @@ def select_voxel_hits(backend: str):
 # ---------------------------------------------------------------------------
 
 
+# The ScanBatch-level debug/parity API stays non-donating on purpose:
+# the filter suites call it repeatedly on the SAME input state for A/B
+# trajectory comparison.  Every production wire entry below donates.
+# graftlint: disable=GL003 — non-donating debug/parity API (see above)
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def filter_step(
     state: FilterState, batch: ScanBatch, cfg: FilterConfig

@@ -232,6 +232,8 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
     r = cfg.max_revs
     n = int(meta[0])
     off = _META
+    # graftlint: policed — slot counts ride the f32 meta plane by wire
+    # contract: small non-negative ints (<= max_nodes), exact in f32
     counts = meta[off : off + r].astype(np.int32)
     ts0 = meta[off + r : off + 2 * r].copy()
     end_ts = meta[off + 2 * r : off + 3 * r].copy()
@@ -241,6 +243,8 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
         outputs = [unpack_output_wire(w[k], cfg.filter) for k in range(n)]
     nodes = node_ts = None
     if cfg.emit_nodes:
+        # graftlint: policed — debug node planes ride f32 by wire
+        # contract; the widest field (18-bit clamped dist) is exact
         nodes = np.asarray(res[2]).astype(np.int32)[:n]
         node_ts = np.asarray(res[3])[:n]
     return IngestBatchResult(
@@ -584,6 +588,8 @@ def fused_ingest_step(
     rx = aux[:mb]
     crc_ok = aux[mb : 2 * mb] > 0.5
     base_shift = aux[-2]
+    # graftlint: policed — the live frame count rides the f32 aux plane
+    # by wire contract: a small non-negative int, exact in f32
     m = aux[-1].astype(jnp.int32)
 
     dec = _decode(cfg, state, frames, crc_ok)
@@ -931,7 +937,10 @@ def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
     rx = aux[:mb]
     crc_ok = aux[mb : 2 * mb] > 0.5
     base_shift = aux[2 * mb]
+    # graftlint: policed — frame count and branch index ride the f32 aux
+    # plane by wire contract: small non-negative ints, exact in f32
     m = aux[2 * mb + 1].astype(jnp.int32)
+    # graftlint: policed — see above
     branch = aux[2 * mb + 2].astype(jnp.int32)
     reset = aux[2 * mb + 3] > 0.5
     state = _reset_stream_decode(state, reset)
@@ -1072,6 +1081,8 @@ def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
         mrow = meta[i]
         n = int(mrow[0])
         off = _META
+        # graftlint: policed — slot counts ride the f32 meta plane by
+        # wire contract (unpack_ingest_result note): exact small ints
         counts = mrow[off : off + r].astype(np.int32)
         ts0 = mrow[off + r : off + 2 * r].copy()
         end_ts = mrow[off + 2 * r : off + 3 * r].copy()
@@ -1088,6 +1099,8 @@ def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
             end_ts=end_ts[:n],
             outputs=outputs,
             nodes=(
+                # graftlint: policed — debug node planes ride f32 by
+                # wire contract; 18-bit clamped dist is exact
                 nodes_all[i].astype(np.int32)[:n]
                 if nodes_all is not None else None
             ),

@@ -177,7 +177,12 @@ def rotation_table(divisions: int) -> np.ndarray:
     and shared VERBATIM by the numpy reference and the jitted kernels
     (where it bakes in as a constant), so no backend ever evaluates a
     transcendental inside the parity-critical datapath."""
+    # graftlint: disable=GL005 — deliberate f64 HOST-side table build;
+    # both backends consume the resulting int32 table verbatim, so the
+    # float math here can never reach the parity-critical datapath
     k = np.arange(divisions, dtype=np.float64) * (2.0 * np.pi / divisions)
+    # graftlint: policed — |cos|,|sin| <= 1 so rint(· * 2^14) is within
+    # ±2^14, exactly representable and in int32 range on every backend
     return np.stack(
         [np.rint(np.cos(k) * ANG), np.rint(np.sin(k) * ANG)], axis=1
     ).astype(np.int32)
@@ -217,6 +222,9 @@ def quantize_points(xy: jax.Array, mask: jax.Array, cfg: MapConfig):
         & (jnp.abs(s[:, 1]) <= lim)
     )
     s = jnp.where(jnp.isfinite(s), s, 0.0)
+    # graftlint: policed — the docstring's whole point: NaN/inf zeroed
+    # and the value clamped into ±PQ_LIMIT in FLOAT space above, so the
+    # cast never sees an implementation-defined conversion
     pq = jnp.round(jnp.clip(s, -lim, lim)).astype(jnp.int32)
     return pq, ok
 
@@ -267,9 +275,13 @@ def cell_hits_matmul(cells_x, cells_y, inb, grid: int) -> jax.Array:
         jnp.bfloat16
     )
     ohy = (cells_y[:, None] == cells[None, :]).astype(jnp.bfloat16)
+    # graftlint: disable=GL004 — the one sanctioned float accumulation
+    # (ops/filters.voxel_hits_matmul note): 0/1 one-hot products are
+    # exact and f32 accumulation is exact below 2^24 counts
     counts = jnp.einsum(
         "bi,bj->ij", ohx, ohy, preferred_element_type=jnp.float32
     )
+    # graftlint: policed — exact small integers in f32 (see above)
     return counts.astype(jnp.int32)
 
 

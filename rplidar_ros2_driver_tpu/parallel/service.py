@@ -425,9 +425,13 @@ class ShardedFilterService:
         if len(scans) != self.streams:
             raise ValueError(f"expected {self.streams} scans, got {len(scans)}")
         packed_np = self._stack(scans)
+        # graftlint: hot-loop (one explicit sharded put + one donated
+        # dispatch per tick; allocation lives in _stack's packing, which
+        # the wire contract zero-pads per tick)
         packed = jax.device_put(packed_np, self._packed_sharding)
         with self._lock:
             self._state, out = self._step(self._state, packed)
+        # graftlint: end-hot-loop
         # bounded like the pipelined collect: the synchronous tick is the
         # fleet analog of the chain's process_raw (reference timed grab)
         live = [s is not None for s in scans]
@@ -464,6 +468,7 @@ class ShardedFilterService:
             )
         return results
 
+    # graftlint: hot-loop
     def submit_pipelined(
         self, scans: Sequence[Optional[dict]]
     ) -> list[Optional[FilterOutput]]:

@@ -1,0 +1,49 @@
+"""graftlint — repo-native static analysis for the invariants this
+codebase's correctness actually rests on.
+
+The four device-resident engines (fused ingest, fleet tick, super-tick,
+SLAM mapper) are bit-exact against their host golden paths, donate their
+carried state, and must never hide a host sync or an implicit transfer
+inside a hot loop.  Nothing checked those invariants mechanically — a
+single float reduction in a fixed-point zone, one unpoliced
+``float→int32`` cast, or a forgotten ``donate_argnums`` silently breaks
+host/device parity or doubles HBM churn, and only a reviewer's memory
+stood in the way.  graftlint is that reviewer, in CI.
+
+Rules (each fires with a file:line finding; suppression is
+``# graftlint: disable=GLxxx — reason`` on the offending or preceding
+line, and ``# graftlint: policed — reason`` blesses a float→int cast):
+
+  GL001  host-sync calls (``.item()``, ``np.asarray``, ``jax.device_get``,
+         ``.block_until_ready()``, ``int()``/``float()`` on traced
+         params) reachable inside ``@jax.jit`` bodies
+  GL002  Python ``if``/``while`` branching on traced values inside
+         jit-reachable code
+  GL003  donation hygiene: a donated argument read after its call site;
+         jitted carry-style ``ops/`` entry points missing donation
+  GL004  bit-exact zones: float reductions (``sum``/``mean``/``dot``/
+         ``einsum``/``cumsum``) and unpoliced ``astype(int32)`` casts
+  GL005  weak-type promotion: bare Python float scalars mixed into
+         array binops inside bit-exact zones
+  GL006  unhashable/mutable ``static_argnames`` values; non-frozen
+         ``*Config`` dataclasses (static args must hash)
+  GL007  allocations (``np.zeros``/``jnp.asarray``/...) inside regions
+         marked ``# graftlint: hot-loop``
+  GL008  structural consistency: jitted ``ops/`` entries reachable from
+         a ``precompile()``; every ``bench.py --config N`` pinned in
+         ``test_bench_meta.py``; every ``DriverParams`` field present in
+         ``param/rplidar.yaml`` and validated in ``core/config.py``
+
+Per-module invariant declarations (zones, hot files, naming-convention
+dtype patterns, exemptions) live in ``pyproject.toml`` under
+``[tool.graftlint]``; findings must reconcile against the checked-in
+baseline (empty in a healthy tree — every entry needs a justification).
+
+CLI: ``python -m rplidar_ros2_driver_tpu.tools.graftlint [--json]``.
+"""
+
+from rplidar_ros2_driver_tpu.tools.graftlint.config import LintConfig, load_config
+from rplidar_ros2_driver_tpu.tools.graftlint.model import Finding, RepoIndex
+from rplidar_ros2_driver_tpu.tools.graftlint.runner import run_lint
+
+__all__ = ["Finding", "LintConfig", "RepoIndex", "load_config", "run_lint"]

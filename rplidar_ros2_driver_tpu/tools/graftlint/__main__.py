@@ -1,0 +1,7 @@
+"""``python -m rplidar_ros2_driver_tpu.tools.graftlint [--json]``."""
+
+import sys
+
+from rplidar_ros2_driver_tpu.tools.graftlint.runner import main
+
+sys.exit(main())

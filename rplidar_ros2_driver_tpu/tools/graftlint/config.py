@@ -1,0 +1,106 @@
+"""``[tool.graftlint]`` — the per-module invariant declarations.
+
+The analyzer is repo-native: which files are bit-exact fixed-point
+zones, which host files carry hot-loop regions, which parameter names
+are compile-time static, and which naming conventions imply a dtype are
+all REPO facts, so they are declared next to the build manifest in
+pyproject.toml rather than hard-coded in the tool.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+try:  # py311+: stdlib; this image's 3.10 ships tomli
+    import tomllib as _toml
+except ImportError:  # pragma: no cover - depends on interpreter version
+    import tomli as _toml
+
+
+@dataclasses.dataclass(frozen=True)
+class LintConfig:
+    """Parsed ``[tool.graftlint]`` tables (all paths repo-relative)."""
+
+    root: str
+    paths: tuple = ("rplidar_ros2_driver_tpu",)
+    baseline: str = "graftlint.baseline.json"
+    # param names that are compile-time static wherever they appear
+    # (configs, backend selectors) — GL001/GL002 never treat them traced
+    static_params: tuple = ()
+    # GL004: bit-exact zones + the naming-convention dtype declarations
+    zones: tuple = ()
+    int_returning: tuple = ()       # calls whose results are integer
+    int_names: tuple = ()           # regexes: names carrying integer data
+    float_names: tuple = ()         # regexes: names carrying float data
+    bool_names: tuple = ()          # regexes: names carrying masks
+    # GL007 hot-loop files
+    hot_files: tuple = ()
+    # GL008 structural-consistency inputs
+    bench: str = "bench.py"
+    bench_meta_test: str = "tests/test_bench_meta.py"
+    params_module: str = "rplidar_ros2_driver_tpu/core/config.py"
+    params_yaml: str = "param/rplidar.yaml"
+    unvalidated_params_ok: tuple = ()
+    precompile_exempt: tuple = ()
+
+    def zone_patterns(self) -> tuple:
+        return tuple(re.compile(p) for p in self.int_names), tuple(
+            re.compile(p) for p in self.float_names
+        ), tuple(re.compile(p) for p in self.bool_names)
+
+
+def load_config(root: str) -> LintConfig:
+    """Read ``[tool.graftlint]`` from ``<root>/pyproject.toml`` (every
+    key optional — missing tables mean the defaults above)."""
+    path = os.path.join(root, "pyproject.toml")
+    data: dict = {}
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            data = _toml.load(f)
+    t = data.get("tool", {}).get("graftlint", {})
+    g4 = t.get("gl004", {})
+    g7 = t.get("gl007", {})
+    g8 = t.get("gl008", {})
+    return LintConfig(
+        root=root,
+        paths=tuple(t.get("paths", ("rplidar_ros2_driver_tpu",))),
+        baseline=t.get("baseline", "graftlint.baseline.json"),
+        static_params=tuple(t.get("static_params", ())),
+        zones=tuple(g4.get("zones", ())),
+        int_returning=tuple(g4.get("int_returning", ())),
+        int_names=tuple(g4.get("int_names", ())),
+        float_names=tuple(g4.get("float_names", ())),
+        bool_names=tuple(g4.get("bool_names", ())),
+        hot_files=tuple(g7.get("files", ())),
+        bench=g8.get("bench", "bench.py"),
+        bench_meta_test=g8.get("bench_meta_test", "tests/test_bench_meta.py"),
+        params_module=g8.get(
+            "params_module", "rplidar_ros2_driver_tpu/core/config.py"
+        ),
+        params_yaml=g8.get("params_yaml", "param/rplidar.yaml"),
+        unvalidated_params_ok=tuple(g8.get("unvalidated_params_ok", ())),
+        precompile_exempt=tuple(g8.get("precompile_exempt", ())),
+    )
+
+
+def load_baseline(root: str, cfg: LintConfig) -> list[dict]:
+    """The checked-in baseline: a list of findings that are KNOWN and
+    individually justified.  Empty in a healthy tree; the runner fails
+    on any finding not in it AND on any stale entry no longer firing
+    (a baseline that outlives its findings stops meaning anything)."""
+    path = os.path.join(root, cfg.baseline)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("findings", [])
+    for e in entries:
+        if not e.get("justification"):
+            raise ValueError(
+                f"baseline entry without a justification: {e!r} — every "
+                "baselined finding must say why it is allowed to stand"
+            )
+    return entries

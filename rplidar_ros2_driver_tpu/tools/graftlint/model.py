@@ -1,0 +1,650 @@
+"""Repo model: parsed modules, suppressions, jit info, call graph, and
+the lightweight expression dtype lattice the bit-exactness rules use.
+
+Everything is plain ``ast`` — the tool never imports the code it
+analyzes (a lint of a module with a broken import must still run).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+from rplidar_ros2_driver_tpu.tools.graftlint.config import LintConfig
+
+_PKG = "rplidar_ros2_driver_tpu"
+
+# expression dtype lattice (GL004/GL005): order matters only for join
+INT, FLOAT, BOOL, UNKNOWN = "int", "float", "bool", "unknown"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z0-9, ]+?)\s*[—–-]\s*\S"
+)
+_POLICED_RE = re.compile(r"#\s*graftlint:\s*policed\s*[—–-]\s*\S")
+_HOT_RE = re.compile(r"#\s*graftlint:\s*hot-loop\b")
+_HOT_END_RE = re.compile(r"#\s*graftlint:\s*end-hot-loop\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str       # repo-relative
+    line: int
+    message: str
+
+    def key(self) -> tuple:
+        # line numbers churn with unrelated edits; identity is
+        # (rule, file, message) — messages name the construct
+        return (self.rule, self.path, self.message)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: "ModuleFile"
+    qualname: str                  # dotted: Class.method / outer.inner
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    params: tuple = ()
+    jitted: bool = False
+    static_names: tuple = ()       # static_argnames of the jit wrapper
+    donate_idx: tuple = ()         # donate_argnums of the jit wrapper
+    cls: str | None = None         # enclosing class name, if a method
+
+
+class ModuleFile:
+    """One parsed source file plus its comment-driven annotations."""
+
+    def __init__(self, root: str, relpath: str) -> None:
+        self.relpath = relpath
+        with open(os.path.join(root, relpath), encoding="utf-8") as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=relpath)
+        self.comments: dict[int, str] = {}
+        self.standalone: set[int] = set()  # comment-only lines
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    line = tok.start[0]
+                    self.comments[line] = tok.string
+                    if tok.string.strip() == tok.line.strip():
+                        self.standalone.add(line)
+        except tokenize.TokenizeError:  # pragma: no cover - parse caught it
+            pass
+        self.functions: dict[str, FunctionInfo] = {}
+        self.imports: dict[str, str] = {}        # alias -> module relpath
+        self.from_imports: dict[str, tuple] = {} # name -> (relpath, orig)
+        self.hot_regions: list[tuple] = []
+        self._index_imports(self.tree)
+        self._index_functions()
+        self._index_hot_regions()
+
+    # -- suppression / marker surface ------------------------------------
+
+    def _marker_lines(self, line: int):
+        """The flagged line itself plus the contiguous standalone-comment
+        block directly above it (markers read best with the directive
+        first and the rationale continuing below, so the whole block
+        counts)."""
+        yield line
+        ln = line - 1
+        while ln in self.standalone:
+            yield ln
+            ln -= 1
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """``# graftlint: disable=GLxxx — reason`` on the line or in the
+        comment block directly above.  A reason is REQUIRED — a bare
+        disable does not suppress (an unexplained exception is exactly
+        what this tool exists to prevent)."""
+        for ln in self._marker_lines(line):
+            c = self.comments.get(ln)
+            if c is None:
+                continue
+            m = _SUPPRESS_RE.search(c)
+            if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                return True
+        return False
+
+    def policed(self, line: int) -> bool:
+        """``# graftlint: policed — reason`` blesses a float→int cast on
+        this line or in the comment block directly above (the GL004
+        cast escape hatch)."""
+        return any(
+            _POLICED_RE.search(self.comments.get(ln, ""))
+            for ln in self._marker_lines(line)
+        )
+
+    def in_hot_region(self, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.hot_regions)
+
+    def _index_hot_regions(self) -> None:
+        """A ``# graftlint: hot-loop`` marker opens a region: to the
+        matching ``end-hot-loop`` if one follows, else over the next
+        ``def``'s whole body (the common shape: mark a dispatch/staging
+        method hot)."""
+        defs = sorted(
+            (n.lineno, getattr(n, "end_lineno", n.lineno))
+            for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        )
+        ends = sorted(
+            ln for ln, c in self.comments.items() if _HOT_END_RE.search(c)
+        )
+        starts = sorted(
+            ln for ln, c in self.comments.items()
+            if _HOT_RE.search(c) and not _HOT_END_RE.search(c)
+        )
+        for i, ln in enumerate(starts):
+            # an end marker only pairs with THIS start if no other start
+            # opens in between — otherwise a def-scoped marker earlier in
+            # the file would absorb a later begin/end pair's end marker
+            # and fuse everything between into one bogus region
+            nxt_start = starts[i + 1] if i + 1 < len(starts) else float("inf")
+            end = next((e for e in ends if ln < e < nxt_start), None)
+            if end is not None:
+                self.hot_regions.append((ln, end))
+                continue
+            nxt = next((d for d in defs if d[0] > ln), None)
+            if nxt is not None:
+                self.hot_regions.append((nxt[0], nxt[1]))
+
+    # -- imports ----------------------------------------------------------
+
+    def _index_imports(self, scope: ast.AST) -> None:
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.name.startswith(_PKG):
+                        alias = a.asname or a.name.split(".")[-1]
+                        self.imports[alias] = _mod_to_path(a.name)
+            elif isinstance(n, ast.ImportFrom) and n.module:
+                if not n.module.startswith(_PKG):
+                    continue
+                for a in n.names:
+                    sub = f"{n.module}.{a.name}"
+                    subpath = _mod_to_path(sub)
+                    if subpath is not None and _looks_module(sub):
+                        # "from pkg.ops import unpack" — a module alias
+                        self.imports[a.asname or a.name] = subpath
+                    self.from_imports[a.asname or a.name] = (
+                        _mod_to_path(n.module), a.name
+                    )
+
+    # -- functions ---------------------------------------------------------
+
+    def _index_functions(self) -> None:
+        def visit(node, prefix, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", child.name)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qn = f"{prefix}{child.name}"
+                    jitted, statics, donate = _jit_decoration(child)
+                    self.functions[qn] = FunctionInfo(
+                        module=self,
+                        qualname=qn,
+                        node=child,
+                        params=tuple(
+                            a.arg for a in (
+                                child.args.posonlyargs + child.args.args
+                            )
+                        ),
+                        jitted=jitted,
+                        static_names=statics,
+                        donate_idx=donate,
+                        cls=cls,
+                    )
+                    visit(child, f"{qn}.", cls)
+
+        visit(self.tree, "", None)
+
+
+def _looks_module(dotted: str) -> bool:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg_root = os.path.dirname(here)  # .../rplidar_ros2_driver_tpu
+    rel = dotted.split(".", 1)[1] if "." in dotted else ""
+    cand = os.path.join(pkg_root, *rel.split("."))
+    return os.path.isfile(cand + ".py") or os.path.isdir(cand)
+
+
+def _mod_to_path(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+def _name_of(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression (``jax.jit`` ->
+    "jax.jit"); "" when it isn't a plain dotted path."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _name_of(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def _jit_decoration(fn: ast.AST) -> tuple:
+    """(jitted, static_argnames, donate_argnums) from the decorators."""
+    for dec in getattr(fn, "decorator_list", ()):
+        if _name_of(dec) in ("jax.jit", "jit", "pjit.pjit", "jax.pmap"):
+            return True, (), ()
+        if isinstance(dec, ast.Call):
+            callee = _name_of(dec.func)
+            inner = dec.args[0] if dec.args else None
+            if callee in ("jax.jit", "jit") or (
+                callee in ("functools.partial", "partial")
+                and inner is not None
+                and _name_of(inner) in ("jax.jit", "jax.pmap", "jit")
+            ):
+                statics: tuple = ()
+                donate: tuple = ()
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        statics = _str_tuple(kw.value)
+                    elif kw.arg == "donate_argnums":
+                        donate = _int_tuple(kw.value)
+                return True, statics, donate
+    return False, (), ()
+
+
+def _str_tuple(node) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _int_tuple(node) -> tuple:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+class RepoIndex:
+    """All scanned modules + the cross-module call graph."""
+
+    def __init__(self, cfg: LintConfig) -> None:
+        self.cfg = cfg
+        self.modules: dict[str, ModuleFile] = {}
+        for top in cfg.paths:
+            full = os.path.join(cfg.root, top)
+            if os.path.isfile(full) and top.endswith(".py"):
+                self._load(top)
+                continue
+            for dirpath, _dirs, files in os.walk(full):
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        rel = os.path.relpath(
+                            os.path.join(dirpath, f), cfg.root
+                        )
+                        self._load(rel)
+
+    def _load(self, rel: str) -> None:
+        if "tools/graftlint" in rel.replace(os.sep, "/"):
+            return  # the linter does not lint itself (fixtures live in tests)
+        try:
+            self.modules[rel.replace(os.sep, "/")] = ModuleFile(cfg_root(self), rel)
+        except (SyntaxError, UnicodeDecodeError):
+            pass  # unparsable files are CI's problem, not this tool's
+
+    # -- function resolution ----------------------------------------------
+
+    def resolve_call(self, mod: ModuleFile, call: ast.AST):
+        """Resolve a Call/Name reference to a FunctionInfo, chasing
+        module aliases and from-imports one hop (package-internal only).
+        Returns None for anything unresolvable (builtins, methods on
+        values, third-party calls)."""
+        name = _name_of(call)
+        if not name:
+            return None
+        if "." in name:
+            head, _, tail = name.partition(".")
+            target = mod.imports.get(head)
+            if target in self.modules and "." not in tail:
+                return self.modules[target].functions.get(tail)
+            return None
+        if name in mod.functions:
+            return mod.functions[name]
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            if src in self.modules:
+                return self.modules[src].functions.get(orig)
+        return None
+
+    def resolve_method(self, fn: FunctionInfo, attr: str):
+        """``self.X`` inside a method resolves to a sibling method."""
+        if fn.cls is None:
+            return None
+        return fn.module.functions.get(f"{fn.cls}.{attr}")
+
+    def reachable_from(self, roots) -> set:
+        """Closure over the call graph: every FunctionInfo reachable
+        from ``roots`` by call OR bare function reference (references
+        cover indirect dispatch — kernel tables, functools.partial)."""
+        seen: set = set()
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            key = (fn.module.relpath, fn.qualname)
+            if key in seen:
+                continue
+            seen.add(key)
+            # function-local lazy imports participate in resolution
+            fn.module._index_imports(fn.node)
+            for n in ast.walk(fn.node):
+                tgt = None
+                if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(n, "ctx", None), ast.Load
+                ):
+                    if (
+                        isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"
+                    ):
+                        tgt = self.resolve_method(fn, n.attr)
+                    else:
+                        tgt = self.resolve_call(fn.module, n)
+                if tgt is not None and not isinstance(
+                    tgt.node, ast.ClassDef
+                ):
+                    frontier.append(tgt)
+        return seen
+
+    def jit_roots(self):
+        return [
+            f
+            for m in self.modules.values()
+            for f in m.functions.values()
+            if f.jitted
+        ]
+
+    def functions_by_key(self) -> dict:
+        return {
+            (m.relpath, f.qualname): f
+            for m in self.modules.values()
+            for f in m.functions.values()
+        }
+
+
+def cfg_root(index: RepoIndex) -> str:
+    return index.cfg.root
+
+
+# ---------------------------------------------------------------------------
+# expression dtype lattice
+# ---------------------------------------------------------------------------
+
+_INT_CALLS = {
+    "argmax", "argmin", "argsort", "searchsorted", "count_nonzero",
+    "broadcasted_iota",
+}
+_BOOL_CALLS = {
+    "isfinite", "isnan", "isinf", "logical_and", "logical_or",
+    "logical_not", "any", "all", "frame_crc_ok",
+}
+_FLOAT_CALLS = {"floor", "ceil", "round", "rint", "sqrt", "cos", "sin", "exp"}
+_PASS_CALLS = {
+    "clip", "minimum", "maximum", "abs", "roll", "take", "take_along_axis",
+    "pad", "broadcast_to", "sort", "flip", "transpose", "squeeze", "copy",
+    "asarray", "reshape", "ravel", "dynamic_slice", "dynamic_update_slice",
+    "dynamic_index_in_dim", "dynamic_update_index_in_dim", "tile", "repeat",
+    "max", "min", "mod", "associative_scan",
+}
+_REDUCE_CALLS = {"sum", "cumsum", "mean", "prod", "cumprod"}
+_DTYPE_CTORS_INT = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                    "uint32", "uint64", "int"}
+_DTYPE_CTORS_FLOAT = {"float16", "float32", "float64", "bfloat16", "float"}
+
+
+def dtype_kind(node) -> str:
+    """INT/FLOAT/BOOL/UNKNOWN for a dtype expression (``jnp.int32``,
+    ``np.float32``, ``bool``, ``"int32"``)."""
+    name = _name_of(node)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        leaf = node.value
+    if leaf in _DTYPE_CTORS_INT:
+        return INT
+    if leaf in _DTYPE_CTORS_FLOAT:
+        return FLOAT
+    if leaf == "bool" or leaf == "bool_":
+        return BOOL
+    return UNKNOWN
+
+
+def _join(*kinds) -> str:
+    if FLOAT in kinds:
+        return FLOAT
+    if UNKNOWN in kinds:
+        return UNKNOWN
+    return INT
+
+
+class ExprTyper:
+    """Best-effort dtype inference for GL004: local assignment tracking
+    first, the repo's declared naming conventions as the fallback.  The
+    goal is not a type system — it is to make the zones' float-vs-int
+    story EXPLICIT, with ``pyproject.toml`` declaring what the names
+    mean and the linter holding code to it."""
+
+    def __init__(self, cfg: LintConfig, module_env: dict | None = None):
+        self.int_pat, self.float_pat, self.bool_pat = cfg.zone_patterns()
+        self.int_returning = set(cfg.int_returning)
+        self.module_env = module_env or {}
+
+    def name_kind(self, name: str) -> str:
+        for pats, kind in (
+            (self.bool_pat, BOOL), (self.int_pat, INT),
+            (self.float_pat, FLOAT),
+        ):
+            if any(p.fullmatch(name) for p in pats):
+                return kind
+        return UNKNOWN
+
+    def build_env(self, fn_node) -> dict:
+        """One forward pass over the function's assignments."""
+        env = dict(self.module_env)
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Name):
+                    env[t.id] = self.etype(n.value, env)
+                elif isinstance(t, ast.Tuple) and isinstance(
+                    n.value, ast.Tuple
+                ) and len(t.elts) == len(n.value.elts):
+                    for te, ve in zip(t.elts, n.value.elts):
+                        if isinstance(te, ast.Name):
+                            env[te.id] = self.etype(ve, env)
+        return env
+
+    def etype(self, node, env) -> str:  # noqa: C901 - a lattice is a switch
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return BOOL
+            if isinstance(node.value, int):
+                return INT
+            if isinstance(node.value, float):
+                return FLOAT
+            return UNKNOWN
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            return BOOL
+        if isinstance(node, ast.UnaryOp):
+            if isinstance(node.op, ast.Not):
+                return BOOL
+            if isinstance(node.op, ast.Invert):
+                return self.etype(node.operand, env)
+            return self.etype(node.operand, env)
+        if isinstance(node, ast.BinOp):
+            if isinstance(
+                node.op,
+                (ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift, ast.RShift),
+            ):
+                lk = self.etype(node.left, env)
+                rk = self.etype(node.right, env)
+                return BOOL if lk == rk == BOOL else INT
+            if isinstance(node.op, ast.Div):
+                return FLOAT
+            return _join(
+                self.etype(node.left, env), self.etype(node.right, env)
+            )
+        if isinstance(node, ast.IfExp):
+            return _join(
+                self.etype(node.body, env), self.etype(node.orelse, env)
+            )
+        if isinstance(node, ast.Subscript):
+            return self.etype(node.value, env)
+        if isinstance(node, ast.Name):
+            kind = env.get(node.id, UNKNOWN)
+            return kind if kind != UNKNOWN else self.name_kind(node.id)
+        if isinstance(node, ast.Attribute):
+            return self.name_kind(node.attr) if node.attr not in (
+                "pi", "inf", "e", "nan"
+            ) else FLOAT
+        if isinstance(node, ast.Call):
+            return self._call_type(node, env)
+        return UNKNOWN
+
+    def _call_type(self, node: ast.Call, env) -> str:
+        # x.astype(dtype)
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "astype":
+            if node.args:
+                return dtype_kind(node.args[0])
+            return UNKNOWN
+        name = _name_of(node.func)
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        dt = next(
+            (kw.value for kw in node.keywords
+             if kw.arg in ("dtype", "preferred_element_type")),
+            None,
+        )
+        if dt is not None:
+            return dtype_kind(dt)
+        if leaf in _DTYPE_CTORS_INT or leaf == "len":
+            return INT
+        if leaf in _DTYPE_CTORS_FLOAT:
+            return FLOAT
+        if leaf in self.int_returning or leaf in _INT_CALLS:
+            return INT
+        if leaf in _BOOL_CALLS:
+            return BOOL
+        if leaf in _FLOAT_CALLS:
+            return FLOAT
+        if leaf == "where" and len(node.args) == 3:
+            return _join(
+                self.etype(node.args[1], env), self.etype(node.args[2], env)
+            )
+        if leaf in ("concatenate", "stack", "hstack", "vstack") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, (ast.List, ast.Tuple)):
+                return _join(*(self.etype(e, env) for e in arg.elts))
+            return self.etype(arg, env)
+        if leaf in _REDUCE_CALLS and node.args:
+            k = self.etype(node.args[0], env)
+            return INT if k == BOOL else k
+        if leaf in ("arange", "zeros", "ones", "full", "empty"):
+            return FLOAT if leaf != "arange" else INT
+        if leaf in _PASS_CALLS and node.args:
+            return self.etype(node.args[0], env)
+        return UNKNOWN
+
+
+# ---------------------------------------------------------------------------
+# taint: does an expression depend on traced (array) values?
+# ---------------------------------------------------------------------------
+
+_CLEAN_ATTRS = {"shape", "ndim", "dtype", "size"}
+_SCALAR_WRAPPERS = _DTYPE_CTORS_INT | _DTYPE_CTORS_FLOAT | {
+    "len", "bool", "range", "log2",
+}
+
+
+def is_static_name(name: str, statics: set) -> bool:
+    return name in statics or "cfg" in name or "config" in name
+
+
+def expr_mentions_tainted(node, tainted: set, statics: set) -> bool:
+    """Any Name in the expression that carries traced data, skipping
+    subtrees that collapse to host scalars (``x.shape``, ``len(x)``,
+    ``int(x)``) and compile-time-static names."""
+    if isinstance(node, ast.Attribute) and node.attr in _CLEAN_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        leaf = _name_of(node.func).rsplit(".", 1)[-1]
+        if leaf in _SCALAR_WRAPPERS:
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted and not is_static_name(node.id, statics)
+    return any(
+        expr_mentions_tainted(c, tainted, statics)
+        for c in ast.iter_child_nodes(node)
+    )
+
+
+_SCALAR_ANNOTATIONS = {"int", "float", "bool", "str", "bytes"}
+
+
+def scalar_annotated(fn_node) -> set:
+    """Params annotated as host scalars (``n: int``) — annotations are a
+    repo-enforceable contract that a value is never traced."""
+    out = set()
+    for a in fn_node.args.posonlyargs + fn_node.args.args + (
+        fn_node.args.kwonlyargs
+    ):
+        ann = a.annotation
+        if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
+            out.add(a.arg)
+    return out
+
+
+def build_taint(fn: FunctionInfo, statics: set) -> set:
+    """Traced-name set for one function: non-static params seed it, and
+    assignments propagate it forward (best effort, flow-insensitive)."""
+    scalars = scalar_annotated(fn.node)
+    tainted = {
+        p for p in fn.params
+        if p not in fn.static_names
+        and p not in scalars
+        and not is_static_name(p, statics)
+    }
+    for n in ast.walk(fn.node):
+        if isinstance(n, ast.Assign):
+            if expr_mentions_tainted(n.value, tainted, statics):
+                for t in n.targets:
+                    for leaf in ast.walk(t):
+                        if isinstance(leaf, ast.Name):
+                            tainted.add(leaf.id)
+    return tainted
+
+
+def is_array_producing(node) -> bool:
+    """Does the expression CONSTRUCT arrays (``jnp.arange`` etc.) even
+    without touching a traced input?  Used by GL005: a bare float scalar
+    against any array is a promotion site, concrete or traced.  Scalar
+    dtype wrappers (``jnp.float32(c)``) are the blessed idiom and do not
+    count."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute) and n.func.attr in (
+                "astype", "reshape", "ravel", "take", "sum", "copy",
+            ):
+                return True  # array methods return arrays
+            name = _name_of(n.func)
+            head, _, leaf = name.rpartition(".")
+            if head in ("jnp", "np", "jax.numpy", "numpy", "jax.lax") and (
+                leaf not in _SCALAR_WRAPPERS
+            ):
+                return True
+    return False

@@ -1,0 +1,680 @@
+"""The eight graftlint rules.  Each takes the RepoIndex and yields
+Findings; suppression/baseline handling lives in the runner."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from rplidar_ros2_driver_tpu.tools.graftlint.model import (
+    BOOL,
+    FLOAT,
+    INT,
+    UNKNOWN,
+    ExprTyper,
+    Finding,
+    RepoIndex,
+    _name_of,
+    build_taint,
+    dtype_kind,
+    expr_mentions_tainted,
+    is_array_producing,
+    is_static_name,
+    scalar_annotated,
+)
+
+_NP_HEADS = {"np", "numpy"}
+_ARRAY_HEADS = {"np", "numpy", "jnp", "jax.numpy"}
+_STATE_PARAMS = {"state", "states", "carry", "fstate"}
+
+
+def _head_leaf(call: ast.Call) -> tuple:
+    name = _name_of(call.func)
+    head, _, leaf = name.rpartition(".")
+    return head, leaf
+
+
+def _statics(index: RepoIndex) -> set:
+    return set(index.cfg.static_params)
+
+
+def _reachable_functions(index: RepoIndex):
+    keys = index.reachable_from(index.jit_roots())
+    by_key = index.functions_by_key()
+    return [by_key[k] for k in sorted(keys) if k in by_key]
+
+
+# ---------------------------------------------------------------------------
+# GL001 — host syncs reachable inside jit
+# ---------------------------------------------------------------------------
+
+def rule_gl001(index: RepoIndex):
+    statics = _statics(index)
+    for fn in _reachable_functions(index):
+        mod = fn.module
+        scalars = scalar_annotated(fn.node)
+        traced = {
+            p for p in fn.params
+            if p not in fn.static_names
+            and p not in scalars
+            and not is_static_name(p, statics)
+        }
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            msg = None
+            if isinstance(n.func, ast.Attribute) and n.func.attr in (
+                "item", "block_until_ready"
+            ):
+                msg = (f".{n.func.attr}() in jit-reachable "
+                       f"{fn.qualname} forces a host sync")
+            else:
+                head, leaf = _head_leaf(n)
+                if head in _NP_HEADS and leaf in ("asarray", "array"):
+                    msg = (f"{head}.{leaf}() in jit-reachable {fn.qualname} "
+                           "materializes on the host mid-trace")
+                elif _name_of(n.func) in ("jax.device_get", "device_get"):
+                    msg = (f"jax.device_get in jit-reachable {fn.qualname} "
+                           "forces a device->host transfer")
+                elif (
+                    isinstance(n.func, ast.Name)
+                    and n.func.id in ("int", "float")
+                    and len(n.args) == 1
+                    and isinstance(n.args[0], ast.Name)
+                    and n.args[0].id in traced
+                ):
+                    msg = (f"{n.func.id}({n.args[0].id}) on a traced "
+                           f"argument of {fn.qualname} forces a host sync")
+            if msg and not mod.suppressed("GL001", n.lineno):
+                yield Finding("GL001", mod.relpath, n.lineno, msg)
+
+
+# ---------------------------------------------------------------------------
+# GL002 — Python branching on traced values inside jit
+# ---------------------------------------------------------------------------
+
+def _is_none_check(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def rule_gl002(index: RepoIndex):
+    statics = _statics(index)
+    for fn in _reachable_functions(index):
+        mod = fn.module
+        tainted = build_taint(fn, statics)
+        for inner in ast.walk(fn.node):
+            if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scalars = scalar_annotated(inner)
+                for a in inner.args.posonlyargs + inner.args.args:
+                    if a.arg not in scalars and not is_static_name(
+                        a.arg, statics
+                    ):
+                        tainted.add(a.arg)
+        for n in ast.walk(fn.node):
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            if _is_none_check(n.test):
+                continue  # `x is None` checks pytree STRUCTURE, not values
+            if expr_mentions_tainted(n.test, tainted, statics):
+                if not mod.suppressed("GL002", n.lineno):
+                    kind = "while" if isinstance(n, ast.While) else "if"
+                    yield Finding(
+                        "GL002", mod.relpath, n.lineno,
+                        f"Python `{kind}` on traced value "
+                        f"`{ast.unparse(n.test)}` in {fn.qualname} — use "
+                        "jnp.where/lax.cond (branching forces a trace-time "
+                        "host sync or a concretization error)",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# GL003 — donation hygiene
+# ---------------------------------------------------------------------------
+
+def _stmts_with_lines(fn_node):
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.stmt):
+            yield n
+
+
+def _enclosing_stmt(fn_node, call):
+    best = None
+    for s in _stmts_with_lines(fn_node):
+        if s.lineno <= call.lineno <= (s.end_lineno or s.lineno):
+            if best is None or s.lineno >= best.lineno:
+                if not isinstance(
+                    s, (ast.FunctionDef, ast.For, ast.While, ast.If, ast.With)
+                ):
+                    best = s
+    return best
+
+
+def _loop_ancestors(fn_node, stmt):
+    loops = []
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.For, ast.While)) and (
+            n.lineno <= stmt.lineno <= (n.end_lineno or n.lineno)
+        ):
+            loops.append(n)
+    return loops
+
+
+def rule_gl003(index: RepoIndex):
+    # (b) carry-style jitted ops/ entries must donate their state
+    for rel, mod in sorted(index.modules.items()):
+        if "/ops/" not in f"/{rel}":
+            continue
+        for fn in mod.functions.values():
+            if "." in fn.qualname or not fn.jitted:
+                continue
+            first_line = (
+                fn.node.decorator_list[0].lineno
+                if fn.node.decorator_list else fn.node.lineno
+            )
+            for i, p in enumerate(fn.params):
+                if p in _STATE_PARAMS and i not in fn.donate_idx:
+                    if not mod.suppressed(
+                        "GL003", fn.node.lineno
+                    ) and not mod.suppressed("GL003", first_line):
+                        yield Finding(
+                            "GL003", rel, fn.node.lineno,
+                            f"jitted {fn.qualname} carries `{p}` without "
+                            "donate_argnums — the old state buffers stay "
+                            "live for a full extra step (HBM churn at "
+                            "window x beams scale)",
+                        )
+
+    # (a) a donated argument must never be read after the call
+    for rel, mod in sorted(index.modules.items()):
+        for fn in mod.functions.values():
+            for call in ast.walk(fn.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                tgt = index.resolve_call(mod, call.func)
+                if tgt is None or not tgt.donate_idx:
+                    continue
+                for i in tgt.donate_idx:
+                    if i >= len(call.args):
+                        continue
+                    text = ast.unparse(call.args[i])
+                    stmt = _enclosing_stmt(fn.node, call)
+                    if stmt is None:
+                        continue
+                    rebound = isinstance(stmt, ast.Assign) and any(
+                        text in [ast.unparse(x) for x in ast.walk(t)
+                                 if isinstance(x, (ast.Name, ast.Attribute))]
+                        for t in stmt.targets
+                    )
+                    for f in _donated_reuse(
+                        fn, mod, call, stmt, text, rebound, tgt
+                    ):
+                        yield f
+
+
+def _donated_reuse(fn, mod, call, stmt, text, rebound, tgt):
+    later_load = None
+    if not rebound:
+        # events after the call, in source order: a re-bind (Store)
+        # before the first Load makes the name fresh again.  Same-line
+        # ties order Load first (in `state = g(state)` the read happens
+        # before the write); the sort key must never reach the AST node
+        # itself (nodes don't compare).
+        events = sorted(
+            (
+                (n.lineno, 0 if isinstance(n.ctx, ast.Load) else 1, i, n)
+                for i, n in enumerate(ast.walk(fn.node))
+                if isinstance(n, (ast.Name, ast.Attribute))
+                and isinstance(n.ctx, (ast.Load, ast.Store))
+                and n.lineno > (stmt.end_lineno or stmt.lineno)
+                and ast.unparse(n) == text
+            ),
+            key=lambda t: t[:3],
+        )
+        for _ln, store_rank, _i, n in events:
+            is_load = store_rank == 0
+            if not is_load:
+                break  # rebound before any read
+            later_load = n
+            break
+        if later_load is None:
+            # in a loop, the back edge is the later use: flag when the
+            # donated name is never re-assigned inside the loop body
+            for loop in _loop_ancestors(fn.node, stmt):
+                assigned = any(
+                    isinstance(x, ast.Name)
+                    and isinstance(x.ctx, ast.Store)
+                    and ast.unparse(x) == text
+                    for x in ast.walk(loop)
+                )
+                if not assigned and isinstance(call.args[0], ast.Name):
+                    later_load = call
+                    break
+    if later_load is not None and not mod.suppressed("GL003", call.lineno):
+        yield Finding(
+            "GL003", mod.relpath, later_load.lineno,
+            f"`{text}` is donated to {tgt.qualname} (line {call.lineno}) "
+            "and read again afterwards — donated buffers are deleted at "
+            "dispatch",
+        )
+
+
+# ---------------------------------------------------------------------------
+# GL004 — bit-exact zones: float reductions / unpoliced casts
+# ---------------------------------------------------------------------------
+
+_REDUCTIONS = {
+    "sum", "mean", "dot", "einsum", "matmul", "tensordot", "vdot",
+    "inner", "cumsum", "prod", "cumprod",
+}
+
+
+def rule_gl004(index: RepoIndex):
+    typer = ExprTyper(index.cfg)
+    for rel in index.cfg.zones:
+        mod = index.modules.get(rel)
+        if mod is None:
+            continue
+        module_env = {}
+        for n in mod.tree.body:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and (
+                isinstance(n.targets[0], ast.Name)
+            ):
+                module_env[n.targets[0].id] = typer.etype(n.value, module_env)
+        for fn in mod.functions.values():
+            if "." in fn.qualname and fn.qualname.split(".")[0] in (
+                mod.functions
+            ):
+                continue  # nested defs ride their parent's walk
+            env = ExprTyper(index.cfg, module_env).build_env(fn.node)
+            for n in ast.walk(fn.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                yield from _gl004_reduction(mod, fn, n, typer, env)
+                yield from _gl004_cast(mod, fn, n, typer, env)
+
+
+def _gl004_reduction(mod, fn, n, typer, env):
+    head, leaf = _head_leaf(n)
+    if leaf not in _REDUCTIONS:
+        return
+    is_mod_call = head in _ARRAY_HEADS
+    is_method = (
+        not is_mod_call and isinstance(n.func, ast.Attribute)
+        and leaf in ("sum", "mean", "dot", "cumsum", "prod")
+    )
+    if not (is_mod_call or is_method):
+        return
+    if leaf == "einsum":
+        pet = next(
+            (kw.value for kw in n.keywords
+             if kw.arg == "preferred_element_type"), None,
+        )
+        kind = dtype_kind(pet) if pet is not None else UNKNOWN
+        if kind != FLOAT:
+            kind = max(
+                (typer.etype(a, env) for a in n.args[1:]),
+                key=lambda k: k == FLOAT, default=UNKNOWN,
+            )
+    else:
+        dt = next((kw.value for kw in n.keywords if kw.arg == "dtype"), None)
+        if dt is not None:
+            kind = dtype_kind(dt)
+        elif is_method:
+            kind = typer.etype(n.func.value, env)
+        else:
+            kind = typer.etype(n.args[0], env) if n.args else UNKNOWN
+        if kind == BOOL:
+            kind = INT  # sums of masks accumulate exactly
+    if kind in (FLOAT, UNKNOWN) and not mod.suppressed("GL004", n.lineno):
+        yield Finding(
+            "GL004", mod.relpath, n.lineno,
+            f"float{'' if kind == FLOAT else '-or-unknown'} reduction "
+            f"`{leaf}` in bit-exact zone function {fn.qualname} — "
+            "reduction order differs between XLA and NumPy, so f32 "
+            "accumulation breaks host/device parity",
+        )
+
+
+def _gl004_cast(mod, fn, n, typer, env):
+    src = None
+    kind_to = UNKNOWN
+    if isinstance(n.func, ast.Attribute) and n.func.attr == "astype" and n.args:
+        kind_to = dtype_kind(n.args[0])
+        src = n.func.value
+    else:
+        head, leaf = _head_leaf(n)
+        if head in _ARRAY_HEADS and leaf in ("asarray", "array") and (
+            len(n.args) >= 2
+        ):
+            kind_to = dtype_kind(n.args[1])
+            src = n.args[0]
+    if kind_to != INT or src is None:
+        return
+    if typer.etype(src, env) == FLOAT:
+        if not mod.policed(n.lineno) and not mod.suppressed(
+            "GL004", n.lineno
+        ):
+            yield Finding(
+                "GL004", mod.relpath, n.lineno,
+                f"float→int cast `{ast.unparse(n)[:60]}` in bit-exact "
+                f"zone function {fn.qualname} without a policing marker — "
+                "out-of-range/NaN float→int conversion is implementation-"
+                "defined and NumPy/XLA disagree (mark the clamp with "
+                "`# graftlint: policed — <why the value is in range>`)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# GL005 — weak-type promotion in bit-exact zones
+# ---------------------------------------------------------------------------
+
+_GL005_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+              ast.Pow)
+_FLOAT_WRAPPERS = {"float16", "float32", "float64", "bfloat16"}
+
+
+def rule_gl005(index: RepoIndex):
+    statics = _statics(index)
+    typer = ExprTyper(index.cfg)
+    for rel in index.cfg.zones:
+        mod = index.modules.get(rel)
+        if mod is None:
+            continue
+        for fn in mod.functions.values():
+            if "." in fn.qualname and fn.qualname.split(".")[0] in (
+                mod.functions
+            ):
+                continue
+            tainted = build_taint(fn, statics)
+            env = typer.build_env(fn.node)
+            blessed = _blessed_locals(fn.node)
+            for n in ast.walk(fn.node):
+                if not (
+                    isinstance(n, ast.BinOp)
+                    and isinstance(n.op, _GL005_OPS)
+                ):
+                    continue
+                yield from _gl005_binop(
+                    mod, fn, n, tainted, statics, typer, env, blessed
+                )
+
+
+def _blessed_locals(fn_node) -> set:
+    out = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and (
+            isinstance(n.targets[0], ast.Name)
+            and isinstance(n.value, ast.Call)
+        ):
+            _, leaf = _head_leaf(n.value)
+            if leaf in _FLOAT_WRAPPERS:
+                out.add(n.targets[0].id)
+    return out
+
+
+def _gl005_binop(mod, fn, n, tainted, statics, typer, env, blessed):
+    def arrayish(x):
+        return expr_mentions_tainted(x, tainted, statics) or (
+            is_array_producing(x)
+        )
+
+    sides = [(n.left, n.right), (n.right, n.left)]
+    for scalar, array in sides:
+        if arrayish(scalar) or not arrayish(array):
+            continue
+        if isinstance(scalar, ast.Call):
+            _, leaf = _head_leaf(scalar)
+            if leaf in _FLOAT_WRAPPERS:
+                break  # jnp.float32(c): the blessed typed-scalar idiom
+        if isinstance(scalar, ast.Name) and scalar.id in blessed:
+            break
+        if typer.etype(scalar, env) == FLOAT:
+            if not mod.suppressed("GL005", n.lineno):
+                yield Finding(
+                    "GL005", mod.relpath, n.lineno,
+                    f"bare Python float scalar `{ast.unparse(scalar)[:40]}`"
+                    f" in array binop in bit-exact zone function "
+                    f"{fn.qualname} — wrap in jnp.float32(...) so the "
+                    "operand dtype is explicit, not weak-type promotion",
+                )
+        break
+
+
+# ---------------------------------------------------------------------------
+# GL006 — static_argnames hygiene
+# ---------------------------------------------------------------------------
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+
+def rule_gl006(index: RepoIndex):
+    for rel, mod in sorted(index.modules.items()):
+        # (b) dataclasses used as static args must hash: *Config frozen
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.ClassDef) and n.name.endswith("Config"):
+                deco = _dataclass_decorator(n)
+                first_line = (
+                    n.decorator_list[0].lineno
+                    if n.decorator_list else n.lineno
+                )
+                if deco is not None and not _has_frozen(deco):
+                    if not mod.suppressed(
+                        "GL006", n.lineno
+                    ) and not mod.suppressed("GL006", first_line):
+                        yield Finding(
+                            "GL006", rel, n.lineno,
+                            f"dataclass {n.name} is a static jit config "
+                            "but not frozen=True — unhashable/mutable "
+                            "static args defeat the jit cache",
+                        )
+        # (a) call sites: mutable literals bound to static params
+        for fn in mod.functions.values():
+            for call in ast.walk(fn.node):
+                if not isinstance(call, ast.Call):
+                    continue
+                tgt = index.resolve_call(mod, call.func)
+                if tgt is None or not tgt.static_names:
+                    continue
+                for kw in call.keywords:
+                    if kw.arg in tgt.static_names and _is_mutable(kw.value):
+                        if not mod.suppressed("GL006", call.lineno):
+                            yield Finding(
+                                "GL006", rel, call.lineno,
+                                f"mutable value for static arg "
+                                f"`{kw.arg}` of {tgt.qualname} — static "
+                                "args must be hashable (use a tuple)",
+                            )
+
+
+def _dataclass_decorator(n: ast.ClassDef):
+    for dec in n.decorator_list:
+        name = _name_of(dec if not isinstance(dec, ast.Call) else dec.func)
+        if name in ("dataclasses.dataclass", "dataclass"):
+            return dec
+    return None
+
+
+def _has_frozen(dec) -> bool:
+    return isinstance(dec, ast.Call) and any(
+        kw.arg == "frozen"
+        and isinstance(kw.value, ast.Constant)
+        and kw.value.value is True
+        for kw in dec.keywords
+    )
+
+
+def _is_mutable(node) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    return isinstance(node, ast.Call) and _name_of(node.func) in (
+        "list", "dict", "set"
+    )
+
+
+# ---------------------------------------------------------------------------
+# GL007 — allocations inside hot-loop regions
+# ---------------------------------------------------------------------------
+
+_ALLOC_LEAVES = {
+    "zeros", "ones", "empty", "full", "zeros_like", "ones_like",
+    "empty_like", "full_like", "array",
+}
+
+
+def rule_gl007(index: RepoIndex):
+    for rel in index.cfg.hot_files:
+        mod = index.modules.get(rel)
+        if mod is None:
+            continue
+        for n in ast.walk(mod.tree):
+            if not isinstance(n, ast.Call) or not mod.in_hot_region(n.lineno):
+                continue
+            head, leaf = _head_leaf(n)
+            bad = head in _ARRAY_HEADS and leaf in _ALLOC_LEAVES
+            bad = bad or (head in ("jnp", "jax.numpy") and leaf == "asarray")
+            if bad and not mod.suppressed("GL007", n.lineno):
+                yield Finding(
+                    "GL007", rel, n.lineno,
+                    f"{head}.{leaf}() inside a `# graftlint: hot-loop` "
+                    "region — per-tick allocation churn; use the recycled "
+                    "staging pairs (the fetch is the completion barrier)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# GL008 — structural consistency
+# ---------------------------------------------------------------------------
+
+def rule_gl008(index: RepoIndex):
+    yield from _gl008_precompile(index)
+    yield from _gl008_bench(index)
+    yield from _gl008_params(index)
+
+
+def _gl008_precompile(index: RepoIndex):
+    roots = [
+        f
+        for m in index.modules.values()
+        for f in m.functions.values()
+        if f.qualname.split(".")[-1].startswith("precompile")
+    ]
+    covered = index.reachable_from(roots)
+    exempt = set(index.cfg.precompile_exempt)
+    for rel, mod in sorted(index.modules.items()):
+        if "/ops/" not in f"/{rel}":
+            continue
+        for fn in mod.functions.values():
+            if "." in fn.qualname or not fn.jitted:
+                continue
+            if fn.qualname in exempt:
+                continue
+            if (rel, fn.qualname) not in covered:
+                if not mod.suppressed("GL008", fn.node.lineno):
+                    yield Finding(
+                        "GL008", rel, fn.node.lineno,
+                        f"jitted ops entry {fn.qualname} is not reachable "
+                        "from any precompile() — its first live dispatch "
+                        "stalls the hot loop on an XLA compile (warm it, "
+                        "or exempt it in [tool.graftlint.gl008] with a "
+                        "reason)",
+                    )
+
+
+def _gl008_bench(index: RepoIndex):
+    import os
+
+    bench = os.path.join(index.cfg.root, index.cfg.bench)
+    meta = os.path.join(index.cfg.root, index.cfg.bench_meta_test)
+    if not (os.path.exists(bench) and os.path.exists(meta)):
+        return
+    with open(bench, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    graded: list[int] = []
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "GRADED" for t in n.targets
+        ):
+            if isinstance(n.value, ast.Dict):
+                graded = [
+                    k.value for k in n.value.keys
+                    if isinstance(k, ast.Constant) and isinstance(k.value, int)
+                ]
+    with open(meta, encoding="utf-8") as f:
+        meta_src = f.read()
+    pinned = {int(m) for m in re.findall(r"metric_name\((\d+)\)", meta_src)}
+    for c in graded:
+        if c not in pinned:
+            yield Finding(
+                "GL008", index.cfg.bench_meta_test, 1,
+                f"bench.py --config {c} has no metric_name({c}) pin in "
+                f"{index.cfg.bench_meta_test} — an accidental rename "
+                "would orphan its recorded series",
+            )
+
+
+def _gl008_params(index: RepoIndex):
+    import os
+
+    import yaml
+
+    mod_path = os.path.join(index.cfg.root, index.cfg.params_module)
+    yaml_path = os.path.join(index.cfg.root, index.cfg.params_yaml)
+    if not (os.path.exists(mod_path) and os.path.exists(yaml_path)):
+        return
+    with open(mod_path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    fields: list[str] = []
+    validated: set = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ClassDef) and n.name == "DriverParams":
+            for item in n.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                    item.target, ast.Name
+                ):
+                    fields.append(item.target.id)
+                if isinstance(item, ast.FunctionDef) and (
+                    item.name == "validate"
+                ):
+                    for a in ast.walk(item):
+                        if isinstance(a, ast.Attribute) and isinstance(
+                            a.value, ast.Name
+                        ) and a.value.id == "self":
+                            validated.add(a.attr)
+    with open(yaml_path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f)
+    if isinstance(doc, dict) and len(doc) == 1:
+        (inner,) = doc.values()
+        if isinstance(inner, dict) and "ros__parameters" in inner:
+            doc = inner["ros__parameters"]
+    yaml_keys = set(doc or {})
+    ok_unvalidated = set(index.cfg.unvalidated_params_ok)
+    for name in fields:
+        if name not in yaml_keys:
+            yield Finding(
+                "GL008", index.cfg.params_yaml, 1,
+                f"DriverParams.{name} is missing from "
+                f"{index.cfg.params_yaml} — the param file is the "
+                "deployment source of truth and must carry every field",
+            )
+        if name not in validated and name not in ok_unvalidated:
+            yield Finding(
+                "GL008", index.cfg.params_module, 1,
+                f"DriverParams.{name} is never validated in validate() "
+                "and not declared exempt in [tool.graftlint.gl008] "
+                "unvalidated_params_ok",
+            )
+    for key in sorted(yaml_keys - set(fields)):
+        yield Finding(
+            "GL008", index.cfg.params_yaml, 1,
+            f"param file key `{key}` does not exist on DriverParams — "
+            "from_yaml would reject this file",
+        )
+
+
+ALL_RULES = (
+    rule_gl001, rule_gl002, rule_gl003, rule_gl004, rule_gl005,
+    rule_gl006, rule_gl007, rule_gl008,
+)

@@ -1,0 +1,88 @@
+"""Runner + baseline reconciliation + CLI entry."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from rplidar_ros2_driver_tpu.tools.graftlint.config import (
+    LintConfig,
+    load_baseline,
+    load_config,
+)
+from rplidar_ros2_driver_tpu.tools.graftlint.model import Finding, RepoIndex
+from rplidar_ros2_driver_tpu.tools.graftlint.rules import ALL_RULES
+
+
+def repo_root() -> str:
+    """Default root: the repo this package is installed from (three
+    levels above this file), overridable with --root."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def run_lint(
+    root: str | None = None, cfg: LintConfig | None = None
+) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """Run every rule.  Returns ``(all_findings, new, stale)`` where
+    ``new`` are findings absent from the baseline and ``stale`` are
+    baseline entries that no longer fire (both fail the run — a
+    baseline must describe the tree exactly)."""
+    root = root or repo_root()
+    cfg = cfg or load_config(root)
+    index = RepoIndex(cfg)
+    findings: list[Finding] = []
+    for rule in ALL_RULES:
+        findings.extend(rule(index))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    baseline = load_baseline(root, cfg)
+    base_keys = {(e["rule"], e["path"], e["message"]) for e in baseline}
+    new = [f for f in findings if f.key() not in base_keys]
+    seen = {f.key() for f in findings}
+    stale = [
+        e for e in baseline
+        if (e["rule"], e["path"], e["message"]) not in seen
+    ]
+    return findings, new, stale
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m rplidar_ros2_driver_tpu.tools.graftlint",
+        description="repo-native static analysis: trace-safety, donation, "
+        "bit-exactness and structural invariants (see [tool.graftlint] "
+        "in pyproject.toml)",
+    )
+    p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument("--root", default=None, help="repo root (default: auto)")
+    args = p.parse_args(argv)
+
+    root = args.root or repo_root()
+    findings, new, stale = run_lint(root)
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [vars(f) for f in new],
+            "stale_baseline": stale,
+            "ok": not new and not stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        for e in stale:
+            print(
+                f"stale baseline entry (no longer fires, remove it): "
+                f"{e['rule']} {e['path']}: {e['message']}"
+            )
+        n_base = len(findings) - len(new)
+        print(
+            f"graftlint: {len(findings)} finding(s), {n_base} baselined, "
+            f"{len(new)} new, {len(stale)} stale"
+        )
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

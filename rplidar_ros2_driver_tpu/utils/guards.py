@@ -1,0 +1,106 @@
+"""Runtime sentinels — turn "never happens in steady state" into raises.
+
+The fused engines' performance contract has two structural halves the
+bench decompositions assert per release but nothing enforced per RUN:
+
+  * zero recompiles after warmup — every precompile() exists so the
+    live loop never pays an in-loop XLA compile (~600 ms measured on a
+    CPU rig when a commit-pattern mismatch sneaks in);
+  * zero implicit transfers — the hot loops perform exactly their
+    DECLARED device_put staging and wire fetches; an implicit
+    numpy->jit upload or a stray mid-loop materialization is a silent
+    per-tick link round-trip on a remote-attached device.
+
+These context managers make both enforceable in tests (tier-1 pins all
+four engines — tests/test_guards.py) and cheap to borrow in soak
+tooling.  They are the RUNTIME complement of graftlint's static rules
+(GL001/GL007 catch the patterns the AST can see; these catch whatever
+it can't).
+
+``assert_no_recompile`` listens for the compile-begin log record that
+``jax_log_compiles`` surfaces ("Compiling <name> with global shapes…",
+logged by jax._src.interpreters.pxla at DEBUG when the flag is off) via
+a scoped handler, so no global config flip — and no log spam — leaks
+out of the context.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+# the module that logs XLA compile begins in this jax lineage (0.4.x);
+# kept in one place so a jax upgrade moving the logger is a one-line fix
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch")
+_COMPILE_PREFIXES = ("Compiling ", "Finished XLA compilation")
+
+
+class RecompileError(AssertionError):
+    """An XLA compile started inside an assert_no_recompile scope."""
+
+
+class _CompileRecorder(logging.Handler):
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.compiles: list[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            # "Compiling <name> with global shapes and types [...]"
+            self.compiles.append(msg.split(" with global", 1)[0])
+
+
+@contextlib.contextmanager
+def assert_no_recompile(max_compiles: int = 0, tag: str = ""):
+    """Raise :class:`RecompileError` if more than ``max_compiles`` XLA
+    compilations START inside the context.  Zero-overhead on the hot
+    path itself (a logging handler fires only when jax actually
+    compiles); the recorder is yielded so callers can inspect
+    ``recorder.compiles`` for diagnostics."""
+    rec = _CompileRecorder()
+    loggers = [logging.getLogger(n) for n in _COMPILE_LOGGERS]
+    saved = [lg.level for lg in loggers]
+    for lg in loggers:
+        lg.addHandler(rec)
+        lg.setLevel(logging.DEBUG)
+    try:
+        yield rec
+    finally:
+        for lg, lvl in zip(loggers, saved):
+            lg.removeHandler(rec)
+            lg.setLevel(lvl)
+    if len(rec.compiles) > max_compiles:
+        where = f" in {tag}" if tag else ""
+        raise RecompileError(
+            f"{len(rec.compiles)} XLA compile(s){where} after warmup "
+            f"(allowed {max_compiles}): {', '.join(rec.compiles[:8])} — "
+            "a precompile() is missing a shape/bucket/commit-pattern, or "
+            "a static config changed mid-stream"
+        )
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """``jax_transfer_guard="disallow"`` for the scope: any transfer not
+    explicitly requested (``jax.device_put`` / ``jax.device_get``)
+    raises inside jax — most importantly the implicit host->device copy
+    of a numpy argument reaching a jitted call, the exact per-tick cost
+    class the engines' explicit ``device_put`` staging exists to
+    declare."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield
+
+
+@contextlib.contextmanager
+def steady_state(max_compiles: int = 0, tag: str = ""):
+    """The post-warmup invariant, whole: zero recompiles AND zero
+    implicit transfers.  Wrap the steady-state portion of any engine
+    loop — after precompile()/warmup ticks — and every violation of the
+    dispatch-amortization story becomes a raised error instead of a
+    silent latency regression."""
+    with assert_no_recompile(max_compiles, tag=tag) as rec:
+        with no_implicit_transfers():
+            yield rec

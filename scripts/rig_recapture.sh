@@ -36,6 +36,16 @@ cd "$(dirname "$0")/.."
 out="artifacts/rig_recapture_$(date +%Y%m%d_%H%M).jsonl"
 mkdir -p artifacts
 
+# fail fast on a dirty tree: a rig window burned measuring code that
+# violates the repo invariants (trace-safety, donation, bit-exactness —
+# tools/graftlint) is not publishable evidence.  Cheap (AST-only, no
+# device), so it runs before any link probing.
+if ! JAX_PLATFORMS=cpu python -m rplidar_ros2_driver_tpu.tools.graftlint >> "$out.log" 2>&1; then
+  echo '{"error": "graftlint found unbaselined findings - fix the tree before burning a rig window (see the sidecar log)"}' >> "$out"
+  echo "$out"
+  exit 4
+fi
+
 case "${WAIT_FOR_LINK_S:-0}" in
   *[!0-9]*)
     echo "WAIT_FOR_LINK_S must be a whole number of seconds, got: ${WAIT_FOR_LINK_S}" >&2

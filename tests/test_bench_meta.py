@@ -17,8 +17,11 @@ def test_metric_names_stable():
     assert bench.metric_name(5) == "denseboost64_filter_chain_scans_per_sec"
     assert bench.metric_name(6) == "e2e_decode_chain_scans_per_sec"
     assert bench.metric_name(1) == "a1m8_passthrough_scans_per_sec"
+    assert bench.metric_name(2) == "graded_config2_scans_per_sec"
+    assert bench.metric_name(3) == "graded_config3_scans_per_sec"
     assert bench.metric_name(7) == "fused_replay_scans_per_sec"
     assert bench.metric_name(4) == "graded_config4_scans_per_sec"
+    assert bench.metric_name(9) == "fused_ingest_bytes_to_output_scans_per_sec"
     assert bench.metric_name(8) == "fleet_fused_replay_scans_per_sec"
     assert bench.metric_name(10) == "fleet_fused_ingest_bytes_to_scans_per_sec"
     assert bench.metric_name(11) == "super_tick_drain_scans_per_sec"

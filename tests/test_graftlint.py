@@ -1,0 +1,571 @@
+"""graftlint self-tests: every rule fires on its positive fixture and
+stays quiet on the negative twin, suppressions/markers behave, baseline
+reconciliation is exact — and the REAL repo lints clean (the tier-1
+gate that keeps the invariants enforced, not aspirational)."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from rplidar_ros2_driver_tpu.tools.graftlint import load_config, run_lint
+
+BASE_CONFIG = """
+[tool.graftlint]
+paths = ["pkg"]
+static_params = ["cfg", "config", "self"]
+
+[tool.graftlint.gl004]
+zones = ["pkg/zone.py"]
+int_returning = ["int_fn"]
+int_names = ["counts_i"]
+float_names = ["fx", "meta"]
+bool_names = ["ok"]
+
+[tool.graftlint.gl007]
+files = ["pkg/hot.py"]
+
+[tool.graftlint.gl008]
+bench = "bench.py"
+bench_meta_test = "tests/test_bench_meta.py"
+params_module = "pkg/config.py"
+params_yaml = "param.yaml"
+unvalidated_params_ok = ["name"]
+precompile_exempt = []
+"""
+
+
+def _lint(tmp_path, files: dict, config: str = BASE_CONFIG):
+    (tmp_path / "pyproject.toml").write_text(config)
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    findings, new, stale = run_lint(str(tmp_path))
+    return findings
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# GL001 — host syncs inside jit
+# ---------------------------------------------------------------------------
+
+
+class TestGL001:
+    def test_fires_on_np_asarray_and_item_in_jit(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                y = np.asarray(x)
+                return x + y.item()
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL001"]
+        assert any("np.asarray" in m for m in msgs)
+        assert any(".item()" in m for m in msgs)
+
+    def test_fires_on_float_of_traced_param(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """})
+        assert any(
+            f.rule == "GL001" and "float(x)" in f.message for f in fs
+        )
+
+    def test_quiet_on_host_function_and_scalar_params(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+            import numpy as np
+
+            def host_parse(res):
+                return np.asarray(res)  # not jit-reachable
+
+            @jax.jit
+            def f(x, n: int):
+                return x * int(n)
+        """})
+        assert "GL001" not in _rules(fs)
+
+    def test_suppression_with_reason_works(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                # graftlint: disable=GL001 — fixture-sanctioned host sync
+                return np.asarray(x)
+        """})
+        assert "GL001" not in _rules(fs)
+
+    def test_suppression_without_reason_is_ignored(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                # graftlint: disable=GL001
+                return np.asarray(x)
+        """})
+        assert "GL001" in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL002 — Python branching on traced values
+# ---------------------------------------------------------------------------
+
+
+class TestGL002:
+    def test_fires_on_if_over_traced_comparison(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """})
+        assert "GL002" in _rules(fs)
+
+    def test_quiet_on_static_config_shape_and_none_checks(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+
+            @jax.jit
+            def f(x, ms, cfg):
+                if cfg.enable:
+                    x = x * 2
+                if x.shape[0] > 4:
+                    x = x[:4]
+                if ms is None:
+                    return x
+                return x + ms
+        """})
+        assert "GL002" not in _rules(fs)
+
+    def test_scalar_annotation_is_trusted(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import jax
+
+            @jax.jit
+            def f(x, n: int):
+                while n < 4:
+                    n *= 2
+                return x * n
+        """})
+        assert "GL002" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL003 — donation hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestGL003:
+    def test_fires_on_read_after_donation(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def use(state, x):
+                out = step(state, x)
+                return out + state
+        """})
+        assert any(
+            f.rule == "GL003" and "donated to step" in f.message for f in fs
+        )
+
+    def test_quiet_when_rebound(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def use(state, x):
+                state = step(state, x)
+                state = step(state, x)
+                return state
+        """})
+        assert "GL003" not in _rules(fs)
+
+    def test_same_line_double_load_reports_not_crashes(self, tmp_path):
+        # regression: two Loads of the donated name on ONE line used to
+        # reach the AST nodes in the sort key (nodes don't compare) and
+        # crash the whole run with TypeError
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            def use(state, x):
+                out = step(state, x)
+                return (state, state)
+        """})
+        assert any(
+            f.rule == "GL003" and "donated to step" in f.message for f in fs
+        )
+
+    def test_fires_on_undonated_carry_entry_in_ops(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/m.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("cfg",))
+            def step(state, x, cfg):
+                return state + x
+        """})
+        assert any(
+            f.rule == "GL003" and "without donate_argnums" in f.message
+            for f in fs
+        )
+
+    def test_quiet_when_donated_or_justified(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/m.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(state, x):
+                return state + x
+
+            # graftlint: disable=GL003 — fixture-sanctioned debug API
+            @jax.jit
+            def debug_step(state, x):
+                return state + x
+        """})
+        assert "GL003" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL004 — bit-exact zones
+# ---------------------------------------------------------------------------
+
+
+class TestGL004:
+    def test_fires_on_float_reduction_and_unpoliced_cast(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/zone.py": """
+            import jax.numpy as jnp
+
+            def score(fx):
+                total = jnp.sum(fx)
+                return total.astype(jnp.int32)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL004"]
+        assert any("reduction" in m for m in msgs)
+        assert any("float→int cast" in m for m in msgs)
+
+    def test_quiet_on_int_reduction_and_policed_cast(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/zone.py": """
+            import jax.numpy as jnp
+
+            def score(fx, ok):
+                n = jnp.sum(ok.astype(jnp.int32))
+                v = jnp.sum(int_fn(fx), axis=0)
+                # graftlint: policed — fixture clamps fx upstream
+                q = fx.astype(jnp.int32)
+                return n + v + q
+
+            def int_fn(fx):
+                return (fx > 0).astype(jnp.int32)
+        """})
+        assert "GL004" not in _rules(fs)
+
+    def test_zone_scoping(self, tmp_path):
+        # identical float reduction OUTSIDE the declared zone: quiet
+        fs = _lint(tmp_path, {"pkg/other.py": """
+            import jax.numpy as jnp
+
+            def score(fx):
+                return jnp.sum(fx)
+        """})
+        assert "GL004" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL005 — weak-type promotion in zones
+# ---------------------------------------------------------------------------
+
+
+class TestGL005:
+    def test_fires_on_bare_float_scalar(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/zone.py": """
+            import jax.numpy as jnp
+
+            def scale(fx):
+                return fx * 0.5
+        """})
+        assert "GL005" in _rules(fs)
+
+    def test_quiet_on_wrapped_scalar_and_int_literal(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/zone.py": """
+            import jax.numpy as jnp
+
+            def scale(fx):
+                half = jnp.float32(0.5)
+                return (fx * half + fx * jnp.float32(0.25)) * 2
+        """})
+        assert "GL005" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL006 — static_argnames hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestGL006:
+    def test_fires_on_mutable_static_value_and_unfrozen_config(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import dataclasses
+            import functools
+            import jax
+
+            @dataclasses.dataclass
+            class StepConfig:
+                n: int = 4
+
+            @functools.partial(jax.jit, static_argnames=("modes",))
+            def f(x, modes):
+                return x
+
+            def call(x):
+                return f(x, modes=[1, 2])
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL006"]
+        assert any("StepConfig" in m for m in msgs)
+        assert any("mutable value" in m for m in msgs)
+
+    def test_quiet_on_frozen_config_and_tuple(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import dataclasses
+            import functools
+            import jax
+
+            @dataclasses.dataclass(frozen=True)
+            class StepConfig:
+                n: int = 4
+
+            @functools.partial(jax.jit, static_argnames=("modes",))
+            def f(x, modes):
+                return x
+
+            def call(x):
+                return f(x, modes=(1, 2))
+        """})
+        assert "GL006" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL007 — hot-loop allocations
+# ---------------------------------------------------------------------------
+
+
+class TestGL007:
+    def test_fires_inside_marked_region_only(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/hot.py": """
+            import numpy as np
+
+            # graftlint: hot-loop
+            def dispatch(self, m):
+                buf = np.zeros((m, 4), np.uint8)
+                return buf
+
+            def cold_setup(m):
+                return np.zeros((m, 4), np.uint8)
+        """})
+        gl7 = [f for f in fs if f.rule == "GL007"]
+        assert len(gl7) == 1 and "dispatch" not in gl7[0].message
+
+    def test_def_marker_does_not_absorb_later_pairs_end(self, tmp_path):
+        # regression: a def-scoped marker used to pair with ANY later
+        # end-hot-loop, fusing everything between into one bogus region
+        fs = _lint(tmp_path, {"pkg/hot.py": """
+            import numpy as np
+
+            # graftlint: hot-loop
+            def dispatch(self, m):
+                return m + 1
+
+            def unrelated(m):
+                return np.zeros((m,), np.uint8)  # NOT hot: must stay quiet
+
+            def other(self, m, raw):
+                # graftlint: hot-loop
+                view = np.frombuffer(raw, np.uint8)
+                # graftlint: end-hot-loop
+                return view
+        """})
+        assert "GL007" not in _rules(fs)
+
+    def test_region_markers_and_frombuffer_ok(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/hot.py": """
+            import numpy as np
+
+            def dispatch(self, m, raw):
+                # graftlint: hot-loop
+                view = np.frombuffer(raw, np.uint8)
+                out = view.reshape(m, 4)
+                # graftlint: end-hot-loop
+                scratch = np.zeros((m,), np.uint8)
+                return out, scratch
+        """})
+        assert "GL007" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL008 — structural consistency
+# ---------------------------------------------------------------------------
+
+
+class TestGL008:
+    def test_bench_pin_and_param_drift_fire(self, tmp_path):
+        fs = _lint(tmp_path, {
+            "bench.py": """
+                GRADED = {1: ("chain", 100, {}), 2: ("e2e", 100, {})}
+            """,
+            "tests/test_bench_meta.py": """
+                def test_names():
+                    assert metric_name(1) == "one"
+            """,
+            "pkg/config.py": """
+                import dataclasses
+
+                @dataclasses.dataclass
+                class DriverParams:
+                    name: str = "x"
+                    rate: int = 7
+                    ghost: int = 1
+
+                    def validate(self):
+                        if self.rate < 0:
+                            raise ValueError("rate")
+            """,
+            "param.yaml": """
+                name: x
+                rate: 7
+                stale_key: true
+            """,
+        })
+        msgs = [f.message for f in fs if f.rule == "GL008"]
+        assert any("metric_name(2)" in m for m in msgs)
+        assert any("DriverParams.ghost" in m for m in msgs)  # not in yaml
+        assert any("never validated" in m and "ghost" in m for m in msgs)
+        assert any("stale_key" in m for m in msgs)
+
+    def test_precompile_reachability(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def warmed(state, x):
+                return state + x
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def cold(state, x):
+                return state - x
+
+            def precompile():
+                warmed(0, 1)
+        """})
+        gl8 = [f.message for f in fs if f.rule == "GL008"]
+        assert any("cold" in m for m in gl8)
+        assert not any("warmed" in m for m in gl8)
+
+
+# ---------------------------------------------------------------------------
+# baseline reconciliation
+# ---------------------------------------------------------------------------
+
+
+class TestBaseline:
+    def test_baselined_finding_passes_and_stale_fails(self, tmp_path):
+        files = {"pkg/zone.py": """
+            import jax.numpy as jnp
+
+            def scale(fx):
+                return fx * 0.5
+        """}
+        (tmp_path / "pyproject.toml").write_text(BASE_CONFIG)
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        findings, new, stale = run_lint(str(tmp_path))
+        target = [f for f in findings if f.rule == "GL005"][0]
+        baseline = {
+            "findings": [{
+                "rule": target.rule, "path": target.path,
+                "message": target.message,
+                "justification": "fixture: known weak-type site",
+            }, {
+                "rule": "GL001", "path": "pkg/zone.py",
+                "message": "no longer fires",
+                "justification": "stale entry",
+            }]
+        }
+        (tmp_path / "graftlint.baseline.json").write_text(
+            json.dumps(baseline)
+        )
+        findings, new, stale = run_lint(str(tmp_path))
+        assert not any(f.key() == target.key() for f in new)
+        assert len(stale) == 1 and stale[0]["message"] == "no longer fires"
+
+    def test_baseline_entry_requires_justification(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text(BASE_CONFIG)
+        (tmp_path / "pkg").mkdir()
+        (tmp_path / "pkg" / "m.py").write_text("x = 1\n")
+        (tmp_path / "graftlint.baseline.json").write_text(json.dumps({
+            "findings": [{"rule": "GL001", "path": "a", "message": "b"}]
+        }))
+        with pytest.raises(ValueError, match="justification"):
+            run_lint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the repo itself
+# ---------------------------------------------------------------------------
+
+
+class TestRepoClean:
+    def test_repo_lints_clean_with_all_rules_active(self):
+        """The acceptance gate: the real tree has no unbaselined finding
+        and no stale baseline entry, with every rule loaded."""
+        from rplidar_ros2_driver_tpu.tools.graftlint.rules import ALL_RULES
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
+
+        assert len(ALL_RULES) >= 8
+        findings, new, stale = run_lint(repo_root())
+        assert new == [], [f"{f.path}:{f.line} {f.rule} {f.message}"
+                           for f in new]
+        assert stale == []
+
+    def test_repo_config_declares_zones_and_hot_files(self):
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
+
+        cfg = load_config(repo_root())
+        assert any("ops/ingest.py" in z for z in cfg.zones)
+        assert any("ops/scan_match" in z for z in cfg.zones)
+        assert any("driver/ingest.py" in h for h in cfg.hot_files)

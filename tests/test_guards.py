@@ -1,0 +1,198 @@
+"""Runtime sentinels (utils/guards): zero recompiles and zero implicit
+transfers in STEADY STATE for all four fused engines.
+
+Every engine ships a precompile() and an explicit device_put staging
+path precisely so its live loop never pays an in-loop XLA compile or an
+undeclared transfer.  The bench decompositions assert the dispatch
+counts; these tests pin the other half of the contract at tier-1: after
+warmup, the hot loop runs under ``jax_transfer_guard="disallow"`` with a
+compile listener attached, and ANY violation raises.
+
+Engines covered (the satellite contract):
+  * FusedIngest          — single-stream fused ingest, frame batches
+  * FleetFusedIngest     — per-tick fleet fused ingest
+  * FleetFusedIngest (T) — super-tick backlog drain
+  * FleetMapper          — fused SLAM front-end ticks
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest, FusedIngest
+from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+from rplidar_ros2_driver_tpu.utils import guards
+
+from test_fused_ingest import BEAMS, _params
+from test_fleet_fused_ingest import _mk_ticks
+from test_live_decode import _make_stream
+
+DENSE = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+
+# ---------------------------------------------------------------------------
+# the guard primitives themselves
+# ---------------------------------------------------------------------------
+
+
+class TestGuardPrimitives:
+    def test_detects_fresh_compile(self):
+        fn = jax.jit(lambda x: x * 2 + 1)
+        x = jax.device_put(np.ones((7,), np.float32))
+        with pytest.raises(guards.RecompileError) as e:
+            with guards.assert_no_recompile(tag="unit"):
+                fn(x).block_until_ready()
+        assert "unit" in str(e.value)
+
+    def test_passes_when_warm(self):
+        fn = jax.jit(lambda x: x - 3)
+        x = jax.device_put(np.ones((5,), np.float32))
+        fn(x).block_until_ready()
+        with guards.assert_no_recompile() as rec:
+            fn(x).block_until_ready()
+        assert rec.compiles == []
+
+    def test_max_compiles_budget(self):
+        fn = jax.jit(lambda x: x / 2)
+        x = jax.device_put(np.ones((11,), np.float32))
+        with guards.assert_no_recompile(max_compiles=8):
+            fn(x).block_until_ready()  # within budget: no raise
+
+    def test_blocks_implicit_numpy_jit_transfer(self):
+        fn = jax.jit(lambda x: x + 1)
+        xnp = np.ones((3,), np.float32)
+        fn(xnp)  # warm OUTSIDE the guard
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with guards.no_implicit_transfers():
+                fn(xnp)
+
+    def test_allows_explicit_device_put(self):
+        fn = jax.jit(lambda x: x + 1)
+        xd = jax.device_put(np.ones((3,), np.float32))
+        fn(xd)
+        with guards.no_implicit_transfers():
+            out = fn(jax.device_put(np.ones((3,), np.float32)))
+            assert float(jax.device_get(out)[0]) == 2.0
+
+    def test_steady_state_combines_both(self):
+        fn = jax.jit(lambda x: x * x)
+        xd = jax.device_put(np.ones((9,), np.float32))
+        fn(xd)
+        with guards.steady_state(tag="combo") as rec:
+            fn(xd).block_until_ready()
+        assert rec.compiles == []
+
+
+# ---------------------------------------------------------------------------
+# engine steady states
+# ---------------------------------------------------------------------------
+
+
+def _timed(frames, t0=100.0, dt=0.002):
+    t = t0
+    out = []
+    for f in frames:
+        t += dt
+        out.append((f, t))
+    return out
+
+
+class TestFusedIngestSteadyState:
+    def test_zero_recompiles_zero_implicit_transfers(self):
+        eng = FusedIngest(_params(), beams=BEAMS, buckets=(4,), max_queue=64)
+        eng.precompile(DENSE)
+        frames = _make_stream(
+            DENSE, 96, np.random.default_rng(7),
+            syncs=(0, 17, 34, 51, 68, 85),
+        )
+        items = _timed(frames)
+        # warmup: stream activation + first live dispatches
+        for i in range(0, 32, 4):
+            eng.on_measurement_batch(DENSE, items[i : i + 4])
+        eng.flush()
+        with guards.steady_state(tag="FusedIngest"):
+            for i in range(32, 96, 4):
+                eng.on_measurement_batch(DENSE, items[i : i + 4])
+            outs = eng.flush()
+        # the guard run must have processed real work, not an idle loop
+        assert eng.scans_completed >= 3
+        assert any(outs)
+
+
+class TestFleetFusedIngestSteadyState:
+    def test_zero_recompiles_zero_implicit_transfers(self):
+        s = 2
+        eng = FleetFusedIngest(
+            _params(), s, beams=BEAMS, buckets=(4,), max_revs=6
+        )
+        eng.precompile([DENSE] * s)
+        streams = [
+            (DENSE, _make_stream(DENSE, 64, np.random.default_rng(i),
+                                 syncs=(0, 17, 34, 51)))
+            for i in range(s)
+        ]
+        ticks = _mk_ticks(streams, np.random.default_rng(99), idle_prob=0.0)
+        cut = max(2, len(ticks) // 3)
+        for tick in ticks[:cut]:  # warmup ticks
+            eng.submit(tick)
+        with guards.steady_state(tag="FleetFusedIngest"):
+            total = 0
+            for tick in ticks[cut:]:
+                for o in eng.submit(tick):
+                    total += len(o)
+        assert eng.dispatch_count >= len(ticks)
+        assert total >= 1  # revolutions completed under the guard
+
+
+class TestSuperTickSteadyState:
+    def test_backlog_drain_zero_recompiles_zero_transfers(self):
+        s, T = 2, 4
+        eng = FleetFusedIngest(
+            _params(), s, beams=BEAMS, buckets=(4,), max_revs=6,
+            super_tick_max=T,
+        )
+        eng.precompile([DENSE] * s)
+        streams = [
+            (DENSE, _make_stream(DENSE, 96, np.random.default_rng(10 + i),
+                                 syncs=(0, 17, 34, 51, 68, 85)))
+            for i in range(s)
+        ]
+        ticks = _mk_ticks(streams, np.random.default_rng(5), idle_prob=0.0)
+        cut = max(T, len(ticks) // 2)
+        eng.submit_backlog(ticks[:cut])  # warmup drain
+        before = eng.super_dispatches
+        with guards.steady_state(tag="super-tick drain"):
+            outs = eng.submit_backlog(ticks[cut:])
+        assert eng.super_dispatches > before  # the drain used the T-program
+        assert sum(len(o) for o in outs) >= 1
+
+
+class TestFleetMapperSteadyState:
+    def test_zero_recompiles_zero_implicit_transfers(self):
+        p = _params(
+            map_enable=True, map_backend="fused", map_grid=64,
+            map_cell_m=0.1,
+        )
+        b = 64
+        m = FleetMapper(p, 2, beams=b)
+        m.precompile()
+        rng = np.random.default_rng(3)
+
+        def tick_args(seed):
+            r = np.random.default_rng(seed)
+            pts = r.uniform(-2.0, 2.0, (2, b, 2)).astype(np.float32)
+            masks = np.ones((2, b), bool)
+            live = np.ones((2,), np.int32)
+            return pts, masks, live
+
+        m.submit_points(*tick_args(0))  # warm the live path
+        with guards.steady_state(tag="FleetMapper"):
+            for k in range(1, 4):
+                est = m.submit_points(*tick_args(k))
+        assert m.dispatch_count == 4
+        assert all(e is not None for e in est)
+        del rng
