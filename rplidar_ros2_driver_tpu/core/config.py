@@ -174,6 +174,26 @@ class DriverParams:
     map_log_odds_hit: float = 0.9     # increment per endpoint hit
     map_log_odds_miss: float = -0.4   # decrement per free-space pass
     map_log_odds_clamp: float = 8.0   # saturation bound (±)
+    # -- fleet fault tolerance (driver/health.py + parallel/service.py) --
+    # attach the per-stream health FSM supervisor to the fleet byte-tick
+    # seams (ShardedFilterService.submit_bytes*): HEALTHY -> SUSPECT ->
+    # QUARANTINED -> RECOVERING per stream, driven by corrupt-frame
+    # ratio and tick-starvation age.  Quarantined streams are masked
+    # onto the existing idle padding lanes (same compiled program, zero
+    # recompiles — graftlint/guards enforced), their filter+map state
+    # checkpointed at quarantine and restored at rejoin.  Off by
+    # default: single-node deployments already have the scan-loop FSM.
+    health_enable: bool = False
+    health_window_ticks: int = 8      # sliding observation window (ticks)
+    health_corrupt_ratio: float = 0.5  # malformed/total over window -> bad
+    health_starvation_ticks: int = 16  # ticks w/o a revolution -> bad
+    health_suspect_ticks: int = 4     # consecutive bad ticks -> quarantine
+    health_probation_ticks: int = 4   # consecutive clean ticks -> healthy
+    # capped exponential backoff on quarantine release / reconnect
+    # probing: min(base * 2**attempt, max) * (1 + jitter * u)
+    health_backoff_base_s: float = 0.5
+    health_backoff_max_s: float = 30.0
+    health_backoff_jitter: float = 0.1
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -239,6 +259,25 @@ class DriverParams:
             )
         if self.collect_timeout_s is not None and self.collect_timeout_s < 0:
             raise ValueError("collect_timeout_s must be >= 0 (or None)")
+        if self.health_window_ticks < 1:
+            raise ValueError("health_window_ticks must be >= 1")
+        if not (0.0 < self.health_corrupt_ratio <= 1.0):
+            raise ValueError("health_corrupt_ratio must be within (0, 1]")
+        if self.health_starvation_ticks < 1:
+            raise ValueError("health_starvation_ticks must be >= 1")
+        if self.health_suspect_ticks < 1:
+            raise ValueError("health_suspect_ticks must be >= 1")
+        if self.health_probation_ticks < 1:
+            raise ValueError("health_probation_ticks must be >= 1")
+        if self.health_backoff_base_s <= 0:
+            raise ValueError("health_backoff_base_s must be positive")
+        if self.health_backoff_max_s < self.health_backoff_base_s:
+            raise ValueError(
+                "health_backoff_max_s must be >= health_backoff_base_s "
+                "(the cap bounds the exponential, it cannot undercut it)"
+            )
+        if not (0.0 <= self.health_backoff_jitter <= 1.0):
+            raise ValueError("health_backoff_jitter must be within [0, 1]")
         if self.ingest_backend not in ("auto", "host", "fused"):
             raise ValueError(
                 "ingest_backend must be 'auto', 'host' or 'fused'"

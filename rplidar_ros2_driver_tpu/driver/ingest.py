@@ -28,6 +28,7 @@ What the fused backend does NOT do:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -47,6 +48,13 @@ log = logging.getLogger("rplidar_tpu.ingest")
 # buckets here: every extra bucket is one more compile of the big fused
 # program).  The engine caps runs at 64 (protocol/engine.py).
 _FUSED_BUCKETS = (4, 64)
+
+# schema version of the PER-STREAM fleet snapshot (snapshot_stream /
+# restore_stream) — the quarantine/rejoin checkpoint and the unit of
+# cross-host stream migration.  Bump on layout changes; restore rejects
+# a mismatched version instead of misreading it (the PR 4 mapper-
+# checkpoint discipline).
+INGEST_STREAM_SNAPSHOT_VERSION = 1
 
 
 class FusedIngest:
@@ -531,6 +539,14 @@ class FleetFusedIngest:
         self.scans_completed = 0
         self.revs_dropped = 0
         self.wires_dropped = 0
+        # per-stream cumulative counters — the latent health signals
+        # surfaced (driver/health.py consumers read deltas): frames
+        # offered, revolutions completed, revolution syncs observed,
+        # and max_revs overflow drops, per lane
+        self.stream_frames = [0] * streams
+        self.stream_scans = [0] * streams
+        self.stream_syncs = [0] * streams
+        self.stream_revs_dropped = [0] * streams
 
     # -- placement ---------------------------------------------------------
 
@@ -684,6 +700,7 @@ class FleetFusedIngest:
                 self._reset_next[i] = True
             runs[i] = (int(ans), frames)
             self.frames_decoded += len(frames)
+            self.stream_frames[i] += len(frames)
         return runs
 
     def _tick_slices(self, items) -> list:
@@ -910,6 +927,9 @@ class FleetFusedIngest:
                 self.nodes_decoded += res.nodes_appended
                 self.scans_completed += res.n_completed
                 self.revs_dropped += res.revs_dropped
+                self.stream_scans[i] += res.n_completed
+                self.stream_syncs[i] += res.syncs
+                self.stream_revs_dropped[i] += res.revs_dropped
                 base = bases[i]
                 for k in range(res.n_completed):
                     ts0 = (base or 0.0) + float(res.ts0[k])
@@ -1103,4 +1123,187 @@ class FleetFusedIngest:
             ]
             self._reset_next = [False] * self.streams
             self._pending.clear()
+        return True
+
+    # -- per-stream checkpoint surface (quarantine/rejoin + migration) ----
+
+    def _row_ops(self) -> tuple:
+        """The shared dynamic-index row gather/scatter
+        (utils/rowops.make_row_ops) with this engine's derived-state
+        fixup: the restored window row invalidates its sorted median
+        view, so the scatter re-sorts ONLY that row — a whole-fleet
+        recompute here measurably dented healthy-lane throughput at
+        full geometry (bench --config 13)."""
+        ops = getattr(self, "_row_ops_cache", None)
+        if ops is not None:
+            return ops
+        from jax import lax
+
+        from rplidar_ros2_driver_tpu.ops.filters import (
+            recompute_median_sorted,
+        )
+        from rplidar_ros2_driver_tpu.utils.rowops import make_row_ops
+
+        def fixup(new, row, idx):
+            if new.filter.median_sorted is None:
+                return new
+            return dataclasses.replace(
+                new,
+                filter=dataclasses.replace(
+                    new.filter,
+                    median_sorted=lax.dynamic_update_index_in_dim(
+                        new.filter.median_sorted,
+                        recompute_median_sorted(row.filter.range_window),
+                        idx, 0,
+                    ),
+                ),
+            )
+
+        ops = make_row_ops(self._jax, fixup=fixup)
+        self._row_ops_cache = ops
+        return ops
+
+    def _put_row_index(self, i: int):
+        """The dynamic stream index as an explicitly placed device
+        scalar — committed to the engine's device (or replicated on its
+        mesh): an implicit numpy->jit or device->device relayout would
+        trip the runtime transfer sentinel."""
+        arr = np.asarray(i, np.int32)
+        if self.mesh is None:
+            return self._jax.device_put(arr, self.device)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return self._jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def snapshot_stream(self, i: int) -> dict:
+        """One stream's rows of the fleet state, schema-versioned — the
+        quarantine checkpoint (parallel/service.py snapshots a stream
+        here the moment its health FSM quarantines it) and the unit of
+        cross-host stream migration (ROADMAP item 1).
+
+        Device traffic is one row gather (a single compiled program,
+        dynamic stream index) plus one explicit ``jax.device_get`` of
+        that ROW — O(1/streams) of the fleet state, so a quarantine
+        event inside a guarded steady-state loop costs zero recompiles,
+        declared transfers only, and no whole-fleet fetch."""
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        gather, _ = self._row_ops()
+        with self._lock:
+            row = self._jax.device_get(
+                gather(self._state, self._put_row_index(i))
+            )
+            fmt = self._stream_fmt[i]
+            base = self._bases[i]
+        snap = {
+            f"ingest.{k}": np.array(v)
+            for k, v in vars(row).items()
+            if k != "filter"
+        }
+        snap.update({
+            f"filter.{k}": np.array(v)
+            for k, v in vars(row.filter).items()
+            if v is not None and k != "median_sorted"
+        })
+        snap["format"] = np.asarray(-1 if fmt is None else int(fmt), np.int32)
+        snap["base"] = np.asarray(
+            np.nan if base is None else float(base), np.float64
+        )
+        snap["version"] = np.asarray(INGEST_STREAM_SNAPSHOT_VERSION, np.int32)
+        return snap
+
+    def restore_stream(
+        self, i: int, snap: dict, *, restore_decode: bool = False
+    ) -> bool:
+        """Install a :meth:`snapshot_stream` into lane ``i`` with every
+        OTHER stream's state — and the pending pipelined wires —
+        untouched (a rejoining stream must not cost its healthy
+        neighbors an in-flight revolution, unlike the whole-fleet
+        :meth:`restore`).
+
+        By default the rolling filter window is restored and the decode
+        /assembly carries are RESET (``_reset_next``), because a rejoin
+        after quarantine re-enters the byte stream at an arbitrary
+        capsule boundary — exactly the host path's decoder+assembler
+        reset with the chain carried through.  ``restore_decode=True``
+        additionally restores the decode rows (same-stream resume, e.g.
+        migration of a live stream between hosts).
+
+        Version or geometry mismatch is rejected with the state
+        untouched.  Device traffic is row-sized and fully declared: one
+        row gather, explicit puts of the snapshot rows, one row scatter
+        (dynamic-index programs shared across streams and warmed by
+        ``attach_health``-style callers before steady state)."""
+        from rplidar_ros2_driver_tpu.ops.filters import FilterState
+
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        ver = int(np.asarray(snap.get("version", -1)))
+        if ver != INGEST_STREAM_SNAPSHOT_VERSION:
+            log.warning(
+                "rejecting stream snapshot with schema version %s (want %d)",
+                snap.get("version"), INGEST_STREAM_SNAPSHOT_VERSION,
+            )
+            return False
+        expected_filter = FilterState.shapes(
+            self.cfg.window, self.cfg.beams, self.cfg.grid
+        )
+        got_filter = {
+            k[len("filter."):]: tuple(np.asarray(v).shape)
+            for k, v in snap.items() if k.startswith("filter.")
+        }
+        if expected_filter != got_filter:
+            log.warning(
+                "rejecting incompatible stream snapshot "
+                "(filter geometry %s != %s)", got_filter, expected_filter,
+            )
+            return False
+        gather, scatter = self._row_ops()
+        with self._lock:
+            idx = self._put_row_index(i)
+            cur = gather(self._state, idx)  # current row: the template
+            filt_rows = {}
+            for k, v in vars(cur.filter).items():
+                if v is None or k == "median_sorted":
+                    continue
+                row = np.asarray(snap[f"filter.{k}"])
+                # the template leaf's own sharding: an unplaced put
+                # would force a device->device relayout inside the
+                # scatter jit, which the transfer sentinel forbids
+                filt_rows[k] = self._jax.device_put(
+                    row.astype(v.dtype, copy=False), v.sharding
+                )
+            new_row = dataclasses.replace(
+                cur, filter=dataclasses.replace(cur.filter, **filt_rows)
+            )
+            if restore_decode:
+                ing_rows = {}
+                for k, v in vars(cur).items():
+                    if k == "filter":
+                        continue
+                    key = f"ingest.{k}"
+                    if key not in snap:
+                        continue
+                    row = np.asarray(snap[key])
+                    if row.shape != tuple(v.shape):
+                        log.warning(
+                            "rejecting incompatible stream snapshot "
+                            "(ingest %s row %s != %s)",
+                            k, row.shape, tuple(v.shape),
+                        )
+                        return False
+                    ing_rows[k] = self._jax.device_put(
+                        row.astype(v.dtype, copy=False), v.sharding
+                    )
+                new_row = dataclasses.replace(new_row, **ing_rows)
+            self._state = scatter(self._state, new_row, idx)
+            fmt = int(np.asarray(snap.get("format", -1)))
+            self._stream_fmt[i] = None if fmt < 0 else fmt
+            if restore_decode:
+                base = float(np.asarray(snap.get("base", np.nan)))
+                self._bases[i] = None if np.isnan(base) else base
+                self._reset_next[i] = False
+            else:
+                self._bases[i] = None
+                self._reset_next[i] = True
         return True

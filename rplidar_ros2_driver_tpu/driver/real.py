@@ -153,6 +153,14 @@ class RealLidarDriver(LidarDriverInterface):
         # every GET/SET_LIDAR_CONF path checks it so a pre-conf device is
         # never sent a query it would silently time out on
         self.conf_supported = False
+        # connect/reconnect observability (/diagnostics): how many
+        # connect calls this driver object has made and how many failed.
+        # The retry PACING lives in the scan-loop FSM's capped-backoff
+        # policy (node/fsm.py); these counters survive only as long as
+        # the driver object, so the FSM's cumulative count is the
+        # session-level truth
+        self.connect_attempts = 0
+        self.connect_failures = 0
 
     # ------------------------------------------------------------------
     # connection
@@ -162,43 +170,52 @@ class RealLidarDriver(LidarDriverInterface):
         with self._lock:
             if self._connected:
                 return True
-            self._angle_compensate = use_geometric_compensation
-            self._baudrate = baudrate
-            try:
-                tx = self._tx_factory(
-                    self._channel_type, port, baudrate, *self._net_target()
-                )
-            except Exception as e:
-                log.error("channel creation failed: %s", e)
-                return False
-            engine = CommandEngine(
-                tx, on_measurement_batch=self._scan_decoder.on_measurement_batch
+            self.connect_attempts += 1
+            ok = self._connect_locked(port, baudrate, use_geometric_compensation)
+            if not ok:
+                self.connect_failures += 1
+            return ok
+
+    def _connect_locked(
+        self, port: str, baudrate: int, use_geometric_compensation: bool
+    ) -> bool:
+        self._angle_compensate = use_geometric_compensation
+        self._baudrate = baudrate
+        try:
+            tx = self._tx_factory(
+                self._channel_type, port, baudrate, *self._net_target()
             )
-            if not engine.start():
-                log.warning("could not open %s channel on %s", self._channel_type, port)
-                return False
-            # quiesce any previous streaming, then identify the device
-            engine.send_only(Cmd.STOP)
-            time.sleep(0.01)
-            engine.reset_decoder()
-            info_payload = engine.request(
-                Cmd.GET_DEVICE_INFO, Ans.DEVINFO, timeout_s=1.0
-            )
-            if info_payload is None or len(info_payload) < 20:
-                log.warning("device did not answer GET_DEVICE_INFO")
-                engine.stop()
-                return False
-            self.device_info = DeviceInfo.from_payload(info_payload)
-            self.conf_supported = supports_conf_commands(self.device_info)
-            self._engine = engine
-            self._connected = True
-            self.motor_ctrl = self._check_motor_ctrl_support()
-            log.info(
-                "connected: %s (motor ctrl: %s)",
-                self.device_info.summary(),
-                self.motor_ctrl.value,
-            )
-            return True
+        except Exception as e:
+            log.error("channel creation failed: %s", e)
+            return False
+        engine = CommandEngine(
+            tx, on_measurement_batch=self._scan_decoder.on_measurement_batch
+        )
+        if not engine.start():
+            log.warning("could not open %s channel on %s", self._channel_type, port)
+            return False
+        # quiesce any previous streaming, then identify the device
+        engine.send_only(Cmd.STOP)
+        time.sleep(0.01)
+        engine.reset_decoder()
+        info_payload = engine.request(
+            Cmd.GET_DEVICE_INFO, Ans.DEVINFO, timeout_s=1.0
+        )
+        if info_payload is None or len(info_payload) < 20:
+            log.warning("device did not answer GET_DEVICE_INFO")
+            engine.stop()
+            return False
+        self.device_info = DeviceInfo.from_payload(info_payload)
+        self.conf_supported = supports_conf_commands(self.device_info)
+        self._engine = engine
+        self._connected = True
+        self.motor_ctrl = self._check_motor_ctrl_support()
+        log.info(
+            "connected: %s (motor ctrl: %s)",
+            self.device_info.summary(),
+            self.motor_ctrl.value,
+        )
+        return True
 
     def _net_target(self) -> tuple[str, int]:
         return self._tcp if self._channel_type == "tcp" else self._udp

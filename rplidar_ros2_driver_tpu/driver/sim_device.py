@@ -101,6 +101,14 @@ class SimConfig:
     # network identity (MAC / static-IP conf keys)
     mac: bytes = b"\xaa\xbb\xcc\xdd\xee\xff"
     ip_conf: bytes = bytes([192, 168, 11, 2, 255, 255, 255, 0, 192, 168, 11, 1])
+    # deterministic fault program (driver/chaos.ChaosConfig): the
+    # emulated firmware mutates its OWN outgoing wire frames — corrupt
+    # bytes, truncated/garbage-prefixed frames, stall windows, and
+    # mid-capsule severs (half a frame, then unplug) — so the full
+    # transport->decoder->assembler->FSM stack chews the damage.  A new
+    # scan start restarts the program at frame 0, so small
+    # disconnect_frames indices model reconnect storms.  None = clean.
+    chaos: object = None
 
 
 class SimulatedDevice:
@@ -113,6 +121,13 @@ class SimulatedDevice:
         self._srv: Optional[socket.socket] = None
         self._conn: Optional[socket.socket] = None
         self._conn_lock = threading.Lock()
+        # one frame on the wire at a time: the stream thread and the
+        # request-answer path (rx thread) share the transport, and real
+        # firmware serializes its UART writes — without this, a
+        # GET_DEVICE_HEALTH answer issued mid-stream tears into a
+        # measurement frame and the host decoder resyncs past it (the
+        # health FSM's quarantine-release probe polls exactly there)
+        self._tx_lock = threading.Lock()
         self._accept_thread: Optional[threading.Thread] = None
         self._stream_thread: Optional[threading.Thread] = None
         self._streaming = threading.Event()
@@ -140,6 +155,9 @@ class SimulatedDevice:
         # can't keep up" apart from "CI host is slow"
         self.stream_t0 = 0.0
         self.stream_send_stalls = 0
+        # the live ChaosStream of the current scan session (cfg.chaos
+        # set): fault tallies for tests; None on a clean stream
+        self.chaos_stream = None
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -296,17 +314,18 @@ class SimulatedDevice:
             return False
         view = memoryview(data)
         deadline = time.monotonic() + 0.5
-        while len(view):
-            try:
-                n = conn.send(view)
-            except socket.timeout:
-                n = 0
-            except OSError:
-                return False
-            if n:
-                view = view[n:]
-            elif time.monotonic() > deadline:
-                return False  # reader is gone; stream is torn either way
+        with self._tx_lock:  # whole-frame atomicity across threads
+            while len(view):
+                try:
+                    n = conn.send(view)
+                except socket.timeout:
+                    n = 0
+                except OSError:
+                    return False
+                if n:
+                    view = view[n:]
+                elif time.monotonic() > deadline:
+                    return False  # reader is gone; stream is torn anyway
         return True
 
     def tx_backlog_bytes(self) -> int:
@@ -515,6 +534,12 @@ class SimulatedDevice:
             self._streaming.clear()
             return
         frame_bytes, pts_per_frame = self.STREAMABLE[mode.ans_type]
+        chaos = None
+        if self.cfg.chaos is not None:
+            from rplidar_ros2_driver_tpu.driver.chaos import ChaosStream
+
+            chaos = ChaosStream(self.cfg.chaos)
+            self.chaos_stream = chaos  # test observability (fault tallies)
         self._send(
             AnsHeader(ans_type=mode.ans_type, payload_len=frame_bytes, is_loop=True).encode()
         )
@@ -618,10 +643,27 @@ class SimulatedDevice:
                     flags,
                     timestamp=idx,
                 )
-            t_send = time.monotonic()
-            sent = self._send(frame)
-            if time.monotonic() - t_send > 0.1:
-                self.stream_send_stalls += 1
+            if chaos is not None:
+                from rplidar_ros2_driver_tpu.driver.chaos import (
+                    FAULT_DISCONNECT,
+                )
+
+                kind, mutated = chaos.apply_frame(frame)
+                if kind == FAULT_DISCONNECT:
+                    # mid-capsule sever: half a frame on the wire, then
+                    # the cable is yanked — the consumer's decoder is
+                    # left holding a torn capsule, exactly the hot-
+                    # unplug shape the reference protocol survives
+                    self._send(bytes(frame[: len(frame) // 2]))
+                    self.unplug()
+                    return
+                frame = mutated  # None = swallowed (stall/drop)
+            sent = False
+            if frame is not None:
+                t_send = time.monotonic()
+                sent = self._send(frame)
+                if time.monotonic() - t_send > 0.1:
+                    self.stream_send_stalls += 1
             idx += pts_per_frame
             if sent:
                 self.points_emitted += pts_per_frame
@@ -722,26 +764,27 @@ class SerialSimulatedDevice(SimulatedDevice):
         retried with a writability wait until a deadline."""
         view = memoryview(data)
         deadline = time.monotonic() + 0.5
-        while len(view):
-            with self._conn_lock:
-                fd = self._master
-                if fd is None:
-                    return False
+        with self._tx_lock:  # whole-frame atomicity across threads
+            while len(view):
+                with self._conn_lock:
+                    fd = self._master
+                    if fd is None:
+                        return False
+                    try:
+                        n = os.write(fd, view)
+                    except BlockingIOError:
+                        n = 0
+                    except OSError:
+                        return False
+                if n:
+                    view = view[n:]
+                    continue
+                if time.monotonic() > deadline:
+                    return False  # reader is gone; stream is torn anyway
                 try:
-                    n = os.write(fd, view)
-                except BlockingIOError:
-                    n = 0
+                    select.select([], [fd], [], 0.05)
                 except OSError:
                     return False
-            if n:
-                view = view[n:]
-                continue
-            if time.monotonic() > deadline:
-                return False  # reader is gone; stream is torn either way
-            try:
-                select.select([], [fd], [], 0.05)
-            except OSError:
-                return False
         return True
 
     def tx_backlog_bytes(self) -> int:

@@ -401,6 +401,97 @@ class FleetMapper:
                 }
         return True
 
+    # -- per-stream checkpoint surface (quarantine/rejoin + migration) ------
+
+    _STREAM_KEYS = ("log_odds", "pose", "origin_xy", "revision")
+
+    def _row_ops(self) -> tuple:
+        """The shared dynamic-index row gather/scatter
+        (utils/rowops.make_row_ops) — MapState has no derived leaves,
+        so no fixup."""
+        ops = getattr(self, "_row_ops_cache", None)
+        if ops is None:
+            from rplidar_ros2_driver_tpu.utils.rowops import make_row_ops
+
+            ops = self._row_ops_cache = make_row_ops(self._jax)
+        return ops
+
+    def snapshot_stream(self, i: int) -> dict:
+        """One stream's MapState row, schema-versioned like the full
+        snapshot — the quarantine checkpoint (a stream that drops for
+        30 s rejoins with its map intact) and the migration unit.  On
+        the fused backend the traffic is one row gather + one explicit
+        ``jax.device_get`` of that ROW (guard-safe inside a
+        steady-state loop, O(1/streams) of the fleet state); host
+        backend is a numpy row copy."""
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        with self._lock:
+            if self.backend == "fused":
+                gather, _ = self._row_ops()
+                idx = self._jax.device_put(
+                    np.asarray(i, np.int32), self.device
+                )
+                row = self._jax.device_get(gather(self._states, idx))
+                snap = {
+                    k: np.array(getattr(row, k))
+                    for k in self._STREAM_KEYS
+                }
+            else:
+                snap = {
+                    k: self._states_np[k][i].copy()
+                    for k in self._STREAM_KEYS
+                }
+        snap["version"] = np.asarray(MAP_STATE_VERSION, np.int32)
+        return snap
+
+    def restore_stream(self, i: int, snap: dict) -> bool:
+        """Install a :meth:`snapshot_stream` into stream ``i`` with
+        every other stream's map untouched.  Version/geometry mismatch
+        is rejected with the live state untouched (the chain's
+        reject-don't-crash contract).  Fused-backend traffic is
+        row-sized: explicit puts of the snapshot row + one dynamic-
+        index scatter (state donated)."""
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        if int(np.asarray(snap.get("version", -1))) != MAP_STATE_VERSION:
+            log.warning(
+                "rejecting stream map snapshot with schema version %s "
+                "(want %d)", snap.get("version"), MAP_STATE_VERSION,
+            )
+            return False
+        expected = MapState.shapes(self.cfg.grid)
+        got = {
+            k: tuple(np.asarray(v).shape)
+            for k, v in snap.items() if k != "version"
+        }
+        if expected != got:
+            log.warning(
+                "rejecting incompatible stream map snapshot (%s != %s)",
+                got, expected,
+            )
+            return False
+        with self._lock:
+            if self.backend == "fused":
+                gather, scatter = self._row_ops()
+                idx = self._jax.device_put(
+                    np.asarray(i, np.int32), self.device
+                )
+                cur = gather(self._states, idx)  # dtype/shape template
+                row = MapState(**{
+                    k: self._jax.device_put(
+                        np.asarray(snap[k], getattr(cur, k).dtype),
+                        self.device,
+                    )
+                    for k in self._STREAM_KEYS
+                })
+                self._states = scatter(self._states, row, idx)
+            else:
+                for k in self._STREAM_KEYS:
+                    st = self._states_np[k]
+                    st[i] = np.asarray(snap[k], st.dtype)
+        return True
+
     # -- sharded (Orbax) checkpointing --------------------------------------
 
     def save_sharded(self, path: str) -> None:

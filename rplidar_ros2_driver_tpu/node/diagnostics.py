@@ -60,6 +60,8 @@ class DiagnosticsUpdater:
         latency_p99_ms: Optional[dict[str, float]] = None,
         rx_scheduling: Optional[int] = None,
         map_status: Optional[dict] = None,
+        reconnect: Optional[dict] = None,
+        stream_health: Optional[list] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -88,6 +90,29 @@ class DiagnosticsUpdater:
                 values["Map Pose"] = f"{x:+.3f} {y:+.3f} {th:+.4f}"
                 values["Map Match Score"] = str(map_status.get("score", 0))
                 values["Map Revision"] = str(map_status.get("revision", 0))
+        # reconnect observability (scan-loop FSM capped backoff +
+        # driver-level connect counters): how hard the node is having to
+        # fight for its link, and how long until the next attempt
+        if reconnect:
+            values["Connect Attempts"] = str(reconnect.get("attempts", 0))
+            backoff = reconnect.get("backoff_s")
+            if backoff:
+                values["Reconnect Backoff (s)"] = f"{backoff:.2f}"
+            drv_fail = reconnect.get("driver_failures")
+            if drv_fail is not None:
+                values["Driver Connect Failures"] = str(drv_fail)
+        # per-stream health FSM states: FLEET deployments (which own a
+        # ShardedFilterService rather than the single-stream node) feed
+        # ``service.health_status()`` through this parameter — one
+        # compact "state (reason)" value per stream
+        # (tests/test_chaos.py pins the rendering)
+        if stream_health:
+            for i, st in enumerate(stream_health):
+                state = st.get("state", "?")
+                reason = st.get("reason") or ""
+                values[f"Stream {i} Health"] = (
+                    f"{state} ({reason})" if reason else state
+                )
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

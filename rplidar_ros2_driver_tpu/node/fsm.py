@@ -51,11 +51,17 @@ class FsmTimings:
     grab_retry_s: float = 0.001
     grab_timeout_s: float = 2.0
     warmup_motor_s: float = 0.0  # motor warm-up handled inside drivers
+    # ceiling of the CONNECTING retry backoff: the flat 1 s retry is the
+    # FIRST delay (connect_retry_s = the base), then capped exponential
+    # growth via driver/health.BackoffPolicy — a dead port costs
+    # seconds-apart probes, not a tight 1 Hz reconnect storm forever
+    connect_backoff_max_s: float = 10.0
 
     @classmethod
     def fast(cls) -> "FsmTimings":
         """Millisecond-scale variant for tests."""
-        return cls(0.01, 0.01, 0.02, 0.001, 0.0005, 0.25)
+        return cls(0.01, 0.01, 0.02, 0.001, 0.0005, 0.25,
+                   connect_backoff_max_s=0.08)
 
 
 class ScanLoopFsm:
@@ -102,6 +108,20 @@ class ScanLoopFsm:
         self.cached_max_range = 0.0
         self.error_count = 0
         self.reset_count = 0
+        # CONNECTING retry discipline: capped exponential backoff
+        # (driver/health.BackoffPolicy) instead of the reference's flat
+        # 1 s loop, with the attempt count surfaced in /diagnostics
+        from rplidar_ros2_driver_tpu.driver.health import BackoffPolicy
+
+        self._connect_backoff = BackoffPolicy(
+            self._t.connect_retry_s,
+            max(self._t.connect_backoff_max_s, self._t.connect_retry_s),
+            jitter=0.1,
+        )
+        # cumulative connect attempts this session (successes included —
+        # the driver-level connect_failures counter carries the failures,
+        # so the two diagnostics values read consistently)
+        self.connect_attempts = 0
 
     # -- state accessors ----------------------------------------------------
 
@@ -122,6 +142,12 @@ class ScanLoopFsm:
     @property
     def is_scanning(self) -> bool:
         return self._running.is_set()
+
+    @property
+    def reconnect_backoff_s(self) -> float:
+        """The CONNECTING retry delay most recently slept (0 when the
+        last connect succeeded) — /diagnostics observability."""
+        return self._connect_backoff.last_delay_s
 
     # -- thread lifecycle ---------------------------------------------------
 
@@ -190,15 +216,21 @@ class ScanLoopFsm:
             if self.driver is None:
                 self.driver = self._factory()
             if not self.driver.is_connected():
+                self.connect_attempts += 1
                 ok = self.driver.connect(
                     self._params.serial_port,
                     self._params.serial_baudrate,
                     self._params.angle_compensate,
                 )
                 if not ok:
-                    log.warning("[FSM] Connection failed. Retrying...")
-                    self._interruptible_sleep(self._t.connect_retry_s)
+                    delay = self._connect_backoff.next_delay()
+                    log.warning(
+                        "[FSM] Connection failed (attempt %d). Retrying "
+                        "in %.2f s...", self.connect_attempts, delay,
+                    )
+                    self._interruptible_sleep(delay)
                     return
+                self._connect_backoff.reset()
                 log.info("[FSM] Connection established.")
             self.driver.detect_and_init_strategy()
             self.cached_device_info = self.driver.get_device_info_str()

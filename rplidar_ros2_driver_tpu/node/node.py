@@ -584,6 +584,17 @@ class RPlidarNode(LifecycleNode):
                     score=est.score,
                     revision=est.revision,
                 )
+        reconnect = None
+        if self.fsm is not None and (
+            self.fsm.connect_attempts or self.fsm.reconnect_backoff_s
+        ):
+            reconnect = {
+                "attempts": self.fsm.connect_attempts,
+                "backoff_s": self.fsm.reconnect_backoff_s,
+            }
+            drv_failures = getattr(driver, "connect_failures", None)
+            if drv_failures:
+                reconnect["driver_failures"] = drv_failures
         self.diagnostics.update(
             lifecycle=lc,
             fsm_state=fsm_state,
@@ -593,6 +604,7 @@ class RPlidarNode(LifecycleNode):
             latency_p99_ms=lat or None,
             rx_scheduling=rx_sched,
             map_status=map_status,
+            reconnect=reconnect,
         )
 
     # ------------------------------------------------------------------

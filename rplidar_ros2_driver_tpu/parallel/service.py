@@ -132,6 +132,18 @@ class ShardedFilterService:
         # the per-stream pose estimates land in ``last_poses``
         self.mapper = None
         self.last_poses: list = [None] * streams
+        # fleet fault-tolerance seam (driver/health.py FleetHealth):
+        # when attached, every live byte tick runs the per-stream health
+        # FSMs — quarantined streams are masked onto the existing idle
+        # padding lanes (same compiled program, zero recompiles), their
+        # filter+map state checkpointed at quarantine and restored at
+        # rejoin (see attach_health / _quarantine_stream)
+        self.health = None
+        self.stream_checkpoints: dict = {}
+        self.quarantines = 0
+        self.rejoins = 0
+        if getattr(params, "health_enable", False):
+            self.attach_health()
 
     def precompile(self) -> None:
         """Compile the batched tick program now (the fleet analog of
@@ -176,6 +188,13 @@ class ShardedFilterService:
                 f"{self.streams}"
             )
         self.mapper = mapper
+        if self.health is not None:
+            # health was attached first (e.g. health_enable in the
+            # ctor): the quarantine path now includes the mapper's row
+            # checkpoint, whose programs must be compiled BEFORE steady
+            # state — a first quarantine must never pay an in-loop
+            # XLA compile
+            self._warm_quarantine_path()
         return mapper
 
     def _map_tick(self, outs: list) -> list:
@@ -185,6 +204,138 @@ class ShardedFilterService:
             return outs
         self.last_poses = self.mapper.submit(outs)
         return outs
+
+    # -- fault tolerance seam -----------------------------------------------
+
+    def attach_health(
+        self,
+        health=None,
+        *,
+        clock=None,
+        probes=None,
+        record_masks: bool = False,
+        warm: bool = True,
+    ) -> "object":
+        """Attach a FleetHealth supervisor (built from this service's
+        ``health_*`` params when not given) over the byte-tick seams:
+        each ``submit_bytes`` tick is observed per stream, quarantined
+        streams are masked onto the existing idle padding lanes — the
+        engines keep dispatching the ONE compiled program per tick with
+        zero recompiles — and the quarantine/rejoin transitions drive
+        this service's per-stream checkpoint machinery (filter+map
+        state snapshotted on quarantine, restored on recovery).
+
+        ``probes`` maps stream index -> device-health callable polled
+        on quarantine release (GET_DEVICE_HEALTH semantics); ``clock``
+        injects a time source for deterministic tests.  ``warm`` runs
+        one snapshot/restore round trip on the fresh engines so the
+        derived-state recompute it needs is compiled BEFORE steady
+        state (skipped automatically once live traffic has flowed).
+        """
+        from rplidar_ros2_driver_tpu.driver.health import (
+            FleetHealth,
+            HealthConfig,
+        )
+
+        self._ensure_byte_ingest()
+        if health is None:
+            import time as _time
+
+            health = FleetHealth(
+                self.streams,
+                HealthConfig.from_params(self.params),
+                clock=clock or _time.monotonic,
+                probes=probes,
+                record_masks=record_masks,
+            )
+        elif clock is not None or probes or record_masks:
+            # construction-only kwargs silently ignored on an explicit
+            # instance would DROP the caller's probes (a still-broken
+            # device would rejoin on backoff alone) — refuse instead
+            raise ValueError(
+                "clock/probes/record_masks only apply when attach_health "
+                "builds the supervisor; configure the passed FleetHealth "
+                "directly (set_probe, record_masks at construction)"
+            )
+        if health.streams != self.streams:
+            raise ValueError(
+                f"health supervisor has {health.streams} streams, "
+                f"service has {self.streams}"
+            )
+        # the service's checkpoint machinery binds to the transition
+        # hooks; hooks the CALLER installed on an explicit instance
+        # (alerting, metrics) are chained after, not silently dropped
+        user_quarantine = health.on_quarantine
+        user_recover = health.on_recover
+
+        def on_quarantine(i: int) -> None:
+            self._quarantine_stream(i)
+            if user_quarantine is not None:
+                user_quarantine(i)
+
+        def on_recover(i: int) -> None:
+            self._rejoin_stream(i)
+            if user_recover is not None:
+                user_recover(i)
+
+        health.on_quarantine = on_quarantine
+        health.on_recover = on_recover
+        self.health = health
+        if warm:
+            self._warm_quarantine_path()
+        return health
+
+    def _warm_quarantine_path(self) -> None:
+        """One snapshot/restore round trip per engine on stream 0 —
+        compiles the derived-state recompute (median re-sort) the
+        rejoin path needs, so a quarantine cycle inside a guarded
+        steady-state loop pays zero in-loop compiles.  Only safe before
+        live traffic (the restore resets stream 0's decode carries), so
+        it no-ops once the engines have ticked."""
+        eng = self.fleet_ingest
+        if eng is not None and eng.ticks == 0:
+            eng.restore_stream(0, eng.snapshot_stream(0))
+            # the warmup reset flag must not leak into the live stream:
+            # a fresh engine's carries are zero, so clearing it restores
+            # the exact pre-warmup state
+            eng._reset_next[0] = False
+        if self.mapper is not None and self.mapper.ticks == 0:
+            self.mapper.restore_stream(0, self.mapper.snapshot_stream(0))
+
+    def _quarantine_stream(self, i: int) -> None:
+        """Health-FSM hook: stream i just entered QUARANTINED — freeze
+        its per-stream state (fused ingest decode+filter rows, map row)
+        via the schema-versioned per-stream checkpoint formats.  Host-
+        backend fleets have no per-stream device rows to freeze (the
+        lockstep window advances all-masked); masking alone degrades
+        them."""
+        snap: dict = {}
+        if self.fleet_ingest is not None:
+            snap["ingest"] = self.fleet_ingest.snapshot_stream(i)
+        if self.mapper is not None:
+            snap["map"] = self.mapper.snapshot_stream(i)
+        self.stream_checkpoints[i] = snap
+        self.quarantines += 1
+        logger.warning("stream %d quarantined (state checkpointed)", i)
+
+    def _rejoin_stream(self, i: int) -> None:
+        """Health-FSM hook: stream i's backoff+probe gate released it —
+        restore the quarantine checkpoint (rolling filter window + map
+        intact, decode carries reset for the mid-capsule re-entry)
+        BEFORE this tick's bytes flow again."""
+        snap = self.stream_checkpoints.pop(i, None)
+        if snap:
+            if "ingest" in snap and self.fleet_ingest is not None:
+                self.fleet_ingest.restore_stream(i, snap["ingest"])
+            if "map" in snap and self.mapper is not None:
+                self.mapper.restore_stream(i, snap["map"])
+        self.rejoins += 1
+        logger.info("stream %d rejoining (state restored from checkpoint)", i)
+
+    def health_status(self) -> Optional[list]:
+        """Per-stream health dicts for /diagnostics-style reporting
+        (None when no supervisor is attached)."""
+        return None if self.health is None else self.health.status()
 
     # -- raw-bytes ingest seam ----------------------------------------------
 
@@ -271,6 +422,24 @@ class ShardedFilterService:
                 f"expected {self.streams} per-stream byte runs, got {len(items)}"
             )
         self._ensure_byte_ingest()
+        if self.health is not None:
+            # per-stream health FSMs: release polls first (a rejoining
+            # stream's checkpoint restores BEFORE its bytes flow), then
+            # quarantined streams mask to None — the idle-lane encoding
+            # the padding buckets already compile for, so the fleet
+            # keeps dispatching one unchanged program per tick
+            items = self.health.begin_tick(items)
+        result = self._submit_bytes_tick(items, pipelined)
+        if self.health is not None:
+            # observations close the loop (under ``pipelined`` the
+            # completions are the previous tick's — one tick of
+            # declared staleness in the health view too)
+            self.health.end_tick(result)
+        return result
+
+    def _submit_bytes_tick(
+        self, items, pipelined: bool
+    ) -> list[Optional[FilterOutput]]:
         if self.fleet_ingest_backend == "fused":
             outs = (
                 self.fleet_ingest.submit_pipelined(items)
@@ -314,6 +483,11 @@ class ShardedFilterService:
         the queue it just caught up on).  The backends' window semantics
         differ exactly as documented on :meth:`submit_bytes`."""
         self._ensure_byte_ingest()
+        if self.health is not None:
+            # masking only: a catch-up drain is one event, not
+            # len(ticks) of steady-state evidence — the health FSMs
+            # advance on live ticks (driver/health.FleetHealth.mask)
+            ticks = [self.health.mask(t) for t in ticks]
         if self.fleet_ingest_backend == "fused":
             outs = self.fleet_ingest.submit_backlog(ticks)
             results = [[o for (o, _ts0, _dur) in s] for s in outs]

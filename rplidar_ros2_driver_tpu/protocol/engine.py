@@ -216,7 +216,14 @@ class CommandEngine:
     def _route_response(self, ans_type: int, data: bytes) -> None:
         with self._pending_lock:
             stale_until = self._stale.pop(ans_type, None)
-            if stale_until is not None and time.monotonic() < stale_until:
+            # the deadline itself is INSIDE the stale window (<=, not <):
+            # an answer landing exactly at the expiry instant still
+            # belongs to the timed-out request — delivering it would
+            # hand request N-1's answer to request N (the conf protocol
+            # reuses one ans type across per-mode queries, so a
+            # boundary-delivered answer is silently WRONG data, not
+            # just late data)
+            if stale_until is not None and time.monotonic() <= stale_until:
                 log.debug("dropping stale ans %#x (%d bytes)", ans_type, len(data))
             elif self._pending_ans == ans_type and self._pending_q is not None:
                 try:

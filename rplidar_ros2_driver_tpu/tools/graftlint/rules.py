@@ -1,4 +1,4 @@
-"""The eight graftlint rules.  Each takes the RepoIndex and yields
+"""The nine graftlint rules.  Each takes the RepoIndex and yields
 Findings; suppression/baseline handling lives in the runner."""
 
 from __future__ import annotations
@@ -674,7 +674,81 @@ def _gl008_params(index: RepoIndex):
         )
 
 
+# ---------------------------------------------------------------------------
+# GL009 — unbounded retry loops (no attempt cap, no backoff ceiling)
+# ---------------------------------------------------------------------------
+
+def _is_while_forever(loop: ast.While) -> bool:
+    return isinstance(loop.test, ast.Constant) and bool(loop.test.value)
+
+
+def _gl009_bounded_escape(loop: ast.While) -> bool:
+    """An escape (break/return/raise) inside an `if` whose test is a
+    comparison/boolean test counts as a cap — an attempt counter or a
+    deadline check gating the exit is exactly the bound this rule
+    demands."""
+    for n in ast.walk(loop):
+        if isinstance(n, ast.If) and isinstance(
+            n.test, (ast.Compare, ast.BoolOp)
+        ):
+            for e in ast.walk(n):
+                if isinstance(e, (ast.Break, ast.Return, ast.Raise)):
+                    return True
+    return False
+
+
+def rule_gl009(index: RepoIndex):
+    """`while True` loops sleeping a CONSTANT delay are retry loops with
+    no backoff and no bound: a dead device turns them into a permanent
+    fixed-rate reconnect storm (and N of them into a synchronized one).
+    A computed sleep argument (a BackoffPolicy delay, a derived
+    remaining-budget) or a comparison-gated escape (attempt cap,
+    deadline) absolves the loop; anything else must justify itself with
+    a suppression."""
+    for rel, mod in sorted(index.modules.items()):
+        for fn in mod.functions.values():
+            # nested defs ride their parent's walk — the IMMEDIATE
+            # parent (rsplit), so a closure inside a method
+            # ("Cls.method.inner") is skipped too; the split('.')[0]
+            # form would double-report it, once per qualname
+            if "." in fn.qualname and fn.qualname.rsplit(".", 1)[0] in (
+                mod.functions
+            ):
+                continue
+            for loop in ast.walk(fn.node):
+                if not isinstance(loop, ast.While) or not _is_while_forever(
+                    loop
+                ):
+                    continue
+                const_sleep = None
+                for n in ast.walk(loop):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    _, leaf = _head_leaf(n)
+                    if leaf == "sleep" and n.args and isinstance(
+                        n.args[0], ast.Constant
+                    ):
+                        const_sleep = n
+                        break
+                if const_sleep is None:
+                    continue
+                if _gl009_bounded_escape(loop):
+                    continue
+                if not mod.suppressed("GL009", loop.lineno) and not (
+                    mod.suppressed("GL009", const_sleep.lineno)
+                ):
+                    yield Finding(
+                        "GL009", rel, loop.lineno,
+                        f"unbounded retry loop in {fn.qualname}: `while "
+                        "True` sleeping a constant delay with no attempt "
+                        "cap, deadline check, or computed backoff — route "
+                        "the wait through driver/health.BackoffPolicy "
+                        "(capped exponential + jitter) or gate an escape "
+                        "on an attempt/deadline bound",
+                    )
+
+
 ALL_RULES = (
     rule_gl001, rule_gl002, rule_gl003, rule_gl004, rule_gl005,
-    rule_gl006, rule_gl007, rule_gl008,
+    rule_gl006, rule_gl007, rule_gl008, rule_gl009,
 )

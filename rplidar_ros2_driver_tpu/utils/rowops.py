@@ -1,0 +1,49 @@
+"""Dynamic-index row gather/scatter over stream-batched pytrees.
+
+The ONE builder behind every per-stream checkpoint surface
+(FleetFusedIngest and FleetMapper quarantine/rejoin rows, and the
+cross-host migration unit of ROADMAP item 1): jitted ``gather(state,
+idx) -> row`` / ``scatter(state, row, idx) -> state`` pairs whose
+stream index is a DEVICE scalar, so every lane shares a single
+compiled program per direction — a Python-int index would bake one
+executable per lane and recompile inside guarded steady-state loops
+the first time each lane quarantines.
+
+Row traffic is O(1/streams) of the fleet state; the whole-state host
+round trip this replaces measured 0.73x healthy-lane throughput at
+full geometry (bench --config 13, docs/BENCHMARKS.md).
+
+``fixup(new_state, row, idx)`` lets a caller repair DERIVED state
+inside the scatter jit (the ingest engine re-sorts the restored
+window row's median view there); the scatter donates the old state.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def make_row_ops(jax, *, fixup: Optional[Callable] = None) -> tuple:
+    """Build the jitted (gather, scatter) pair.  ``jax`` is passed in
+    (the engines import jax lazily); leaves that are ``None`` in the
+    pytree are skipped by tree_map as usual."""
+    from jax import lax
+
+    def gather(state, idx):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, idx, 0, keepdims=False),
+            state,
+        )
+
+    def scatter(state, row, idx):
+        new = jax.tree_util.tree_map(
+            lambda a, r: lax.dynamic_update_index_in_dim(a, r, idx, 0),
+            state, row,
+        )
+        if fixup is not None:
+            new = fixup(new, row, idx)
+        return new
+
+    # donate the full state only: row buffers are strictly smaller
+    # than any output buffer, so donating them just warns
+    return jax.jit(gather), jax.jit(scatter, donate_argnums=(0,))

@@ -22,6 +22,10 @@
 #   9. SLAM front-end A/B (config 12: N-stream correlative match +
 #      log-odds update, host reference vs one vmapped dispatch per
 #      fleet tick — the map_backend decision key)
+#  10. degraded-fleet chaos throughput (config 13: N=8 streams, K of
+#      them quarantined by the health FSM under a seeded fault
+#      program — healthy-lane throughput vs the K=0 baseline, zero
+#      recompiles across quarantine/rejoin asserted)
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -79,7 +83,8 @@ if [ $# -eq 0 ]; then
     "python bench.py --config 10" \
     "python scripts/fleet_latency.py --fleet-ingest fused" \
     "python bench.py --config 11" \
-    "python bench.py --config 12"
+    "python bench.py --config 12" \
+    "python bench.py --config 13"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

@@ -496,6 +496,142 @@ class TestGL008:
 
 
 # ---------------------------------------------------------------------------
+# GL009 — unbounded retry loops
+# ---------------------------------------------------------------------------
+
+
+class TestGL009:
+    def test_fires_on_constant_sleep_retry_loop(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            def reconnect(dev):
+                while True:
+                    if dev.connect():
+                        break
+                    time.sleep(1.0)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL009"]
+        assert len(msgs) == 1 and "unbounded retry loop" in msgs[0]
+
+    def test_quiet_on_computed_backoff(self, tmp_path):
+        # non-constant sleep argument = a computed backoff: absolved
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            def reconnect(dev, policy):
+                while True:
+                    if dev.connect():
+                        break
+                    time.sleep(policy.next_delay())
+        """})
+        assert "GL009" not in _rules(fs)
+
+    def test_quiet_on_attempt_cap_and_deadline(self, tmp_path):
+        # comparison-gated escapes (attempt cap, deadline) are the bound
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            def capped(dev):
+                attempt = 0
+                while True:
+                    if dev.connect():
+                        break
+                    attempt += 1
+                    if attempt >= 5:
+                        raise RuntimeError("gave up")
+                    time.sleep(1.0)
+
+            def deadlined(dev, deadline):
+                while True:
+                    if dev.connect():
+                        return True
+                    if time.monotonic() > deadline:
+                        return False
+                    time.sleep(1.0)
+        """})
+        assert "GL009" not in _rules(fs)
+
+    def test_quiet_on_bounded_while_condition(self, tmp_path):
+        # not `while True`: the loop condition itself is the bound
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            def run(self):
+                while self._running.is_set():
+                    self.poll()
+                    time.sleep(0.2)
+        """})
+        assert "GL009" not in _rules(fs)
+
+    def test_suppression_with_reason_works(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            def watchdog(dev):
+                # graftlint: disable=GL009 — fixture-sanctioned daemon poll
+                while True:
+                    dev.kick()
+                    time.sleep(5.0)
+        """})
+        assert "GL009" not in _rules(fs)
+
+    def test_closure_in_method_reports_once(self, tmp_path):
+        # regression: the nested-def skip used split('.')[0], so a
+        # retry loop in a closure inside a METHOD was reported twice
+        # (once per qualname walk) — unbaselineable, since the two
+        # messages differ
+        fs = _lint(tmp_path, {"pkg/m.py": """
+            import time
+
+            class Node:
+                def start(self, dev):
+                    def worker():
+                        while True:
+                            if dev.connect():
+                                break
+                            time.sleep(1.0)
+                    return worker
+        """})
+        gl9 = [f for f in fs if f.rule == "GL009"]
+        assert len(gl9) == 1, [f.message for f in gl9]
+
+    def test_baseline_reconcile_covers_gl009(self, tmp_path):
+        """A baselined GL009 finding passes; a stale GL009 entry fails
+        (the same exact-description contract every rule carries)."""
+        src = {"pkg/m.py": """
+            import time
+
+            def reconnect(dev):
+                while True:
+                    if dev.connect():
+                        break
+                    time.sleep(1.0)
+        """}
+        (tmp_path / "pyproject.toml").write_text(BASE_CONFIG)
+        for rel, body in src.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(body))
+        findings, new, stale = run_lint(str(tmp_path))
+        target = [f for f in findings if f.rule == "GL009"][0]
+        (tmp_path / "graftlint.baseline.json").write_text(json.dumps({
+            "findings": [{
+                "rule": target.rule, "path": target.path,
+                "message": target.message,
+                "justification": "fixture: legacy loop, fix queued",
+            }, {
+                "rule": "GL009", "path": "pkg/gone.py",
+                "message": "no longer fires",
+                "justification": "stale entry",
+            }]
+        }))
+        findings, new, stale = run_lint(str(tmp_path))
+        assert not any(f.key() == target.key() for f in new)
+        assert len(stale) == 1 and stale[0]["path"] == "pkg/gone.py"
+
+
+# ---------------------------------------------------------------------------
 # baseline reconciliation
 # ---------------------------------------------------------------------------
 
@@ -556,7 +692,7 @@ class TestRepoClean:
         from rplidar_ros2_driver_tpu.tools.graftlint.rules import ALL_RULES
         from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
 
-        assert len(ALL_RULES) >= 8
+        assert len(ALL_RULES) >= 9
         findings, new, stale = run_lint(repo_root())
         assert new == [], [f"{f.path}:{f.line} {f.rule} {f.message}"
                            for f in new]

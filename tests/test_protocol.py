@@ -165,6 +165,41 @@ class TestStaleAnswerGuard:
         finally:
             eng.stop()
 
+    def test_boundary_answer_is_still_stale(self, monkeypatch):
+        """Regression: a late answer arriving EXACTLY at the stale
+        deadline used to slip through (`<` vs `<=`) and complete the
+        next caller's request with the previous request's data.  Pin
+        the boundary by routing a response at a monotonic clock frozen
+        to the recorded deadline."""
+        import time as time_mod
+
+        eng, tx = self._engine()
+        try:
+            assert eng.request(Cmd.GET_LIDAR_CONF, Ans.GET_LIDAR_CONF,
+                               timeout_s=0.05) is None
+            deadline = eng._stale[int(Ans.GET_LIDAR_CONF)]
+            # request 2 pending; the late answer lands at t == deadline
+            t, result = self._background_request(eng, tx, timeout_s=2.0)
+            from rplidar_ros2_driver_tpu.protocol import engine as engine_mod
+
+            real_monotonic = time_mod.monotonic
+            monkeypatch.setattr(
+                engine_mod.time, "monotonic", lambda: deadline
+            )
+            try:
+                eng._route_response(int(Ans.GET_LIDAR_CONF), b"LATE")
+            finally:
+                monkeypatch.setattr(
+                    engine_mod.time, "monotonic", real_monotonic
+                )
+            # the boundary answer must have been dropped as stale; the
+            # genuine answer then completes request 2
+            tx.q.put((int(Ans.GET_LIDAR_CONF), b"FRESH", False))
+            t.join(10.0)
+            assert result["ans"] == b"FRESH"
+        finally:
+            eng.stop()
+
     def test_stale_window_expires(self):
         import time
 

@@ -185,3 +185,138 @@ def test_endurance_serial_soak_with_replug_cycles():
     assert pub.scan_count >= 5.0 * wall * 0.3, (pub.scan_count, wall)
     lo, hi = int(np.percentile(counts, 5)), int(np.percentile(counts, 95))
     assert 2000 <= lo and hi <= 4000, (lo, hi)
+
+
+@pytest.mark.slow
+def test_chaos_fleet_soak_quarantine_cycles_stay_bit_exact():
+    """Minutes-scale chaos soak at fleet scale (the slow extension of
+    the tier-1 chaos smoke in tests/test_chaos.py): a fleet of 4 runs
+    hundreds of ticks while TWO streams take repeated seeded fault
+    bursts — corruption, truncation, stall windows — cycling through
+    quarantine/recovery several times each.  Criteria: every faulty
+    stream quarantined AND recovered at least twice, healthy streams
+    never left HEALTHY, zero recompiles/implicit transfers across the
+    whole steady-state span, and every published output plus the final
+    per-stream maps are bit-exact against the host-golden replay of
+    the identical masked byte stream."""
+    from rplidar_ros2_driver_tpu.driver.chaos import ChaosConfig, chaos_ticks
+    from rplidar_ros2_driver_tpu.driver.health import (
+        FleetHealth,
+        HealthConfig,
+        StreamState,
+    )
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    from test_chaos import (
+        DENSE,
+        OUT_FIELDS,
+        _fleet_ticks,
+        _host_replay,
+        _map_params,
+    )
+
+    streams = 4
+    # floor of 60 revolutions: the repeated-cycle assertions below need
+    # enough stream for several stall windows per faulty stream
+    revs = max(60, int(os.environ.get("CHAOS_SOAK_REVS", 60)))
+    ticks = _fleet_ticks(streams, revs)
+    n_frames = revs * 10
+    # streams 1 and 2: repeating fault cycles — periodic stall windows
+    # (starvation-driven quarantines) over a floor of corruption and
+    # truncation, phase-shifted so the quarantines overlap sometimes
+    # and not others; the last ~10 revolutions run clean so both
+    # streams finish the soak recovered
+    stop = max(n_frames - 100, 1)
+    cfgs = {
+        1: ChaosConfig(seed=31, start_frame=30, stop_frame=stop,
+                       stall_period=120, stall_frames=30,
+                       corrupt_rate=0.1, truncate_rate=0.05),
+        2: ChaosConfig(seed=32, start_frame=80, stop_frame=stop,
+                       stall_period=150, stall_frames=35,
+                       corrupt_rate=0.15),
+    }
+    cticks = chaos_ticks(ticks, cfgs)
+
+    params = _map_params(fleet_ingest_backend="fused", map_backend="fused")
+    from test_fused_ingest import BEAMS
+
+    svc = ShardedFilterService(
+        params, streams, beams=BEAMS, fleet_ingest_buckets=(8,)
+    )
+    svc._ensure_byte_ingest()
+    svc.fleet_ingest.precompile([DENSE])
+    svc.attach_mapper()
+    svc.mapper.precompile()
+    fake = {"now": 0.0}
+    health = FleetHealth(
+        streams,
+        HealthConfig(window_ticks=3, corrupt_ratio=0.5, starvation_ticks=3,
+                     suspect_ticks=2, probation_ticks=2,
+                     backoff_base_s=0.3, backoff_max_s=1.2,
+                     backoff_jitter=0.0, seed=7),
+        clock=lambda: fake["now"],
+        probes={1: lambda: 0, 2: lambda: 0},
+        record_masks=True,
+    )
+    svc.attach_health(health)
+
+    outs_log = []
+    warm = 3
+    t0 = time.monotonic()
+    for tick in cticks[:warm]:
+        outs_log.append(list(svc.submit_bytes(tick)))
+        fake["now"] += 0.1
+    with guards.steady_state(tag="chaos soak"):
+        for tick in cticks[warm:]:
+            outs_log.append(list(svc.submit_bytes(tick)))
+            fake["now"] += 0.1
+    wall = time.monotonic() - t0
+
+    # repeated full cycles on BOTH faulty streams; healthy ones
+    # untouched; everyone recovered by the clean tail
+    for s in (1, 2):
+        assert health.health[s].quarantines >= 2, health.status()[s]
+        assert health.health[s].recoveries >= 2, health.status()[s]
+        assert health.health[s].state is StreamState.HEALTHY, (
+            health.status()[s]
+        )
+    for s in (0, 3):
+        assert health.health[s].quarantines == 0
+        assert health.health[s].state is StreamState.HEALTHY
+    assert svc.rejoins >= 4 and not svc.stream_checkpoints
+
+    # host-golden replay of the identical masked stream, bit-exact
+    rejoins = {
+        s: {t for (t, s2, _o, new) in health.events
+            if s2 == s and new == "recovering"}
+        for s in range(streams)
+    }
+    per_tick, host_mappers = _host_replay(
+        cticks, health.mask_log, rejoins, streams,
+        _map_params(map_backend="host"),
+    )
+    published = 0
+    for t, row in enumerate(outs_log):
+        for i in range(streams):
+            h, f = per_tick[t][i], row[i]
+            assert (h is None) == (f is None), (t, i)
+            if h is None:
+                continue
+            published += 1
+            for field in OUT_FIELDS:
+                assert np.array_equal(
+                    np.asarray(getattr(h, field)),
+                    np.asarray(getattr(f, field)),
+                ), (t, i, field)
+    assert published >= revs  # the soak actually streamed at scale
+    for i in range(streams):
+        fused_row = svc.mapper.snapshot_stream(i)
+        host_row = host_mappers[i].snapshot_stream(0)
+        for k in ("log_odds", "pose", "origin_xy", "revision"):
+            assert np.array_equal(fused_row[k], host_row[k]), (i, k)
+    print(
+        f"chaos soak: {len(cticks)} ticks / {published} published in "
+        f"{wall:.1f}s; quarantines="
+        f"{[h.quarantines for h in health.health]}"
+    )
