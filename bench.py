@@ -197,6 +197,7 @@ GRADED = {
     11: ("super_tick", POINTS, dict(window=WINDOW)),  # T-tick super-step drain A/B
     12: ("mapping", POINTS, dict(window=WINDOW)),  # SLAM front-end host-vs-fused A/B
     13: ("chaos", POINTS, dict(window=WINDOW)),  # degraded-fleet chaos throughput
+    14: ("pallas_match", POINTS, dict(window=WINDOW)),  # matcher kernel xla-vs-pallas A/B
 }
 
 
@@ -1481,6 +1482,49 @@ def bench_super_tick(smoke: bool = False) -> dict:
     }
 
 
+def _room_fleet_ticks(streams: int, beams: int, n_ticks: int):
+    """The shared config-12/14 matcher fixture: a synthetic 5x5 m square
+    room observed from per-stream drifting poses — B beam rays cast to
+    the walls, expressed in the sensor frame, one (N, B, 2) plane per
+    tick.  Both A/Bs feed the SAME planes to both of their arms, so
+    backend choice cannot change the inputs (the mapper's own input
+    contract), and both share this one builder so the scene and drift
+    constants cannot diverge between configs.
+
+    Returns ``(tick_inputs, truth_pose, masks, live)``; drift is one to
+    two cells per tick — inside the matcher's search window, outside
+    its quantization noise."""
+    half_room = 2.5
+    t = np.linspace(0, 2 * np.pi, beams, endpoint=False)
+    dx, dy = np.cos(t), np.sin(t)
+    with np.errstate(divide="ignore"):
+        r_wall = np.minimum(
+            np.where(np.abs(dx) > 1e-12, half_room / np.abs(dx), np.inf),
+            np.where(np.abs(dy) > 1e-12, half_room / np.abs(dy), np.inf),
+        )
+    wx, wy = dx * r_wall, dy * r_wall
+
+    def truth_pose(s: int, k: int) -> tuple:
+        return (
+            0.03 * k * (1 + 0.1 * s),
+            -0.02 * k * (1 + 0.2 * s),
+            0.004 * k,
+        )
+
+    tick_inputs = []
+    for k in range(n_ticks):
+        pts = np.zeros((streams, beams, 2), np.float32)
+        for s in range(streams):
+            x0, y0, th = truth_pose(s, k)
+            c, si = np.cos(-th), np.sin(-th)
+            pts[s, :, 0] = c * (wx - x0) - si * (wy - y0)
+            pts[s, :, 1] = si * (wx - x0) + c * (wy - y0)
+        tick_inputs.append(pts)
+    masks = np.ones((streams, beams), bool)
+    live = np.ones((streams,), np.int32)
+    return tick_inputs, truth_pose, masks, live
+
+
 def bench_mapping(smoke: bool = False) -> dict:
     """Config 12 — the SLAM front-end A/B: identical synthetic-room
     fleets through the mapper (mapping/mapper.FleetMapper — correlative
@@ -1526,40 +1570,9 @@ def bench_mapping(smoke: bool = False) -> dict:
             map_grid=grid, map_cell_m=cell, map_match_window=0.4,
         )
 
-    # synthetic 5x5 m square room observed from a drifting pose: B beam
-    # rays cast to the walls, expressed in the sensor frame — the same
-    # (N, B, 2) planes feed both arms, so backend choice cannot change
-    # the inputs (the mapper's own input contract)
-    half_room = 2.5
-    t = np.linspace(0, 2 * np.pi, beams, endpoint=False)
-    dx, dy = np.cos(t), np.sin(t)
-    with np.errstate(divide="ignore"):
-        r_wall = np.minimum(
-            np.where(np.abs(dx) > 1e-12, half_room / np.abs(dx), np.inf),
-            np.where(np.abs(dy) > 1e-12, half_room / np.abs(dy), np.inf),
-        )
-    wx, wy = dx * r_wall, dy * r_wall
-
-    def truth_pose(s: int, k: int) -> tuple:
-        # per-stream drift, one-to-two cells per tick — inside the
-        # matcher's search window, outside its quantization noise
-        return (
-            0.03 * k * (1 + 0.1 * s),
-            -0.02 * k * (1 + 0.2 * s),
-            0.004 * k,
-        )
-
-    tick_inputs = []
-    for k in range(ticks_n):
-        pts = np.zeros((streams, beams, 2), np.float32)
-        for s in range(streams):
-            x0, y0, th = truth_pose(s, k)
-            c, si = np.cos(-th), np.sin(-th)
-            pts[s, :, 0] = c * (wx - x0) - si * (wy - y0)
-            pts[s, :, 1] = si * (wx - x0) + c * (wy - y0)
-        tick_inputs.append(pts)
-    masks = np.ones((streams, beams), bool)
-    live = np.ones((streams,), np.int32)
+    tick_inputs, truth_pose, masks, live = _room_fleet_ticks(
+        streams, beams, ticks_n
+    )
 
     def run_arm(backend: str):
         mapper = FleetMapper(make_params(backend), streams, beams=beams)
@@ -1621,8 +1634,10 @@ def bench_mapping(smoke: bool = False) -> dict:
         if not np.array_equal(host_best["snap"][k], fused_best["snap"][k]):
             raise RuntimeError(f"mapping parity broke: map state {k!r}")
     # -- claim 3: the matcher actually tracked the drift --
+    from rplidar_ros2_driver_tpu.ops.scan_match import SUB
+
     cfg = fused_best["cfg"]
-    sub_per_cell = 32.0
+    sub_per_cell = float(SUB)
     errs = []
     for s in range(streams):
         x0, y0, _ = truth_pose(s, ticks_n - 1)
@@ -2132,6 +2147,239 @@ class _ChainRunner:
         return (time.perf_counter() - t0) / iters * 1e3
 
 
+def bench_pallas_match(smoke: bool = False) -> dict:
+    """Config 14 — the correlative-matcher kernel A/B: identical
+    synthetic-room fleets through the FUSED mapper (one vmapped dispatch
+    per fleet tick) under both matcher lowerings:
+
+      * xla    — the jnp score-volume + log-odds-update arm
+        (ops/scan_match.py).
+      * pallas — the VMEM-tiled Pallas kernels (ops/pallas_scan_match.py:
+        map resident in VMEM across the whole (dθ,dx,dy) candidate grid,
+        scatter-free one-hot/matmul log-odds update) — INTERPRET mode on
+        a CPU device, Mosaic on TPU (_lowering_dispatch).
+
+    Four claims are asserted, not inferred (a violation raises):
+
+      1. STRUCTURAL — each arm issues exactly one dispatch per fleet
+         tick (the mapper's ``dispatch_count`` counter).
+      2. ZERO-RECOMPILE — the timed loop of BOTH arms runs under the
+         runtime sentinels (utils/guards.steady_state): any in-loop XLA
+         compile or implicit transfer raises.
+      3. PARITY — both arms produce byte-identical pose trajectories
+         and final map states (the int32 datapath's exactness contract
+         re-checked at bench geometry).
+      4. ACCURACY — the matcher tracks the synthetic drift (mean
+         |pose error| below ``2 * coarse`` cells).
+
+    The artifact decomposes the tick into coarse sweep / joint
+    refinement / log-odds update per arm (jitted stage probes), and the
+    ``pallas_match_ab`` decision key rides with TWO clamp flags:
+    ``overhead_clamped`` (no measured saving) and ``interpret_mode``
+    (non-TPU device — the Pallas arm ran the emulator, so the ratio
+    measures interpret-mode overhead, not the datapath;
+    scripts/decide_backends.py drops such records on top of its
+    TPU-only rule).  ``smoke`` shrinks geometry to a seconds-scale CPU
+    run — the tier-1 gate (tests/test_bench_meta.py).
+    """
+    import functools as _ft
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+    from rplidar_ros2_driver_tpu.ops import scan_match as sm
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        grid, cell, beams, streams, ticks_n, reps = 32, 0.1, 256, 2, 4, 2
+    else:
+        grid, cell, beams, streams, ticks_n, reps = 128, 0.05, 1024, 4, 10, 4
+
+    def make_params(match_backend: str) -> DriverParams:
+        return DriverParams(
+            filter_chain=("clip", "median", "voxel"),
+            map_enable=True, map_backend="fused",
+            match_backend=match_backend,
+            map_grid=grid, map_cell_m=cell, map_match_window=0.4,
+        )
+
+    # the shared config-12/14 synthetic room; +1 tick: the steady-state
+    # warm tick
+    tick_inputs, truth_pose, masks, live = _room_fleet_ticks(
+        streams, beams, ticks_n + 1
+    )
+
+    def run_arm(match_backend: str):
+        mapper = FleetMapper(
+            make_params(match_backend), streams, beams=beams
+        )
+        mapper.precompile()
+        mapper.submit_points(tick_inputs[0], masks, live)  # warm live path
+        traj = np.zeros((ticks_n, streams, 3), np.int32)
+        # claim 2: the timed loop holds the steady-state contract —
+        # any recompile or implicit transfer raises out of the bench
+        t0 = time.perf_counter()
+        with guards.steady_state(tag=f"pallas-match[{match_backend}]"):
+            for k in range(ticks_n):
+                ests = mapper.submit_points(
+                    tick_inputs[k + 1], masks, live
+                )
+                for s, est in enumerate(ests):
+                    traj[k, s] = est.pose_q
+        dt = time.perf_counter() - t0
+        return {
+            "dt_s": dt, "traj": traj, "snap": mapper.snapshot(),
+            "dispatches": mapper.dispatch_count, "cfg": mapper.cfg,
+        }
+
+    def stage_probes(cfg) -> dict:
+        """Median ms of the jitted coarse / full-match / update stages
+        on one mid-density map (refine is derived: match - coarse)."""
+        rng = np.random.default_rng(14)
+        lo = jnp.asarray(
+            rng.integers(0, cfg.clamp_q + 1, (grid, grid), np.int32)
+        )
+        pose = jnp.zeros((3,), jnp.int32)
+        pts = jnp.asarray(tick_inputs[0][0])
+        pq, ok = sm.quantize_points(pts, jnp.ones((beams,), bool), cfg)
+
+        def timed(fn, *args):
+            out = fn(*args)  # compile outside the timing
+            jax.block_until_ready(out)
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(*args))
+                ts.append(time.perf_counter() - t0)
+            return float(np.percentile(ts, 50)) * 1e3
+
+        coarse = jax.jit(
+            lambda l, p, q, o: sm.match_coarse_scores(l, p, q, o, cfg)[1]
+        )
+        match = jax.jit(_ft.partial(sm.match_scan, cfg=cfg))
+        update = jax.jit(_ft.partial(sm.update_map, cfg=cfg))
+        coarse_ms = timed(coarse, lo, pose, pq, ok)
+        match_ms = timed(match, lo, pose, pq, ok)
+        update_ms = timed(update, lo, pose, pq, ok)
+        return {
+            "coarse_ms": round(coarse_ms, 3),
+            "refine_ms": round(max(match_ms - coarse_ms, 0.0), 3),
+            "match_ms": round(match_ms, 3),
+            "update_ms": round(update_ms, 3),
+        }
+
+    # interleave the arms, best-of (1.5-core load drifts ~2x across
+    # seconds — docs/BENCHMARKS.md discipline); smoke runs one round,
+    # its gate is structural
+    xla_best = pal_best = None
+    for _ in range(1 if smoke else 2):
+        a = run_arm("xla")
+        if xla_best is None or a["dt_s"] < xla_best["dt_s"]:
+            xla_best = a
+        b = run_arm("pallas")
+        if pal_best is None or b["dt_s"] < pal_best["dt_s"]:
+            pal_best = b
+
+    # -- claim 1: one dispatch per fleet tick on both arms --
+    for name, arm in (("xla", xla_best), ("pallas", pal_best)):
+        if arm["dispatches"] != ticks_n + 1:  # warm tick + timed ticks
+            raise RuntimeError(
+                f"{name} arm dispatched {arm['dispatches']} times for "
+                f"{ticks_n + 1} fleet ticks (expected one per tick)"
+            )
+    # -- claim 3: bit-exact xla/pallas parity --
+    if not np.array_equal(xla_best["traj"], pal_best["traj"]):
+        raise RuntimeError("pallas-match parity broke: trajectories differ")
+    for k in xla_best["snap"]:
+        if not np.array_equal(xla_best["snap"][k], pal_best["snap"][k]):
+            raise RuntimeError(f"pallas-match parity broke: map state {k!r}")
+    # -- claim 4: the matcher tracked the drift --
+    cfg_p = pal_best["cfg"]
+    errs = []
+    for s in range(streams):
+        x0, y0, _ = truth_pose(s, ticks_n)
+        got = pal_best["traj"][-1, s].astype(np.float64)
+        errs.append(abs(got[0] / sm.SUB - x0 / cell))
+        errs.append(abs(got[1] / sm.SUB - y0 / cell))
+    pose_err_cells = float(np.mean(errs))
+    if pose_err_cells > 2.0 * cfg_p.coarse:
+        raise RuntimeError(
+            f"matcher lost the synthetic drift: mean |pose error| "
+            f"{pose_err_cells:.2f} cells > {2 * cfg_p.coarse}"
+        )
+
+    decomposition = {
+        "xla": stage_probes(xla_best["cfg"]),
+        "pallas": stage_probes(cfg_p),
+    }
+
+    scans = ticks_n * streams
+    xla_sps = scans / xla_best["dt_s"]
+    pal_sps = scans / pal_best["dt_s"]
+    measured_saving_ms = (xla_best["dt_s"] - pal_best["dt_s"]) * 1e3
+    device = str(jax.devices()[0].platform)
+    interpret_mode = device != "tpu"
+    return {
+        "metric": metric_name(14),
+        "value": round(pal_sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(pal_sps / (streams * BASELINE_SCANS_PER_SEC), 3),
+        "streams": streams,
+        "ticks": ticks_n,
+        "xla": {
+            "scans_per_sec": round(xla_sps, 2),
+            "dispatches": xla_best["dispatches"],
+            "drain_ms": round(xla_best["dt_s"] * 1e3, 3),
+        },
+        "pallas": {
+            "scans_per_sec": round(pal_sps, 2),
+            "dispatches": pal_best["dispatches"],
+            "drain_ms": round(pal_best["dt_s"] * 1e3, 3),
+        },
+        "decomposition_ms": decomposition,
+        "structural": {
+            "one_dispatch_per_tick": True,     # asserted above
+            "zero_recompiles": True,           # guards.steady_state held
+            "zero_implicit_transfers": True,   # same sentinel
+            "bit_exact_parity_holds": True,    # asserted above
+        },
+        "pose_err_cells": round(pose_err_cells, 3),
+        "measured_saving_ms": round(measured_saving_ms, 3),
+        # the decide_backends decision key for the match_backend auto
+        # recommendation: TPU records only, and interpret-mode runs
+        # (any non-TPU device) carry no weight even there
+        "pallas_match_ab": {
+            "match_speedup": round(
+                xla_best["dt_s"] / max(pal_best["dt_s"], 1e-9), 3
+            ),
+            "overhead_clamped": measured_saving_ms <= 0,
+            "interpret_mode": interpret_mode,
+        },
+        "ceiling_analysis": (
+            "on a non-TPU device the pallas arm runs in INTERPRET mode "
+            "(ops/pallas_kernels._lowering_dispatch): the kernel body "
+            "executes as traced jnp ops plus emulation overhead, so the "
+            "wall-time ratio here measures the emulator against a "
+            "compiled XLA arm on a throttled 1.5-core rig — it says "
+            "nothing about the Mosaic datapath and can never flip the "
+            "backend (interpret_mode clamp + the TPU-only rule).  What "
+            "a chip inherits from this artifact is the asserted "
+            "structure: bit-exact parity, one dispatch per fleet tick, "
+            "zero recompiles/transfers in steady state, and the stage "
+            "decomposition showing where the tick's time goes.  The "
+            "on-chip capture queued in scripts/rig_recapture.sh is the "
+            "real A/B: the match map read once into VMEM per tick "
+            "instead of per-corner HBM gather planes, targeting a "
+            "measured multiple of the 33,250 scans/s last-good "
+            "on-device headline (LAST_GOOD_DEVICE.json)."
+        ),
+        "grid": grid,
+        "cell_m": cell,
+        "beams": beams,
+        "smoke": smoke,
+        "device": device,
+    }
+
+
 def metric_name(config: int) -> str:
     """The one config -> metric-name mapping (success AND failure records
     of a config must share a name to land in the same series)."""
@@ -2146,6 +2394,7 @@ def metric_name(config: int) -> str:
         11: "super_tick_drain_scans_per_sec",
         12: "mapping_match_update_scans_per_sec",
         13: "chaos_degraded_fleet_scans_per_sec",
+        14: "pallas_match_kernel_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -2165,6 +2414,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_mapping()
     if kind == "chaos":
         return bench_chaos()
+    if kind == "pallas_match":
+        return bench_pallas_match()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -2476,7 +2727,8 @@ if __name__ == "__main__":
         "10=fleet-tick host-vs-fused ingest A/B, bytes to N scans, "
         "11=T-tick super-step drain A/B, backlog in ceil(T/super) "
         "dispatches, 12=SLAM front-end A/B, 13=chaos degraded-fleet "
-        "throughput with K faulty streams quarantined)",
+        "throughput with K faulty streams quarantined, 14=correlative-"
+        "matcher kernel A/B, xla vs VMEM-tiled pallas lowering)",
     )
     ap.add_argument(
         "--smoke-ingest",
@@ -2509,6 +2761,16 @@ if __name__ == "__main__":
         "one fused dispatch per fleet tick, bit-exact host/fused parity "
         "and drift tracking — the tier-1 regression gate for the "
         "mapping subsystem",
+    )
+    ap.add_argument(
+        "--smoke-pallas-match",
+        action="store_true",
+        help="seconds-scale CPU run of the config-14 matcher-kernel A/B "
+        "(small geometry, forced CPU backend, pallas arm in interpret "
+        "mode, no tunnel probe): asserts bit-exact xla/pallas parity, "
+        "one dispatch per fleet tick and zero recompiles/transfers in "
+        "steady state — the tier-1 regression gate for the Pallas "
+        "matcher kernels",
     )
     ap.add_argument(
         "--smoke-chaos",
@@ -2580,6 +2842,13 @@ if __name__ == "__main__":
         # must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_mapping(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_pallas_match:
+        # same CPU-only discipline: the kernel-parity structural gate
+        # must run anywhere (the pallas arm interprets off-TPU)
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_pallas_match(smoke=True)))
         raise SystemExit(0)
 
     if args.smoke_chaos:

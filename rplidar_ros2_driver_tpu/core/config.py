@@ -166,6 +166,20 @@ class DriverParams:
     # resolve_map_backend — host until an on-chip config-12 artifact
     # clears the bar; scripts/decide_backends.py reads `mapping_ab`).
     map_backend: str = "auto"
+    # correlative-matcher kernel lowering (MapConfig.match_backend):
+    # "xla" = the jnp score-volume + log-odds-update arm in
+    # ops/scan_match.py; "pallas" = the VMEM-tiled Pallas kernels
+    # (ops/pallas_scan_match.py — match map resident in VMEM across the
+    # whole (dθ,dx,dy) candidate grid, scatter-free one-hot/matmul
+    # log-odds update; interpret mode off-TPU so CPU configs stay
+    # runnable).  Bit-exact either way (the int32 datapath makes
+    # evaluation order irrelevant; tests/test_pallas_scan_match.py).
+    # "auto" resolves per the standing decision procedure
+    # (mapping/mapper.resolve_match_backend — xla until an on-chip
+    # config-14 artifact clears the bar; scripts/decide_backends.py
+    # reads `pallas_match_ab`, TPU records only, interpret-mode runs
+    # carry no weight).
+    match_backend: str = "auto"
     map_grid: int = 256               # cells per side of the log-odds map
     map_cell_m: float = 0.05          # metres per map cell
     map_match_window: float = 0.4     # translation search radius (m)
@@ -303,6 +317,10 @@ class DriverParams:
         if self.map_backend not in ("auto", "host", "fused"):
             raise ValueError(
                 "map_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.match_backend not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                "match_backend must be 'auto', 'xla' or 'pallas'"
             )
         if self.map_enable and not self.filter_chain:
             raise ValueError(

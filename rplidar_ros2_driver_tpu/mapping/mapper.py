@@ -64,6 +64,24 @@ def resolve_map_backend(requested: str, platform: Optional[str] = None) -> str:
     return "host"
 
 
+def resolve_match_backend(
+    requested: str, platform: Optional[str] = None
+) -> str:
+    """Resolve the ``auto`` matcher lowering (``MapConfig.match_backend``:
+    the correlative score volume + log-odds update kernels).  Explicit
+    requests pass through; ``auto`` stays on the XLA arm until an
+    on-chip ``pallas_match_ab`` artifact (bench.py --config 14) clears
+    the standing decision bar — the CPU artifact runs the Pallas
+    kernels in INTERPRET mode (ops/pallas_kernels._lowering_dispatch),
+    which measures the emulator, not the datapath, so CPU evidence can
+    never flip this (scripts/decide_backends.py clamps the key to TPU
+    records and drops interpret-mode runs on top)."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "xla"
+
+
 def map_config_from_params(
     params, beams: int = 2048, platform: Optional[str] = None
 ) -> MapConfig:
@@ -92,6 +110,9 @@ def map_config_from_params(
         quant_shift=min_quant_shift(clamp_q, beams),
         voxel_backend=resolve_voxel_backend(
             getattr(params, "voxel_backend", "auto"), platform
+        ),
+        match_backend=resolve_match_backend(
+            getattr(params, "match_backend", "auto"), platform
         ),
     )
 
@@ -195,7 +216,16 @@ class FleetMapper:
     def precompile(self) -> None:
         """Warm the fused program on a throwaway state (the mapper's
         analog of the chain/ingest precompiles) so the first live tick
-        never stalls on an XLA compile.  No-op on the host backend."""
+        never stalls on an XLA compile.  No-op on the host backend.
+
+        The warmed executable covers the configured matcher lowering
+        end to end: with ``match_backend=pallas`` the Pallas score-
+        volume and log-odds-update kernels trace INSIDE this program
+        (the inner jits inline), so one warm dispatch compiles every
+        kernel the live tick will run — the steady-state guards
+        (tests/test_guards.py) pin the Pallas arm to zero recompiles
+        and zero implicit transfers after this call, same as the XLA
+        arm."""
         if self.backend != "fused":
             return
         from rplidar_ros2_driver_tpu.ops.scan_match import (

@@ -38,6 +38,17 @@ to ±(2^15 - 1) before rotation (|c·x - s·y| ≤ 2·2^15·2^14 = 2^30); map
 values are clipped to [0, clamp_q] and right-shifted by ``quant_shift``,
 chosen per config so (clamp_q >> quant_shift)·1024·beams < 2^31.
 
+Because the datapath is int32 end to end — and int32 addition is
+associative and commutative even at wrap-around — ANY evaluation order
+produces bit-identical results.  That is what lets the matcher carry a
+second lowering: ``MapConfig.match_backend`` routes the score volume
+and the log-odds update through either the jnp arm in this module
+("xla") or the VMEM-tiled Pallas kernels ("pallas",
+ops/pallas_scan_match.py, interpret mode off-TPU), with the argmax and
+accept/assemble epilogues shared so first-max-wins tie-breaking is
+structurally backend-independent.  tests/test_pallas_scan_match.py pins
+all three implementations (xla / pallas / numpy) byte-for-byte.
+
 The occupancy update reuses the voxel-accumulation machinery's two
 kernel shapes — a scatter-add histogram and the one-hot bf16 einsum with
 f32 accumulation that rides the MXU (ops/filters.voxel_hits /
@@ -124,6 +135,13 @@ class MapConfig:
     free_samples: int = 4      # ray samples for the free-space miss pass
     quant_shift: int = 4       # match-map right shift (int32 score bound)
     voxel_backend: str = "scatter"  # endpoint histogram: scatter | matmul
+    # score-volume + log-odds-update lowering: "xla" (the jnp arm below)
+    # or "pallas" (ops/pallas_scan_match VMEM-tiled kernels, interpret
+    # mode off-TPU via _lowering_dispatch).  Bit-exact either way — the
+    # int32 datapath makes evaluation order irrelevant — so the seam is
+    # purely a performance choice (resolve_match_backend in
+    # mapping/mapper.py holds the auto mapping and its evidence bar).
+    match_backend: str = "xla"
 
     def __post_init__(self):
         if self.grid < 8 or self.grid > 1024:
@@ -142,6 +160,12 @@ class MapConfig:
             raise ValueError("log-odds clamp must be >= the hit increment")
         if self.theta_window >= self.theta_divisions // 2:
             raise ValueError("theta window exceeds half a turn")
+        if self.match_backend not in ("xla", "pallas"):
+            raise ValueError(
+                "match_backend must be 'xla' or 'pallas' once resolved "
+                "(the 'auto' spelling resolves in mapping/mapper."
+                "resolve_match_backend before MapConfig is built)"
+            )
         # int32 score bound: per-point ≤ (clamp>>shift)·1024, summed over
         # beams — must stay under 2^31 (module docstring)
         if (self.clamp_q >> self.quant_shift) * W_SCALE * self.beams >= 2**31:
@@ -229,14 +253,21 @@ def quantize_points(xy: jax.Array, mask: jax.Array, cfg: MapConfig):
     return pq, ok
 
 
-def rotate_points(pq: jax.Array, cos_q, sin_q):
-    """Fixed-point rotation: (c·x - s·y) at ANG scale, rounded back to
-    subcells.  Broadcasts over leading axes of cos_q/sin_q."""
-    x, y = pq[..., 0], pq[..., 1]
+def rotate_rows(x, y, cos_q, sin_q):
+    """Fixed-point rotation of split x/y coordinate planes: (c·x - s·y)
+    at ANG scale, rounded back to subcells.  THE one rotation core —
+    `rotate_points` and the Pallas kernels both call it, so the rounding
+    contract cannot drift between the matcher backends."""
     half = 1 << (ANG_BITS - 1)
     xr = (cos_q * x - sin_q * y + half) >> ANG_BITS
     yr = (sin_q * x + cos_q * y + half) >> ANG_BITS
     return xr, yr
+
+
+def rotate_points(pq: jax.Array, cos_q, sin_q):
+    """Fixed-point rotation of packed (…, 2) points — `rotate_rows` on
+    the unpacked planes.  Broadcasts over leading axes of cos_q/sin_q."""
+    return rotate_rows(pq[..., 0], pq[..., 1], cos_q, sin_q)
 
 
 def _bilinear_gather(mf: jax.Array, gdim: int, ix, iy, fx, fy):
@@ -302,14 +333,21 @@ def select_cell_hits(backend: str):
 # ---------------------------------------------------------------------------
 
 
-def match_scan(
+def _theta_trig(pose: jax.Array, cfg: MapConfig):
+    """(T,) int32 cos/sin rotation-table rows of the θ search candidates
+    around ``pose`` — the one place both matcher backends read the
+    table, so the candidate set cannot drift between them."""
+    table = jnp.asarray(rotation_table(cfg.theta_divisions))
+    dth = jnp.asarray(theta_offsets(cfg))                       # (T,)
+    th_idx = jnp.mod(pose[2] + dth, cfg.theta_divisions)
+    return jnp.take(table[:, 0], th_idx), jnp.take(table[:, 1], th_idx)
+
+
+def match_coarse_scores(
     log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
     cfg: MapConfig,
 ):
-    """Dense multi-resolution correlative match of one quantized scan
-    against the map, searching a (dθ, dx, dy) lattice around ``pose``.
-
-    Coarse stage — TRANSLATION-ONLY at the predicted heading: the match
+    """Coarse TRANSLATION-ONLY sweep at the predicted heading: the match
     map (positive log-odds, quantized) is max-pooled by ``cfg.coarse``
     and every coarse (dx, dy) candidate scored with bilinear gathers.
     The pooled map upper-bounds the fine map (the standard correlative
@@ -319,36 +357,35 @@ def match_scan(
     it can only mis-seed the refinement (a hazard the golden rotation
     tests pin).
 
-    Fine stage — JOINT (dθ, dx, dy) at full resolution around the
-    coarse winner: every θ candidate re-rotates the scan and scores a
-    ±fine_radius cell window; the subcell bilinear fractions resolve
-    the sub-cell endpoint shifts a single θ step causes.  Greedy
-    single-seed refinement rather than the papers' full
-    branch-and-bound — sufficient to recover lattice-resolution offsets
-    (golden tests) at a fraction of the search.
-
-    Returns (dpose (3,) int32 [dx_sub, dy_sub, dθ_steps], score, n_valid).
-    An empty or informationless window (best score ≤ 0 — e.g. a fresh
-    map, or an all-invalid scan) yields the identity delta.
-    """
+    Returns ``(ctx, score_c)``: the (U, V) int32 coarse score plane and
+    a backend-specific context tuple the fine stage reuses (quantized
+    map forms and, on the XLA arm, the rotated candidate planes).  Both
+    backends produce bit-identical ``score_c`` — int32 end to end."""
     g, c = cfg.grid, cfg.coarse
     gc = g // c
     clog = int(math.log2(c))
     center = (g // 2) * SUB
+    cos_q, sin_q = _theta_trig(pose, cfg)                       # (T,)
+    t_mid = cfg.theta_window                                    # the dθ=0 row
+    w = cfg.window_cells
+
+    if cfg.match_backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_scan_match import (
+            coarse_scores_pallas,
+        )
+
+        posec = pose[:2] + center
+        mq, score_c = coarse_scores_pallas(
+            log_odds, pq, ok, posec, cos_q[t_mid], sin_q[t_mid], cfg
+        )
+        return (mq,), score_c
 
     mq = jnp.clip(log_odds, 0, cfg.clamp_q) >> cfg.quant_shift
     mc = mq.reshape(gc, c, gc, c).max(axis=(1, 3))
     mq_f, mc_f = mq.reshape(-1), mc.reshape(-1)
-
-    table = jnp.asarray(rotation_table(cfg.theta_divisions))
-    dth = jnp.asarray(theta_offsets(cfg))                       # (T,)
-    th_idx = jnp.mod(pose[2] + dth, cfg.theta_divisions)
-    cos_q = jnp.take(table[:, 0], th_idx)[:, None]              # (T, 1)
-    sin_q = jnp.take(table[:, 1], th_idx)[:, None]
-    rx, ry = rotate_points(pq[None, :, :], cos_q, sin_q)        # (T, B)
+    rx, ry = rotate_points(pq[None, :, :], cos_q[:, None], sin_q[:, None])
     bx = rx + pose[0] + center                                  # world subcells
     by = ry + pose[1] + center
-    t_mid = cfg.theta_window                                    # the dθ=0 row
 
     # -- coarse: predicted heading only; subcell coords at coarse scale
     # (SUB subcells per coarse cell), translations = whole coarse cells
@@ -357,7 +394,6 @@ def match_scan(
     scx, scy = bx[t_mid] >> clog, by[t_mid] >> clog             # (B,)
     ccx, ccy = scx >> SUB_BITS, scy >> SUB_BITS
     cfx, cfy = scx & (SUB - 1), scy & (SUB - 1)
-    w = cfg.window_cells
     shifts = jnp.arange(-w, w + 1, dtype=jnp.int32)             # (U,)
     ix = ccx[:, None, None] + shifts[None, :, None]             # (B, U, 1)
     iy = ccy[:, None, None] + shifts[None, None, :]             # (B, 1, V)
@@ -367,28 +403,88 @@ def match_scan(
     score_c = jnp.sum(
         jnp.where(ok[:, None, None], vals, 0), axis=0
     )                                                           # (U, V)
+    return (mq_f, bx, by), score_c
+
+
+def match_fine_scores(
+    ctx: tuple, pose: jax.Array, pq: jax.Array, ok: jax.Array,
+    u_best: jax.Array, v_best: jax.Array, cfg: MapConfig,
+):
+    """Fine JOINT (dθ, dx, dy) stage at full resolution around the
+    coarse winner: every θ candidate re-rotates the scan and scores a
+    ±fine_radius cell window; the subcell bilinear fractions resolve
+    the sub-cell endpoint shifts a single θ step causes.  Greedy
+    single-seed refinement rather than the papers' full
+    branch-and-bound — sufficient to recover lattice-resolution offsets
+    (golden tests) at a fraction of the search.
+
+    Returns the (T, F, F) int32 score volume in C order (θ, du, dv) —
+    the layout both backends reproduce exactly, so the shared
+    first-max-wins argmax downstream cannot diverge."""
+    c = cfg.coarse
+    r = cfg.fine_radius
+
+    if cfg.match_backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_scan_match import (
+            fine_scores_pallas,
+        )
+
+        (mq,) = ctx
+        center = (cfg.grid // 2) * SUB
+        cos_q, sin_q = _theta_trig(pose, cfg)
+        posec = pose[:2] + center
+        return fine_scores_pallas(
+            mq, pq, ok, posec, cos_q, sin_q, u_best, v_best, cfg
+        )
+
+    mq_f, bx, by = ctx
+    fbx = bx + u_best * (c * SUB)                               # (T, B)
+    fby = by + v_best * (c * SUB)
+    fcx, fcy = fbx >> SUB_BITS, fby >> SUB_BITS
+    ffx, ffy = fbx & (SUB - 1), fby & (SUB - 1)
+    fsh = jnp.arange(-r, r + 1, dtype=jnp.int32)
+    fix = fcx[:, :, None, None] + fsh[None, None, :, None]      # (T, B, F, 1)
+    fiy = fcy[:, :, None, None] + fsh[None, None, None, :]      # (T, B, 1, F)
+    fvals = _bilinear_gather(
+        mq_f, cfg.grid, fix, fiy,
+        ffx[:, :, None, None], ffy[:, :, None, None],
+    )                                                           # (T, B, F, F)
+    return jnp.sum(
+        jnp.where(ok[None, :, None, None], fvals, 0), axis=1
+    )                                                           # (T, F, F)
+
+
+def match_scan(
+    log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
+    cfg: MapConfig,
+):
+    """Dense multi-resolution correlative match of one quantized scan
+    against the map, searching a (dθ, dx, dy) lattice around ``pose``:
+    the coarse translation sweep (:func:`match_coarse_scores`), a
+    first-max-wins argmax seed, the joint full-resolution refinement
+    (:func:`match_fine_scores`), and the accept/assemble epilogue.
+    ``cfg.match_backend`` selects the score-volume lowering (XLA arm or
+    the VMEM-tiled Pallas kernels, ops/pallas_scan_match.py); both arms
+    land bit-identical volumes, and the argmaxes live HERE in shared
+    code, so tie-breaking is structurally backend-independent.
+
+    Returns (dpose (3,) int32 [dx_sub, dy_sub, dθ_steps], score, n_valid).
+    An empty or informationless window (best score ≤ 0 — e.g. a fresh
+    map, or an all-invalid scan) yields the identity delta.
+    """
+    c = cfg.coarse
+    w = cfg.window_cells
+    r = cfg.fine_radius
+    dth = jnp.asarray(theta_offsets(cfg))                       # (T,)
+
+    ctx, score_c = match_coarse_scores(log_odds, pose, pq, ok, cfg)
 
     nu = 2 * w + 1
     kbest = jnp.argmax(score_c.reshape(-1)).astype(jnp.int32)
     u_best = kbest // nu - w                                    # coarse cells
     v_best = kbest % nu - w
 
-    # -- fine: joint (θ, dx, dy) at full resolution around the winner
-    fbx = bx + u_best * (c * SUB)                               # (T, B)
-    fby = by + v_best * (c * SUB)
-    fcx, fcy = fbx >> SUB_BITS, fby >> SUB_BITS
-    ffx, ffy = fbx & (SUB - 1), fby & (SUB - 1)
-    r = cfg.fine_radius
-    fsh = jnp.arange(-r, r + 1, dtype=jnp.int32)
-    fix = fcx[:, :, None, None] + fsh[None, None, :, None]      # (T, B, F, 1)
-    fiy = fcy[:, :, None, None] + fsh[None, None, None, :]      # (T, B, 1, F)
-    fvals = _bilinear_gather(
-        mq_f, g, fix, fiy,
-        ffx[:, :, None, None], ffy[:, :, None, None],
-    )                                                           # (T, B, F, F)
-    score_f = jnp.sum(
-        jnp.where(ok[None, :, None, None], fvals, 0), axis=1
-    )                                                           # (T, F, F)
+    score_f = match_fine_scores(ctx, pose, pq, ok, u_best, v_best, cfg)
 
     nf = 2 * r + 1
     fbest = jnp.argmax(score_f.reshape(-1)).astype(jnp.int32)
@@ -420,12 +516,28 @@ def update_map(
     hit this revolution), clamped to ±clamp_q.  The free pass samples
     each ray at integer fractions k/S (k < S, endpoint excluded) —
     the dense-sampling stand-in for exact ray tracing, one histogram per
-    sample index, all inside the fused program."""
+    sample index, all inside the fused program.
+
+    ``cfg.match_backend`` routes the whole update through the Pallas
+    one-hot/matmul kernel (ops/pallas_scan_match.log_odds_update_pallas)
+    or the jnp arm below; both are bit-identical to the NumPy reference
+    (integer counts, integer increments — nothing order-sensitive)."""
     g = cfg.grid
     center = (g // 2) * SUB
     table = jnp.asarray(rotation_table(cfg.theta_divisions))
     cos_q = jnp.take(table[:, 0], pose[2])
     sin_q = jnp.take(table[:, 1], pose[2])
+
+    if cfg.match_backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_scan_match import (
+            log_odds_update_pallas,
+        )
+
+        posec = pose[:2] + center
+        return log_odds_update_pallas(
+            log_odds, pq, ok, posec, cos_q, sin_q, cfg
+        )
+
     wx, wy = rotate_points(pq, cos_q, sin_q)
     wx, wy = wx + pose[0] + center, wy + pose[1] + center       # (B,)
 

@@ -187,6 +187,14 @@ class ShardedFilterService:
                 f"mapper has {mapper.streams} streams, service has "
                 f"{self.streams}"
             )
+        # warm the fused tick program NOW, whatever the matcher lowering
+        # (with match_backend=pallas the score-volume and update kernels
+        # trace inside the one fleet program, so this single warm
+        # dispatch compiles every executable the live tick runs) — the
+        # first live tick must never stall on an XLA/Mosaic compile,
+        # and the steady-state guards hold from here on
+        if mapper.backend == "fused":
+            mapper.precompile()
         self.mapper = mapper
         if self.health is not None:
             # health was attached first (e.g. health_enable in the

@@ -1,4 +1,4 @@
-"""The nine graftlint rules.  Each takes the RepoIndex and yields
+"""The ten graftlint rules.  Each takes the RepoIndex and yields
 Findings; suppression/baseline handling lives in the runner."""
 
 from __future__ import annotations
@@ -748,7 +748,100 @@ def rule_gl009(index: RepoIndex):
                     )
 
 
+# ---------------------------------------------------------------------------
+# GL010 — Pallas kernels in ops/ must ride the compiled-vs-interpret selector
+# ---------------------------------------------------------------------------
+
+
+def _gl010_functions(mod):
+    """Module functions minus nested defs (a nested def rides its
+    IMMEDIATE parent's walk — the GL009 rsplit form, so closures inside
+    methods are skipped too and never double-reported)."""
+    for fn in mod.functions.values():
+        if "." in fn.qualname and fn.qualname.rsplit(".", 1)[0] in (
+            mod.functions
+        ):
+            continue
+        yield fn
+
+
+def rule_gl010(index: RepoIndex):
+    """Every ``pl.pallas_call`` under ops/ must be routed through the
+    ``_lowering_dispatch`` compiled-vs-interpret selector
+    (ops/pallas_kernels.py): a bare compiled-only kernel bricks every
+    CPU config pinned to a pallas backend the moment it lowers ("Only
+    interpret mode is supported on CPU backend").  Two locally checkable
+    obligations stand in for the full call-chain property:
+
+      * the ``pallas_call`` must take ``interpret=<param>`` where the
+        name is a parameter of the enclosing function — a missing or
+        constant ``interpret`` is a kernel nothing can ever re-lower;
+      * the module must reference (or define) ``_lowering_dispatch``,
+        the one sanctioned selector feeding those parameters.
+    """
+    for rel, mod in sorted(index.modules.items()):
+        if "/ops/" not in f"/{rel}":
+            continue
+        has_selector = "_lowering_dispatch" in mod.functions or any(
+            isinstance(n, (ast.Name, ast.Attribute))
+            and _name_of(n).rsplit(".", 1)[-1] == "_lowering_dispatch"
+            for n in ast.walk(mod.tree)
+        )
+        for fn in _gl010_functions(mod):
+            # every param of the enclosing def chain counts: the call
+            # usually sits in a helper whose own `interpret` param is
+            # threaded down from the selector
+            params = set(fn.params)
+            for inner in ast.walk(fn.node):
+                if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    params.update(
+                        a.arg for a in
+                        inner.args.posonlyargs + inner.args.args
+                        + inner.args.kwonlyargs
+                    )
+            for n in ast.walk(fn.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                _, leaf = _head_leaf(n)
+                if leaf != "pallas_call":
+                    continue
+                interp = next(
+                    (kw.value for kw in n.keywords if kw.arg == "interpret"),
+                    None,
+                )
+                msg = None
+                if interp is None or isinstance(interp, ast.Constant):
+                    msg = (
+                        f"pallas_call in {fn.qualname} with "
+                        f"{'no' if interp is None else 'a constant'} "
+                        "`interpret=` — a compiled-only kernel bricks "
+                        "every CPU config pinned to a pallas backend; "
+                        "thread an `interpret` parameter down from "
+                        "_lowering_dispatch"
+                    )
+                elif not (
+                    isinstance(interp, ast.Name) and interp.id in params
+                ):
+                    msg = (
+                        f"pallas_call in {fn.qualname} takes `interpret="
+                        f"{ast.unparse(interp)[:40]}` which is not a "
+                        "parameter of the enclosing function — the "
+                        "lowering choice must come from the "
+                        "_lowering_dispatch selector, not be computed "
+                        "in place"
+                    )
+                elif not has_selector:
+                    msg = (
+                        f"pallas_call in {fn.qualname} but the module "
+                        "never references _lowering_dispatch — without "
+                        "the compiled-vs-interpret selector a CPU-"
+                        "traced pallas config cannot lower"
+                    )
+                if msg and not mod.suppressed("GL010", n.lineno):
+                    yield Finding("GL010", rel, n.lineno, msg)
+
+
 ALL_RULES = (
     rule_gl001, rule_gl002, rule_gl003, rule_gl004, rule_gl005,
-    rule_gl006, rule_gl007, rule_gl008, rule_gl009,
+    rule_gl006, rule_gl007, rule_gl008, rule_gl009, rule_gl010,
 )

@@ -46,7 +46,7 @@ def _records(paths: list[str]):
 
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
-    "super_tick_ab", "mapping_ab",
+    "super_tick_ab", "mapping_ab", "pallas_match_ab",
 )
 
 
@@ -240,6 +240,28 @@ def analyze(records: list[dict]) -> dict:
                     "match_speedup", "per_dispatch_floor_ms",
                     "overhead_clamped",
                 ) if k in mab
+            })
+
+        # config 14: the matcher-kernel A/B (match_backend mapping).
+        # TWO clamps on top of the device=tpu rule: a clamped
+        # decomposition (no measured saving) and an interpret-mode
+        # record (the pallas arm ran the emulator, not Mosaic — a
+        # malformed device field could otherwise smuggle one in)
+        pmb = rec.get("pallas_match_ab")
+        if isinstance(pmb, dict):
+            v = pmb.get("match_speedup")
+            if isinstance(v, (int, float)) and not pmb.get(
+                "overhead_clamped"
+            ) and not pmb.get("interpret_mode"):
+                recommend("match_backend.tpu", ratio_entry(
+                    "xla", "pallas",
+                    "config14 pallas match_speedup",
+                    float(v), "pallas_match_ab",
+                ))
+            out["evidence"].setdefault("pallas_match_ab", []).append({
+                k: pmb[k] for k in (
+                    "match_speedup", "overhead_clamped", "interpret_mode",
+                ) if k in pmb
             })
 
         # ablation: resample + voxel kernels

@@ -26,6 +26,11 @@
 #      them quarantined by the health FSM under a seeded fault
 #      program — healthy-lane throughput vs the K=0 baseline, zero
 #      recompiles across quarantine/rejoin asserted)
+#  11. correlative-matcher kernel A/B (config 14: xla vs the VMEM-tiled
+#      pallas score-volume + log-odds-update kernels, bit-exact parity
+#      + zero recompiles asserted — the FIRST Mosaic compile of these
+#      kernels happens here; the match_backend decision key
+#      `pallas_match_ab` only counts on-chip, non-interpret records)
 # Override by passing commands as arguments (one quoted string each).
 #
 # WAIT_FOR_LINK_S=<seconds>: probe the backend in a throwaway child
@@ -84,7 +89,8 @@ if [ $# -eq 0 ]; then
     "python scripts/fleet_latency.py --fleet-ingest fused" \
     "python bench.py --config 11" \
     "python bench.py --config 12" \
-    "python bench.py --config 13"
+    "python bench.py --config 13" \
+    "python bench.py --config 14"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

@@ -27,6 +27,7 @@ def test_metric_names_stable():
     assert bench.metric_name(11) == "super_tick_drain_scans_per_sec"
     assert bench.metric_name(12) == "mapping_match_update_scans_per_sec"
     assert bench.metric_name(13) == "chaos_degraded_fleet_scans_per_sec"
+    assert bench.metric_name(14) == "pallas_match_kernel_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -34,6 +35,7 @@ def test_graded_table_well_formed():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
+            "pallas_match",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1024,6 +1026,124 @@ def test_bench_smoke_chaos():
     assert isinstance(out["within_5pct"], bool)
     assert isinstance(out["worst_healthy_ratio"], float)
     assert "ceiling_analysis" in out
+
+
+def test_bench_smoke_pallas_match():
+    """`bench.py --smoke-pallas-match` — the tier-1 gate for the Pallas
+    matcher kernels (config-14 A/B at seconds-scale CPU geometry, the
+    pallas arm in interpret mode).  The structural claims are what
+    matters: byte-identical xla/pallas trajectories and maps, one fused
+    dispatch per fleet tick on both arms, zero recompiles / zero
+    implicit transfers inside the timed loops (the bench itself raises
+    on violation; this gate pins that the asserted artifact lands).
+    Wall-time numbers are interpret-mode CI weather and double-clamped
+    in the decision key; kernel-level bit-exactness lives in
+    tests/test_pallas_scan_match.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-pallas-match"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(14)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claims, re-checked from the artifact
+    s = out["structural"]
+    assert s["one_dispatch_per_tick"] is True
+    assert s["zero_recompiles"] is True
+    assert s["zero_implicit_transfers"] is True
+    assert s["bit_exact_parity_holds"] is True
+    # both arms: one dispatch per tick (warm tick + timed ticks)
+    assert out["xla"]["dispatches"] == out["ticks"] + 1
+    assert out["pallas"]["dispatches"] == out["ticks"] + 1
+    # accuracy + liveness
+    assert 0 <= out["pose_err_cells"] <= 8.0
+    assert out["value"] > 0 and out["xla"]["scans_per_sec"] > 0
+    # the stage decomposition is present for both arms
+    for arm in ("xla", "pallas"):
+        d = out["decomposition_ms"][arm]
+        assert d["match_ms"] > 0 and d["update_ms"] > 0
+        assert d["refine_ms"] >= 0 and d["coarse_ms"] > 0
+    # the decision key rides with BOTH clamp flags, and a CPU run is
+    # always marked interpret-mode (the emulator, not the datapath)
+    ab = out["pallas_match_ab"]
+    assert ab["match_speedup"] > 0
+    assert isinstance(ab["overhead_clamped"], bool)
+    assert ab["interpret_mode"] is True
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_pallas_match_key():
+    """The match_backend recommendation flips from config-14 evidence
+    alone: TPU Mosaic records past the bar recommend pallas; CPU
+    records, clamped decompositions and interpret-mode records never
+    flip (the CPU artifact is interpret-mode by construction, so it is
+    doubly inert)."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        sys.path.remove(scripts_dir)
+
+    out = db.analyze([
+        {"device": "tpu",
+         "pallas_match_ab": {"match_speedup": 2.7,
+                             "overhead_clamped": False,
+                             "interpret_mode": False}},
+        {"device": "cpu",  # CPU record: no decision weight
+         "pallas_match_ab": {"match_speedup": 9.0,
+                             "overhead_clamped": False,
+                             "interpret_mode": True}},
+    ])
+    rec = out["recommendations"]["match_backend.tpu"]
+    assert rec["flip"] is True and rec["recommended"] == "pallas"
+    assert rec["value"] == 2.7  # the TPU record, not the CPU 9.0
+    assert out["evidence"]["pallas_match_ab"]
+
+    # an interpret-mode record never flips, even with device=tpu (a
+    # malformed record must not smuggle emulator numbers past the bar)
+    interp = db.analyze([
+        {"device": "tpu",
+         "pallas_match_ab": {"match_speedup": 50.0,
+                             "overhead_clamped": False,
+                             "interpret_mode": True}},
+    ])
+    assert "match_backend.tpu" not in interp["recommendations"]
+    assert interp["evidence"]["pallas_match_ab"]
+
+    # a clamped decomposition records evidence but cannot flip
+    clamped = db.analyze([
+        {"device": "tpu",
+         "pallas_match_ab": {"match_speedup": 50.0,
+                             "overhead_clamped": True,
+                             "interpret_mode": False}},
+    ])
+    assert "match_backend.tpu" not in clamped["recommendations"]
+
+    # sub-margin TPU evidence keeps xla
+    keep = db.analyze([
+        {"device": "tpu",
+         "pallas_match_ab": {"match_speedup": 1.02,
+                             "overhead_clamped": False,
+                             "interpret_mode": False}},
+    ])
+    rec = keep["recommendations"]["match_backend.tpu"]
+    assert rec["flip"] is False and rec["recommended"] == "xla"
 
 
 def test_decide_backends_mapping_key():

@@ -632,6 +632,190 @@ class TestGL009:
 
 
 # ---------------------------------------------------------------------------
+# GL010 — pallas_call must ride the compiled-vs-interpret selector
+# ---------------------------------------------------------------------------
+
+
+_GL010_GOOD = """
+    import functools
+    import jax
+    from jax.experimental import pallas as pl
+
+    def _lowering_dispatch(compiled_fn, interpret_fn, *args):
+        return jax.lax.platform_dependent(
+            *args, tpu=compiled_fn, default=interpret_fn
+        )
+
+    def _kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:]
+
+    def _call(x, interpret):
+        return pl.pallas_call(_kernel, interpret=interpret)(x)
+
+    def entry(x, interpret=None):
+        if interpret is None:
+            return _lowering_dispatch(
+                functools.partial(_call, interpret=False),
+                functools.partial(_call, interpret=True),
+                x,
+            )
+        return _call(x, interpret)
+"""
+
+
+class TestGL010:
+    def test_fires_on_missing_interpret_kwarg(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                return pl.pallas_call(_kernel)(x)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL010"]
+        assert len(msgs) == 1 and "no `interpret=`" in msgs[0]
+
+    def test_fires_on_constant_interpret(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                return pl.pallas_call(_kernel, interpret=False)(x)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL010"]
+        assert len(msgs) == 1 and "constant" in msgs[0]
+
+    def test_fires_on_computed_interpret(self, tmp_path):
+        """The lowering choice computed in place (process default
+        backend — the exact bug _lowering_dispatch exists to prevent)
+        is no better than a constant."""
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            import jax
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                interp = jax.default_backend() != "tpu"
+                return pl.pallas_call(_kernel, interpret=interp)(x)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL010"]
+        assert len(msgs) == 1 and "not a parameter" in msgs[0]
+
+    def test_fires_without_module_selector(self, tmp_path):
+        """interpret threaded as a parameter but no _lowering_dispatch
+        anywhere in the module: nothing sanctioned ever supplies it."""
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x, interpret):
+                return pl.pallas_call(_kernel, interpret=interpret)(x)
+        """})
+        msgs = [f.message for f in fs if f.rule == "GL010"]
+        assert len(msgs) == 1 and "_lowering_dispatch" in msgs[0]
+
+    def test_quiet_on_the_sanctioned_pattern(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": _GL010_GOOD})
+        assert "GL010" not in _rules(fs)
+
+    def test_quiet_on_imported_selector(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            from pkg.ops.base import _lowering_dispatch
+            import functools
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def _call(x, interpret):
+                return pl.pallas_call(_kernel, interpret=interpret)(x)
+
+            def entry(x):
+                return _lowering_dispatch(
+                    functools.partial(_call, interpret=False),
+                    functools.partial(_call, interpret=True),
+                    x,
+                )
+        """, "pkg/ops/base.py": """
+            import jax
+
+            def _lowering_dispatch(compiled_fn, interpret_fn, *args):
+                return jax.lax.platform_dependent(
+                    *args, tpu=compiled_fn, default=interpret_fn
+                )
+        """})
+        assert "GL010" not in _rules(fs)
+
+    def test_quiet_outside_ops(self, tmp_path):
+        """The rule polices ops/ — a bench-local experiment kernel is
+        not a production lowering."""
+        fs = _lint(tmp_path, {"pkg/scratch.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                return pl.pallas_call(_kernel, interpret=False)(x)
+        """})
+        assert "GL010" not in _rules(fs)
+
+    def test_suppression_with_reason_works(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                # graftlint: disable=GL010 — fixture-sanctioned TPU-only tool
+                return pl.pallas_call(_kernel, interpret=False)(x)
+        """})
+        assert "GL010" not in _rules(fs)
+
+    def test_baseline_reconcile_covers_gl010(self, tmp_path):
+        src = {"pkg/ops/k.py": """
+            from jax.experimental import pallas as pl
+
+            def _kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:]
+
+            def call(x):
+                return pl.pallas_call(_kernel)(x)
+        """}
+        (tmp_path / "pyproject.toml").write_text(BASE_CONFIG)
+        for rel, body in src.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(body))
+        findings, new, stale = run_lint(str(tmp_path))
+        target = [f for f in findings if f.rule == "GL010"][0]
+        (tmp_path / "graftlint.baseline.json").write_text(json.dumps({
+            "findings": [{
+                "rule": target.rule, "path": target.path,
+                "message": target.message,
+                "justification": "fixture: port to selector queued",
+            }, {
+                "rule": "GL010", "path": "pkg/ops/gone.py",
+                "message": "no longer fires",
+                "justification": "stale entry",
+            }]
+        }))
+        findings, new, stale = run_lint(str(tmp_path))
+        assert not any(f.key() == target.key() for f in new)
+        assert len(stale) == 1 and stale[0]["path"] == "pkg/ops/gone.py"
+
+
+# ---------------------------------------------------------------------------
 # baseline reconciliation
 # ---------------------------------------------------------------------------
 
@@ -692,7 +876,7 @@ class TestRepoClean:
         from rplidar_ros2_driver_tpu.tools.graftlint.rules import ALL_RULES
         from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
 
-        assert len(ALL_RULES) >= 9
+        assert len(ALL_RULES) >= 10
         findings, new, stale = run_lint(repo_root())
         assert new == [], [f"{f.path}:{f.line} {f.rule} {f.message}"
                            for f in new]

@@ -172,13 +172,20 @@ class TestSuperTickSteadyState:
 
 
 class TestFleetMapperSteadyState:
-    def test_zero_recompiles_zero_implicit_transfers(self):
+    @pytest.mark.parametrize("match_backend", ["xla", "pallas"])
+    def test_zero_recompiles_zero_implicit_transfers(self, match_backend):
+        """Both matcher lowerings — the jnp arm and the Pallas kernels
+        (interpret mode on this CPU backend, the exact code path a
+        pallas-pinned CPU config runs) — hold the steady-state contract
+        post-warmup: precompile() compiles every executable the live
+        tick dispatches, including the in-program Pallas calls."""
         p = _params(
             map_enable=True, map_backend="fused", map_grid=64,
-            map_cell_m=0.1,
+            map_cell_m=0.1, match_backend=match_backend,
         )
         b = 64
         m = FleetMapper(p, 2, beams=b)
+        assert m.cfg.match_backend == match_backend
         m.precompile()
         rng = np.random.default_rng(3)
 

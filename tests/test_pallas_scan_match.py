@@ -1,0 +1,489 @@
+"""Bit-exact parity suite for the Pallas correlative-matcher kernels
+(ops/pallas_scan_match.py vs the XLA arm vs the NumPy reference).
+
+The contract under test is EQUALITY, not closeness: the matcher datapath
+is int32 fixed point end to end, so the VMEM-tiled Pallas lowering
+(interpret mode on this CPU backend — the exact code path a
+pallas-pinned CPU config runs) must reproduce the XLA arm and
+ops/scan_match_ref.py byte-for-byte — poses, scores, score volumes, and
+final Q10 log-odds maps — across map geometries, fleet sizes,
+degenerate scans, score ties, and the int32 score bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.mapping.mapper import (
+    FleetMapper,
+    map_config_from_params,
+    resolve_match_backend,
+)
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    PQ_LIMIT,
+    SUB,
+    MapConfig,
+    MapState,
+    map_match_step,
+    match_scan,
+    min_quant_shift,
+    theta_offsets,
+    update_map,
+)
+from rplidar_ros2_driver_tpu.ops.scan_match_ref import (
+    create_map_state_np,
+    map_match_step_np,
+    match_scan_np,
+    quantize_points_np,
+    update_map_np,
+)
+
+pytestmark = pytest.mark.pallas
+
+BEAMS = 192
+
+
+def _cfg(grid: int = 64, beams: int = BEAMS, clamp_q: int = 8192,
+         **kw) -> MapConfig:
+    kw.setdefault("quant_shift", min_quant_shift(clamp_q, beams))
+    return MapConfig(
+        grid=grid, cell_m=0.1, beams=beams, clamp_q=clamp_q, **kw
+    )
+
+
+def _arms(cfg: MapConfig):
+    """(xla_cfg, pallas_cfg) twins of one geometry."""
+    return cfg, dataclasses.replace(cfg, match_backend="pallas")
+
+
+def _rand_inputs(rng, cfg: MapConfig, beams: int):
+    """Randomized fixed-point inputs: a structured-noise map (positive
+    blobs so matches actually accept), a pose inside the translation
+    clamp, and subcell endpoints spanning the whole quantization
+    window including its edges."""
+    g = cfg.grid
+    lo = rng.integers(-cfg.clamp_q, cfg.clamp_q + 1, (g, g), np.int32)
+    lo[rng.integers(0, g, g), rng.integers(0, g, g)] = cfg.clamp_q
+    lim = cfg.t_limit_sub
+    pose = np.asarray([
+        rng.integers(-lim // 2, lim // 2),
+        rng.integers(-lim // 2, lim // 2),
+        rng.integers(0, cfg.theta_divisions),
+    ], np.int32)
+    span = min((g // 2) * SUB, PQ_LIMIT)
+    pq = rng.integers(-span, span + 1, (beams, 2)).astype(np.int32)
+    ok = rng.uniform(size=beams) > 0.15
+    return lo, pose, pq, ok
+
+
+def _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p):
+    """match_scan on both device arms + the numpy oracle: dpose, score
+    and n_valid must be byte-equal."""
+    dp_x, s_x, n_x = match_scan(lo, pose, pq, ok, cfg_x)
+    dp_p, s_p, n_p = match_scan(lo, pose, pq, ok, cfg_p)
+    dp_n, s_n, n_n = match_scan_np(lo, pose, pq, ok, cfg_x)
+    np.testing.assert_array_equal(np.asarray(dp_x), dp_n)
+    np.testing.assert_array_equal(np.asarray(dp_p), dp_n)
+    assert int(s_x) == int(s_n) == int(s_p)
+    assert int(n_x) == int(n_n) == int(n_p)
+    return dp_n, int(s_n)
+
+
+def _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p):
+    up_x = np.asarray(update_map(lo, pose, pq, ok, cfg_x))
+    up_p = np.asarray(update_map(lo, pose, pq, ok, cfg_p))
+    up_n = update_map_np(lo, pose, pq, ok, cfg_x)
+    np.testing.assert_array_equal(up_x, up_n)
+    np.testing.assert_array_equal(up_p, up_n)
+    return up_n
+
+
+# ---------------------------------------------------------------------------
+# randomized kernel parity across the MapConfig geometry range
+# ---------------------------------------------------------------------------
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("grid", [8, 64, 256, 1024])
+    def test_match_scan_bit_exact_across_grids(self, grid):
+        """Every grid size class the MapConfig validation range admits:
+        the minimum (8), the defaults' neighborhood, and the maximum
+        (1024) — each with randomized maps, poses and scans."""
+        beams = 64 if grid >= 256 else BEAMS
+        cfg_x, cfg_p = _arms(_cfg(grid=grid, beams=beams))
+        rng = np.random.default_rng(grid)
+        for trial in range(2 if grid >= 256 else 4):
+            lo, pose, pq, ok = _rand_inputs(rng, cfg_x, beams)
+            _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+
+    @pytest.mark.parametrize("grid", [8, 64, 256])
+    def test_update_map_bit_exact_across_grids(self, grid):
+        beams = 64 if grid >= 256 else BEAMS
+        cfg_x, cfg_p = _arms(_cfg(grid=grid, beams=beams))
+        rng = np.random.default_rng(1000 + grid)
+        for trial in range(3):
+            lo, pose, pq, ok = _rand_inputs(rng, cfg_x, beams)
+            up = _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+            assert np.abs(up).max() <= cfg_x.clamp_q
+
+    def test_update_map_matches_both_voxel_arms(self):
+        """The Pallas update always uses the one-hot/matmul tiling; it
+        must equal BOTH XLA voxel-kernel arms (scatter and matmul are
+        already pinned equal to each other)."""
+        cfg_s = _cfg(voxel_backend="scatter")
+        cfg_m = dataclasses.replace(cfg_s, voxel_backend="matmul")
+        cfg_p = dataclasses.replace(cfg_s, match_backend="pallas")
+        rng = np.random.default_rng(7)
+        lo, pose, pq, ok = _rand_inputs(rng, cfg_s, BEAMS)
+        up_s = np.asarray(update_map(lo, pose, pq, ok, cfg_s))
+        up_m = np.asarray(update_map(lo, pose, pq, ok, cfg_m))
+        up_p = np.asarray(update_map(lo, pose, pq, ok, cfg_p))
+        np.testing.assert_array_equal(up_s, up_m)
+        np.testing.assert_array_equal(up_s, up_p)
+
+    def test_free_samples_zero_skips_miss_pass(self):
+        cfg_x, cfg_p = _arms(_cfg(free_samples=0))
+        rng = np.random.default_rng(8)
+        lo, pose, pq, ok = _rand_inputs(rng, cfg_x, BEAMS)
+        up = _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        # no miss pass: nothing ever decrements below the prior value
+        assert (up >= np.clip(lo, -cfg_x.clamp_q, cfg_x.clamp_q)).all()
+
+    def test_explicit_interpret_matches_dispatch_resolution(self):
+        """interpret=True pinned explicitly must equal the
+        interpret=None lowering-dispatch resolution on this CPU-only
+        process (the _lowering_dispatch contract for the matcher
+        kernels)."""
+        from rplidar_ros2_driver_tpu.ops.pallas_scan_match import (
+            coarse_scores_pallas,
+            log_odds_update_pallas,
+        )
+        import jax.numpy as jnp
+
+        cfg_x, cfg_p = _arms(_cfg())
+        rng = np.random.default_rng(9)
+        lo, pose, pq, ok = _rand_inputs(rng, cfg_x, BEAMS)
+        center = (cfg_p.grid // 2) * SUB
+        posec = jnp.asarray(pose[:2] + center)
+        trig = np.asarray([1 << 14, 0], np.int32)  # θ = 0
+        for interp in (True, None):
+            mq, sc = coarse_scores_pallas(
+                jnp.asarray(lo), jnp.asarray(pq), jnp.asarray(ok), posec,
+                jnp.asarray(trig[0]), jnp.asarray(trig[1]), cfg_p,
+                interpret=interp,
+            )
+            up = log_odds_update_pallas(
+                jnp.asarray(lo), jnp.asarray(pq), jnp.asarray(ok), posec,
+                jnp.asarray(trig[0]), jnp.asarray(trig[1]), cfg_p,
+                interpret=interp,
+            )
+            if interp is True:
+                pinned = (np.asarray(mq), np.asarray(sc), np.asarray(up))
+            else:
+                np.testing.assert_array_equal(np.asarray(mq), pinned[0])
+                np.testing.assert_array_equal(np.asarray(sc), pinned[1])
+                np.testing.assert_array_equal(np.asarray(up), pinned[2])
+
+
+# ---------------------------------------------------------------------------
+# degenerate scans
+# ---------------------------------------------------------------------------
+
+
+class TestDegenerate:
+    def test_all_invalid_scan(self):
+        cfg_x, cfg_p = _arms(_cfg())
+        rng = np.random.default_rng(11)
+        lo, pose, pq, _ = _rand_inputs(rng, cfg_x, BEAMS)
+        ok = np.zeros(BEAMS, bool)
+        dp, score = _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        assert score == 0 and tuple(dp) == (0, 0, 0)
+        up = _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        np.testing.assert_array_equal(
+            up, np.clip(lo, -cfg_x.clamp_q, cfg_x.clamp_q)
+        )
+
+    def test_single_beam_scan(self):
+        cfg_x, cfg_p = _arms(_cfg())
+        rng = np.random.default_rng(12)
+        lo, pose, pq, _ = _rand_inputs(rng, cfg_x, BEAMS)
+        ok = np.zeros(BEAMS, bool)
+        ok[0] = True
+        _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+
+    def test_far_point_int32_wrap_guard(self):
+        """Endpoints at the subcell clamp boundary (±PQ_LIMIT — the
+        quantizer's int32-wrap guard): the rotated coordinates reach
+        their extreme magnitudes and the off-map gathers must drop them
+        identically on every arm, with no wrap divergence."""
+        cfg_x, cfg_p = _arms(_cfg())
+        pq = np.asarray(
+            [[PQ_LIMIT, PQ_LIMIT], [-PQ_LIMIT, PQ_LIMIT],
+             [PQ_LIMIT, -PQ_LIMIT], [-PQ_LIMIT, -PQ_LIMIT],
+             [PQ_LIMIT, 0], [0, -PQ_LIMIT]] + [[0, 0]] * (BEAMS - 6),
+            np.int32,
+        )
+        ok = np.ones(BEAMS, bool)
+        rng = np.random.default_rng(13)
+        lo = rng.integers(0, cfg_x.clamp_q + 1, (64, 64), np.int32)
+        for th in (0, 137, 359):
+            pose = np.asarray([0, 0, th], np.int32)
+            _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+            _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+
+    def test_nonfinite_float_points_quantize_identically(self):
+        """The float quantizer upstream of the kernels drops NaN/inf
+        and out-of-window points BEFORE the cast; the full step (float
+        points in) must stay bit-exact on the pallas arm too."""
+        cfg_x, cfg_p = _arms(_cfg())
+        pts = np.full((BEAMS, 2), np.inf, np.float32)
+        pts[: BEAMS // 2] = np.nan
+        mask = np.ones(BEAMS, bool)
+        pq, ok = quantize_points_np(pts, mask, cfg_x)
+        assert not ok.any()
+        st_p = MapState.create(cfg_p)
+        st_p, wire = map_match_step(st_p, pts, mask, np.int32(1), cfg=cfg_p)
+        st_n, wire_n = map_match_step_np(
+            create_map_state_np(cfg_x), pts, mask, 1, cfg_x
+        )
+        np.testing.assert_array_equal(np.asarray(wire), wire_n)
+        assert np.count_nonzero(np.asarray(st_p.log_odds)) == 0
+
+
+# ---------------------------------------------------------------------------
+# score ties: first-max-wins argmax survives the tiling
+# ---------------------------------------------------------------------------
+
+
+class TestScoreTies:
+    def test_uniform_map_picks_first_candidate_in_c_order(self):
+        """A uniformly positive map scores EVERY candidate identically,
+        so the winner is pure tie-break: flat index 0 of the coarse
+        (U, V) plane, then flat index 0 of the fine (T, F, F) volume —
+        i.e. u=-w, v=-w, θ=first offset, du=-r, dv=-r.  All three arms
+        must agree on exactly that candidate."""
+        cfg_x, cfg_p = _arms(_cfg())
+        lo = np.full((64, 64), 4096, np.int32)
+        # one central beam: its window gathers stay on-map for every
+        # candidate shift, keeping the tie perfect
+        pq = np.zeros((BEAMS, 2), np.int32)
+        ok = np.zeros(BEAMS, bool)
+        ok[0] = True
+        pose = np.zeros(3, np.int32)
+        dp, score = _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        assert score > 0
+        w, r, c = cfg_x.window_cells, cfg_x.fine_radius, cfg_x.coarse
+        dth = theta_offsets(cfg_x)
+        expect = np.asarray(
+            [(-w * c - r) * SUB, (-w * c - r) * SUB, dth[0]], np.int32
+        )
+        np.testing.assert_array_equal(dp, expect)
+
+    def test_two_way_tie_earlier_flat_index_wins(self):
+        """Two disjoint occupied blobs placed so two translation
+        candidates score equally: the earlier flat index must win on
+        every arm (a tiled lowering that reordered its reduction or
+        argmax would flip this)."""
+        cfg_x, cfg_p = _arms(_cfg())
+        g, c = cfg_x.grid, cfg_x.coarse
+        lo = np.zeros((g, g), np.int32)
+        # symmetric pair around the beam's landing cell: candidates
+        # +d and -d see mirror-identical mass
+        center_cell = g // 2
+        for d in (2, 6):
+            lo[center_cell - d, center_cell] = 4096
+            lo[center_cell + d, center_cell] = 4096
+        pq = np.zeros((BEAMS, 2), np.int32)
+        ok = np.zeros(BEAMS, bool)
+        ok[0] = True
+        pose = np.zeros(3, np.int32)
+        dp_n, s_n, _ = match_scan_np(lo, pose, pq, ok, cfg_x)
+        dp, score = _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        assert score == int(s_n) and score > 0
+        # the accepted delta is the numpy oracle's first-max candidate
+        np.testing.assert_array_equal(dp, dp_n)
+
+
+# ---------------------------------------------------------------------------
+# quant_shift boundary at the int32 score bound
+# ---------------------------------------------------------------------------
+
+
+class TestQuantShiftBoundary:
+    def test_saturated_map_at_min_quant_shift_stays_exact(self):
+        """clamp_q and beams chosen so min_quant_shift is the LAST
+        shift keeping (clamp >> shift) * 1024 * beams under 2^31, the
+        map saturated at clamp everywhere and every beam valid on one
+        cell: scores sit near the int32 bound, where any extra or
+        missing shift — or a 64-bit accumulation detour — would
+        diverge.  All three arms must agree bit-for-bit."""
+        beams, clamp_q = 2048, 16384
+        shift = min_quant_shift(clamp_q, beams)
+        assert shift > 0  # the bound is actually binding
+        assert (clamp_q >> shift) * SUB * SUB * beams < 2**31
+        assert (clamp_q >> (shift - 1)) * SUB * SUB * beams >= 2**31
+        cfg_x = MapConfig(
+            grid=64, cell_m=0.1, beams=beams, clamp_q=clamp_q,
+            quant_shift=shift,
+        )
+        cfg_p = dataclasses.replace(cfg_x, match_backend="pallas")
+        lo = np.full((64, 64), clamp_q, np.int32)
+        pq = np.zeros((beams, 2), np.int32)  # all beams on the centre
+        ok = np.ones(beams, bool)
+        pose = np.zeros(3, np.int32)
+        dp, score = _assert_match_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+        # every gather corner hits clamp>>shift with full Σw weight
+        assert score == (clamp_q >> shift) * SUB * SUB * beams
+        _assert_update_parity(lo, pose, pq, ok, cfg_x, cfg_p)
+
+
+# ---------------------------------------------------------------------------
+# fleet-level parity through the mapper (vmapped dispatch + checkpoint)
+# ---------------------------------------------------------------------------
+
+
+def _params(**kw) -> DriverParams:
+    base = dict(
+        dummy_mode=True,
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        map_enable=True,
+        map_backend="host",
+        map_grid=64,
+        map_cell_m=0.1,
+    )
+    base.update(kw)
+    return DriverParams(**base)
+
+
+def _room_points(pose_xyt, n: int, half: float = 2.5):
+    t = np.linspace(0, 2 * np.pi, n, endpoint=False)
+    dx, dy = np.cos(t), np.sin(t)
+    with np.errstate(divide="ignore"):
+        r = np.minimum(
+            np.where(np.abs(dx) > 1e-12, half / np.abs(dx), np.inf),
+            np.where(np.abs(dy) > 1e-12, half / np.abs(dy), np.inf),
+        )
+    wx, wy = dx * r, dy * r
+    x0, y0, th = pose_xyt
+    c, s = np.cos(-th), np.sin(-th)
+    px = c * (wx - x0) - s * (wy - y0)
+    py = s * (wx - x0) + c * (wy - y0)
+    return np.stack([px, py], 1).astype(np.float32), np.ones(n, bool)
+
+
+def _fleet_inputs(streams: int, tick: int, beams: int):
+    pts = np.zeros((streams, beams, 2), np.float32)
+    masks = np.zeros((streams, beams), bool)
+    live = np.zeros((streams,), np.int32)
+    for s in range(streams):
+        if (tick + s) % 4 == 3:
+            continue  # idle this tick
+        pose = (0.04 * tick * (1 + 0.3 * s), -0.03 * tick, 0.003 * tick)
+        p, m = _room_points(pose, beams)
+        rng = np.random.default_rng(100 * s + tick)
+        m &= rng.uniform(size=beams) > 0.1
+        pts[s], masks[s] = p, m
+        live[s] = 1
+    return pts, masks, live
+
+
+class TestFleetParity:
+    @pytest.mark.parametrize("streams", [1, 3, 8])
+    def test_pallas_fleet_bit_exact_vs_host_with_restore(self, streams):
+        """The acceptance bar: fused+pallas fleets 1/3/8 vs N numpy host
+        steps, byte-equal estimates and final maps, INCLUDING a
+        snapshot/restore cycle mid-run (the restored mapper must resume
+        on the same byte trajectory)."""
+        beams = 128
+        host = FleetMapper(_params(), streams, beams=beams)
+        pal = FleetMapper(
+            _params(map_backend="fused", match_backend="pallas"),
+            streams, beams=beams,
+        )
+        assert pal.cfg.match_backend == "pallas"
+        for tick in range(3):
+            pts, masks, live = _fleet_inputs(streams, tick, beams)
+            eh = host.submit_points(pts, masks, live)
+            ep = pal.submit_points(pts, masks, live)
+            for s in range(streams):
+                if eh[s] is None:
+                    assert ep[s] is None
+                    continue
+                np.testing.assert_array_equal(eh[s].pose_q, ep[s].pose_q)
+                assert eh[s].score == ep[s].score
+                assert eh[s].matched_points == ep[s].matched_points
+        # snapshot/restore cycle: resume and stay on the byte trajectory
+        snap = pal.snapshot()
+        resumed = FleetMapper(
+            _params(map_backend="fused", match_backend="pallas"),
+            streams, beams=beams,
+        )
+        assert resumed.restore(snap) is True
+        for tick in range(3, 5):
+            pts, masks, live = _fleet_inputs(streams, tick, beams)
+            eh = host.submit_points(pts, masks, live)
+            er = resumed.submit_points(pts, masks, live)
+            for s in range(streams):
+                if eh[s] is not None:
+                    np.testing.assert_array_equal(
+                        eh[s].pose_q, er[s].pose_q
+                    )
+        sh, sr = host.snapshot(), resumed.snapshot()
+        for k in sh:
+            np.testing.assert_array_equal(sh[k], sr[k])
+        assert resumed.dispatch_count == 2  # one vmapped dispatch per tick
+
+    def test_pallas_vs_xla_fused_identical_programs(self):
+        """fused+xla and fused+pallas land identical wires and maps over
+        the same tick stream (the two device arms of bench config 14)."""
+        beams = 128
+        fx = FleetMapper(
+            _params(map_backend="fused", match_backend="xla"), 2,
+            beams=beams,
+        )
+        fp = FleetMapper(
+            _params(map_backend="fused", match_backend="pallas"), 2,
+            beams=beams,
+        )
+        for tick in range(4):
+            pts, masks, live = _fleet_inputs(2, tick, beams)
+            ex = fx.submit_points(pts, masks, live)
+            ep = fp.submit_points(pts, masks, live)
+            for s in range(2):
+                if ex[s] is not None:
+                    np.testing.assert_array_equal(ex[s].pose_q, ep[s].pose_q)
+        sx, sp = fx.snapshot(), fp.snapshot()
+        for k in sx:
+            np.testing.assert_array_equal(sx[k], sp[k])
+
+
+# ---------------------------------------------------------------------------
+# config plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSeam:
+    def test_resolver(self):
+        assert resolve_match_backend("auto") == "xla"
+        assert resolve_match_backend("auto", "tpu") == "xla"  # clamped
+        assert resolve_match_backend("pallas") == "pallas"
+        assert resolve_match_backend("xla", "cpu") == "xla"
+
+    def test_params_flow_to_map_config(self):
+        cfg = map_config_from_params(_params(match_backend="pallas"), 128)
+        assert cfg.match_backend == "pallas"
+        cfg = map_config_from_params(_params(), 128)
+        assert cfg.match_backend == "xla"  # auto resolves clamped
+
+    def test_param_validation(self):
+        _params(match_backend="pallas").validate()
+        with pytest.raises(ValueError, match="match_backend"):
+            _params(match_backend="mosaic").validate()
+        with pytest.raises(ValueError, match="match_backend"):
+            MapConfig(match_backend="auto")  # must be resolved by then
