@@ -208,6 +208,36 @@ class DriverParams:
     health_backoff_base_s: float = 0.5
     health_backoff_max_s: float = 30.0
     health_backoff_jitter: float = 0.1
+    # -- elastic fleet / shard failover (parallel/service.
+    # ElasticFleetService + driver/health.ShardHealth) --
+    # number of shards in the fleet-of-fleets pod: each shard is one
+    # fused engine pair (FleetFusedIngest + FleetMapper) hosting
+    # `shard_lanes` stream lanes; streams are placed onto shards by
+    # parallel/sharding.FleetTopology and migrate between them with
+    # zero recompiles (membership changes relabel lanes, never shapes).
+    # 1 = single-shard (no failover headroom — nowhere to evacuate to).
+    shard_count: int = 1
+    # stream lanes compiled per shard: 0 = auto, the smallest count
+    # that survives one full shard loss ((shards-1)*lanes >= streams).
+    # The idle lanes are the evacuation headroom AND the padding lanes
+    # quarantined streams already ride.
+    shard_lanes: int = 0
+    # shard health FSM thresholds (UP -> SUSPECT -> LOST ->
+    # READMITTING): fleet-wide tick starvation walks a shard to LOST;
+    # a raised dispatch or a chaos kill is LOST immediately.
+    shard_starvation_ticks: int = 8   # all-lane dry ticks -> bad
+    shard_suspect_ticks: int = 4      # consecutive bad ticks -> LOST
+    shard_probation_ticks: int = 4    # productive readmitting ticks -> UP
+    # capped exponential backoff + probe gate on shard re-admission
+    shard_backoff_base_s: float = 1.0
+    shard_backoff_max_s: float = 60.0
+    shard_backoff_jitter: float = 0.1
+    # cadence of the per-stream snapshot pulls that feed the evacuation
+    # store (row-sized gather + host fetch per stream, every N ticks):
+    # on shard loss, each victim restores from its LAST pulled snapshot
+    # — ticks since it are lost, so the cadence bounds the loss window.
+    # 0 disables pulls (victims restore as fresh streams).
+    failover_snapshot_ticks: int = 8
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -292,6 +322,30 @@ class DriverParams:
             )
         if not (0.0 <= self.health_backoff_jitter <= 1.0):
             raise ValueError("health_backoff_jitter must be within [0, 1]")
+        if self.shard_count < 1:
+            raise ValueError("shard_count must be >= 1")
+        if self.shard_lanes < 0:
+            raise ValueError("shard_lanes must be >= 0 (0 = auto)")
+        if self.shard_starvation_ticks < 1:
+            raise ValueError("shard_starvation_ticks must be >= 1")
+        if self.shard_suspect_ticks < 1:
+            raise ValueError("shard_suspect_ticks must be >= 1")
+        if self.shard_probation_ticks < 1:
+            raise ValueError("shard_probation_ticks must be >= 1")
+        if self.shard_backoff_base_s <= 0:
+            raise ValueError("shard_backoff_base_s must be positive")
+        if self.shard_backoff_max_s < self.shard_backoff_base_s:
+            raise ValueError(
+                "shard_backoff_max_s must be >= shard_backoff_base_s "
+                "(the cap bounds the exponential, it cannot undercut it)"
+            )
+        if not (0.0 <= self.shard_backoff_jitter <= 1.0):
+            raise ValueError("shard_backoff_jitter must be within [0, 1]")
+        if self.failover_snapshot_ticks < 0:
+            raise ValueError(
+                "failover_snapshot_ticks must be >= 0 (0 disables the "
+                "periodic snapshot pulls)"
+            )
         if self.ingest_backend not in ("auto", "host", "fused"):
             raise ValueError(
                 "ingest_backend must be 'auto', 'host' or 'fused'"

@@ -296,6 +296,88 @@ class ChaosTransport:
         return getattr(self._tx, name)
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardChaosConfig:
+    """One seeded SHARD-loss fault program — the chaos plane of the
+    elastic fleet (parallel/service.ElasticFleetService).  Where
+    :class:`ChaosConfig` damages a stream's bytes, this kills whole
+    shards: every hosted stream's engine state vanishes at once (a chip
+    falling out of the pod), and the pod must evacuate the victims onto
+    surviving shards' idle lanes from their last per-stream snapshots.
+
+    Same one-schedule discipline as the frame program: whether shard
+    ``s`` is down at tick ``t`` is a pure function of ``(seed, s, t)``,
+    so a kill->evacuate->re-admit cycle replays identically in tests,
+    the bench, and the host-golden replay harness.
+
+    ``kills`` holds explicit ``(shard, start_tick, stop_tick)`` outages
+    (stop 0 = never recovers); ``kill_rate``/``outage_ticks`` add
+    seeded random outages on top (an outage of ``outage_ticks`` ticks
+    begins at tick ``t0`` iff the per-index draw fires there).
+    """
+
+    seed: int = 0
+    kills: tuple = ()
+    kill_rate: float = 0.0
+    outage_ticks: int = 0
+
+    def __post_init__(self) -> None:
+        if not (0.0 <= self.kill_rate <= 1.0):
+            raise ValueError(
+                f"kill_rate must be within [0, 1], got {self.kill_rate}"
+            )
+        if self.kill_rate > 0.0 and self.outage_ticks < 1:
+            raise ValueError(
+                "kill_rate needs outage_ticks >= 1 (a zero-length outage "
+                "kills nothing)"
+            )
+        for k in self.kills:
+            if len(k) != 3:
+                raise ValueError(
+                    "kills entries are (shard, start_tick, stop_tick) "
+                    f"triples, got {k!r}"
+                )
+            shard, start, stop = k
+            if shard < 0 or start < 0 or stop < 0:
+                raise ValueError(f"kills entry {k!r} has negative fields")
+            if stop and stop <= start:
+                raise ValueError(
+                    f"kills entry {k!r}: stop_tick must exceed start_tick "
+                    "(0 = never recovers)"
+                )
+
+
+class ShardChaosSchedule:
+    """Stateless per-(shard, tick) outage resolver — the pure core the
+    pod service, the failover bench and the replay harness all share."""
+
+    def __init__(self, cfg: ShardChaosConfig) -> None:
+        self.cfg = cfg
+
+    def down(self, shard: int, tick: int) -> bool:
+        """Whether ``shard`` is dead at ``tick`` — deterministic,
+        identical for every consumer (the shard-level analog of
+        :meth:`ChaosSchedule.plan`)."""
+        cfg = self.cfg
+        for s, start, stop in cfg.kills:
+            if s == shard and start <= tick and (stop == 0 or tick < stop):
+                return True
+        if cfg.kill_rate > 0.0:
+            lo = max(0, tick - cfg.outage_ticks + 1)
+            for t0 in range(lo, tick + 1):
+                u = np.random.default_rng(
+                    (cfg.seed, shard, t0)
+                ).random()
+                if u < cfg.kill_rate:
+                    return True
+        return False
+
+    def down_shards(self, tick: int, shards: int) -> frozenset:
+        return frozenset(
+            s for s in range(shards) if self.down(s, tick)
+        )
+
+
 def chaos_ticks(ticks: list, stream_cfgs: dict) -> list:
     """Apply per-stream fault programs to a whole fleet tick list (the
     ``submit_bytes`` layout: ``ticks[t][i] = (ans, [(payload, ts), ...])``

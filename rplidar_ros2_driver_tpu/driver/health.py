@@ -187,6 +187,29 @@ def probe_ok(result) -> bool:
         return False
 
 
+def gated_release(clock, release_at: float, probe, backoff) -> tuple:
+    """The ONE backoff+probe re-admission gate, shared by
+    :meth:`StreamHealth.poll_release` and
+    :meth:`ShardHealth.poll_readmit` so the semantics (probe exceptions
+    count as failures, :func:`probe_ok` interpretation, escalated — not
+    reset — re-arm on failure) cannot drift between the two FSMs.
+
+    Returns ``("wait", None)`` while the backoff has not expired,
+    ``("failed", rearm_at)`` when the probe refused (caller records the
+    failure + new release time), or ``("pass", None)``.
+    """
+    if clock() < release_at:
+        return "wait", None
+    if probe is not None:
+        try:
+            result = probe()
+        except Exception:
+            result = None
+        if not probe_ok(result):
+            return "failed", clock() + backoff.next_delay()
+    return "pass", None
+
+
 class StreamHealth:
     """One stream's health FSM (see module diagram).
 
@@ -324,20 +347,18 @@ class StreamHealth:
         RECOVERING.  A failed probe re-arms the (escalated) backoff."""
         if self.state is not StreamState.QUARANTINED:
             return None
-        if self._clock() < self.release_at:
+        verdict, rearm = gated_release(
+            self._clock, self.release_at, self.probe, self.backoff
+        )
+        if verdict == "wait":
             return None
-        if self.probe is not None:
-            try:
-                result = self.probe()
-            except Exception:
-                result = None
-            if not probe_ok(result):
-                self.reconnect_failures += 1
-                self.release_at = self._clock() + self.backoff.next_delay()
-                self.last_reason = (
-                    f"health probe failed x{self.reconnect_failures}"
-                )
-                return None
+        if verdict == "failed":
+            self.reconnect_failures += 1
+            self.release_at = rearm
+            self.last_reason = (
+                f"health probe failed x{self.reconnect_failures}"
+            )
+            return None
         self._clear_signals()
         self.last_reason = "backoff expired, probe ok"
         return self._to(StreamState.RECOVERING)
@@ -358,6 +379,257 @@ class StreamHealth:
             "quarantines": self.quarantines,
             "recoveries": self.recoveries,
             "reconnect_failures": self.reconnect_failures,
+            "backoff_attempt": self.backoff.attempt,
+            "backoff_s": round(self.backoff.last_delay_s, 3),
+            "reason": self.last_reason,
+        }
+
+
+# ---------------------------------------------------------------------------
+# shard-level health (the fleet-of-fleets layer above the per-stream FSM)
+# ---------------------------------------------------------------------------
+
+
+class ShardState(enum.Enum):
+    UP = "up"
+    SUSPECT = "suspect"
+    LOST = "lost"
+    READMITTING = "readmitting"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardHealthConfig:
+    """Thresholds for one SHARD's FSM (defaults mirror
+    core/config.DriverParams.shard_*).  A shard is a whole engine pair
+    hosting several streams (parallel/service.ElasticFleetService), so
+    its failure signals differ from a stream's: a dead dispatch
+    (heartbeat) is LOST immediately — there is no "maybe" about an
+    exception out of the compiled tick — while fleet-wide tick
+    starvation (zero completions anywhere while bytes are offered on
+    its lanes — or while a previously streaming shard sits silent,
+    like a sick cable: the upstream going quiet is a loss signal too)
+    walks UP -> SUSPECT -> LOST like a sick cable would."""
+
+    starvation_ticks: int = 8    # all-lane dry ticks while offered -> bad
+    suspect_ticks: int = 4       # consecutive bad ticks -> LOST
+    probation_ticks: int = 4     # clean ticks in READMITTING -> UP
+    backoff_base_s: float = 1.0  # re-admission probe backoff
+    backoff_max_s: float = 60.0
+    backoff_jitter: float = 0.1
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.starvation_ticks < 1:
+            raise ValueError("starvation_ticks must be >= 1")
+        if self.suspect_ticks < 1:
+            raise ValueError("suspect_ticks must be >= 1")
+        if self.probation_ticks < 1:
+            raise ValueError("probation_ticks must be >= 1")
+        if self.backoff_base_s <= 0 or self.backoff_max_s < (
+            self.backoff_base_s
+        ):
+            raise ValueError("need 0 < backoff_base_s <= backoff_max_s")
+        if not (0.0 <= self.backoff_jitter <= 1.0):
+            raise ValueError("backoff_jitter must be within [0, 1]")
+
+    @classmethod
+    def from_params(cls, params) -> "ShardHealthConfig":
+        """The one params -> ShardHealthConfig mapping (DriverParams
+        carries these as ``shard_*``, param/rplidar.yaml is the
+        deployment source of truth)."""
+        g = lambda k, d: getattr(params, k, d)  # noqa: E731 - tiny local
+        return cls(
+            starvation_ticks=int(g("shard_starvation_ticks", 8)),
+            suspect_ticks=int(g("shard_suspect_ticks", 4)),
+            probation_ticks=int(g("shard_probation_ticks", 4)),
+            backoff_base_s=float(g("shard_backoff_base_s", 1.0)),
+            backoff_max_s=float(g("shard_backoff_max_s", 60.0)),
+            backoff_jitter=float(g("shard_backoff_jitter", 0.1)),
+        )
+
+
+class ShardHealth:
+    """One shard's health FSM::
+
+        UP ──starved×K──► SUSPECT ──bad×S──► LOST ◄────────┐
+        ▲        │ clean                        │ backoff   │ relapse
+        │◄───────┘                              │ + probe OK│ (escalated)
+        │                                       ▼           │
+        └──────────clean×P────────────── READMITTING ───────┘
+
+    plus the hard edge every state except LOST has: ``force_lost`` (a
+    heartbeat failure — the shard's dispatch raised, or the chaos
+    schedule killed it) goes straight to LOST, no probation.
+
+    Drive it with one :meth:`observe` per tick while hosting streams
+    and one :meth:`poll_readmit` per tick while LOST.  Host-side only
+    (no jax), ``clock``-injected like :class:`StreamHealth`.
+    """
+
+    def __init__(
+        self,
+        cfg: Optional[ShardHealthConfig] = None,
+        shard_id: int = 0,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        probe: Optional[Callable[[], object]] = None,
+    ) -> None:
+        self.cfg = cfg or ShardHealthConfig()
+        self.shard_id = shard_id
+        self._clock = clock
+        self.probe = probe
+        self.state = ShardState.UP
+        self.backoff = BackoffPolicy(
+            self.cfg.backoff_base_s,
+            self.cfg.backoff_max_s,
+            self.cfg.backoff_jitter,
+            seed=self.cfg.seed * 131071 + shard_id,
+        )
+        self.release_at = 0.0
+        self._starved = 0
+        self._bad_streak = 0
+        self._clean_streak = 0
+        self._streaming = False  # any lane ever completed a revolution?
+        # cumulative counters (diagnostics surface)
+        self.losses = 0
+        self.readmissions = 0
+        self.probe_failures = 0
+        self.last_reason = ""
+
+    def _to(self, new: ShardState) -> tuple:
+        old, self.state = self.state, new
+        log.info(
+            "shard %d health: %s -> %s (%s)",
+            self.shard_id, old.value, new.value, self.last_reason or "-",
+        )
+        return (old, new)
+
+    def _enter_lost(self) -> tuple:
+        self.losses += 1
+        self.release_at = self._clock() + self.backoff.next_delay()
+        self._starved = 0
+        self._bad_streak = 0
+        self._clean_streak = 0
+        # the loss wipes the shard's engines (cold_reset): it is
+        # factually a fresh shard, so "has it ever streamed" restarts
+        # too.  Carrying _streaming across the loss would make an
+        # empty re-admitted shard (rebalance found no stream to give
+        # it) starve on silence and relapse forever — a permanent
+        # LOST/READMITTING flap on healthy hardware
+        self._streaming = False
+        return self._to(ShardState.LOST)
+
+    def force_lost(self, reason: str = "heartbeat failure") -> Optional[tuple]:
+        """Hard kill: dispatch raised / chaos schedule / operator drain.
+        No probation — the shard's device state is gone either way."""
+        if self.state is ShardState.LOST:
+            return None
+        self.last_reason = reason
+        return self._enter_lost()
+
+    def observe(self, offered: bool, completed: int) -> Optional[tuple]:
+        """One hosted tick's aggregate signals: whether any lane was
+        offered bytes, and how many revolutions completed across all
+        lanes.  LOST shards host nothing and must not be fed here."""
+        if self.state is ShardState.LOST:
+            return None
+        if completed > 0:
+            self._starved = 0
+            self._streaming = True
+            bad = False
+        elif offered or self._streaming:
+            self._starved += 1
+            # READMITTING gets ONE extra starvation window: the
+            # migrate-back reset every victim's decode carries, so the
+            # first revolution structurally needs up to a full window
+            # of dry ticks before silence is evidence of anything — a
+            # healthy shard must not be condemned to relapse on every
+            # re-admission.  A dead shard still relapses (promotion
+            # needs PRODUCTIVE ticks), one window later.
+            limit = self.cfg.starvation_ticks * (
+                2 if self.state is ShardState.READMITTING else 1
+            )
+            bad = self._starved > limit
+            if bad:
+                self.last_reason = f"shard starved {self._starved} ticks"
+        else:
+            bad = False  # nothing offered, never streamed: idle shard
+        if bad:
+            self._bad_streak += 1
+            self._clean_streak = 0
+        elif completed > 0 or not (offered or self._streaming):
+            self._clean_streak += 1
+            self._bad_streak = 0
+        else:
+            # offered but dry, below the starvation threshold: neither
+            # clean nor bad.  A clean streak must be PRODUCTIVE ticks
+            # (or true idle) — otherwise a probe-passing-but-dead shard
+            # fills probation_ticks of silence before starvation can
+            # fire, gets promoted with its backoff reset, and flaps
+            # forever at the base delay with streams migrated onto it
+            # each cycle (the relapse edge below would be dead code
+            # whenever probation_ticks <= starvation_ticks)
+            self._bad_streak = 0
+        if self.state is ShardState.UP:
+            if bad:
+                return self._to(ShardState.SUSPECT)
+        elif self.state is ShardState.SUSPECT:
+            if self._bad_streak >= self.cfg.suspect_ticks:
+                return self._enter_lost()
+            if self._clean_streak >= self.cfg.probation_ticks:
+                self.last_reason = "probation clean"
+                return self._to(ShardState.UP)
+        elif self.state is ShardState.READMITTING:
+            if bad:
+                # relapse: straight back, backoff ESCALATED (not reset)
+                return self._enter_lost()
+            if self._clean_streak >= self.cfg.probation_ticks:
+                self.last_reason = "readmitted"
+                self.readmissions += 1
+                self.backoff.reset()
+                return self._to(ShardState.UP)
+        return None
+
+    def poll_readmit(self) -> Optional[tuple]:
+        """Re-admission gate, once per tick while LOST: after the
+        capped backoff expires the shard must also pass its probe (when
+        wired — the pod wires the chaos schedule's liveness there, a
+        real deployment wires a device/host health check) before it
+        re-enters as READMITTING.  A failed probe re-arms the
+        escalated backoff."""
+        if self.state is not ShardState.LOST:
+            return None
+        verdict, rearm = gated_release(
+            self._clock, self.release_at, self.probe, self.backoff
+        )
+        if verdict == "wait":
+            return None
+        if verdict == "failed":
+            self.probe_failures += 1
+            self.release_at = rearm
+            self.last_reason = (
+                f"readmission probe failed x{self.probe_failures}"
+            )
+            return None
+        self._starved = 0
+        self._bad_streak = 0
+        self._clean_streak = 0
+        self.last_reason = "backoff expired, probe ok"
+        return self._to(ShardState.READMITTING)
+
+    @property
+    def hosting(self) -> bool:
+        """Whether this shard can host streams (LOST shards host
+        nothing; their lanes were evacuated)."""
+        return self.state is not ShardState.LOST
+
+    def status(self) -> dict:
+        """Host dict for /diagnostics-style reporting."""
+        return {
+            "state": self.state.value,
+            "losses": self.losses,
+            "readmissions": self.readmissions,
+            "probe_failures": self.probe_failures,
             "backoff_attempt": self.backoff.attempt,
             "backoff_s": round(self.backoff.last_delay_s, 3),
             "reason": self.last_reason,

@@ -515,14 +515,13 @@ class FleetFusedIngest:
         self._icfg = None                           # active FleetIngestConfig
         # the carried state's SHAPE is format-independent (prev plane at
         # the global max payload width), so it is created once here and
-        # survives every format-set recompile untouched
-        self._state = self._place(create_fleet_ingest_state(
-            fleet_ingest_config_for(
-                (Ans.MEASUREMENT,), self.timing, self.cfg,
-                max_nodes=self.max_nodes, max_revs=self.max_revs,
-            ),
-            streams,
-        ))
+        # survives every format-set recompile untouched.  The cold_reset
+        # host template is NOT captured here: only the elastic pod ever
+        # cold-resets, and the capture costs a D2H fetch plus a
+        # permanently retained host copy of the whole fleet state —
+        # single-shard deployments skip both (capture_cold_template).
+        self._fresh_host = None
+        self._state = self._fresh_fleet_state()
         self._pending: deque = deque()
         self._max_queue = max_queue
         # structural counters (the bench decomposition's O(1) assertion)
@@ -560,6 +559,63 @@ class FleetFusedIngest:
         )
 
         return place_fleet_ingest_state(self.mesh, state)
+
+    def _fresh_fleet_state(self):
+        """A placed all-fresh fleet state — the __init__ construction,
+        shared with :meth:`cold_reset` so the two can never drift.  The
+        shape is format-independent, so the baseline single-format
+        config describes every lane."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            create_fleet_ingest_state,
+            fleet_ingest_config_for,
+        )
+
+        return self._place(create_fleet_ingest_state(
+            fleet_ingest_config_for(
+                (Ans.MEASUREMENT,), self.timing, self.cfg,
+                max_nodes=self.max_nodes, max_revs=self.max_revs,
+            ),
+            self.streams,
+        ))
+
+    def capture_cold_template(self) -> None:
+        """Capture the host-side :meth:`cold_reset` template (one D2H
+        fetch of a fresh state, retained for the engine's lifetime).
+        The elastic pod calls this at precompile — before traffic, so
+        the fetch never lands inside a guarded loop — and it is the
+        only cold_reset caller; everyone else skips the cost."""
+        if self._fresh_host is None:
+            self._fresh_host = self._jax.device_get(
+                self._fresh_fleet_state()
+            )
+
+    def cold_reset(self) -> None:
+        """Device-loss reinitialization — the elastic fleet's shard-kill
+        / re-admission entry point (parallel/service.ElasticFleetService):
+        every lane's device state is replaced with a fresh one and every
+        host tracker cleared, exactly as if this engine had just been
+        constructed on a rebooted chip.  Unlike :meth:`reset` (scan
+        stop/start — filter windows survive) nothing survives here: the
+        pod wipes a lost shard the moment it dies so a later re-admission
+        provably rebuilds from per-stream snapshots, never from stale
+        device state.  The fresh state is an explicit placement of the
+        host template (guard-safe: one declared device_put, no compiles,
+        inside a guarded steady-state loop) — re-creating the jnp state
+        here instead would trip the transfer sentinel on its fill-value
+        scalar uploads."""
+        if self._fresh_host is None:
+            raise RuntimeError(
+                "capture_cold_template() must run before cold_reset() "
+                "(the elastic pod captures it at precompile, before "
+                "traffic)"
+            )
+        fresh = self._place(self._fresh_host)
+        with self._lock:
+            self._state = fresh
+            self._stream_fmt = [None] * self.streams
+            self._bases = [None] * self.streams
+            self._reset_next = [False] * self.streams
+            self._pending.clear()
 
     def _put_staging(self, buf, aux, *, super_step: bool = False) -> tuple:
         """EXPLICIT H2D staging of one dispatch's input planes — the

@@ -205,8 +205,20 @@ class FleetMapper:
         }
 
     def reset(self) -> None:
-        """Cold reset of every stream's map and pose."""
-        fresh = self._fresh_states()
+        """Cold reset of every stream's map and pose.  Guard-safe on the
+        fused backend: the fresh state is re-placed from a host template
+        captured on first use (one explicit device_put) — a shard-loss
+        wipe (parallel/service.ElasticFleetService) runs inside guarded
+        steady-state loops, where re-CREATING the jnp state would trip
+        the transfer sentinel on its fill-value scalar uploads."""
+        if self.backend == "fused":
+            tmpl = getattr(self, "_fresh_host", None)
+            if tmpl is None:
+                tmpl = self._jax.device_get(self._fresh_states())
+                self._fresh_host = tmpl
+            fresh = self._jax.device_put(tmpl, self.device)
+        else:
+            fresh = self._fresh_states()
         with self._lock:
             if self.backend == "fused":
                 self._states = fresh

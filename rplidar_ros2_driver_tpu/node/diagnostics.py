@@ -62,6 +62,7 @@ class DiagnosticsUpdater:
         map_status: Optional[dict] = None,
         reconnect: Optional[dict] = None,
         stream_health: Optional[list] = None,
+        shard_topology: Optional[dict] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -113,6 +114,34 @@ class DiagnosticsUpdater:
                 values[f"Stream {i} Health"] = (
                     f"{state} ({reason})" if reason else state
                 )
+        # elastic-fleet shard topology + migration counters: pod
+        # deployments (parallel/service.ElasticFleetService) feed
+        # ``service.failover_status()`` through this parameter — one
+        # compact "state [streams] (reason)" value per shard plus the
+        # pod-level evacuation/migration counters
+        # (tests/test_failover.py pins the rendering)
+        if shard_topology:
+            for i, sh in enumerate(shard_topology.get("shards", [])):
+                state = sh.get("state", "?")
+                hosted = ",".join(str(s) for s in sh.get("streams", []))
+                reason = sh.get("reason") or ""
+                val = f"{state} [{hosted}]"
+                if reason:
+                    val = f"{val} ({reason})"
+                values[f"Shard {i}"] = val
+            values["Evacuations"] = str(
+                shard_topology.get("evacuations", 0)
+            )
+            values["Stream Migrations"] = str(
+                shard_topology.get("migrations", 0)
+            )
+            values["Shard Readmissions"] = str(
+                shard_topology.get("readmits", 0)
+            )
+            last = shard_topology.get("last_migration_tick")
+            values["Last Migration Tick"] = (
+                "n/a" if last is None else str(last)
+            )
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

@@ -19,6 +19,7 @@ import dataclasses
 import functools
 import logging
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -1081,3 +1082,585 @@ class ShardedFilterService:
             self._pending = None
             self._epoch += 1
         return False
+
+
+# ---------------------------------------------------------------------------
+# elastic fleet-of-fleets (ROADMAP item 1: shard-loss failover)
+# ---------------------------------------------------------------------------
+
+
+class ElasticFleetService:
+    """Fleet-of-fleets: ``streams`` lidars spread over S *shards*, each
+    shard one fused engine pair (FleetFusedIngest + FleetMapper behind a
+    :class:`ShardedFilterService`) compiled for a fixed lane count — and
+    the pod survives losing a whole shard, not just a noisy stream.
+
+    The three coupled pieces:
+
+      * **placement** — parallel/sharding.FleetTopology maps streams
+        onto shard lanes.  Lanes beyond the hosted streams are the idle
+        padding lanes the compiled programs already encode, so every
+        membership change (join/leave/evacuate/rebalance) is a
+        relabeling of live lanes: zero recompiles by construction,
+        pinned under utils/guards.steady_state across the whole
+        kill -> evacuate -> re-admit cycle.
+      * **shard supervision** — driver/health.ShardHealth per shard,
+        layered ABOVE the per-stream FSM (which still runs per shard
+        when ``health_enable`` is set): a raised dispatch or a chaos
+        kill is LOST immediately; fleet-wide tick starvation walks
+        UP -> SUSPECT -> LOST; re-admission is gated on capped backoff
+        plus a probe (the chaos schedule's liveness in tests, a device
+        health check in production).
+      * **evacuation** — the per-stream schema-versioned snapshots
+        (FleetFusedIngest/FleetMapper.snapshot_stream — the SAME
+        row-sized dynamic-index gather/scatter the quarantine path
+        uses) are pulled periodically (``failover_snapshot_ticks``)
+        into a host-side store; on shard loss every victim's filter+map
+        state is restored from its last snapshot into a surviving
+        shard's idle lane BEFORE bytes flow, decode carries reset.
+        Ticks absorbed by the dead shard after the last snapshot are
+        lost — recorded per stream in the replay plan, so the
+        host-golden replay of every migrated stream (feed the included
+        ticks, reset decoder+assembler at each recorded reset) is
+        bit-exact including final maps (tests/test_failover.py).
+
+    A lost shard's engines are wiped (``cold_reset``) the moment it
+    dies, so a later re-admission provably rebuilds from snapshots —
+    never from stale device state.  On re-admission the topology
+    rebalances: streams migrate back via a FRESH live snapshot (same
+    restore discipline, decode reset recorded), restoring headroom for
+    the next loss.
+
+    Single-controller, byte-tick seam only (the per-shard pipelined and
+    backlog seams remain available on each shard service).  In a real
+    pod each shard's ``ShardedFilterService`` is constructed over its
+    own device mesh slice / host; on this rig all shards share one
+    device and the kill is simulated by the chaos schedule + engine
+    wipe, which exercises every host-side seam the real loss does.
+    """
+
+    def __init__(
+        self,
+        params: DriverParams,
+        streams: int,
+        *,
+        shards: Optional[int] = None,
+        lanes: Optional[int] = None,
+        mesh=None,
+        beams: int = DEFAULT_BEAMS,
+        capacity: int = MAX_SCAN_NODES,
+        fleet_ingest_buckets: Optional[tuple] = None,
+        clock=None,
+        probes: Optional[dict] = None,
+    ) -> None:
+        from rplidar_ros2_driver_tpu.driver.health import (
+            ShardHealth,
+            ShardHealthConfig,
+        )
+        from rplidar_ros2_driver_tpu.parallel.sharding import (
+            FleetTopology,
+            make_mesh,
+        )
+
+        if shards is None:
+            shards = int(getattr(params, "shard_count", 1))
+        if lanes is None:
+            lanes = int(getattr(params, "shard_lanes", 0))
+        if lanes == 0:
+            # smallest lane count that survives one full shard loss
+            # ((shards-1)*lanes >= streams); single-shard pods get no
+            # failover headroom (there is nowhere to evacuate to)
+            lanes = (
+                streams if shards == 1
+                else -(-streams // (shards - 1))
+            )
+        self.params = params
+        self.streams = streams
+        self.topology = FleetTopology(streams, shards, lanes)
+        self.clock = clock or time.monotonic
+        if mesh is None:
+            # one shard = one mesh SLICE: the available devices split
+            # into contiguous per-shard groups (fewer devices than
+            # shards: groups share devices round-robin — the CPU-rig
+            # simulation), so a shard loss models a chip/host falling
+            # out of the pod, not a slice of a shared program.  The
+            # stream axis is pinned to 1 — lane counts must stay free
+            # for the capacity invariant (membership changes relabel
+            # lanes), so a shard's devices all go to the beam axis.
+            from rplidar_ros2_driver_tpu.parallel import multihost
+
+            multihost.initialize()
+            devices = jax.devices()
+            per = max(1, len(devices) // shards)
+            groups = [
+                [devices[(s * per + k) % len(devices)] for k in range(per)]
+                for s in range(shards)
+            ]
+            meshes = [
+                make_mesh(devices=group, stream=1) for group in groups
+            ]
+        else:
+            meshes = [mesh] * shards
+        self.meshes = meshes
+        # one shard = one ShardedFilterService over `lanes` lanes; all
+        # shards share identical geometry, so the fused tick programs
+        # (module-level jits, static cfg) compile once PER MESH SLICE
+        # and the precompile below warms every slice before traffic
+        self.shards = [
+            ShardedFilterService(
+                params, lanes, mesh=meshes[s], beams=beams,
+                capacity=capacity,
+                fleet_ingest_buckets=fleet_ingest_buckets,
+            )
+            for s in range(shards)
+        ]
+        for sh in self.shards:
+            if sh.fleet_ingest_backend != "fused":
+                raise ValueError(
+                    "ElasticFleetService needs fleet_ingest_backend="
+                    "'fused' (the per-stream device rows are the "
+                    "snapshot/migration unit; the host backend has none)"
+                )
+        probes = probes or {}
+        cfg = ShardHealthConfig.from_params(params)
+        self.shard_health = [
+            ShardHealth(cfg, s, clock=self.clock, probe=probes.get(s))
+            for s in range(shards)
+        ]
+        self.snapshot_ticks = int(
+            getattr(params, "failover_snapshot_ticks", 8)
+        )
+        self.tick_no = 0
+        self.chaos = None                   # ShardChaosSchedule
+        self._chaos_probe_wired = False
+        # per-stream snapshot store: stream -> (tick, {"ingest","map"})
+        self._snap: dict = {}
+        self._fresh_snap = None             # canonical fresh-lane rows
+        # replay-plan bookkeeping (the host-golden replay contract):
+        # ticks absorbed since each stream's last snapshot (lost if the
+        # hosting shard dies), decode-reset ticks, and lost ticks
+        self._since_snap: list[list[int]] = [[] for _ in range(streams)]
+        self._resets: list[set] = [set() for _ in range(streams)]
+        self._excluded: list[set] = [set() for _ in range(streams)]
+        # counters + event/evacuation logs (diagnostics surface)
+        self.evacuations = 0
+        self.migrations = 0
+        self.readmits = 0
+        self.shard_evacuations = [0] * shards
+        self.shard_migrations_in = [0] * shards
+        self.shard_last_migration_tick: list = [None] * shards
+        self.last_migration_tick: Optional[int] = None
+        self.streams_lost_unhosted = 0
+        self.events: list[tuple] = []
+        self.evacuation_log: list[dict] = []
+        self.last_evacuation: Optional[dict] = None
+        self._first_tick_pending = False
+        self.last_poses: list = [None] * streams
+
+    # -- warmup ------------------------------------------------------------
+
+    def precompile(self, formats) -> None:
+        """Warm every shard's engines (fleet tick programs for every
+        padding bucket, the mapper tick when attached, and the
+        row-sized snapshot gather/scatter programs), and capture the
+        canonical FRESH lane rows used to scrub a lane whose new tenant
+        has no snapshot yet.  After this, a full kill -> evacuate ->
+        re-admit cycle runs with zero XLA compiles."""
+        for sh in self.shards:
+            sh._ensure_byte_ingest()
+            sh.fleet_ingest.precompile(formats)
+            # the shard-kill wipe template: a D2H fetch single-shard
+            # deployments never pay — captured here, before traffic
+            sh.fleet_ingest.capture_cold_template()
+            if getattr(self.params, "map_enable", False) and (
+                sh.mapper is None
+            ):
+                sh.attach_mapper()
+            sh._warm_quarantine_path()
+        if self._fresh_snap is None:
+            # engines are fresh here (precompile before traffic), so
+            # lane 0's rows ARE the fresh-lane template
+            eng = self.shards[0].fleet_ingest
+            self._fresh_snap = {"ingest": eng.snapshot_stream(0)}
+            if self.shards[0].mapper is not None:
+                self._fresh_snap["map"] = (
+                    self.shards[0].mapper.snapshot_stream(0)
+                )
+
+    # -- chaos seam --------------------------------------------------------
+
+    def attach_shard_chaos(self, schedule) -> None:
+        """Attach a deterministic shard-loss schedule
+        (driver/chaos.ShardChaosSchedule): shards the schedule marks
+        down are force-LOST at the tick boundary, and — unless a caller
+        probe is already wired — re-admission probes answer from the
+        same schedule, so the whole kill -> evacuate -> re-admit cycle
+        is a pure function of (seed, tick)."""
+        self.chaos = schedule
+        if not self._chaos_probe_wired:
+            for s, hs in enumerate(self.shard_health):
+                if hs.probe is None:
+                    hs.probe = (
+                        lambda s=s: not self.chaos.down(s, self.tick_no)
+                    )
+            self._chaos_probe_wired = True
+
+    # -- the fleet tick ----------------------------------------------------
+
+    def submit_bytes(self, items) -> list:
+        """One pod tick from raw frame bytes (the global
+        :meth:`ShardedFilterService.submit_bytes` layout: ``items[i]``
+        is stream i's ``(ans_type, [(payload, ts), ...])`` or None).
+        Routes each stream's bytes to its hosting shard, runs every UP
+        shard's one-dispatch tick, and returns one
+        Optional[FilterOutput] per GLOBAL stream (None: idle, no
+        completed revolution, or currently unhosted).
+
+        The tick boundary is where fault handling lives, in order:
+        chaos kills (schedule-driven LOST + evacuation), re-admission
+        polls (backoff + probe -> engine rebuild + rebalance), then the
+        routed dispatches (a raised dispatch is a heartbeat failure:
+        the shard is LOST and evacuated; its victims lose this tick).
+        Periodic per-stream snapshots refresh after the dispatches so
+        a snapshot never includes a half-applied tick.
+        """
+        if len(items) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} per-stream items, got {len(items)}"
+            )
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        t = self.tick_no
+        t0 = time.perf_counter()
+        # 1. chaos-driven kills.  The tick's FULL down set is forced
+        #    LOST before any evacuation runs: processing kills one at a
+        #    time would evacuate the first casualty's victims onto a
+        #    shard the schedule already marks down this tick, then
+        #    immediately re-evacuate them (double restore work, phantom
+        #    migration counts)
+        if self.chaos is not None:
+            downed = [
+                s for s, hs in enumerate(self.shard_health)
+                if hs.state is not ShardState.LOST
+                and self.chaos.down(s, t)
+            ]
+            for s in downed:
+                self.shard_health[s].force_lost("chaos: shard killed")
+            for s in downed:
+                self._on_lost(s, "chaos: shard killed")
+        # 2. re-admission polls (engines rebuilt + rebalance BEFORE
+        #    this tick's bytes flow — the evacuation contract's mirror)
+        for s, hs in enumerate(self.shard_health):
+            if hs.poll_readmit() is not None:
+                self._readmit_shard(s)
+        # 3. routed dispatches.  Routing is FROZEN before the loop: a
+        #    heartbeat failure mid-loop evacuates its victims, but their
+        #    bytes for THIS tick died with the dispatch that consumed
+        #    them — re-delivering them to the new shard in the same tick
+        #    would double-apply the tick on the survivor
+        outs: list = [None] * self.streams
+        routing = []
+        for s, hs in enumerate(self.shard_health):
+            if not hs.hosting:
+                continue
+            lane_streams = self.topology.lane_streams(s)
+            routing.append((
+                s, hs, lane_streams, self.topology.lane_items(s, items)
+            ))
+        for s, hs, lane_streams, lane_items in routing:
+            if not hs.hosting:
+                continue  # lost mid-loop (cascading failure)
+            if not any(st is not None for st in lane_streams):
+                tr = hs.observe(False, 0)
+                if tr is not None and tr[1] is ShardState.LOST:
+                    self._on_lost(s, hs.last_reason)
+                continue  # empty shard: nothing to dispatch
+            offered = any(it for it in lane_items)
+            try:
+                shard_outs = self.shards[s].submit_bytes(lane_items)
+            except Exception as e:  # noqa: BLE001 - heartbeat boundary
+                logger.exception("shard %d dispatch failed", s)
+                self._lose_shard(
+                    s, f"heartbeat: {type(e).__name__}: {e}"
+                )
+                # victims lose THIS tick's bytes too (consumed by the
+                # dead dispatch): excluded from the replay plan
+                for lane, stream in enumerate(lane_streams):
+                    if stream is not None and items[stream]:
+                        self._excluded[stream].add(t)
+                continue
+            completed = 0
+            for lane, stream in enumerate(lane_streams):
+                if stream is None:
+                    continue
+                outs[stream] = shard_outs[lane]
+                self.last_poses[stream] = self.shards[s].last_poses[lane]
+                if shard_outs[lane] is not None:
+                    completed += 1
+                if items[stream]:
+                    self._since_snap[stream].append(t)
+            tr = hs.observe(offered, completed)
+            if tr is not None and tr[1] is ShardState.LOST:
+                # FSM-driven loss (tick starvation walked the ladder,
+                # or a READMITTING relapse): the same wipe+evacuate as
+                # a hard kill — the device kept answering dispatches
+                # but completed nothing, so its state is not trusted;
+                # victims restore from their last snapshots and every
+                # tick since (the starvation window included) is
+                # excluded from the replay plan
+                self._on_lost(s, hs.last_reason)
+        # unhosted streams (double loss without capacity): their bytes
+        # never reach a device — excluded, masked output
+        for stream in self.topology.unhosted():
+            if items[stream]:
+                self._excluded[stream].add(t)
+        # 4. periodic snapshot refresh (state now includes tick t)
+        if self.snapshot_ticks > 0 and (t + 1) % self.snapshot_ticks == 0:
+            self._refresh_snapshots(t)
+        if self._first_tick_pending and self.last_evacuation is not None:
+            self.last_evacuation["first_tick_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            self._first_tick_pending = False
+        self.tick_no += 1
+        return outs
+
+    # -- snapshots ---------------------------------------------------------
+
+    def _stream_snapshot(self, stream: int) -> Optional[dict]:
+        """Pull one hosted stream's fresh row snapshot from its shard's
+        live engines (row gather + explicit row fetch, the quarantine-
+        checkpoint machinery — O(1/lanes) of the shard state)."""
+        got = self.topology.placement(stream)
+        if got is None:
+            return None
+        s, lane = got
+        sh = self.shards[s]
+        snap = {"ingest": sh.fleet_ingest.snapshot_stream(lane)}
+        if sh.mapper is not None:
+            snap["map"] = sh.mapper.snapshot_stream(lane)
+        return snap
+
+    def _refresh_snapshots(self, t: int) -> None:
+        """Refresh the host-side snapshot store for every hosted stream
+        on an UP shard; the stored tick marks how much history the
+        snapshot holds (ticks <= t).  SUSPECT and READMITTING shards
+        are skipped: their device state is exactly what the FSM has
+        stopped trusting, and an in-window refresh would make a later
+        evacuation restore FROM the distrusted state (breaking the
+        host-golden replay contract, which excludes every tick since
+        the last trusted snapshot).  A stream migrated onto a
+        READMITTING shard already has a fresh migration-time snapshot
+        pulled from its previous (trusted) host."""
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        for stream in range(self.streams):
+            got = self.topology.placement(stream)
+            if got is None or (
+                self.shard_health[got[0]].state is not ShardState.UP
+            ):
+                continue
+            snap = self._stream_snapshot(stream)
+            if snap is not None:
+                self._snap[stream] = (t, snap)
+                self._since_snap[stream] = []
+
+    def _restore_into(
+        self, stream: int, dst: int, lane: int, snap: Optional[dict]
+    ) -> None:
+        """Install ``snap`` (or the canonical fresh rows) into the
+        destination lane BEFORE bytes flow: rolling filter window + map
+        restored, decode carries reset (restore_stream's rejoin
+        discipline — the stream re-enters the byte stream at an
+        arbitrary capsule boundary).  Always restores — a reused lane
+        may hold a previous tenant's residue."""
+        use = snap if snap is not None else self._fresh_snap
+        if use is None:
+            raise RuntimeError(
+                "ElasticFleetService.precompile() must run before "
+                "migrations (no fresh-lane template captured)"
+            )
+        sh = self.shards[dst]
+        if not sh.fleet_ingest.restore_stream(lane, use["ingest"]):
+            raise RuntimeError(
+                f"stream {stream}: ingest snapshot rejected by shard "
+                f"{dst} lane {lane} (schema/geometry drift)"
+            )
+        if sh.mapper is not None:
+            if "map" not in use or not sh.mapper.restore_stream(
+                lane, use["map"]
+            ):
+                raise RuntimeError(
+                    f"stream {stream}: map snapshot rejected by shard "
+                    f"{dst} lane {lane} (schema/geometry drift)"
+                )
+
+    # -- failure handling --------------------------------------------------
+
+    def _lose_shard(self, s: int, reason: str) -> None:
+        """Shard ``s`` just died hard (chaos kill / raised dispatch):
+        force the FSM to LOST, then wipe + evacuate."""
+        self.shard_health[s].force_lost(reason)
+        self._on_lost(s, reason)
+
+    def _on_lost(self, s: int, reason: str) -> None:
+        """The loss handler shared by every path to LOST — hard kills
+        (:meth:`_lose_shard`) and FSM-driven walks (tick starvation, a
+        READMITTING relapse observed in the tick loop): wipe the
+        shard's engines (stale state must never survive into a
+        re-admission), and evacuate every victim stream from its LAST
+        snapshot into surviving shards' idle lanes."""
+        t = self.tick_no
+        self.events.append((t, "lost", s, reason))
+        sh = self.shards[s]
+        if sh.fleet_ingest is not None:
+            sh.fleet_ingest.cold_reset()
+        if sh.mapper is not None:
+            sh.mapper.reset()
+        self._evacuate_shard(s)
+
+    def _evacuate_shard(self, s: int) -> None:
+        t = self.tick_no
+        t0 = time.perf_counter()
+        # victims must land on shards that can actually host them: a
+        # double loss must not evacuate onto an earlier casualty's
+        # empty (wiped) lanes
+        dead = [
+            x for x, hs in enumerate(self.shard_health)
+            if not hs.hosting and x != s
+        ]
+        victims = self.topology.streams_on(s)
+        plan = self.topology.evacuate(s, avoid=dead)
+        # ticks the dead shard absorbed after the last snapshot are
+        # lost — for EVERY victim, including one that found no idle
+        # lane (double loss beyond capacity) and goes unhosted: its
+        # later re-admission restore (the src<0 branch of
+        # _readmit_shard) also comes from that snapshot, so the replay
+        # plan must drop the post-snapshot ticks either way
+        for stream in victims:
+            self._excluded[stream].update(self._since_snap[stream])
+            self._since_snap[stream] = []
+        # snapshot pull: the last stored per-stream snapshots (the dead
+        # shard's device state is gone — the store IS the source)
+        snaps = {
+            stream: self._snap.get(stream) for stream, _d, _l in plan
+        }
+        t1 = time.perf_counter()
+        for stream, dst, lane in plan:
+            entry = snaps[stream]
+            self._restore_into(
+                stream, dst, lane, entry[1] if entry else None
+            )
+            self._resets[stream].add(t)
+            self.migrations += 1
+            self.shard_migrations_in[dst] += 1
+            self.shard_last_migration_tick[dst] = t
+            self.events.append((t, "evacuated", stream, s, dst, lane))
+        t2 = time.perf_counter()
+        unhosted = self.topology.unhosted()
+        if unhosted:
+            self.streams_lost_unhosted = len(unhosted)
+            logger.error(
+                "shard %d loss left streams %s unhosted (no idle lanes "
+                "— double loss?); they stay masked until a shard "
+                "re-admits", s, unhosted,
+            )
+        self.evacuations += 1
+        self.shard_evacuations[s] += 1
+        self.last_migration_tick = t
+        self.last_evacuation = {
+            "tick": t,
+            "shard": s,
+            "streams": [stream for stream, _d, _l in plan],
+            "snapshot_pull_ms": round((t1 - t0) * 1e3, 3),
+            "restore_scatter_ms": round((t2 - t1) * 1e3, 3),
+            "first_tick_ms": None,
+        }
+        self.evacuation_log.append(self.last_evacuation)
+        self._first_tick_pending = True
+        logger.warning(
+            "shard %d evacuated: %d streams restored onto survivors "
+            "(pull %.1f ms, restore %.1f ms)",
+            s, len(plan), (t1 - t0) * 1e3, (t2 - t1) * 1e3,
+        )
+
+    def _readmit_shard(self, s: int) -> None:
+        """Shard ``s`` passed its backoff+probe gate: its engines were
+        wiped at loss (fresh state), so rebalance streams back onto it
+        — each mover's state travels as a FRESH live snapshot from its
+        current shard (zero lost ticks; the in-flight partial
+        revolution is dropped by the decode reset, recorded in the
+        replay plan), restoring the pod's single-loss headroom."""
+        t = self.tick_no
+        self.readmits += 1
+        self.events.append((t, "readmitting", s))
+        moves = self.topology.rebalance_into(s)
+        for stream, src, src_lane, dst, lane in moves:
+            if src < 0:
+                # was unhosted: last stored snapshot (its post-snapshot
+                # ticks were already excluded when it went unhosted)
+                entry = self._snap.get(stream)
+                snap = entry[1] if entry else None
+            else:
+                snap = {
+                    "ingest": self.shards[src].fleet_ingest
+                    .snapshot_stream(src_lane),
+                }
+                if self.shards[src].mapper is not None:
+                    snap["map"] = self.shards[src].mapper.snapshot_stream(
+                        src_lane
+                    )
+                # the live snapshot holds everything up to tick t-1
+                self._snap[stream] = (t - 1, snap)
+                self._since_snap[stream] = []
+            self._restore_into(stream, dst, lane, snap)
+            self._resets[stream].add(t)
+            self.migrations += 1
+            self.shard_migrations_in[dst] += 1
+            self.shard_last_migration_tick[dst] = t
+            self.last_migration_tick = t
+            self.events.append((t, "migrated", stream, src, dst, lane))
+        self.streams_lost_unhosted = len(self.topology.unhosted())
+
+    # -- observability -----------------------------------------------------
+
+    def replay_plan(self) -> list[dict]:
+        """Per-stream host-golden replay plan: feed every tick's bytes
+        EXCEPT the ``excluded`` ones to an independent decoder +
+        assembler + chain (+ host mapper), resetting decoder and
+        assembler at each ``resets`` tick — the filter window and map,
+        like the restored rows, carry through.  The replay is then
+        bit-exact against this pod's outputs for that stream, final
+        map included (tests/test_failover.py pins it)."""
+        return [
+            {
+                "resets": sorted(self._resets[i]),
+                "excluded": sorted(self._excluded[i]),
+            }
+            for i in range(self.streams)
+        ]
+
+    def shard_status(self) -> list[dict]:
+        """Per-shard dicts for /diagnostics (node/diagnostics.py renders
+        these under the ``shard_topology`` surface)."""
+        out = []
+        for s, hs in enumerate(self.shard_health):
+            d = hs.status()
+            d["streams"] = self.topology.streams_on(s)
+            d["evacuations"] = self.shard_evacuations[s]
+            d["migrations_in"] = self.shard_migrations_in[s]
+            d["last_migration_tick"] = self.shard_last_migration_tick[s]
+            out.append(d)
+        return out
+
+    def failover_status(self) -> dict:
+        """Pod-level failover counters + per-shard states — the
+        /diagnostics topology payload."""
+        return {
+            "shards": self.shard_status(),
+            "evacuations": self.evacuations,
+            "migrations": self.migrations,
+            "readmits": self.readmits,
+            "last_migration_tick": self.last_migration_tick,
+            "unhosted": self.topology.unhosted(),
+        }

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Callable
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -458,6 +458,195 @@ def place_fleet_ingest_state(mesh: Mesh, state):
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(shard, state)
+
+
+class FleetTopology:
+    """Stream -> (shard, lane) placement planner for the elastic fleet
+    (parallel/service.ElasticFleetService).
+
+    A *shard* is one engine pair (FleetFusedIngest + FleetMapper)
+    compiled for a FIXED lane count; a *lane* is one row of its
+    stream-batched state.  Lanes beyond the hosted streams are the
+    idle padding lanes the compiled programs already encode (a None
+    tick item = the all-masked idle frame), so every membership change
+    this planner performs — join, leave, evacuation, rebalance — is a
+    relabeling of which lanes are live, never a shape change: **zero
+    recompiles by construction** (the same guarantee the quarantine
+    masking rides, guards-pinned).
+
+    Capacity invariant: with S shards of L lanes, the planner refuses
+    a fleet that cannot survive one full shard loss —
+    ``(S - 1) * L >= streams`` for S > 1 — so an evacuation always
+    finds idle lanes (the ``shard_lanes`` auto default in
+    core/config.py picks the smallest such L).  Host-side bookkeeping
+    only: no jax, no device work.
+    """
+
+    def __init__(self, streams: int, shards: int, lanes: int) -> None:
+        if streams < 1:
+            raise ValueError("need at least one stream")
+        if shards < 1:
+            raise ValueError("need at least one shard")
+        if lanes < 1:
+            raise ValueError("need at least one lane per shard")
+        if shards * lanes < streams:
+            raise ValueError(
+                f"{shards} shards x {lanes} lanes cannot host "
+                f"{streams} streams"
+            )
+        if shards > 1 and (shards - 1) * lanes < streams:
+            raise ValueError(
+                f"{shards} shards x {lanes} lanes cannot survive a "
+                f"shard loss with {streams} streams (need "
+                f"(shards-1)*lanes >= streams)"
+            )
+        self.streams = streams
+        self.shards = shards
+        self.lanes = lanes
+        # lane tables: _lane_map[shard][lane] = stream or None (idle)
+        self._lane_map: list[list] = [
+            [None] * lanes for _ in range(shards)
+        ]
+        # stream -> (shard, lane); absent = unhosted
+        self._placement: dict[int, tuple[int, int]] = {}
+        # initial placement: round-robin across shards, so losing any
+        # one shard strands ~streams/shards victims, not a whole block
+        for i in range(streams):
+            self._place(i, i % shards)
+
+    # -- queries -----------------------------------------------------------
+
+    def placement(self, stream: int) -> Optional[tuple[int, int]]:
+        """``(shard, lane)`` hosting ``stream``, or None (unhosted)."""
+        return self._placement.get(stream)
+
+    def streams_on(self, shard: int) -> list[int]:
+        """Hosted streams of ``shard``, in lane order."""
+        return [s for s in self._lane_map[shard] if s is not None]
+
+    def lane_items(self, shard: int, items: Sequence) -> list:
+        """Route a GLOBAL per-stream item list into ``shard``'s
+        lane-ordered list (None for idle lanes) — the per-shard
+        ``submit_bytes`` layout."""
+        return [
+            None if s is None else items[s]
+            for s in self._lane_map[shard]
+        ]
+
+    def unhosted(self) -> list[int]:
+        return [
+            i for i in range(self.streams) if i not in self._placement
+        ]
+
+    # -- membership changes ------------------------------------------------
+
+    def _free_lane(self, shard: int) -> Optional[int]:
+        for lane, s in enumerate(self._lane_map[shard]):
+            if s is None:
+                return lane
+        return None
+
+    def _place(self, stream: int, shard: int) -> tuple[int, int]:
+        lane = self._free_lane(shard)
+        if lane is None:
+            raise ValueError(f"shard {shard} has no idle lane")
+        self._lane_map[shard][lane] = stream
+        self._placement[stream] = (shard, lane)
+        return (shard, lane)
+
+    def release(self, stream: int) -> None:
+        """Stream leaves the fleet (or goes unhosted): its lane reverts
+        to idle padding."""
+        got = self._placement.pop(stream, None)
+        if got is not None:
+            shard, lane = got
+            self._lane_map[shard][lane] = None
+
+    def assign(
+        self, stream: int, avoid: Sequence[int] = (),
+    ) -> Optional[tuple[int, int]]:
+        """Place an unhosted ``stream`` on the least-loaded shard not in
+        ``avoid``; returns the new (shard, lane) or None when no shard
+        has an idle lane."""
+        if stream in self._placement:
+            raise ValueError(f"stream {stream} is already hosted")
+        best, best_load = None, None
+        for shard in range(self.shards):
+            if shard in avoid or self._free_lane(shard) is None:
+                continue
+            load = len(self.streams_on(shard))
+            if best_load is None or load < best_load:
+                best, best_load = shard, load
+        if best is None:
+            return None
+        return self._place(stream, best)
+
+    def evacuate(
+        self, shard: int, avoid: Sequence[int] = (),
+    ) -> list[tuple[int, int, int]]:
+        """Plan the moves off a LOST ``shard``: every victim stream is
+        released and reassigned to the least-loaded surviving shard's
+        idle lane.  ``avoid`` names OTHER shards that must not receive
+        victims (the service passes every non-hosting shard, so a
+        double loss cannot evacuate onto an earlier casualty's empty
+        lanes).  Returns ``[(stream, dst_shard, dst_lane), ...]`` in
+        lane order; victims that found no lane stay unhosted (absent
+        from the plan) — the capacity invariant makes that impossible
+        for a single shard loss, but a double loss degrades instead of
+        raising."""
+        victims = self.streams_on(shard)
+        skip = frozenset(avoid) | {shard}
+        plan = []
+        for stream in victims:
+            self.release(stream)
+            got = self.assign(stream, avoid=skip)
+            if got is not None:
+                plan.append((stream, got[0], got[1]))
+        return plan
+
+    def rebalance_into(self, shard: int) -> list[tuple[int, int, int, int, int]]:
+        """Plan the migrations BACK onto a re-admitted (empty) ``shard``
+        until it is balanced: streams move from the most-loaded shards
+        while doing so strictly improves balance.  Returns
+        ``[(stream, src_shard, src_lane, dst_shard, dst_lane), ...]``
+        (src -1/-1 for streams that were unhosted — they need no
+        migration source); the source lane rides along because the
+        mover must snapshot the live state from it BEFORE the
+        relabeling takes effect."""
+        moves: list[tuple[int, int, int, int, int]] = []
+        for stream in self.unhosted():
+            if self._free_lane(shard) is None:
+                break
+            _, lane = self._place(stream, shard)
+            moves.append((stream, -1, -1, shard, lane))
+        while self._free_lane(shard) is not None:
+            loads = {
+                s: len(self.streams_on(s))
+                for s in range(self.shards) if s != shard
+            }
+            if not loads:
+                break
+            src = max(loads, key=lambda s: (loads[s], s))
+            if loads[src] <= len(self.streams_on(shard)) + 1:
+                break  # moving one more no longer improves balance
+            stream = self.streams_on(src)[-1]
+            src_lane = self._placement[stream][1]
+            self.release(stream)
+            _, lane = self._place(stream, shard)
+            moves.append((stream, src, src_lane, shard, lane))
+        return moves
+
+    def lane_streams(self, shard: int) -> list:
+        """``shard``'s raw lane table (stream id or None per lane) — the
+        inverse of :meth:`lane_items` for routing outputs back."""
+        return list(self._lane_map[shard])
+
+    def status(self) -> list[dict]:
+        """Per-shard host dicts (the /diagnostics topology surface)."""
+        return [
+            {"streams": self.streams_on(s), "lanes": self.lanes}
+            for s in range(self.shards)
+        ]
 
 
 def shard_batch(mesh: Mesh, batch: ScanBatch) -> ScanBatch:

@@ -17,20 +17,41 @@ process index.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 import zipfile
+import zlib
 from typing import Any, Optional
 
 import numpy as np
 
+log = logging.getLogger("rplidar_tpu.checkpoint")
+
 FORMAT_VERSION = 1
+
+
+def _array_crc(v: np.ndarray) -> int:
+    """CRC32 of an array's raw bytes (C-order contiguous view, so the
+    checksum is layout-independent of how the caller built it)."""
+    return zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF
 
 
 def _fingerprint(snap: dict[str, np.ndarray]) -> dict[str, Any]:
     return {
         "version": FORMAT_VERSION,
-        "arrays": {k: {"shape": list(v.shape), "dtype": str(v.dtype)} for k, v in snap.items()},
+        # shape/dtype pre-validate the restore; crc32 detects torn or
+        # bit-flipped payloads that still parse (a truncated zip fails
+        # earlier, but a corrupt-but-well-formed npz would otherwise
+        # restore silent garbage into a compiled step)
+        "arrays": {
+            k: {
+                "shape": list(v.shape),
+                "dtype": str(v.dtype),
+                "crc32": _array_crc(np.asarray(v)),
+            }
+            for k, v in snap.items()
+        },
     }
 
 
@@ -73,7 +94,9 @@ def save_checkpoint(path: str, snap: dict[str, np.ndarray], extra: Optional[dict
 
 
 def load_checkpoint(path: str) -> Optional[tuple[dict[str, np.ndarray], dict]]:
-    """Read a checkpoint; None when absent or unreadable/torn."""
+    """Read a checkpoint; None when absent, unreadable, torn, or failing
+    its own CRC manifest — every rejection is a logged clean refusal,
+    never a crash or a silent garbage restore."""
     if not os.path.exists(path):
         return None
     try:
@@ -81,13 +104,34 @@ def load_checkpoint(path: str) -> Optional[tuple[dict[str, np.ndarray], dict]]:
             raw_meta = z["__meta__"].tobytes()
             meta = json.loads(raw_meta)
             if meta.get("version") != FORMAT_VERSION:
+                log.warning(
+                    "rejecting checkpoint %s: format version %s (want %d)",
+                    path, meta.get("version"), FORMAT_VERSION,
+                )
                 return None
             snap = {k: z[k] for k in z.files if k != "__meta__"}
-    except (OSError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile):
+    except (OSError, EOFError, ValueError, KeyError, json.JSONDecodeError, zipfile.BadZipFile) as e:
+        # EOFError: a zero-length / headerless torn file (np.load raises
+        # it before the zip machinery ever sees the bytes)
+        log.warning("rejecting unreadable/torn checkpoint %s: %s", path, e)
         return None
-    # verify the payload matches its own manifest (truncation guard)
+    # verify the payload matches its own manifest: shape/dtype (a
+    # truncation guard) AND the per-array CRC32 (a corruption guard —
+    # a bit-flipped npz can still unzip and parse).  Checkpoints
+    # written before the crc32 field simply lack it and skip that leg.
     want = meta.get("arrays", {})
     for k, spec in want.items():
         if k not in snap or list(snap[k].shape) != spec["shape"] or str(snap[k].dtype) != spec["dtype"]:
+            log.warning(
+                "rejecting checkpoint %s: array %r missing or "
+                "shape/dtype drifted from its manifest", path, k,
+            )
+            return None
+        crc = spec.get("crc32")
+        if crc is not None and _array_crc(snap[k]) != crc:
+            log.warning(
+                "rejecting checkpoint %s: array %r failed its CRC32 "
+                "(torn or bit-flipped payload)", path, k,
+            )
             return None
     return snap, meta

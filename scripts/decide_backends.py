@@ -46,7 +46,7 @@ def _records(paths: list[str]):
 
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
-    "super_tick_ab", "mapping_ab", "pallas_match_ab",
+    "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
 )
 
 
@@ -262,6 +262,49 @@ def analyze(records: list[dict]) -> dict:
                 k: pmb[k] for k in (
                     "match_speedup", "overhead_clamped", "interpret_mode",
                 ) if k in pmb
+            })
+
+        # config 15: the shard-failover pod A/B (shard_count default).
+        # The key is a FLOOR, not a speedup bar: survivor-lane steady
+        # throughput under a shard loss must stay >= 0.95x the paired
+        # baseline before multi-shard pods are recommended as the
+        # deployment default.  Under the strongest-evidence merge the
+        # entry's strength must come from evidence AGAINST the flip
+        # (the deep_window keep-entry discipline): a clean record
+        # carries parity strength no matter how far ABOVE parity the
+        # survivors ran — otherwise a 1.25x noise record outweighs a
+        # genuine 0.85x degradation record (|log 1.25| > |log 0.85|)
+        # and flips the default over committed floor-violation
+        # evidence.  The measured ratio still lands in "measured" and
+        # the evidence list.
+        fov = rec.get("failover_ab")
+        if isinstance(fov, dict):
+            v = fov.get("survivor_steady_ratio")
+            if isinstance(v, (int, float)) and not fov.get(
+                "ratio_clamped"
+            ):
+                # a clamped ratio (one arm under the timer floor)
+                # records evidence but never moves the default
+                shards_m = fov.get("shards")
+                proposed = (
+                    str(shards_m) if isinstance(shards_m, int) else "4"
+                )
+                flip = v >= 0.95
+                recommend("shard_count.tpu", {
+                    "current": "1",
+                    "recommended": proposed if flip else "1",
+                    "flip": flip,
+                    "key": "config15 survivor_steady_ratio",
+                    "value": 1.0 if flip else float(v),
+                    "measured": float(v),
+                    "margin": 0.95,
+                    "source": "failover_ab",
+                })
+            out["evidence"].setdefault("failover_ab", []).append({
+                k: fov[k] for k in (
+                    "survivor_steady_ratio", "shards", "streams",
+                    "ratio_clamped",
+                ) if k in fov
             })
 
         # ablation: resample + voxel kernels

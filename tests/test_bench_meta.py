@@ -28,6 +28,7 @@ def test_metric_names_stable():
     assert bench.metric_name(12) == "mapping_match_update_scans_per_sec"
     assert bench.metric_name(13) == "chaos_degraded_fleet_scans_per_sec"
     assert bench.metric_name(14) == "pallas_match_kernel_scans_per_sec"
+    assert bench.metric_name(15) == "shard_failover_survivor_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -35,7 +36,7 @@ def test_graded_table_well_formed():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
-            "pallas_match",
+            "pallas_match", "failover",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1026,6 +1027,151 @@ def test_bench_smoke_chaos():
     assert isinstance(out["within_5pct"], bool)
     assert isinstance(out["worst_healthy_ratio"], float)
     assert "ceiling_analysis" in out
+
+
+def test_bench_smoke_failover():
+    """`bench.py --smoke-failover` — the tier-1 gate for the elastic-
+    fleet failover path (config-15 shard-loss A/B at seconds-scale CPU
+    geometry).  The structural claims are what matters: the full
+    kill -> evacuate -> re-admit cycle completes under the steady-state
+    guard (zero recompiles / zero implicit transfers, evacuation and
+    snapshot pulls included), survivors stay byte-for-byte on the
+    unkilled baseline pod, and every migrated stream matches its
+    host-golden replay (the bench itself raises on violation; this
+    gate pins that the asserted artifact lands).  The survivor
+    throughput ratio is 1.5-core-CI weather and only floor-bounded
+    inside the bench; the bit-exact failover contract incl. final maps
+    lives in tests/test_failover.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-failover"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(15)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claims, re-checked from the artifact
+    s = out["structural"]
+    assert s["one_dispatch_per_tick_per_survivor"] is True
+    assert s["zero_recompiles"] is True
+    assert s["zero_implicit_transfers"] is True
+    assert s["fault_isolation_bit_exact"] is True
+    assert s["migrated_replay_bit_exact"] is True
+    assert s["evacuate_readmit_completed"] is True
+    # the acceptance topology: 1 of 4 shards killed, its 2 streams
+    # migrated, the other 6 survivors carried the metric
+    assert out["shards"] == 4 and out["streams"] == 8
+    assert out["migrated"] == [1, 5]
+    assert len(out["survivors"]) == 6
+    # liveness + the floor the bench itself asserts in smoke mode
+    assert out["value"] > 0 and out["survivor_steady_ratio"] >= 0.9
+    # the evacuation-latency decomposition rides the artifact
+    ev = out["evacuation"]
+    assert ev["snapshot_pull_ms"] >= 0.0
+    assert ev["restore_scatter_ms"] > 0.0
+    assert ev["first_tick_ms"] > 0.0
+    # the decision key rides with its clamp flag
+    assert "survivor_steady_ratio" in out["failover_ab"]
+    assert isinstance(out["failover_ab"]["ratio_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_failover_key():
+    """The shard_count recommendation flips from config-15 evidence
+    alone: an unclamped TPU record at or above the 0.95 survivor floor
+    recommends the measured pod width; CPU records, clamped ratios and
+    below-floor records never flip — and a record showing real
+    survivor degradation displaces a clean parity record (strength is
+    distance from parity)."""
+    import importlib
+    import os
+    import sys
+
+    sys.modules.pop("decide_backends", None)
+    scripts_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    )
+    sys.path.insert(0, scripts_dir)
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        sys.path.remove(scripts_dir)
+
+    out = db.analyze([
+        {"device": "tpu",
+         "failover_ab": {"survivor_steady_ratio": 0.99, "shards": 4,
+                         "streams": 8, "ratio_clamped": False}},
+        {"device": "cpu",  # CPU record: no decision weight
+         "failover_ab": {"survivor_steady_ratio": 1.02, "shards": 4,
+                         "streams": 8, "ratio_clamped": False}},
+    ])
+    rec = out["recommendations"]["shard_count.tpu"]
+    assert rec["flip"] is True and rec["recommended"] == "4"
+    # a flip entry carries parity strength (the floor discipline: its
+    # strength must come from evidence AGAINST the flip, of which a
+    # clean record has none); the measured ratio rides separately
+    assert rec["value"] == 1.0
+    assert rec["measured"] == 0.99  # the TPU record, not the CPU one
+    assert out["evidence"]["failover_ab"]
+
+    # a clamped ratio records evidence but cannot flip
+    clamped = db.analyze([
+        {"device": "tpu",
+         "failover_ab": {"survivor_steady_ratio": 1.0, "shards": 4,
+                         "ratio_clamped": True}},
+    ])
+    assert "shard_count.tpu" not in clamped["recommendations"]
+    assert clamped["evidence"]["failover_ab"]
+
+    # below the survivor floor: the single-shard default holds
+    keep = db.analyze([
+        {"device": "tpu",
+         "failover_ab": {"survivor_steady_ratio": 0.80, "shards": 4,
+                         "ratio_clamped": False}},
+    ])
+    rec = keep["recommendations"]["shard_count.tpu"]
+    assert rec["flip"] is False and rec["recommended"] == "1"
+
+    # degradation evidence outweighs parity evidence in the merge
+    mixed = db.analyze([
+        {"device": "tpu",
+         "failover_ab": {"survivor_steady_ratio": 0.999, "shards": 4,
+                         "ratio_clamped": False}},
+        {"device": "tpu",
+         "failover_ab": {"survivor_steady_ratio": 0.70, "shards": 4,
+                         "ratio_clamped": False}},
+    ])
+    rec = mixed["recommendations"]["shard_count.tpu"]
+    assert rec["flip"] is False and rec["value"] == 0.70
+
+    # ...including ABOVE-parity evidence: |log 1.25| > |log 0.85|, but
+    # survivors running above parity argues nothing FOR multi-shard
+    # pods — a floor violation must hold the flip back in either
+    # merge order
+    for records in (
+        [{"device": "tpu",
+          "failover_ab": {"survivor_steady_ratio": 1.25, "shards": 4,
+                          "ratio_clamped": False}},
+         {"device": "tpu",
+          "failover_ab": {"survivor_steady_ratio": 0.85, "shards": 4,
+                          "ratio_clamped": False}}],
+        [{"device": "tpu",
+          "failover_ab": {"survivor_steady_ratio": 0.85, "shards": 4,
+                          "ratio_clamped": False}},
+         {"device": "tpu",
+          "failover_ab": {"survivor_steady_ratio": 1.25, "shards": 4,
+                          "ratio_clamped": False}}],
+    ):
+        rec = db.analyze(records)["recommendations"]["shard_count.tpu"]
+        assert rec["flip"] is False, records
+        assert rec["measured"] == 0.85
 
 
 def test_bench_smoke_pallas_match():

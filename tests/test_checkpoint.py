@@ -73,6 +73,72 @@ class TestFileFormat:
         save_checkpoint(p, {"a": np.zeros(4, np.float32)})
         assert [f for f in os.listdir(tmp_path)] == ["ck.npz"]
 
+    def test_truncated_at_every_cut_rejected(self, tmp_path):
+        """A torn write of ANY length (power loss through a non-atomic
+        copy of the file) is a clean None, never a crash."""
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": np.arange(64, dtype=np.int32)})
+        raw = open(p, "rb").read()
+        for cut in range(0, len(raw), max(1, len(raw) // 23)):
+            with open(p, "wb") as f:
+                f.write(raw[:cut])
+            assert load_checkpoint(p) is None, cut
+
+    def test_crc_mismatch_rejected(self, tmp_path):
+        """A corrupt-but-well-formed npz (unzips, parses, matches the
+        manifest's shape/dtype — e.g. storage-layer corruption, or a
+        buggy writer pairing a stale payload with a fresh manifest) is
+        caught ONLY by the per-array CRC32 leg."""
+        import json
+        import zipfile
+
+        p = str(tmp_path / "ck.npz")
+        arr = np.arange(64, dtype=np.int32)
+        save_checkpoint(p, {"a": arr, "b": np.ones(3, np.float32)})
+        with np.load(p) as z:
+            meta_raw = z["__meta__"]
+        bad = arr.copy()
+        bad[17] ^= 1  # one flipped bit, same shape/dtype
+        with open(p, "wb") as f:
+            np.savez(f, __meta__=meta_raw, a=bad,
+                     b=np.ones(3, np.float32))
+        with zipfile.ZipFile(p) as z:  # well-formed as a zip...
+            assert z.testzip() is None
+        meta = json.loads(meta_raw.tobytes())
+        assert meta["arrays"]["a"]["shape"] == [64]  # ...and manifest
+        assert load_checkpoint(p) is None  # only the CRC catches it
+
+    def test_manifest_array_missing_rejected(self, tmp_path):
+        p = str(tmp_path / "ck.npz")
+        save_checkpoint(p, {"a": np.arange(8, dtype=np.int32),
+                            "b": np.zeros(2, np.float32)})
+        with np.load(p) as z:
+            meta_raw, b = z["__meta__"], z["b"]
+        with open(p, "wb") as f:
+            np.savez(f, __meta__=meta_raw, b=b)  # "a" vanished
+        assert load_checkpoint(p) is None
+
+    def test_pre_crc_checkpoint_still_loads(self, tmp_path):
+        """Checkpoints written before the crc32 manifest field carry
+        shape/dtype only; they must keep loading (the CRC leg is
+        skipped, not required)."""
+        import json
+
+        p = str(tmp_path / "ck.npz")
+        arr = np.arange(16, dtype=np.int32)
+        save_checkpoint(p, {"a": arr})
+        with np.load(p) as z:
+            meta = json.loads(z["__meta__"].tobytes())
+        for spec in meta["arrays"].values():
+            del spec["crc32"]
+        with open(p, "wb") as f:
+            np.savez(f, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), np.uint8
+            ), a=arr)
+        loaded = load_checkpoint(p)
+        assert loaded is not None
+        np.testing.assert_array_equal(loaded[0]["a"], arr)
+
 
 class TestChainResume:
     def test_chain_state_survives_disk_roundtrip(self, tmp_path):

@@ -1,0 +1,1041 @@
+"""Shard-loss failover: elastic fleet membership, cross-shard stream
+migration, and crash-safe re-admission (parallel/service.
+ElasticFleetService + parallel/sharding.FleetTopology +
+driver/health.ShardHealth + driver/chaos.ShardChaosSchedule).
+
+The acceptance contract this suite pins:
+
+  * **Kill -> evacuate -> re-admit, bit-exact** — a deterministic chaos
+    shard-kill of 1 of 4 shards (8 streams) completes the full cycle:
+    every victim stream's filter+map state is restored from its last
+    per-stream snapshot into a surviving shard's idle lane BEFORE bytes
+    flow (decode carries reset), and on re-admission streams migrate
+    back via fresh live snapshots.  Every stream's outputs and final
+    map are byte-for-byte equal to the host-golden replay of its
+    recorded plan (feed the included ticks, reset decoder+assembler at
+    each recorded reset — the filter window and map carry through).
+  * **Zero recompiles / zero implicit transfers** — the whole cycle
+    runs inside utils/guards.steady_state: membership changes relabel
+    which lanes are live (the idle padding lanes the compiled programs
+    already encode), never shapes, and every migration rides the
+    row-sized dynamic-index gather/scatter programs warmed at
+    precompile.
+  * The placement planner, shard FSM, and shard-loss schedule as
+    units; the /diagnostics shard-topology rendering; the snapshot
+    version-mismatch reject paths the migration depends on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+from rplidar_ros2_driver_tpu.driver.chaos import (
+    ShardChaosConfig,
+    ShardChaosSchedule,
+)
+from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+from rplidar_ros2_driver_tpu.driver.health import (
+    ShardHealth,
+    ShardHealthConfig,
+    ShardState,
+)
+from rplidar_ros2_driver_tpu.driver.ingest import (
+    INGEST_STREAM_SNAPSHOT_VERSION,
+    FleetFusedIngest,
+)
+from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+from rplidar_ros2_driver_tpu.ops.scan_match import MAP_STATE_VERSION
+from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+from rplidar_ros2_driver_tpu.parallel.sharding import FleetTopology
+from rplidar_ros2_driver_tpu.utils import guards
+
+from test_chaos import DENSE, OUT_FIELDS, _fleet_ticks, _map_params
+from test_fused_ingest import BEAMS, _params
+
+MAP_KEYS = ("log_odds", "pose", "origin_xy", "revision")
+
+
+def _host_replay_plan(ticks, plan, streams, params):
+    """The host-golden replay of an elastic pod's recorded per-stream
+    plan (ElasticFleetService.replay_plan): per stream, an independent
+    decoder + assembler + chain + host mapper over every tick EXCEPT the
+    ``excluded`` ones (ticks whose effect died with a shard), with the
+    decoder and assembler reset at each ``resets`` tick (the migration's
+    decode-carry reset) — the filter window and map, like the restored
+    snapshot rows, carry straight through."""
+    per_tick = [[None] * streams for _ in ticks]
+    mappers = [FleetMapper(params, 1, beams=BEAMS) for _ in range(streams)]
+    for i in range(streams):
+        completed: list = []
+        asm = ScanAssembler(
+            on_complete=lambda sc, c=completed: c.append(dict(sc))
+        )
+        dec = BatchScanDecoder(asm)
+        chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+        resets = set(plan[i]["resets"])
+        excluded = set(plan[i]["excluded"])
+        for t, tick in enumerate(ticks):
+            if t in resets:
+                dec.reset()
+                asm.reset()
+            if t in excluded:
+                continue
+            item = tick[i]
+            n0 = len(completed)
+            if item:
+                dec.on_measurement_batch(item[0], list(item[1]))
+            outs = [
+                chain.process_raw(
+                    sc["angle_q14"], sc["dist_q2"], sc["quality"], sc["flag"]
+                )
+                for sc in completed[n0:]
+            ]
+            if outs:
+                per_tick[t][i] = outs[-1]
+                mappers[i].submit([outs[-1]])
+    return per_tick, mappers
+
+
+def _pod_params(**over):
+    base = dict(
+        fleet_ingest_backend="fused", map_backend="fused",
+        shard_count=4, shard_lanes=0,
+        failover_snapshot_ticks=4,
+        shard_backoff_base_s=0.45, shard_backoff_max_s=2.0,
+        shard_backoff_jitter=0.0,
+        shard_starvation_ticks=8, shard_suspect_ticks=4,
+        shard_probation_ticks=2,
+    )
+    base.update(over)
+    return _map_params(**base)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 acceptance test
+# ---------------------------------------------------------------------------
+
+
+class TestShardFailoverParity:
+    def test_shard_kill_evacuate_readmit_bit_exact_zero_recompiles(self):
+        """4 shards x 8 streams; a deterministic chaos kill takes shard
+        1 (streams 1 and 5) down for 2 ticks past its last snapshot.
+        The pod must evacuate both victims onto surviving shards' idle
+        lanes from their stored snapshots, re-admit the shard after
+        backoff+probe and migrate streams back via fresh live
+        snapshots — all with zero recompiles / zero implicit transfers,
+        and every stream's outputs + final map byte-for-byte equal to
+        the host-golden replay of the recorded plan."""
+        streams, shards, revs = 8, 4, 12
+        ticks = _fleet_ticks(streams, revs)  # 2 ticks per revolution
+        kill_start, kill_stop = 10, 12  # last snapshot at tick 7
+        params = _pod_params()
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        assert pod.topology.lanes == 3  # auto: ceil(8 / (4-1))
+        pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+            kills=((1, kill_start, kill_stop),),
+        )))
+        pod.precompile([DENSE])
+
+        outs_log = []
+        warm = 3  # compiles + window fill, outside the guarded region
+        for tick in ticks[:warm]:
+            outs_log.append(pod.submit_bytes(tick))
+            fake["now"] += 0.1
+        with guards.steady_state(tag="shard kill/evacuate/readmit"):
+            for tick in ticks[warm:]:
+                outs_log.append(pod.submit_bytes(tick))
+                fake["now"] += 0.1
+
+        # the cycle completed: one loss, one evacuation, one
+        # re-admission, and the victims migrated twice (out and back)
+        kinds = [e[1] for e in pod.events]
+        assert "lost" in kinds and "evacuated" in kinds
+        assert "readmitting" in kinds and "migrated" in kinds
+        assert pod.evacuations == 1 and pod.readmits == 1
+        assert pod.migrations == 4  # 2 victims out + 2 back
+        assert pod.shard_health[1].state is ShardState.UP  # probation done
+        assert pod.topology.unhosted() == []
+        victims = {s for (_t, kind, s, *_r) in pod.events
+                   if kind == "evacuated"}
+        assert victims == {1, 5}  # round-robin: streams 1,5 on shard 1
+
+        # the recorded replay plan: the victims lost exactly the ticks
+        # the dead shard absorbed after their last snapshot, and reset
+        # decode carries at the evacuation and at the migration back
+        plan = pod.replay_plan()
+        readmit_tick = next(
+            t for (t, kind, *_r) in pod.events if kind == "readmitting"
+        )
+        for i in range(streams):
+            if i in victims:
+                assert plan[i]["excluded"] == [8, 9], i
+                assert plan[i]["resets"] == [kill_start, readmit_tick], i
+            else:
+                assert plan[i]["excluded"] == [] and plan[i]["resets"] == []
+
+        # host-golden replay: outputs bit-exact at every non-excluded
+        # tick, for survivors and migrated victims alike
+        host_params = _pod_params(map_backend="host")
+        per_tick, host_mappers = _host_replay_plan(
+            ticks, plan, streams, host_params
+        )
+        published = 0
+        post_migration = {i: 0 for i in victims}
+        for t, row in enumerate(outs_log):
+            for i in range(streams):
+                if t in set(plan[i]["excluded"]):
+                    continue  # the tick's effect died with the shard
+                h, f = per_tick[t][i], row[i]
+                assert (h is None) == (f is None), (t, i)
+                if h is None:
+                    continue
+                published += 1
+                if i in victims and t >= readmit_tick:
+                    post_migration[i] += 1
+                for field in OUT_FIELDS:
+                    assert np.array_equal(
+                        np.asarray(getattr(h, field)),
+                        np.asarray(getattr(f, field)),
+                    ), (t, i, field)
+        assert published >= 2 * streams  # real coverage, not idle ticks
+        # every migrated stream published bit-exact output AFTER its
+        # migration back — the "post-migration output" criterion
+        assert all(v >= 1 for v in post_migration.values())
+
+        # final maps: each stream's fused map row (pulled from whichever
+        # shard hosts it now) is bit-exact vs its host mapper — the
+        # victims' maps crossed TWO snapshot/restore migrations
+        for i in range(streams):
+            s, lane = pod.topology.placement(i)
+            fused_row = pod.shards[s].mapper.snapshot_stream(lane)
+            host_row = host_mappers[i].snapshot_stream(0)
+            for k in MAP_KEYS:
+                assert np.array_equal(fused_row[k], host_row[k]), (i, k)
+
+        # the evacuation-latency decomposition the bench also reports
+        ev = pod.last_evacuation
+        assert ev["shard"] == 1 and sorted(ev["streams"]) == [1, 5]
+        assert ev["snapshot_pull_ms"] >= 0.0
+        assert ev["restore_scatter_ms"] > 0.0
+        assert ev["first_tick_ms"] > 0.0  # the tick that resumed flow
+
+    def test_heartbeat_failure_evacuates_and_excludes_the_tick(self):
+        """A raised dispatch is a shard heartbeat failure: the shard is
+        LOST mid-tick, its victims lose THAT tick's bytes (consumed by
+        the dead dispatch — recorded in the replay plan) and are
+        restored onto survivors before the next tick's bytes flow."""
+        streams, shards = 4, 2
+        ticks = _fleet_ticks(streams, 8)
+        params = _pod_params(shard_count=2, map_enable=False)
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        pod.precompile([DENSE])
+        boom_tick = 4
+        real_submit = pod.shards[1].submit_bytes
+
+        def maybe_boom(items):
+            if pod.tick_no == boom_tick:
+                raise RuntimeError("device fell off the bus")
+            return real_submit(items)
+
+        pod.shards[1].submit_bytes = maybe_boom
+        outs_log = []
+        for tick in ticks:
+            outs_log.append(pod.submit_bytes(tick))
+            fake["now"] += 0.1
+        assert pod.evacuations == 1
+        assert pod.shard_health[1].losses == 1
+        lost = next(e for e in pod.events if e[1] == "lost")
+        assert lost[2] == 1 and "heartbeat" in lost[3]
+        plan = pod.replay_plan()
+        for i in (1, 3):  # round-robin: shard 1 hosted streams 1, 3
+            assert boom_tick in plan[i]["excluded"], i
+            assert boom_tick in plan[i]["resets"], i
+        # the victims kept publishing from their new lanes, bit-exact
+        per_tick, _ = _host_replay_plan(
+            ticks, plan, streams, _pod_params(shard_count=2,
+                                              map_enable=False),
+        )
+        resumed = 0
+        for t in range(boom_tick + 1, len(ticks)):
+            for i in (1, 3):
+                h, f = per_tick[t][i], outs_log[t][i]
+                assert (h is None) == (f is None), (t, i)
+                if h is not None:
+                    resumed += 1
+                    assert np.array_equal(
+                        np.asarray(h.ranges), np.asarray(f.ranges)
+                    ), (t, i)
+        assert resumed >= 2
+
+    def test_starvation_loss_evacuates_via_the_fsm(self):
+        """An FSM-driven loss (no exception, no chaos kill): the
+        victims' upstream goes silent, tick starvation walks the shard
+        UP -> SUSPECT -> LOST inside the tick loop, and the SAME
+        wipe+evacuate handler as a hard kill must run — victims
+        restored onto survivors, replay plan recorded, shard
+        re-admitted once bytes resume, everything bit-exact."""
+        streams, shards = 4, 2
+        ticks = _fleet_ticks(streams, 14)
+        # silence ends BEFORE the re-admission poll: a shard whose
+        # upstream is still dry at probation relapses (escalated) by
+        # design, which would add a second loss/evacuation cycle here
+        silent_start, silent_stop = 6, 12
+        params = _pod_params(
+            shard_count=2, map_enable=False,
+            shard_starvation_ticks=2, shard_suspect_ticks=2,
+        )
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        pod.precompile([DENSE])
+        victims = (1, 3)  # round-robin: shard 1's streams
+        fed = []
+        outs_log = []
+        for t, tick in enumerate(ticks):
+            tick = list(tick)
+            if silent_start <= t < silent_stop:
+                for i in victims:
+                    tick[i] = None  # upstream dried up
+            fed.append(tick)
+            outs_log.append(pod.submit_bytes(tick))
+            fake["now"] += 0.1
+        lost = next(e for e in pod.events if e[1] == "lost")
+        assert lost[2] == 1 and "starved" in lost[3]
+        assert pod.evacuations == 1 and pod.readmits == 1
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        assert pod.shard_health[1].state is ShardState.UP
+        plan = pod.replay_plan()
+        for i in victims:
+            # the t=7 refresh fell inside the SUSPECT window (silence
+            # began at 6, starvation_ticks=2) and was therefore
+            # SKIPPED: the FSM had stopped trusting the shard's state,
+            # so the last trusted snapshot is t=3 and the victims'
+            # data ticks 4 and 5 died with the distrusted device state
+            assert plan[i]["excluded"] == [4, 5], i
+            assert len(plan[i]["resets"]) == 2, i  # out and back
+        per_tick, _ = _host_replay_plan(
+            fed, plan, streams, _pod_params(shard_count=2,
+                                            map_enable=False),
+        )
+        resumed = 0
+        for t, row in enumerate(outs_log):
+            for i in range(streams):
+                if t in set(plan[i]["excluded"]):
+                    continue
+                h, f = per_tick[t][i], row[i]
+                assert (h is None) == (f is None), (t, i)
+                if h is not None:
+                    assert np.array_equal(
+                        np.asarray(h.ranges), np.asarray(f.ranges)
+                    ), (t, i)
+                    if i in victims and t >= silent_stop:
+                        resumed += 1
+        assert resumed >= 2  # victims published again, bit-exact
+
+    def test_double_loss_unhosted_victims_replay_stays_bit_exact(self):
+        """Double loss beyond capacity: the second shard's victims find
+        no idle lane and go unhosted — the ticks the dead shard
+        absorbed after their last snapshot must STILL be excluded from
+        the replay plan (their later re-hosting restores from that
+        snapshot), and once the first shard re-admits they come back
+        bit-exact."""
+        streams, shards = 6, 3
+        ticks = _fleet_ticks(streams, 14)
+        params = _pod_params(shard_count=3, map_enable=False)
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        assert pod.topology.lanes == 3
+        # shard 0 recovers; shard 1 never does
+        pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+            kills=((0, 6, 12), (1, 9, 0)),
+        )))
+        pod.precompile([DENSE])
+        outs_log = []
+        for tick in ticks:
+            outs_log.append(pod.submit_bytes(tick))
+            fake["now"] += 0.1
+        assert pod.evacuations == 2 and pod.readmits == 1
+        assert pod.topology.unhosted() == []
+        # shard 1's victims at its death: its own streams plus the
+        # shard-0 evacuee it absorbed — all went unhosted
+        stranded = {1, 4, 0}
+        readmit_tick = next(
+            t for (t, kind, *_r) in pod.events if kind == "readmitting"
+        )
+        plan = pod.replay_plan()
+        for i in stranded:
+            # tick 8 (after the t=7 snapshot, before the t=9 loss) died
+            # with shard 1's state: it must be excluded even though the
+            # stream found no lane to evacuate to
+            assert 8 in plan[i]["excluded"], (i, plan[i])
+            # and the whole unhosted stretch rides along
+            assert set(range(9, readmit_tick)) <= set(
+                plan[i]["excluded"]
+            ), i
+            assert readmit_tick in plan[i]["resets"], i
+        per_tick, _ = _host_replay_plan(
+            ticks, plan, streams, _pod_params(shard_count=3,
+                                              map_enable=False),
+        )
+        rehosted = 0
+        for t, row in enumerate(outs_log):
+            for i in range(streams):
+                if t in set(plan[i]["excluded"]):
+                    continue
+                h, f = per_tick[t][i], row[i]
+                assert (h is None) == (f is None), (t, i)
+                if h is not None:
+                    assert np.array_equal(
+                        np.asarray(h.ranges), np.asarray(f.ranges)
+                    ), (t, i)
+                    if i in stranded and t > readmit_tick:
+                        rehosted += 1
+        assert rehosted >= 3  # every stranded stream came back
+
+    def test_snapshots_disabled_victims_restart_fresh(self):
+        """failover_snapshot_ticks=0: no snapshot store, so a victim
+        restores as a FRESH stream — every pre-loss tick is excluded
+        from its replay plan (the honest contract: the state is gone)."""
+        streams, shards = 4, 2
+        ticks = _fleet_ticks(streams, 8)
+        params = _pod_params(
+            shard_count=2, map_enable=False, failover_snapshot_ticks=0,
+        )
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        pod.precompile([DENSE])
+        kill = 5
+        pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+            kills=((1, kill, 0),),  # never recovers
+        )))
+        outs_log = []
+        for tick in ticks:
+            outs_log.append(pod.submit_bytes(tick))
+            fake["now"] += 0.1
+        plan = pod.replay_plan()
+        for i in (1, 3):
+            # every data tick before the kill died with the shard state
+            assert plan[i]["excluded"] == list(range(kill)), i
+        per_tick, _ = _host_replay_plan(
+            ticks, plan, streams,
+            _pod_params(shard_count=2, map_enable=False),
+        )
+        for t in range(kill, len(ticks)):
+            for i in (1, 3):
+                h, f = per_tick[t][i], outs_log[t][i]
+                assert (h is None) == (f is None), (t, i)
+                if h is not None:
+                    assert np.array_equal(
+                        np.asarray(h.ranges), np.asarray(f.ranges)
+                    ), (t, i)
+
+    def test_suspect_shard_snapshots_are_not_refreshed(self):
+        """SUSPECT is the FSM saying 'this device's state may be
+        garbage': the periodic refresh must not overwrite a stream's
+        last trusted snapshot with an in-window pull — a later
+        evacuation would restore FROM the distrusted state, breaking
+        the host-golden replay contract (which excludes every tick
+        since the last TRUSTED snapshot).  Refresh resumes at UP."""
+        streams, shards = 4, 2
+        ticks = _fleet_ticks(streams, 10)  # 20 ticks
+        params = _pod_params(
+            shard_count=2, map_enable=False, failover_snapshot_ticks=2,
+            shard_starvation_ticks=2, shard_suspect_ticks=50,
+        )
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        pod.precompile([DENSE])
+        victims = (1, 3)  # round-robin: shard 1's streams
+        # victims silent for t in [6, 14): starvation (starved > 2)
+        # marks shard 1 SUSPECT at t=8; suspect_ticks=50 keeps it
+        # there (never LOST) until bytes resume
+        silent_start, silent_stop = 6, 14
+        frozen = {}
+        for t, tick in enumerate(ticks):
+            tick = list(tick)
+            if silent_start <= t < silent_stop:
+                for i in victims:
+                    tick[i] = None
+            pod.submit_bytes(tick)
+            fake["now"] += 0.1
+            if t == 8:
+                assert pod.shard_health[1].state is ShardState.SUSPECT
+                frozen = {i: pod._snap[i][0] for i in range(streams)}
+                # SUSPECT entered at t=7 (starved 3 > 2), BEFORE that
+                # tick's refresh ran: the last trusted snapshot is t=5
+                assert frozen[victims[0]] == 5
+            if t == 13:
+                # three refresh intervals (t=9,11,13) passed while
+                # SUSPECT: the stored snapshots never advanced
+                for i in victims:
+                    assert pod._snap[i][0] == frozen[i], i
+        # bytes resumed at t=14 -> probation promoted the shard back to
+        # UP and the refresh caught the victims up
+        assert pod.shard_health[1].state is ShardState.UP
+        for i in victims:
+            assert pod._snap[i][0] > frozen[i], i
+        # the healthy shard's streams refreshed throughout
+        for i in (0, 2):
+            assert pod._snap[i][0] == len(ticks) - 1, i
+
+    def test_same_tick_double_kill_never_evacuates_onto_a_casualty(self):
+        """Two shards chaos-killed at the SAME tick: the tick's full
+        down set is marked LOST before any evacuation runs, so the
+        first casualty's victims are never restored onto the second
+        (and then immediately re-evacuated) — every evacuation's
+        destination is a genuine survivor and no victim is evacuated
+        twice (no phantom migration counts, no double restore work)."""
+        streams, shards = 8, 4
+        ticks = _fleet_ticks(streams, 8)
+        params = _pod_params(map_enable=False)
+        fake = {"now": 0.0}
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,), clock=lambda: fake["now"],
+        )
+        pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+            kills=((1, 6, 0), (2, 6, 0)),  # same tick, never recover
+        )))
+        pod.precompile([DENSE])
+        for tick in ticks:
+            pod.submit_bytes(tick)
+            fake["now"] += 0.1
+        assert pod.evacuations == 2
+        evac = [e for e in pod.events if e[1] == "evacuated"]
+        # (t, "evacuated", stream, src, dst, lane): every destination
+        # is a surviving shard, and nobody was moved twice
+        assert evac and all(e[4] in (0, 3) for e in evac)
+        moved = [e[2] for e in evac]
+        assert len(moved) == len(set(moved))
+        # capacity check: 4 victims, 2 survivor idle lanes -> exactly
+        # 2 restored, 2 honestly unhosted (not silently double-placed)
+        assert len(moved) == 2
+        assert len(pod.topology.unhosted()) == 2
+        assert pod.migrations == 2
+
+
+# ---------------------------------------------------------------------------
+# placement planner units
+# ---------------------------------------------------------------------------
+
+
+class TestFleetTopology:
+    def test_round_robin_initial_placement(self):
+        topo = FleetTopology(8, 4, 3)
+        for i in range(8):
+            assert topo.placement(i)[0] == i % 4
+        assert topo.streams_on(0) == [0, 4]
+        assert topo.unhosted() == []
+
+    def test_capacity_invariant_rejected(self):
+        with pytest.raises(ValueError, match="cannot host"):
+            FleetTopology(9, 2, 4)
+        with pytest.raises(ValueError, match="survive a"):
+            FleetTopology(8, 4, 2)  # (4-1)*2 < 8: one loss strands
+        FleetTopology(8, 4, 3)      # (4-1)*3 >= 8: fine
+        FleetTopology(4, 1, 4)      # single shard: no failover headroom
+        with pytest.raises(ValueError):
+            FleetTopology(0, 2, 2)
+        with pytest.raises(ValueError):
+            FleetTopology(2, 0, 2)
+        with pytest.raises(ValueError):
+            FleetTopology(2, 2, 0)
+
+    def test_lane_items_routes_and_inverts(self):
+        topo = FleetTopology(5, 2, 5)
+        items = [f"s{i}" for i in range(5)]
+        lane_items = topo.lane_items(0, items)
+        assert lane_items == ["s0", "s2", "s4", None, None]
+        assert topo.lane_streams(0) == [0, 2, 4, None, None]
+
+    def test_release_assign_and_avoid(self):
+        topo = FleetTopology(4, 2, 4)
+        topo.release(2)
+        assert topo.placement(2) is None and topo.unhosted() == [2]
+        with pytest.raises(ValueError):
+            topo.assign(0)  # already hosted
+        got = topo.assign(2, avoid=(0,))
+        assert got[0] == 1
+        topo.release(2)
+        assert topo.assign(2, avoid=(0, 1)) is None  # nowhere to go
+
+    def test_evacuate_moves_all_victims_to_least_loaded(self):
+        topo = FleetTopology(8, 4, 3)
+        plan = topo.evacuate(1)
+        assert [p[0] for p in plan] == [1, 5]
+        assert all(dst != 1 for (_s, dst, _l) in plan)
+        assert topo.streams_on(1) == [] and topo.unhosted() == []
+        loads = [len(topo.streams_on(s)) for s in range(4)]
+        assert sorted(loads) == [0, 2, 3, 3]
+
+    def test_double_loss_degrades_to_unhosted(self):
+        topo = FleetTopology(6, 3, 3)
+        topo.evacuate(0)
+        # second loss: the dead shard 0 is off-limits, shard 2 is full —
+        # the victims degrade to unhosted instead of raising (or worse,
+        # landing on the earlier casualty's wiped lanes)
+        plan = topo.evacuate(1, avoid=(0,))
+        assert plan == []
+        assert topo.unhosted() == [0, 1, 4]
+
+    def test_rebalance_into_restores_headroom(self):
+        topo = FleetTopology(8, 4, 3)
+        topo.evacuate(1)
+        moves = topo.rebalance_into(1)
+        # movers come from the most-loaded shards with their source
+        # lane recorded (the live-snapshot source)
+        assert len(moves) == 2
+        for stream, src, src_lane, dst, _lane in moves:
+            assert dst == 1 and src != 1 and src_lane >= 0
+        loads = [len(topo.streams_on(s)) for s in range(4)]
+        assert max(loads) - min(loads) <= 1
+
+    def test_rebalance_places_unhosted_first(self):
+        topo = FleetTopology(6, 3, 3)
+        topo.evacuate(0)
+        topo.evacuate(1, avoid=(0,))  # strands 0, 1, 4
+        moves = topo.rebalance_into(1)
+        unhosted_moves = [m for m in moves if m[1] == -1]
+        assert {m[0] for m in unhosted_moves} == {0, 1, 4}
+        assert topo.unhosted() == []
+
+    def test_status_shape(self):
+        topo = FleetTopology(4, 2, 4)
+        st = topo.status()
+        assert st == [
+            {"streams": [0, 2], "lanes": 4},
+            {"streams": [1, 3], "lanes": 4},
+        ]
+
+
+# ---------------------------------------------------------------------------
+# shard health FSM units
+# ---------------------------------------------------------------------------
+
+
+def _shard_cfg(**over):
+    base = dict(
+        starvation_ticks=2, suspect_ticks=2, probation_ticks=2,
+        backoff_base_s=1.0, backoff_max_s=8.0, backoff_jitter=0.0,
+    )
+    base.update(over)
+    return ShardHealthConfig(**base)
+
+
+class TestShardHealthFsm:
+    def test_force_lost_is_immediate_and_idempotent(self):
+        t = {"now": 0.0}
+        h = ShardHealth(_shard_cfg(), 3, clock=lambda: t["now"])
+        assert h.hosting
+        tr = h.force_lost("chaos: killed")
+        assert tr == (ShardState.UP, ShardState.LOST)
+        assert not h.hosting and h.losses == 1
+        assert h.force_lost("again") is None  # already lost
+        assert h.losses == 1
+
+    def test_starvation_walks_up_suspect_lost(self):
+        h = ShardHealth(_shard_cfg(), clock=lambda: 0.0)
+        h.observe(True, 2)  # streamed once
+        walked = [h.observe(True, 0) for _ in range(8)]
+        trs = [tr for tr in walked if tr]
+        assert trs[0] == (ShardState.UP, ShardState.SUSPECT)
+        assert trs[1] == (ShardState.SUSPECT, ShardState.LOST)
+        assert "starved" in h.last_reason
+
+    def test_suspect_clears_on_probation(self):
+        h = ShardHealth(_shard_cfg(suspect_ticks=5), clock=lambda: 0.0)
+        h.observe(True, 1)
+        for _ in range(4):
+            h.observe(True, 0)
+        assert h.state is ShardState.SUSPECT
+        trs = [h.observe(True, 1) for _ in range(3)]
+        assert (ShardState.SUSPECT, ShardState.UP) in [t for t in trs if t]
+
+    def test_idle_shard_is_not_sick(self):
+        h = ShardHealth(_shard_cfg(starvation_ticks=1), clock=lambda: 0.0)
+        for _ in range(10):
+            assert h.observe(False, 0) is None  # never streamed: idle
+        assert h.state is ShardState.UP
+
+    def test_readmit_gated_on_backoff_and_probe(self):
+        t = {"now": 0.0}
+        probe_ok = {"v": False}
+        h = ShardHealth(
+            _shard_cfg(), clock=lambda: t["now"],
+            probe=lambda: probe_ok["v"],
+        )
+        h.force_lost()
+        assert h.poll_readmit() is None  # backoff not expired
+        t["now"] = h.release_at + 0.1
+        assert h.poll_readmit() is None  # probe failed
+        assert h.probe_failures == 1 and h.backoff.attempt == 2
+        probe_ok["v"] = True
+        t["now"] = h.release_at + 0.1
+        assert h.poll_readmit() == (ShardState.LOST, ShardState.READMITTING)
+        # probation: clean ticks walk back to UP and reset the backoff
+        assert h.observe(True, 1) is None
+        assert h.observe(True, 1) == (ShardState.READMITTING, ShardState.UP)
+        assert h.readmissions == 1 and h.backoff.attempt == 0
+
+    def test_readmitting_relapse_escalates(self):
+        t = {"now": 0.0}
+        h = ShardHealth(_shard_cfg(starvation_ticks=1), clock=lambda: t["now"])
+        h.observe(True, 1)
+        h.force_lost()
+        t["now"] = h.release_at + 0.1
+        h.poll_readmit()
+        assert h.state is ShardState.READMITTING
+        trs = [h.observe(True, 0) for _ in range(3)]
+        assert (ShardState.READMITTING, ShardState.LOST) in [
+            tr for tr in trs if tr
+        ]
+        assert h.backoff.attempt >= 2  # escalated, not reset
+
+    def test_readmitting_silence_never_promotes(self):
+        """A probe-passing-but-dead shard must not fill probation with
+        offered-but-dry ticks: the clean streak counts PRODUCTIVE ticks
+        only (completions, or true idle), so silence walks starvation to
+        a relapse with the backoff ESCALATED — never to UP with the
+        backoff reset (the flap-forever bug: with probation_ticks <=
+        starvation_ticks the relapse edge used to be unreachable)."""
+        t = {"now": 0.0}
+        h = ShardHealth(
+            _shard_cfg(starvation_ticks=4, probation_ticks=2),
+            clock=lambda: t["now"],
+        )
+        h.observe(True, 1)  # streamed once, then died
+        h.force_lost()
+        t["now"] = h.release_at + 0.1
+        h.poll_readmit()
+        assert h.state is ShardState.READMITTING
+        # relapse horizon: one REFILL window (the migrate-back decode
+        # reset) on top of the normal starvation window = 2*4 ticks
+        trs = [h.observe(True, 0) for _ in range(10)]
+        assert ShardState.UP not in [tr[1] for tr in trs if tr]
+        assert h.state is ShardState.LOST      # relapsed via starvation
+        assert h.backoff.attempt >= 2          # escalated, not reset
+        # productive probation ticks DO promote (dry ticks in between
+        # are neutral: they neither fill nor reset the streak)
+        t["now"] = h.release_at + 0.1
+        h.poll_readmit()
+        seq = [(True, 1), (True, 0), (True, 1)]
+        trs = [h.observe(o, c) for o, c in seq]
+        assert (ShardState.READMITTING, ShardState.UP) in [
+            tr for tr in trs if tr
+        ]
+        assert h.backoff.attempt == 0  # reset on a REAL readmission
+
+    def test_lost_clears_streaming_history(self):
+        """An empty re-admitted shard (rebalance had no stream to give
+        it) must be idle, not sick: the loss wiped the engines, so the
+        'has ever streamed' flag restarts with them — carrying it
+        across the loss made such a shard starve on silence and flap
+        LOST/READMITTING forever on healthy hardware."""
+        t = {"now": 0.0}
+        h = ShardHealth(_shard_cfg(), clock=lambda: t["now"])
+        h.observe(True, 1)  # streamed, then died
+        h.force_lost()
+        t["now"] = h.release_at + 0.1
+        h.poll_readmit()
+        assert h.state is ShardState.READMITTING
+        trs = [h.observe(False, 0) for _ in range(4)]  # hosting nothing
+        assert (ShardState.READMITTING, ShardState.UP) in [
+            tr for tr in trs if tr
+        ]
+        assert h.state is ShardState.UP
+
+    def test_probe_exception_counts_as_failure(self):
+        t = {"now": 0.0}
+        h = ShardHealth(
+            _shard_cfg(), clock=lambda: t["now"],
+            probe=lambda: (_ for _ in ()).throw(RuntimeError("dead")),
+        )
+        h.force_lost()
+        t["now"] = h.release_at + 0.1
+        assert h.poll_readmit() is None and h.probe_failures == 1
+
+    def test_status_dict(self):
+        h = ShardHealth(_shard_cfg(), 2, clock=lambda: 0.0)
+        st = h.status()
+        assert st["state"] == "up" and st["losses"] == 0
+        for k in ("readmissions", "probe_failures", "backoff_attempt",
+                  "backoff_s", "reason"):
+            assert k in st
+
+    def test_config_validates_domain(self):
+        with pytest.raises(ValueError):
+            ShardHealthConfig(starvation_ticks=0)
+        with pytest.raises(ValueError):
+            ShardHealthConfig(suspect_ticks=0)
+        with pytest.raises(ValueError):
+            ShardHealthConfig(probation_ticks=0)
+        with pytest.raises(ValueError):
+            ShardHealthConfig(backoff_base_s=2.0, backoff_max_s=1.0)
+        with pytest.raises(ValueError):
+            ShardHealthConfig(backoff_jitter=1.5)
+
+    def test_from_params_reads_shard_keys(self):
+        cfg = ShardHealthConfig.from_params(_params(
+            shard_starvation_ticks=3, shard_suspect_ticks=5,
+            shard_probation_ticks=7, shard_backoff_base_s=0.25,
+            shard_backoff_max_s=9.0, shard_backoff_jitter=0.5,
+        ))
+        assert cfg.starvation_ticks == 3 and cfg.suspect_ticks == 5
+        assert cfg.probation_ticks == 7
+        assert cfg.backoff_base_s == 0.25 and cfg.backoff_max_s == 9.0
+        assert cfg.backoff_jitter == 0.5
+
+
+# ---------------------------------------------------------------------------
+# shard-loss schedule units
+# ---------------------------------------------------------------------------
+
+
+class TestShardChaosSchedule:
+    def test_explicit_kills_window(self):
+        s = ShardChaosSchedule(ShardChaosConfig(kills=((1, 5, 8),)))
+        assert not any(s.down(1, t) for t in range(5))
+        assert all(s.down(1, t) for t in range(5, 8))
+        assert not any(s.down(1, t) for t in range(8, 12))
+        assert not any(s.down(0, t) for t in range(12))
+
+    def test_stop_zero_never_recovers(self):
+        s = ShardChaosSchedule(ShardChaosConfig(kills=((2, 3, 0),)))
+        assert not s.down(2, 2)
+        assert all(s.down(2, t) for t in (3, 100, 10_000))
+
+    def test_seeded_outages_are_deterministic(self):
+        cfg = ShardChaosConfig(seed=7, kill_rate=0.05, outage_ticks=4)
+        a, b = ShardChaosSchedule(cfg), ShardChaosSchedule(cfg)
+        got = [(s, t) for s in range(4) for t in range(200)
+               if a.down(s, t)]
+        assert got == [(s, t) for s in range(4) for t in range(200)
+                       if b.down(s, t)]
+        assert got  # the rate actually fires at this seed
+        other = ShardChaosSchedule(ShardChaosConfig(
+            seed=8, kill_rate=0.05, outage_ticks=4,
+        ))
+        assert got != [(s, t) for s in range(4) for t in range(200)
+                       if other.down(s, t)]
+
+    def test_outage_spans_outage_ticks(self):
+        cfg = ShardChaosConfig(seed=3, kill_rate=0.02, outage_ticks=5)
+        s = ShardChaosSchedule(cfg)
+        downs = [t for t in range(400) if s.down(0, t)]
+        assert downs
+        # every down tick belongs to a run of >= 1 started by a draw;
+        # runs last at least until the starting draw ages out
+        runs = np.split(np.asarray(downs),
+                        np.where(np.diff(downs) > 1)[0] + 1)
+        assert all(len(r) >= 1 for r in runs)
+        assert max(len(r) for r in runs) >= 5  # a full outage span
+
+    def test_down_shards_aggregates(self):
+        s = ShardChaosSchedule(ShardChaosConfig(
+            kills=((0, 1, 3), (2, 2, 4)),
+        ))
+        assert s.down_shards(2, 4) == frozenset({0, 2})
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError):
+            ShardChaosConfig(kill_rate=1.5)
+        with pytest.raises(ValueError):
+            ShardChaosConfig(kill_rate=0.1)  # needs outage_ticks
+        with pytest.raises(ValueError):
+            ShardChaosConfig(kills=((1, 2),))
+        with pytest.raises(ValueError):
+            ShardChaosConfig(kills=((1, 5, 4),))  # stop <= start
+        with pytest.raises(ValueError):
+            ShardChaosConfig(kills=((-1, 0, 2),))
+
+
+# ---------------------------------------------------------------------------
+# snapshot version-mismatch reject paths (the migration's schema gate)
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotVersionRejects:
+    def _ingest(self):
+        eng = FleetFusedIngest(_params(), 2, beams=BEAMS, buckets=(4,))
+        return eng, eng.snapshot_stream(0)
+
+    def test_ingest_forward_version_rejected_state_untouched(self):
+        eng, snap = self._ingest()
+        before = eng.snapshot_stream(1)
+        fwd = dict(snap)
+        fwd["version"] = np.asarray(
+            INGEST_STREAM_SNAPSHOT_VERSION + 1, np.int32
+        )
+        assert not eng.restore_stream(1, fwd)
+        after = eng.snapshot_stream(1)
+        for k in before:
+            if before[k].dtype.kind == "f":
+                # the fresh lane's timestamp base is NaN (= no base)
+                assert np.array_equal(
+                    before[k], after[k], equal_nan=True
+                ), k
+            else:
+                assert np.array_equal(before[k], after[k]), k
+
+    def test_ingest_missing_version_rejected(self):
+        eng, snap = self._ingest()
+        missing = {k: v for k, v in snap.items() if k != "version"}
+        assert not eng.restore_stream(0, missing)
+
+    def _mapper(self):
+        m = FleetMapper(_map_params(map_backend="fused"), 2, beams=64)
+        pts = np.random.default_rng(1).uniform(-2, 2, (2, 64, 2))
+        m.submit_points(
+            pts.astype(np.float32), np.ones((2, 64), bool),
+            np.ones((2,), np.int32),
+        )
+        return m, m.snapshot_stream(0)
+
+    def test_mapper_forward_version_rejected_state_untouched(self):
+        m, snap = self._mapper()
+        before = m.snapshot_stream(1)
+        fwd = dict(snap)
+        fwd["version"] = np.asarray(MAP_STATE_VERSION + 1, np.int32)
+        assert not m.restore_stream(1, fwd)
+        after = m.snapshot_stream(1)
+        for k in MAP_KEYS:
+            assert np.array_equal(before[k], after[k]), k
+
+    def test_mapper_missing_version_rejected(self):
+        m, snap = self._mapper()
+        missing = {k: v for k, v in snap.items() if k != "version"}
+        assert not m.restore_stream(0, missing)
+
+
+# ---------------------------------------------------------------------------
+# /diagnostics shard-topology rendering (pinned like stream_health)
+# ---------------------------------------------------------------------------
+
+
+class TestShardDiagnostics:
+    def test_rendering_pinned(self):
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        payload = {
+            "shards": [
+                {"state": "up", "streams": [0, 4], "reason": "",
+                 "evacuations": 0, "migrations_in": 2,
+                 "last_migration_tick": 15},
+                {"state": "lost", "streams": [],
+                 "reason": "chaos: shard killed", "evacuations": 1,
+                 "migrations_in": 0, "last_migration_tick": None},
+            ],
+            "evacuations": 1,
+            "migrations": 4,
+            "readmits": 1,
+            "last_migration_tick": 15,
+            "unhosted": [],
+        }
+        upd = DiagnosticsUpdater("rig", CollectingPublisher())
+        status = upd.update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="pod", rpm=0, device_info="",
+            shard_topology=payload,
+        )
+        assert status.values["Shard 0"] == "up [0,4]"
+        assert status.values["Shard 1"] == "lost [] (chaos: shard killed)"
+        assert status.values["Evacuations"] == "1"
+        assert status.values["Stream Migrations"] == "4"
+        assert status.values["Shard Readmissions"] == "1"
+        assert status.values["Last Migration Tick"] == "15"
+
+    def test_pod_payload_feeds_the_renderer(self):
+        """failover_status() is shaped for the shard_topology surface:
+        the live pod's payload renders without adaptation."""
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        pod = ElasticFleetService(
+            _pod_params(shard_count=2, map_enable=False), 4,
+            shards=2, beams=BEAMS, fleet_ingest_buckets=(8,),
+        )
+        status = DiagnosticsUpdater("rig", CollectingPublisher()).update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="pod", rpm=0, device_info="",
+            shard_topology=pod.failover_status(),
+        )
+        assert status.values["Shard 0"] == "up [0,2]"
+        assert status.values["Shard 1"] == "up [1,3]"
+        assert status.values["Last Migration Tick"] == "n/a"
+
+
+# ---------------------------------------------------------------------------
+# service seams
+# ---------------------------------------------------------------------------
+
+
+class TestElasticServiceSeams:
+    def test_auto_lanes_and_single_shard(self):
+        pod = ElasticFleetService(
+            _pod_params(shard_count=2, map_enable=False), 4,
+            shards=2, beams=BEAMS, fleet_ingest_buckets=(8,),
+        )
+        assert pod.topology.lanes == 4  # ceil(4 / (2-1))
+        solo = ElasticFleetService(
+            _pod_params(shard_count=1, map_enable=False), 3,
+            shards=1, beams=BEAMS, fleet_ingest_buckets=(8,),
+        )
+        assert solo.topology.lanes == 3  # no failover headroom to mint
+
+    def test_host_backend_rejected(self):
+        with pytest.raises(ValueError, match="fused"):
+            ElasticFleetService(
+                _pod_params(shard_count=2, map_enable=False,
+                            fleet_ingest_backend="host"),
+                4, shards=2, beams=BEAMS,
+            )
+
+    def test_migration_before_precompile_refused(self):
+        pod = ElasticFleetService(
+            _pod_params(shard_count=2, map_enable=False), 4,
+            shards=2, beams=BEAMS, fleet_ingest_buckets=(8,),
+        )
+        with pytest.raises(RuntimeError, match="precompile"):
+            pod._restore_into(0, 0, 0, None)
+
+    def test_wrong_item_count_rejected(self):
+        pod = ElasticFleetService(
+            _pod_params(shard_count=2, map_enable=False), 4,
+            shards=2, beams=BEAMS, fleet_ingest_buckets=(8,),
+        )
+        with pytest.raises(ValueError, match="per-stream"):
+            pod.submit_bytes([None] * 3)
