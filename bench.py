@@ -199,6 +199,7 @@ GRADED = {
     13: ("chaos", POINTS, dict(window=WINDOW)),  # degraded-fleet chaos throughput
     14: ("pallas_match", POINTS, dict(window=WINDOW)),  # matcher kernel xla-vs-pallas A/B
     15: ("failover", POINTS, dict(window=WINDOW)),  # shard-loss failover pod A/B
+    16: ("deskew", POINTS, dict(window=WINDOW)),  # de-skew + sweep-recon A/B
 }
 
 
@@ -2753,6 +2754,294 @@ def bench_pallas_match(smoke: bool = False) -> dict:
     }
 
 
+def bench_deskew(smoke: bool = False) -> dict:
+    """Config 16 — de-skew + sweep-reconstruction A/B: two identical
+    fused fleets (ShardedFilterService, fleet_ingest_backend=fused, a
+    host-reference FleetMapper attached) advance TICK-PAIRED over the
+    same byte stream; the RECONSTRUCT arm runs
+    ``deskew_enable=true`` (ops/deskew.py inside the one fused ingest
+    program), the baseline arm runs the plain per-revolution path.
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      * one ingest dispatch per tick PER ARM (engine counters): the
+        de-skew + reconstruction stages ride INSIDE the existing fused
+        program — same dispatch count, same transfer count;
+      * zero recompiles / zero implicit transfers across both timed
+        loops (utils/guards.steady_state wraps the paired loop);
+      * R× update multiplication: the reconstruct arm's mapper absorbs
+        >= 2 updates per physical revolution (one per DATA TICK from
+        the sub-sweep ring's newest-wins overlay) while the baseline
+        arm updates once per completed revolution — same byte stream,
+        same revolution count on both arms;
+      * zero-motion identity: the bench scene is static, so the motion
+        estimator must return exact zeros and the reconstruct arm's
+        per-revolution chain outputs must be BYTE-IDENTICAL to the
+        baseline arm's;
+      * bit-exact host replay: stream 0's reconstructed sweep planes
+        and de-skewed revolution outputs are replayed through the
+        NumPy host twin (ops/deskew_ref.DeskewHostTwin) + a golden
+        ScanFilterChain and compared byte-for-byte.
+
+    The artifact carries the clamped ``deskew_ab`` decision key
+    (scripts/decide_backends.py: only unclamped TPU records meeting
+    BOTH the >= 2x update multiplication and the tick-ratio floor can
+    recommend flipping ``deskew_enable`` on).  ``smoke`` shrinks
+    geometry to a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.ops.deskew import deskew_config_from_params
+    from rplidar_ros2_driver_tpu.ops.deskew_ref import DeskewHostTwin
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, grid = 4, 256, 32
+        points_per_rev, revs, capacity = 800, 8, 1024
+        streams, run, map_grid = 2, 8, 64
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 16, CAPACITY
+        streams, run, map_grid = 4, 16, 128
+    # dense capsules carry 40 samples: ticks per revolution = the
+    # update multiplier the reconstruct arm is architecturally owed
+    ticks_per_rev = points_per_rev / 40 / run
+    assert ticks_per_rev >= 2, "scene must span >= 2 ticks per revolution"
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    frames = _denseboost_wire_frames(revs, points_per_rev)
+    warm = 2
+
+    def build(deskew: bool):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused",
+            deskew_enable=deskew, sweep_reconstruct_window=4,
+            deskew_profile_beams=128, deskew_shift_window=4,
+            map_enable=True, map_backend="host",
+            map_grid=map_grid, map_cell_m=0.1,
+        )
+        svc = ShardedFilterService(
+            params, streams, beams=beams, capacity=capacity,
+            fleet_ingest_buckets=(run,),
+        )
+        svc._ensure_byte_ingest()
+        svc.fleet_ingest.precompile([ans])
+        if deskew:
+            svc.fleet_ingest.recon_log = True
+        svc.attach_mapper()
+        ticks = _paced_fleet_byte_ticks(frames, run, streams, ans)
+        for t in ticks[:warm]:
+            svc.submit_bytes(t)
+        return svc, params, ticks
+
+    base_svc, base_params, base_ticks = build(False)
+    rec_svc, rec_params, rec_ticks = build(True)
+    n_ticks = len(base_ticks) - warm
+    counts = {"base": {"revs": 0, "updates": 0},
+              "rec": {"revs": 0, "updates": 0}}
+    outputs = {"base": [], "rec": []}   # (tick, stream, ranges) triples
+    base_s: list[float] = []
+    rec_s: list[float] = []
+    d0b = base_svc.fleet_ingest.dispatch_count
+    d0r = rec_svc.fleet_ingest.dispatch_count
+    with guards.steady_state(tag="deskew A/B pair"):
+        for t, (bt, rt) in enumerate(
+            zip(base_ticks[warm:], rec_ticks[warm:])
+        ):
+            # alternate which arm goes first so any second-in-pair
+            # systematic cost cancels instead of biasing one arm
+            # (config 13's tick-paired discipline)
+            if t % 2 == 0:
+                tb = time.perf_counter()
+                res_b = base_svc.submit_bytes(bt)
+                tm = time.perf_counter()
+                res_r = rec_svc.submit_bytes(rt)
+                te = time.perf_counter()
+                base_s.append(tm - tb)
+                rec_s.append(te - tm)
+            else:
+                tb = time.perf_counter()
+                res_r = rec_svc.submit_bytes(rt)
+                tm = time.perf_counter()
+                res_b = base_svc.submit_bytes(bt)
+                te = time.perf_counter()
+                rec_s.append(tm - tb)
+                base_s.append(te - tm)
+            for name, svc, res in (
+                ("base", base_svc, res_b), ("rec", rec_svc, res_r)
+            ):
+                for i in range(streams):
+                    if res[i] is not None:
+                        counts[name]["revs"] += 1
+                        outputs[name].append(
+                            (t, i, np.asarray(res[i].ranges).copy())
+                        )
+                counts[name]["updates"] += sum(
+                    1 for p in svc.last_poses if p is not None
+                )
+                # baseline poses are per-revolution: clear so an idle
+                # tick cannot double-count the stash
+                svc.last_poses = [None] * streams
+
+    # -- structural claims: violations are bugs, not weather --
+    for name, svc, d0 in (
+        ("baseline", base_svc, d0b), ("reconstruct", rec_svc, d0r)
+    ):
+        got = svc.fleet_ingest.dispatch_count - d0
+        if got != n_ticks:
+            raise RuntimeError(
+                f"{name} arm: {got} ingest dispatches over {n_ticks} "
+                "ticks — not one dispatch per tick"
+            )
+    if counts["base"]["revs"] != counts["rec"]["revs"]:
+        raise RuntimeError(
+            f"arms completed different revolution counts "
+            f"({counts['base']['revs']} vs {counts['rec']['revs']}) on "
+            "the same byte stream"
+        )
+    # zero-motion identity: static scene => the reconstruct arm's
+    # per-revolution chain outputs are byte-identical to the baseline's
+    if len(outputs["base"]) != len(outputs["rec"]) or not all(
+        tb == tr and ib == ir and np.array_equal(a, b)
+        for (tb, ib, a), (tr, ir, b) in zip(outputs["base"], outputs["rec"])
+    ):
+        raise RuntimeError(
+            "reconstruct arm's revolution outputs diverged from the "
+            "baseline on a static scene — zero-motion de-skew is not "
+            "the identity"
+        )
+    update_multiplier = counts["rec"]["updates"] / max(
+        counts["base"]["updates"], 1
+    )
+    if update_multiplier < 2.0:
+        raise RuntimeError(
+            f"reconstruct arm delivered {update_multiplier:.2f}x the "
+            "baseline's map updates (claimed >= 2x per revolution)"
+        )
+
+    # -- bit-exact host replay (stream 0): NumPy twin + golden chain --
+    dsk = deskew_config_from_params(rec_params, beams)
+    twin = DeskewHostTwin(dsk, max_nodes=capacity)
+    chain = ScanFilterChain(rec_params, beams=beams, warmup=False)
+    twin_recons: list[np.ndarray] = []
+    twin_ranges: list[np.ndarray] = []
+    for items in (t[0] for t in rec_ticks):
+        combined, pushed, revs_t = twin.tick(items[0], items[1])
+        if pushed:
+            twin_recons.append(combined)
+        for a2, d2, scan in revs_t:
+            out = chain.process_raw(a2, d2, scan["quality"], scan["flag"])
+            twin_ranges.append(np.asarray(out.ranges).copy())
+    eng_recons = [
+        plane for plane, _pts in rec_svc.fleet_ingest.recon_history[0]
+    ]
+    if len(eng_recons) != len(twin_recons) or not all(
+        np.array_equal(a, b) for a, b in zip(eng_recons, twin_recons)
+    ):
+        raise RuntimeError(
+            "reconstructed sweep planes diverged from the NumPy host "
+            "twin — the de-skew/reconstruction datapath is not "
+            "bit-exact"
+        )
+    fused_ranges = [
+        r for t, i, r in outputs["rec"] if i == 0
+    ]
+    # at >= 2 ticks per revolution each tick completes at most one
+    # revolution, so the per-tick newest-wins seam drops nothing: the
+    # timed loop's outputs are exactly the TAIL of the twin's full
+    # replay (the warm ticks' completions precede it)
+    tail = twin_ranges[len(twin_ranges) - len(fused_ranges):]
+    if not fused_ranges or len(tail) != len(fused_ranges) or not all(
+        np.array_equal(a, b) for a, b in zip(fused_ranges, tail)
+    ):
+        raise RuntimeError(
+            "de-skewed revolution outputs diverged from the host-twin "
+            "golden chain replay"
+        )
+
+    base_dt = float(np.sum(base_s))
+    rec_dt = float(np.sum(rec_s))
+    pair_ratio = np.asarray(base_s) / np.maximum(np.asarray(rec_s), 1e-9)
+    steady_ratio = float(np.percentile(pair_ratio, 50))
+    value = counts["rec"]["updates"] / max(rec_dt, 1e-9)
+    base_ups = counts["base"]["updates"] / max(base_dt, 1e-9)
+    # EITHER arm under the 50 us/tick floor: the ratio's magnitude is
+    # the timer's, not the rig's — record evidence, never flip a
+    # default (the reconstruct arm can be the faster one, so a
+    # baseline-only check would let an under-floor rec arm smuggle an
+    # unclamped garbage ratio through)
+    clamped = min(
+        float(np.percentile(base_s, 50)), float(np.percentile(rec_s, 50))
+    ) < 50e-6
+    return {
+        "metric": metric_name(16),
+        "value": round(value, 2),
+        "unit": "updates/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "ticks": n_ticks,
+        "revolutions": counts["rec"]["revs"],
+        "updates": {
+            "baseline": counts["base"]["updates"],
+            "reconstruct": counts["rec"]["updates"],
+            "multiplier": round(update_multiplier, 3),
+            "ticks_per_rev": round(ticks_per_rev, 3),
+        },
+        "baseline_updates_per_sec": round(base_ups, 2),
+        "steady_tick_ratio": round(steady_ratio, 4),
+        "base_tick_p50_ms": round(
+            float(np.percentile(base_s, 50)) * 1e3, 3
+        ),
+        "rec_tick_p50_ms": round(
+            float(np.percentile(rec_s, 50)) * 1e3, 3
+        ),
+        "structural": {
+            "one_dispatch_per_tick": True,      # asserted above
+            "zero_recompiles": True,            # steady_state guard
+            "zero_implicit_transfers": True,    # steady_state guard
+            "update_multiplication": True,      # asserted above
+            "zero_motion_identity": True,       # asserted above
+            "host_twin_bit_exact": True,        # asserted above
+        },
+        # the decide_backends decision key for the deskew_enable
+        # recommendation: TPU records only, the clamp honored, and the
+        # flip additionally gated on the update multiplication AND a
+        # tick-ratio floor (the extra per-tick mapper work must not
+        # halve the fleet rate)
+        "deskew_ab": {
+            "update_multiplier": round(update_multiplier, 3),
+            "steady_tick_ratio": round(steady_ratio, 4),
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the R× claim is structural: the reconstruct arm emits one "
+            "mapper update per DATA TICK (the sub-sweep ring's "
+            "newest-wins overlay, cached segments reused across "
+            "overlapping windows) instead of one per completed "
+            "revolution, at an asserted-identical ingest dispatch "
+            "count.  The tick-time ratio records what the extra "
+            "updates cost on THIS rig — on a throttled 1.5-core CPU "
+            "the host-reference mapper dominates the tick, so the "
+            "ratio here is a mapper-throughput statement, not an "
+            "ingest one; the on-chip capture queued in "
+            "scripts/rig_recapture.sh (fused mapper, one vmapped "
+            "update dispatch per tick) is where the headline "
+            "map-update rate lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def metric_name(config: int) -> str:
     """The one config -> metric-name mapping (success AND failure records
     of a config must share a name to land in the same series)."""
@@ -2769,6 +3058,7 @@ def metric_name(config: int) -> str:
         13: "chaos_degraded_fleet_scans_per_sec",
         14: "pallas_match_kernel_scans_per_sec",
         15: "shard_failover_survivor_scans_per_sec",
+        16: "deskew_recon_map_updates_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -2792,6 +3082,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_pallas_match()
     if kind == "failover":
         return bench_failover()
+    if kind == "deskew":
+        return bench_deskew()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -3171,6 +3463,16 @@ if __name__ == "__main__":
         "elastic-fleet failover path",
     )
     ap.add_argument(
+        "--smoke-deskew",
+        action="store_true",
+        help="seconds-scale CPU run of the config-16 de-skew + sweep-"
+        "reconstruction A/B (small geometry, forced CPU backend, no "
+        "tunnel probe): asserts one dispatch per tick per arm, >= 2x "
+        "map-update multiplication, zero-motion identity and bit-exact "
+        "host-twin replay under the steady-state guard — the tier-1 "
+        "regression gate for the de-skew/reconstruction stage",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -3251,6 +3553,13 @@ if __name__ == "__main__":
         # must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_failover(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_deskew:
+        # same CPU-only discipline: the de-skew/reconstruction
+        # structural gate must run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_deskew(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -188,6 +188,33 @@ class DriverParams:
     map_log_odds_hit: float = 0.9     # increment per endpoint hit
     map_log_odds_miss: float = -0.4   # decrement per free-space pass
     map_log_odds_clamp: float = 8.0   # saturation bound (±)
+    # -- de-skew + sweep reconstruction (ops/deskew.py, fused ingest) --
+    # per-revolution range-only de-skew + caching-aware sweep
+    # reconstruction INSIDE the fused ingest core
+    # (ops/ingest._segment_filter_core — rides the single-stream,
+    # fleet-vmapped and super-tick lowerings with zero extra
+    # dispatches): the per-revolution rigid motion is estimated from
+    # consecutive revolutions' range profiles (no IMU — the wire
+    # carries none) and every beam re-projected to the revolution's end
+    # pose by its phase fraction, int32 end to end so the NumPy host
+    # twin (ops/deskew_ref.py) stays bit-exact; each tick's nodes also
+    # land in a device-resident ring of the last K sub-sweep segments
+    # whose newest-wins overlay is emitted EVERY tick as a
+    # reconstructed sweep — the mapper seam consumes it for R >= 2
+    # matcher/mapper updates per physical revolution at the same
+    # dispatch count (bench --config 16; scripts/decide_backends.py
+    # `deskew_ab` key gates the default flip on on-chip evidence).
+    # Requires a fused ingest seam (the host service path has no
+    # per-tick device residency to cache sub-sweeps in).
+    deskew_enable: bool = False
+    # K: sub-sweep segments cached per stream; the reconstruction
+    # window (and the cache-expiry horizon — data older than K data
+    # ticks ages out of the ring)
+    sweep_reconstruct_window: int = 4
+    # motion-profile beam grid (power of two in [64, 1024]) and the
+    # ± dθ search radius in profile-beam steps
+    deskew_profile_beams: int = 256
+    deskew_shift_window: int = 8
     # -- fleet fault tolerance (driver/health.py + parallel/service.py) --
     # attach the per-stream health FSM supervisor to the fleet byte-tick
     # seams (ShardedFilterService.submit_bytes*): HEALTHY -> SUSPECT ->
@@ -368,6 +395,37 @@ class DriverParams:
             )
         if self.super_tick_max < 1:
             raise ValueError("super_tick_max must be >= 1 (1 disables)")
+        if not (2 <= self.sweep_reconstruct_window <= 64):
+            raise ValueError(
+                "sweep_reconstruct_window must be within [2, 64] (a "
+                "1-deep ring cannot reconstruct across ticks)"
+            )
+        d = self.deskew_profile_beams
+        if d < 64 or d > 1024 or d & (d - 1):
+            raise ValueError(
+                "deskew_profile_beams must be a power of two in [64, 1024]"
+            )
+        if not (1 <= self.deskew_shift_window <= d // 8):
+            raise ValueError(
+                "deskew_shift_window must be within [1, "
+                "deskew_profile_beams/8]"
+            )
+        if self.deskew_enable:
+            if not self.filter_chain:
+                raise ValueError(
+                    "deskew_enable requires filter_chain stages (the "
+                    "de-skewed revolutions feed the fused filter step)"
+                )
+            if "fused" not in (
+                self.ingest_backend, self.fleet_ingest_backend
+            ):
+                raise ValueError(
+                    "deskew_enable requires a fused ingest seam "
+                    "(ingest_backend='fused' or fleet_ingest_backend="
+                    "'fused'): the sub-sweep cache lives inside the "
+                    "fused program's device state — the host decode "
+                    "path has nowhere to keep it"
+                )
         if self.map_backend not in ("auto", "host", "fused"):
             raise ValueError(
                 "map_backend must be 'auto', 'host' or 'fused'"

@@ -54,7 +54,11 @@ _FUSED_BUCKETS = (4, 64)
 # cross-host stream migration.  Bump on layout changes; restore rejects
 # a mismatched version instead of misreading it (the PR 4 mapper-
 # checkpoint discipline).
-INGEST_STREAM_SNAPSHOT_VERSION = 1
+#   v2: optional de-skew/reconstruction planes (recon_ring, recon_pos,
+#       deskew_prof, deskew_motion) join the ingest.* key space when
+#       ``deskew_enable`` is set; None leaves are omitted, so a
+#       deskew-off snapshot still carries exactly the v1 keys.
+INGEST_STREAM_SNAPSHOT_VERSION = 2
 
 
 class FusedIngest:
@@ -90,6 +94,21 @@ class FusedIngest:
         self.cfg = config_from_params(
             params, beams or DEFAULT_BEAMS, platform=self.device.platform
         )
+        # fixed-point de-skew + sweep reconstruction (ops/deskew.py):
+        # rides inside the fused program when params enable it
+        from rplidar_ros2_driver_tpu.ops.deskew import (
+            deskew_config_from_params,
+        )
+
+        self._deskew = deskew_config_from_params(params, self.cfg.beams)
+        # newest reconstructed sweep surfaced by _parse (per dispatch
+        # that pushed a sub-sweep): (recon_plane (B,) i32, recon_pts
+        # (B, 3) f32).  ``recon_log=True`` additionally appends every
+        # pushed reconstruction to ``recon_history`` (offline parity /
+        # replay tooling; unbounded, so live engines leave it off).
+        self.last_recon = None
+        self.recon_log = False
+        self.recon_history: list = []
         self.max_nodes = capacity or MAX_SCAN_NODES
         self.max_revs = max_revs
         self.emit_nodes = emit_nodes
@@ -158,6 +177,7 @@ class FusedIngest:
             ans_type, self.timing, self.cfg,
             max_nodes=self.max_nodes, max_revs=self.max_revs,
             emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+            deskew=self._deskew,
         )
         filt = (
             self._state.filter if self._state is not None
@@ -182,6 +202,7 @@ class FusedIngest:
             self._base = None
             self._pending.clear()
             self._event.clear()
+            self.last_recon = None
 
     def reset_filter(self) -> None:
         """Cold filter reset (the chain.reset() analog)."""
@@ -305,6 +326,7 @@ class FusedIngest:
             ans_type, self.timing, self.cfg,
             max_nodes=self.max_nodes, max_revs=self.max_revs,
             emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+            deskew=self._deskew,
         )
         for b in self._buckets:
             st = self._jax.device_put(create_ingest_state(icfg), self.device)
@@ -329,6 +351,10 @@ class FusedIngest:
         # the unpack fetched this dispatch's results, proving its staged
         # inputs consumed: the staging pair is safe to recycle
         self._staging_free.setdefault(skey, []).append(pair)
+        if res.recon_pushed:
+            self.last_recon = (res.recon_plane, res.recon_pts)
+            if self.recon_log:
+                self.recon_history.append(self.last_recon)
         self.nodes_decoded += res.nodes_appended
         self.scans_completed += res.n_completed
         self.revs_dropped += res.revs_dropped
@@ -483,6 +509,20 @@ class FleetFusedIngest:
         self.cfg = config_from_params(
             params, beams or DEFAULT_BEAMS, platform=platform
         )
+        from rplidar_ros2_driver_tpu.ops.deskew import (
+            deskew_config_from_params,
+        )
+
+        self._deskew = deskew_config_from_params(params, self.cfg.beams)
+        # per-stream reconstructed-sweep surface (see FusedIngest):
+        # ``last_recon[i]`` holds stream i's newest (plane, pts) pair,
+        # ``take_recon()`` drains the ticks' FRESH reconstructions for
+        # the mapper seam, ``recon_log=True`` appends every pushed
+        # reconstruction to ``recon_history[i]`` (offline parity only)
+        self.last_recon: list = [None] * streams
+        self._recon_fresh: list = [False] * streams
+        self.recon_log = False
+        self.recon_history: list = [[] for _ in range(streams)]
         self.max_nodes = capacity or MAX_SCAN_NODES
         self.max_revs = max_revs
         self.emit_nodes = emit_nodes
@@ -574,6 +614,7 @@ class FleetFusedIngest:
             fleet_ingest_config_for(
                 (Ans.MEASUREMENT,), self.timing, self.cfg,
                 max_nodes=self.max_nodes, max_revs=self.max_revs,
+                deskew=self._deskew,
             ),
             self.streams,
         ))
@@ -616,6 +657,11 @@ class FleetFusedIngest:
             self._bases = [None] * self.streams
             self._reset_next = [False] * self.streams
             self._pending.clear()
+            # the sub-sweep cache dies with the engines (the PR 9
+            # `_streaming`-flag discipline: host mirrors of wiped
+            # device state restart with it)
+            self.last_recon = [None] * self.streams
+            self._recon_fresh = [False] * self.streams
 
     def _put_staging(self, buf, aux, *, super_step: bool = False) -> tuple:
         """EXPLICIT H2D staging of one dispatch's input planes — the
@@ -660,6 +706,7 @@ class FleetFusedIngest:
             tuple(sorted(have | set(need))), self.timing, self.cfg,
             max_nodes=self.max_nodes, max_revs=self.max_revs,
             emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
+            deskew=self._deskew,
         )
 
     def precompile(self, formats, buckets: Optional[tuple] = None) -> None:
@@ -980,6 +1027,11 @@ class FleetFusedIngest:
 
         def absorb(results, bases):
             for i, res in enumerate(results):
+                if res.recon_pushed:
+                    self.last_recon[i] = (res.recon_plane, res.recon_pts)
+                    self._recon_fresh[i] = True
+                    if self.recon_log:
+                        self.recon_history[i].append(self.last_recon[i])
                 self.nodes_decoded += res.nodes_appended
                 self.scans_completed += res.n_completed
                 self.revs_dropped += res.revs_dropped
@@ -1004,6 +1056,22 @@ class FleetFusedIngest:
             # the unpack above fetched this dispatch's results, proving
             # its staged inputs consumed: the pair is safe to reuse
             self._recycle_staging(kind, mb, pair)
+        return out
+
+    def take_recon(self) -> list:
+        """Drain the FRESH reconstructed sweeps since the last call: one
+        ``(recon_plane, recon_pts)`` or None per stream.  Fresh means a
+        parsed dispatch actually pushed a sub-sweep for that stream —
+        an idle tick re-emits nothing, so a mapper fed from this seam
+        updates exactly once per data tick (the R× update-rate claim of
+        bench --config 16), never on stale cache re-reads."""
+        out = []
+        with self._lock:
+            for i in range(self.streams):
+                out.append(
+                    self.last_recon[i] if self._recon_fresh[i] else None
+                )
+                self._recon_fresh[i] = False
         return out
 
     def submit(self, items) -> list:
@@ -1073,6 +1141,8 @@ class FleetFusedIngest:
             self._stream_fmt = [None] * self.streams
             self._bases = [None] * self.streams
             self._reset_next = [True] * self.streams
+            self.last_recon = [None] * self.streams
+            self._recon_fresh = [False] * self.streams
 
     # -- checkpoint surface ------------------------------------------------
 
@@ -1102,7 +1172,7 @@ class FleetFusedIngest:
         snap = {
             f"ingest.{k}": np.asarray(v)
             for k, v in vars(state).items()
-            if k != "filter"
+            if k != "filter" and v is not None
         }
         snap.update({
             f"filter.{k}": np.asarray(v)
@@ -1136,12 +1206,30 @@ class FleetFusedIngest:
             }
         except KeyError:
             return False
-        if formats.shape != (self.streams,) or ing[
-            "partial"
-        ].shape != (self.streams, self.max_nodes, 4):
+        if formats.shape != (self.streams,) or ing.get(
+            "partial", np.empty(0)
+        ).shape != (self.streams, self.max_nodes, 4):
             log.warning(
                 "rejecting incompatible fleet ingest snapshot "
                 "(streams/geometry mismatch)"
+            )
+            return False
+        # the ingest key space must match this engine's state EXACTLY —
+        # including the optional de-skew/reconstruction planes: a
+        # deskew-off snapshot installed into a deskew-on engine (or a
+        # ring of the wrong geometry) would desync the donated program's
+        # state structure at the next dispatch, after the old state was
+        # already replaced
+        expected_ing = {
+            k: tuple(v.shape)
+            for k, v in vars(self._state).items()
+            if k != "filter" and v is not None
+        }
+        got_ing = {k: tuple(v.shape) for k, v in ing.items()}
+        if expected_ing != got_ing:
+            log.warning(
+                "rejecting incompatible fleet ingest snapshot "
+                "(ingest planes %s != %s)", got_ing, expected_ing,
             )
             return False
         # the filter planes must match this engine's chain geometry too —
@@ -1254,7 +1342,7 @@ class FleetFusedIngest:
         snap = {
             f"ingest.{k}": np.array(v)
             for k, v in vars(row).items()
-            if k != "filter"
+            if k != "filter" and v is not None
         }
         snap.update({
             f"filter.{k}": np.array(v)
@@ -1333,13 +1421,30 @@ class FleetFusedIngest:
                 cur, filter=dataclasses.replace(cur.filter, **filt_rows)
             )
             if restore_decode:
+                # same-stream resume: the snapshot's ingest key space
+                # must cover THIS engine's state exactly — a deskew-off
+                # snapshot silently skipped here would leave the lane's
+                # previous occupant's recon_ring/profile/motion in place
+                # (and restore_decode suppresses the reset that would
+                # otherwise clear them), attributing another stream's
+                # sub-sweep cache to the migrated stream
+                expected_keys = {
+                    f"ingest.{k}" for k, v in vars(cur).items()
+                    if k != "filter" and v is not None
+                }
+                got_keys = {k for k in snap if k.startswith("ingest.")}
+                if expected_keys != got_keys:
+                    log.warning(
+                        "rejecting incompatible stream snapshot "
+                        "(ingest keys %s != %s)",
+                        sorted(got_keys), sorted(expected_keys),
+                    )
+                    return False
                 ing_rows = {}
                 for k, v in vars(cur).items():
-                    if k == "filter":
+                    if k == "filter" or v is None:
                         continue
                     key = f"ingest.{k}"
-                    if key not in snap:
-                        continue
                     row = np.asarray(snap[key])
                     if row.shape != tuple(v.shape):
                         log.warning(

@@ -114,6 +114,18 @@ class RPlidarNode(LifecycleNode):
 
         backend = resolve_ingest_backend(self.params.ingest_backend)
         if backend != "fused" or not self.params.filter_chain:
+            if getattr(self.params, "deskew_enable", False):
+                # the validator only sees the FIELDS; here the node
+                # knows its ACTIVE seam resolved to host — refusing
+                # beats silently publishing skewed scans with the
+                # operator believing de-skew is on
+                raise ValueError(
+                    "deskew_enable requires this node's ingest seam to "
+                    f"resolve fused (ingest_backend="
+                    f"{self.params.ingest_backend!r} resolved "
+                    f"{backend!r}) — de-skew/reconstruction runs inside "
+                    "the fused ingest program only"
+                )
             return False
         if self.params.dummy_mode and self._driver_factory is None:
             log.warning(

@@ -1,0 +1,391 @@
+"""Fixed-point de-skew + caching-aware sweep reconstruction (ROADMAP 3).
+
+A spinning 2-D lidar's revolution is not instantaneous: on a moving
+platform every beam is measured from a slightly different pose, and at
+fleet scale that intra-revolution skew is the dominant map-quality
+error.  Following "Robust De-skewing Exclusively Relying on Range
+Measurements" (range-only — no IMU, which matches our wire data: the
+frames carry nothing but angle/dist/quality/flag) and SR-LIO++'s
+caching-aware sweep reconstruction (both PAPERS.md), this module adds
+two coupled stages that ride INSIDE the fused ingest core
+(ops/ingest._segment_filter_core), so every lowering — single-stream,
+fleet-vmapped, `lax.scan` super-tick — inherits them with zero extra
+dispatches:
+
+  1. **per-revolution range-only de-skew** — the per-revolution rigid
+     motion (dx, dy, dθ) is estimated from CONSECUTIVE revolutions'
+     beam-gridded range profiles (circular shift search for dθ, a
+     diagonal least-squares radial fit for the translation), and every
+     beam is re-projected to the revolution's END pose by its
+     intra-revolution phase fraction (its wire angle: a node at angle a
+     has (65536 - a)/65536 of the revolution's motion still ahead of
+     it).  The whole datapath is int32 — the matcher's fixed-point
+     rotation tables (ops/scan_match.rotation_table) supply cos/sin at
+     2^14 scale, divisions are floor divisions, clamps are explicit —
+     so the NumPy twin (ops/deskew_ref.py) is BIT-EXACT, not close.
+
+  2. **caching-aware sweep reconstruction** — each tick's freshly
+     arrived nodes (de-skewed with the carried motion estimate) are
+     rasterized into a sub-sweep segment on the filter's beam grid and
+     pushed into a device-resident ring of the last K segments; the
+     reconstructed sweep emitted EVERY tick is the newest-wins overlay
+     of the ring (cached segments are REUSED across overlapping
+     windows, never recomputed — SR-LIO++'s cache discipline), turning
+     one physical revolution into R >= 2 matcher/mapper updates at the
+     same dispatch count.
+
+EXACTNESS NOTES (the module is a graftlint GL004/GL005 bit-exact zone):
+the only float arithmetic is (a) the clip predicate folded into the
+sub-sweep rasterizer — a single f32 multiply + compares, mirroring
+ops/filters._clip_ok, deterministic on every backend — and (b) the
+reconstructed sweep's polar->Cartesian decode, which REUSES the filter
+chain's jitted helpers (ops/filters._grid_decode / polar_to_cartesian)
+so both ingest backends hand the mapper identical f32 planes (the same
+elementwise-XLA argument the chain's own parity rests on).  Everything
+that feeds state carries is integer.
+
+Overflow discipline (int32 end to end): profile values are 18-bit wire
+distances; per-beam diffs clamp to ±``max_trans_q2`` (<= 2^11) before
+any product; cos/sin enter the normal equations pre-shifted to 7 bits
+(|ΔR·c7| <= 2^18, summed over <= 2^10 profile beams < 2^28); the phase
+products bound by 2^16 · 2^13 < 2^29.  ``DeskewConfig.__post_init__``
+rejects geometries that break these bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rplidar_ros2_driver_tpu.ops.filters import _INT_INF
+from rplidar_ros2_driver_tpu.ops.scan_match import ANG_BITS, rotation_table
+
+# empty-beam sentinel shared by the motion profiles and the sub-sweep
+# ring.  It MUST be ops/filters._INT_INF — combine_ring output feeds
+# the chain's _grid_decode, whose miss test is `!= _INT_INF` — so it is
+# aliased, not re-declared (a plain Python int either way: a
+# module-scope jnp constant would initialize a backend at import time)
+RECON_EMPTY = _INT_INF
+
+# rotation-table resolution for per-node trig: 1024 rows of the
+# matcher's int32 cos/sin table (2^14 scale) — the wire angle indexes it
+# with one shift (65536 / 1024 = 64 angle units per row)
+TABLE_DIVISIONS = 1024
+
+# packed sub-sweep cell layout (dist << 8 | quality), the resampler's
+# convention (ops/filters._resample_keys) minus the f32 decode
+_QUAL_BITS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class DeskewConfig:
+    """Static (compile-time) de-skew + reconstruction configuration."""
+
+    recon_beams: int          # sub-sweep/reconstruction beam grid (= chain beams)
+    profile_beams: int = 256  # motion-profile beam grid (power of two)
+    shift_window: int = 8     # dθ search: ± profile-beam shifts
+    recon_window: int = 4     # K sub-sweep segments kept per stream
+    max_trans_q2: int = 2048  # per-revolution translation clamp (q2 units)
+    min_valid: int = 16       # min overlapping profile beams for an estimate
+    # clip fold for the sub-sweep rasterizer (the chain's _clip_ok
+    # domain, so reconstructed sweeps see the same returns the filter
+    # keeps); mirrored from FilterConfig by the factory — INCLUDING the
+    # enable flag: a chain without the clip stage keeps out-of-range
+    # returns, and the reconstruction must keep them too
+    enable_clip: bool = True
+    range_min_m: float = 0.15
+    range_max_m: float = 40.0
+    intensity_min: float = 0.0
+
+    def __post_init__(self):
+        d = self.profile_beams
+        if d < 64 or d > 1024 or d & (d - 1):
+            raise ValueError(
+                "deskew profile_beams must be a power of two in [64, 1024]"
+            )
+        if TABLE_DIVISIONS % d:
+            raise ValueError(
+                "deskew profile_beams must divide the trig table "
+                f"({TABLE_DIVISIONS} rows)"
+            )
+        if not (1 <= self.shift_window <= d // 8):
+            raise ValueError(
+                "deskew shift_window must be within [1, profile_beams/8]"
+            )
+        if self.shift_window * (65536 // d) > (1 << 13):
+            raise ValueError(
+                "deskew shift window exceeds the 2^13 dθ overflow bound"
+            )
+        if not (2 <= self.recon_window <= 64):
+            raise ValueError("sweep_reconstruct_window must be in [2, 64]")
+        if self.recon_beams < 8:
+            raise ValueError("recon_beams must be >= 8")
+        if not (0 < self.max_trans_q2 <= (1 << 11)):
+            raise ValueError(
+                "deskew max_trans_q2 must be in (0, 2^11] (the int32 "
+                "normal-equation bound)"
+            )
+        if self.min_valid < 1:
+            raise ValueError("deskew min_valid must be >= 1")
+
+
+def deskew_config_from_params(params, beams: int) -> Optional[DeskewConfig]:
+    """The one params -> DeskewConfig mapping (None when disabled), so
+    the engines, the service, replay and the bench cannot drift on
+    geometry.  The clip fold mirrors the chain's clip params — the
+    reconstructed sweep must keep exactly the returns the filter keeps."""
+    if not getattr(params, "deskew_enable", False):
+        return None
+    return DeskewConfig(
+        recon_beams=beams,
+        profile_beams=int(getattr(params, "deskew_profile_beams", 256)),
+        shift_window=int(getattr(params, "deskew_shift_window", 8)),
+        recon_window=int(getattr(params, "sweep_reconstruct_window", 4)),
+        enable_clip="clip" in tuple(params.filter_chain),
+        range_min_m=float(params.range_clip_min_m),
+        range_max_m=float(params.range_clip_max_m),
+        intensity_min=float(params.intensity_min),
+    )
+
+
+def shift_candidates(cfg: DeskewConfig) -> np.ndarray:
+    """(2S+1,) int32 dθ shift candidates ordered by |s| (0, -1, 1, ...):
+    the first-min-wins argmin then prefers the SMALLEST rotation on
+    ties, so a featureless scene (every shift scores equally) estimates
+    identity instead of the window edge.  Shared by both twins."""
+    out = [0]
+    for s in range(1, cfg.shift_window + 1):
+        out.extend((-s, s))
+    # graftlint: disable=GL001 — builds a compile-time candidate table
+    # from Python ints (static per config); nothing traced reaches it
+    return np.asarray(out, np.int32)
+
+
+def profile_trig(cfg: DeskewConfig) -> np.ndarray:
+    """(D, 2) int32 cos/sin at 2^14 scale for each profile beam's start
+    angle — rows of the matcher's rotation table (numpy-built once,
+    consumed verbatim by both twins, like ops/scan_match's)."""
+    table = rotation_table(TABLE_DIVISIONS)
+    step = TABLE_DIVISIONS // cfg.profile_beams
+    return table[:: step]
+
+
+def node_trig_table() -> np.ndarray:
+    """(TABLE_DIVISIONS, 2) int32 cos/sin for per-node de-skew trig,
+    indexed by ``angle >> 6`` (65536 / 1024 angle units per row)."""
+    return rotation_table(TABLE_DIVISIONS)
+
+
+# ---------------------------------------------------------------------------
+# fixed-point building blocks (literal numpy mirrors in ops/deskew_ref.py
+# — keep the two in lockstep, the parity suite pins them bit-exact)
+# ---------------------------------------------------------------------------
+
+
+def beam_of(angle, beams: int):
+    """Wire angle -> beam cell, the chain resampler's exact convention
+    (ops/filters._resample_keys: Q14 full turn == 65536)."""
+    return jnp.clip((angle * beams) // 65536, 0, beams - 1)
+
+
+def profile_from_nodes(angle, dist, valid, cfg: DeskewConfig, block: int = 64):
+    """(D,) int32 min-range beam profile of one revolution's nodes
+    (RECON_EMPTY where no return).  Dense tiled masked-min, the fused
+    path's scatter-free formulation (ops/filters.grid_resample_batch):
+    min is order-independent over int32, so any evaluation order — XLA,
+    vmap, numpy — lands the identical profile."""
+    d = cfg.profile_beams
+    b = beam_of(angle, d)
+    live = valid & (dist > 0)
+    outs = []
+    for t0 in range(0, d, block):
+        bt = jnp.arange(t0, min(t0 + block, d), dtype=jnp.int32)
+        m = jnp.where(
+            (b[None, :] == bt[:, None]) & live[None, :],
+            dist[None, :], RECON_EMPTY,
+        )
+        outs.append(jnp.min(m, axis=1))
+    return jnp.concatenate(outs)
+
+
+def estimate_motion(prev_prof, cur_prof, cfg: DeskewConfig):
+    """(3,) int32 [dx_q2, dy_q2, dθ_q16] rigid-motion estimate between
+    two consecutive revolutions' range profiles — range-only, the
+    de-skewing paper's premise.
+
+    dθ: circular shift search — ``aligned_s = roll(cur, s)`` matches
+    ``prev`` when s equals the inter-revolution rotation in beam units;
+    the score is the mean absolute range difference over beams valid in
+    BOTH profiles (diffs clamped to ±max_trans_q2 so one outlier beam
+    cannot out-vote the consensus), candidates ordered by |s| so ties
+    prefer identity.  (dx, dy): with the rotation taken out, a static
+    point's range changes by the radial projection -(dx·cosφ + dy·sinφ)
+    >> 14, so the translation drops out of one diagonal least-squares
+    fit per axis (the off-diagonal Σcos·sin term vanishes over a full
+    turn).  Fewer than ``min_valid`` overlapping beams — a fresh
+    stream, an empty revolution — estimates exact zero: de-skew
+    degrades to the identity, never to garbage."""
+    d = cfg.profile_beams
+    mt = cfg.max_trans_q2
+    cands_np = shift_candidates(cfg)                             # (C,) host
+    cands = jnp.asarray(cands_np)
+    vp = prev_prof != RECON_EMPTY
+    vc = cur_prof != RECON_EMPTY
+
+    def sad_of(s):
+        aligned = jnp.roll(cur_prof, s)
+        both = vp & jnp.roll(vc, s)
+        diff = jnp.clip(
+            jnp.where(both, aligned - prev_prof, 0), -mt, mt
+        )
+        cnt = jnp.sum(both.astype(jnp.int32))
+        sad = jnp.sum(jnp.abs(diff))
+        return jnp.where(
+            cnt >= cfg.min_valid, sad // jnp.maximum(cnt, 1), RECON_EMPTY
+        )
+
+    # static unroll over the (small) candidate set: scores in |s| order
+    scores = jnp.stack([sad_of(int(s)) for s in cands_np])
+    k = jnp.argmin(scores).astype(jnp.int32)   # first-min-wins: ties -> s=0
+    s_best = jnp.take(cands, k)
+    usable = jnp.take(scores, k) != RECON_EMPTY
+
+    aligned = jnp.roll(cur_prof, s_best)
+    both = vp & jnp.roll(vc, s_best)
+    diff = jnp.clip(jnp.where(both, aligned - prev_prof, 0), -mt, mt)
+    trig = jnp.asarray(profile_trig(cfg))
+    c7 = trig[:, 0] >> 7
+    s7 = trig[:, 1] >> 7
+    bi = both.astype(jnp.int32)
+    num_x = jnp.sum(diff * c7 * bi)
+    den_x = jnp.sum(c7 * c7 * bi)
+    num_y = jnp.sum(diff * s7 * bi)
+    den_y = jnp.sum(s7 * s7 * bi)
+    dx = jnp.clip(-(num_x // jnp.maximum(den_x >> 7, 1)), -mt, mt)
+    dy = jnp.clip(-(num_y // jnp.maximum(den_y >> 7, 1)), -mt, mt)
+    dth = s_best * (65536 // d)
+    motion = jnp.stack([dx, dy, dth]).astype(jnp.int32)
+    return jnp.where(usable, motion, jnp.zeros((3,), jnp.int32))
+
+
+def apply_deskew(angle, dist, valid, motion, cfg: DeskewConfig):
+    """Re-project nodes to the revolution's END pose by their phase
+    fraction: a node at wire angle ``a`` still has ``(65536 - a)/65536``
+    of the revolution's motion ahead of it, so its angle drifts by that
+    fraction of -dθ and its range by the radial projection of the
+    remaining translation.  Zero motion is the exact identity (every
+    correction term multiplies by motion components).  Returns
+    (angle', dist') with dist' clamped into the 18-bit wire domain and
+    invalid/no-return nodes passed through untouched (a correction must
+    never resurrect a dropped node)."""
+    table = jnp.asarray(node_trig_table())
+    rem = 65536 - angle                                         # (n,) 1..65536
+    dang = (rem * motion[2]) >> 16
+    angle2 = (angle - dang) & 0xFFFF
+    idx = angle >> 6                                            # table row
+    c = jnp.take(table[:, 0], idx)
+    s = jnp.take(table[:, 1], idx)
+    half = 1 << (ANG_BITS - 1)
+    radial = (motion[0] * c + motion[1] * s + half) >> ANG_BITS  # q2 units
+    corr = (radial * rem) >> 16
+    dist2 = jnp.clip(dist - corr, 1, 0x3FFFF)
+    live = valid & (dist > 0)
+    return (
+        jnp.where(live, angle2, angle),
+        jnp.where(live, dist2, dist),
+    )
+
+
+def rasterize_subsweep(angle, dist, quality, valid, cfg: DeskewConfig,
+                       block: int = 256):
+    """(B,) int32 packed sub-sweep segment from one tick's (de-skewed)
+    nodes: per-beam min of ``dist << 8 | quality`` (nearest return wins,
+    carrying its intensity — the chain resampler's packing), RECON_EMPTY
+    where the tick left a beam untouched.  The chain's clip predicate
+    folds into the drop mask here (one f32 multiply + compares,
+    ops/filters._clip_ok's exact domain) so the reconstructed sweep
+    keeps exactly the returns the filter keeps."""
+    b = cfg.recon_beams
+    ok = valid & (dist > 0)
+    if cfg.enable_clip:
+        # THE one clip predicate (ops/filters._clip_ok), not a copy:
+        # DeskewConfig carries the chain's range/intensity fields under
+        # the same names, so the shared predicate applies directly — a
+        # future change to the clip convention reaches the
+        # reconstruction through this call (and breaks the NumPy twin's
+        # parity suite loudly, forcing the mirror to follow)
+        from rplidar_ros2_driver_tpu.core.types import ScanBatch
+        from rplidar_ros2_driver_tpu.ops.filters import _clip_ok
+
+        batch = ScanBatch(
+            angle_q14=angle, dist_q2=dist, quality=quality,
+            flag=jnp.zeros_like(angle), valid=valid,
+            count=jnp.asarray(angle.shape[0], jnp.int32),
+        )
+        ok = ok & _clip_ok(batch, cfg)
+    # packed-cell layout: the resampler's exact convention
+    # (ops/filters._resample_keys — dist << 8 | 8-bit quality, nearest
+    # return wins); _grid_decode inverts it downstream
+    beam = beam_of(angle, b)
+    packed = (dist << _QUAL_BITS) | jnp.clip(quality, 0, 255)
+    packed = jnp.where(ok, packed, RECON_EMPTY)
+    outs = []
+    for t0 in range(0, b, block):
+        bt = jnp.arange(t0, min(t0 + block, b), dtype=jnp.int32)
+        m = jnp.where(
+            beam[None, :] == bt[:, None], packed[None, :], RECON_EMPTY
+        )
+        outs.append(jnp.min(m, axis=1))
+    return jnp.concatenate(outs)
+
+
+def push_ring(ring, pos, seg, pushed):
+    """Advance the sub-sweep ring by one segment when ``pushed`` (an
+    idle tick leaves the ring untouched — the ring holds the last K
+    NON-EMPTY sub-sweeps, so a stalled stream's cache does not expire
+    under it).  ``pos`` counts pushes cumulatively; the write slot is
+    ``pos % K``."""
+    k = ring.shape[0]
+    slot = jnp.remainder(pos, k)
+    written = jax.lax.dynamic_update_index_in_dim(ring, seg, slot, 0)
+    new_ring = jnp.where(pushed, written, ring)
+    new_pos = pos + pushed.astype(jnp.int32)
+    return new_ring, new_pos
+
+
+def combine_ring(ring, pos):
+    """(B,) int32 reconstructed sweep: newest-wins overlay of the ring's
+    segments (a beam keeps the most recent segment that touched it —
+    SR-LIO++'s cache reuse: segments rasterized once, reused across
+    every overlapping window they appear in).  ``pos`` is the push
+    count; the newest row is ``(pos - 1) % K``."""
+    k = ring.shape[0]
+    # age order, oldest first: rolling by -(pos % K) puts slot (pos % K)
+    # — the OLDEST entry once the ring has wrapped, the first empty slot
+    # before — at row 0 and the newest at row K-1
+    aged = jnp.roll(ring, -jnp.remainder(pos, k), axis=0)
+    combined = jnp.full(ring.shape[1:], RECON_EMPTY, jnp.int32)
+    for i in range(k):
+        combined = jnp.where(aged[i] != RECON_EMPTY, aged[i], combined)
+    return combined
+
+
+def recon_points(combined):
+    """Reconstructed sweep -> ((B,) ranges, (B, 2) xy, (B,) mask): the
+    chain's own decode + polar projection (ops/filters._grid_decode /
+    polar_to_cartesian), so the mapper consumes reconstructed sweeps in
+    exactly the representation the per-revolution path feeds it.  The
+    f32 math here is the same elementwise-XLA code on every path —
+    identical int planes in, identical f32 planes out."""
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        _grid_decode,
+        polar_to_cartesian,
+    )
+
+    ranges, _inten = _grid_decode(combined)
+    xy, mask = polar_to_cartesian(ranges, combined.shape[0])
+    return ranges, xy, mask

@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
+from rplidar_ros2_driver_tpu.ops import deskew as deskewmod
+from rplidar_ros2_driver_tpu.ops.deskew import RECON_EMPTY, DeskewConfig
 from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
@@ -106,6 +108,10 @@ class IngestConfig:
     # per-revolution slot lowering: "auto" | "cond" | "fori" (bit-exact
     # either way — see _slot_impl_for; pinnable for A/B and parity tests)
     slot_impl: str = "auto"
+    # fixed-point de-skew + sweep reconstruction (ops/deskew.py): None
+    # keeps the core byte-identical to the pre-deskew program (no extra
+    # state planes, no extra outputs)
+    deskew: Optional[DeskewConfig] = None
 
 
 def ingest_config_for(
@@ -117,6 +123,7 @@ def ingest_config_for(
     max_revs: int = 2,
     emit_nodes: bool = False,
     slot_impl: str = "auto",
+    deskew: Optional[DeskewConfig] = None,
 ) -> IngestConfig:
     """Build the static config for one (answer type, timing desc, chain)."""
     at = Ans(ans_type)
@@ -133,6 +140,7 @@ def ingest_config_for(
         emit_nodes=emit_nodes,
         filter=filter_cfg,
         slot_impl=slot_impl,
+        deskew=deskew,
     )
 
 
@@ -152,6 +160,14 @@ class IngestState:
     have_prev: jax.Array      # bool
     scans_completed: jax.Array  # int32, cumulative
     revs_dropped: jax.Array     # int32, cumulative (max_revs overflow drops)
+    # de-skew + sweep-reconstruction planes (cfg.deskew; None otherwise —
+    # a None pytree leaf is an empty subtree, so the state structure
+    # stays jit/donation-stable per compiled config, like FilterState's
+    # median_sorted).  See ops/deskew.py.
+    recon_ring: Optional[jax.Array] = None    # (K, B) int32 sub-sweep ring
+    recon_pos: Optional[jax.Array] = None     # int32 cumulative push count
+    deskew_prof: Optional[jax.Array] = None   # (D,) int32 prev-rev profile
+    deskew_motion: Optional[jax.Array] = None  # (3,) int32 [dx,dy,dθ_q16]
 
 
 def create_ingest_state(
@@ -160,6 +176,7 @@ def create_ingest_state(
     """Fresh stream state; ``filter_state`` carries the rolling window
     across scan-mode switches (the host path's chain survives an answer-
     type change too — only decode/assembly state resets)."""
+    dsk = cfg.deskew
     return IngestState(
         filter=filter_state
         if filter_state is not None
@@ -174,6 +191,21 @@ def create_ingest_state(
         have_prev=jnp.asarray(False),
         scans_completed=jnp.asarray(0, jnp.int32),
         revs_dropped=jnp.asarray(0, jnp.int32),
+        # RECON_EMPTY (not zero) marks fresh ring/profile planes: a
+        # zero cell would decode as a live dist-0 return
+        recon_ring=(
+            jnp.full(
+                (dsk.recon_window, dsk.recon_beams), RECON_EMPTY, jnp.int32
+            ) if dsk is not None else None
+        ),
+        recon_pos=jnp.asarray(0, jnp.int32) if dsk is not None else None,
+        deskew_prof=(
+            jnp.full((dsk.profile_beams,), RECON_EMPTY, jnp.int32)
+            if dsk is not None else None
+        ),
+        deskew_motion=(
+            jnp.zeros((3,), jnp.int32) if dsk is not None else None
+        ),
     )
 
 
@@ -183,20 +215,27 @@ def create_ingest_state(
 # that the host only touches when meta says revolutions completed)
 # ---------------------------------------------------------------------------
 #
-#   meta (float32, _META + 3*max_revs):
+#   meta (float32, _META + 3*max_revs [+ _DESKEW_META]):
 #     [0] n_completed  [1] revs_dropped_this_step  [2] syncs_in_batch
 #     [3] nodes_appended
 #     [4 : 4+R]        per-slot node counts          (R = max_revs)
 #     [.. : ..+R]      per-slot ts0 epoch offsets
 #     [.. : ..+R]      per-slot end_ts epoch offsets
+#     (cfg.deskew only, appended) [recon_pushed, recon_valid_beams,
+#       motion_dx_q2, motion_dy_q2, motion_dθ_q16]
 #   out_wires: (R, wire_output_len(filter)) float32
+#   (cfg.deskew only) recon_plane (B,) int32 + recon_pts (B, 3) f32
 #   (emit_nodes only) nodes (R, max_nodes, 4) f32 + node_ts (R, max_nodes)
 
 _META = 4
+_DESKEW_META = 5
 
 
 def ingest_meta_len(cfg: IngestConfig) -> int:
-    return _META + 3 * cfg.max_revs
+    return (
+        _META + 3 * cfg.max_revs
+        + (_DESKEW_META if cfg.deskew is not None else 0)
+    )
 
 
 @dataclasses.dataclass
@@ -213,6 +252,12 @@ class IngestBatchResult:
     outputs: list               # n_completed FilterOutput (numpy-backed)
     nodes: Optional[np.ndarray] = None      # (n_completed, max_nodes, 4)
     node_ts: Optional[np.ndarray] = None    # (n_completed, max_nodes)
+    # de-skew + reconstruction surface (cfg.deskew only)
+    recon_plane: Optional[np.ndarray] = None  # (B,) int32 packed sweep
+    recon_pts: Optional[np.ndarray] = None    # (B, 3) f32 [x, y, mask]
+    recon_pushed: bool = False       # this dispatch appended a sub-sweep
+    recon_valid: int = 0             # beams carrying a return in the sweep
+    deskew_motion: Optional[np.ndarray] = None  # (3,) int32 estimate
 
 
 def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
@@ -241,12 +286,28 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
     if n > 0:
         w = np.asarray(res[1])
         outputs = [unpack_output_wire(w[k], cfg.filter) for k in range(n)]
+    idx = 2
+    recon_plane = recon_pts = motion = None
+    recon_pushed = False
+    recon_valid = 0
+    if cfg.deskew is not None:
+        doff = _META + 3 * r
+        recon_pushed = bool(meta[doff] > 0.5)
+        recon_valid = int(meta[doff + 1])
+        # graftlint: policed — deskew meta rides the f32 plane by wire
+        # contract: pushed flag, beam count (<= recon_beams) and the
+        # clamped motion components (|dx|,|dy| <= 2^11, |dθ| <= 2^13)
+        # are all exact in f32
+        motion = meta[doff + 2 : doff + 5].astype(np.int32)
+        recon_plane = np.asarray(res[idx])
+        recon_pts = np.asarray(res[idx + 1])
+        idx += 2
     nodes = node_ts = None
     if cfg.emit_nodes:
         # graftlint: policed — debug node planes ride f32 by wire
         # contract; the widest field (18-bit clamped dist) is exact
-        nodes = np.asarray(res[2]).astype(np.int32)[:n]
-        node_ts = np.asarray(res[3])[:n]
+        nodes = np.asarray(res[idx]).astype(np.int32)[:n]
+        node_ts = np.asarray(res[idx + 1])[:n]
     return IngestBatchResult(
         n_completed=n,
         revs_dropped=int(meta[1]),
@@ -258,6 +319,11 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
         outputs=outputs,
         nodes=nodes,
         node_ts=node_ts,
+        recon_plane=recon_plane,
+        recon_pts=recon_pts,
+        recon_pushed=recon_pushed,
+        recon_valid=recon_valid,
+        deskew_motion=motion,
     )
 
 
@@ -346,6 +412,13 @@ class _CoreResult(NamedTuple):
     out_wires: jax.Array
     nodes: Optional[jax.Array]
     node_ts: Optional[jax.Array]
+    # de-skew + reconstruction (cfg.deskew only; None otherwise)
+    recon_ring: Optional[jax.Array] = None
+    recon_pos: Optional[jax.Array] = None
+    deskew_prof: Optional[jax.Array] = None
+    deskew_motion: Optional[jax.Array] = None
+    recon_plane: Optional[jax.Array] = None
+    recon_pts: Optional[jax.Array] = None
 
 
 def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResult:
@@ -389,6 +462,39 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
     fullts = jax.lax.dynamic_update_slice(fullts, ts_c, (state.partial_len,))
     total = state.partial_len + nv  # live stream length in full4/fullts
     flag_c = batch4[:, 3]
+
+    # -- de-skew + sweep reconstruction (cfg.deskew, ops/deskew.py):
+    # the tick's freshly appended nodes — de-skewed by their phase
+    # fraction with the CARRIED motion estimate — become one sub-sweep
+    # segment pushed into the device-resident ring, and the ring's
+    # newest-wins overlay is the reconstructed sweep emitted EVERY tick
+    # (cached segments reused across overlapping windows, never
+    # recomputed).  Deliberately decoupled from the revolution
+    # bookkeeping below: every valid node of the tick enters the cache,
+    # pre-first-sync and overflow-capped data included — it is real
+    # measurement data and the host twin sees the identical stream.
+    dsk = cfg.deskew
+    recon_ring = state.recon_ring
+    recon_pos = state.recon_pos
+    recon_plane = recon_pts = recon_pushed = None
+    if dsk is not None:
+        jb = jnp.arange(n, dtype=jnp.int32)
+        live_b = jb < nv
+        a_ds, d_ds = deskewmod.apply_deskew(
+            batch4[:, 0], batch4[:, 1], live_b, state.deskew_motion, dsk
+        )
+        seg = deskewmod.rasterize_subsweep(
+            a_ds, d_ds, batch4[:, 2], live_b, dsk
+        )
+        recon_pushed = nv > 0
+        recon_ring, recon_pos = deskewmod.push_ring(
+            state.recon_ring, state.recon_pos, seg, recon_pushed
+        )
+        recon_plane = deskewmod.combine_ring(recon_ring, recon_pos)
+        _rr, rxy, rmask = deskewmod.recon_points(recon_plane)
+        recon_pts = jnp.concatenate(
+            [rxy, rmask.astype(jnp.float32)[:, None]], axis=1
+        )
 
     # -- revolution segmentation: sync-bit cumsum + searchsorted starts --
     j = jnp.arange(n, dtype=jnp.int32)
@@ -463,23 +569,47 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         # count, so bit-exactness requires the same dead-lane values
         return jnp.where(lv[:, None], nodes_r, 0), jnp.where(lv, nts_r, 0.0), lv
 
-    def _slot_step(r, fstate):
-        cnt = counts[r]
-        nodes_r, _, lv = _slot_nodes(seg_start[r], cnt)
+    def _slot_filter(carry, nodes_r, lv, cnt):
+        """The shared slot tail: (optional) per-revolution de-skew, then
+        the donated filter step.  ``carry`` is (FilterState, prof,
+        motion) — the de-skew planes thread through the slot loop so a
+        dispatch completing several revolutions estimates each one
+        against its true predecessor (prof/motion are None-leaves when
+        cfg.deskew is None and cost nothing)."""
+        fstate, prof, motion = carry
+        angle_r, dist_r = nodes_r[:, 0], nodes_r[:, 1]
+        if dsk is not None:
+            # per-revolution range-only de-skew: profile this revolution
+            # RAW, estimate the rigid motion against the carried
+            # predecessor profile, re-project every node to the
+            # revolution's end pose by its phase fraction — the filter
+            # consumes the corrected nodes on BOTH backends
+            prof_r = deskewmod.profile_from_nodes(angle_r, dist_r, lv, dsk)
+            motion = deskewmod.estimate_motion(prof, prof_r, dsk)
+            prof = prof_r
+            angle_r, dist_r = deskewmod.apply_deskew(
+                angle_r, dist_r, lv, motion, dsk
+            )
         batch = ScanBatch(
-            angle_q14=nodes_r[:, 0],
-            dist_q2=nodes_r[:, 1],
+            angle_q14=angle_r,
+            dist_q2=dist_r,
             quality=nodes_r[:, 2],
             flag=nodes_r[:, 3],
             valid=lv,
             count=cnt,
         )
         fstate, out = _filter_step_impl(fstate, batch, fcfg)
-        return fstate, _pack_output_wire(out)
+        return (fstate, prof, motion), _pack_output_wire(out)
 
-    def _slot_skip(fstate):
-        return fstate, jnp.zeros((wire_len,), jnp.float32)
+    def _slot_step(r, carry):
+        cnt = counts[r]
+        nodes_r, _, lv = _slot_nodes(seg_start[r], cnt)
+        return _slot_filter(carry, nodes_r, lv, cnt)
 
+    def _slot_skip(carry):
+        return carry, jnp.zeros((wire_len,), jnp.float32)
+
+    carry0 = (state.filter, state.deskew_prof, state.deskew_motion)
     if _slot_impl_for(cfg) == "cond":
         # small filter state: per-slot lax.cond — only the taken branch
         # executes, the pass-through copy of the small state is cheap,
@@ -487,14 +617,14 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         # (NOTE: under vmap — the fleet lowering — a batched predicate
         # lowers to select-of-both-branches, so the fleet default is
         # "fori"; cond stays available for parity pinning)
-        fstate = state.filter
+        carry = carry0
         wire_rows = []
         for r in range(cfg.max_revs):
-            fstate, w = jax.lax.cond(
+            carry, w = jax.lax.cond(
                 r < n_completed,
                 functools.partial(_slot_step, r),
                 _slot_skip,
-                fstate,
+                carry,
             )
             wire_rows.append(w)
         out_wires = jnp.stack(wire_rows)
@@ -504,34 +634,26 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         # the filter without round-tripping the multi-MB FilterState
         # (conditionals copy their carried operands per branch on CPU,
         # which measured ~3 ms/dispatch at the DenseBoost-64 geometry)
-        def _slot_step_dyn(r, fstate):
+        def _slot_step_dyn(r, carry):
             cnt = jax.lax.dynamic_index_in_dim(counts, r, 0, keepdims=False)
             begin = jax.lax.dynamic_index_in_dim(
                 seg_start, r, 0, keepdims=False
             )
             nodes_r, _, lv = _slot_nodes(begin, cnt)
-            batch = ScanBatch(
-                angle_q14=nodes_r[:, 0],
-                dist_q2=nodes_r[:, 1],
-                quality=nodes_r[:, 2],
-                flag=nodes_r[:, 3],
-                valid=lv,
-                count=cnt,
-            )
-            fstate, out = _filter_step_impl(fstate, batch, fcfg)
-            return fstate, _pack_output_wire(out)
+            return _slot_filter(carry, nodes_r, lv, cnt)
 
-        def _loop_body(r, carry):
-            fstate, wires = carry
-            fstate, w = _slot_step_dyn(r, fstate)
-            return fstate, jax.lax.dynamic_update_index_in_dim(wires, w, r, 0)
+        def _loop_body(r, loop_carry):
+            carry, wires = loop_carry
+            carry, w = _slot_step_dyn(r, carry)
+            return carry, jax.lax.dynamic_update_index_in_dim(wires, w, r, 0)
 
-        fstate, out_wires = jax.lax.fori_loop(
+        carry, out_wires = jax.lax.fori_loop(
             0,
             n_completed,
             _loop_body,
-            (state.filter, jnp.zeros((cfg.max_revs, wire_len), jnp.float32)),
+            (carry0, jnp.zeros((cfg.max_revs, wire_len), jnp.float32)),
         )
+    fstate, new_prof, new_motion = carry
 
     meta = jnp.concatenate([
         jnp.stack([
@@ -541,6 +663,21 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         ts0,
         end_ts,
     ])
+    if dsk is not None:
+        recon_valid = jnp.sum(
+            (recon_plane != RECON_EMPTY).astype(jnp.int32)
+        )
+        # graftlint: policed — deskew meta rides the f32 plane by wire
+        # contract: pushed flag, beam count (<= recon_beams) and the
+        # clamped motion components (|dx|,|dy| <= 2^11, |dθ| <= 2^13)
+        # are small exact ints in f32
+        meta = jnp.concatenate([
+            meta,
+            jnp.stack([
+                recon_pushed.astype(jnp.int32), recon_valid,
+                new_motion[0], new_motion[1], new_motion[2],
+            ]).astype(jnp.float32),
+        ])
 
     nodes_arr = ts_arr = None
     if cfg.emit_nodes:
@@ -566,7 +703,27 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         out_wires=out_wires,
         nodes=nodes_arr,
         node_ts=ts_arr,
+        recon_ring=recon_ring,
+        recon_pos=recon_pos,
+        deskew_prof=new_prof,
+        deskew_motion=new_motion,
+        recon_plane=recon_plane,
+        recon_pts=recon_pts,
     )
+
+
+def _core_outputs(cfg, core: _CoreResult) -> tuple:
+    """The one result-arity rule, shared by the single-stream step and
+    every fleet lane: ``(meta, out_wires[, recon_plane, recon_pts]
+    [, nodes, node_ts])`` — reconstruction planes appear iff
+    ``cfg.deskew``, the debug node surface iff ``cfg.emit_nodes``.  The
+    unpackers invert this ordering; keep them in lockstep."""
+    out = [core.meta, core.out_wires]
+    if cfg.deskew is not None:
+        out += [core.recon_plane, core.recon_pts]
+    if cfg.emit_nodes:
+        out += [core.nodes, core.node_ts]
+    return tuple(out)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
@@ -673,10 +830,12 @@ def fused_ingest_step(
         have_prev=new_have_prev,
         scans_completed=state.scans_completed + core.n_completed,
         revs_dropped=state.revs_dropped + core.drop_head,
+        recon_ring=core.recon_ring,
+        recon_pos=core.recon_pos,
+        deskew_prof=core.deskew_prof,
+        deskew_motion=core.deskew_motion,
     )
-    if not cfg.emit_nodes:
-        return new_state, core.meta, core.out_wires
-    return new_state, core.meta, core.out_wires, core.nodes, core.node_ts
+    return (new_state,) + _core_outputs(cfg, core)
 
 
 # ---------------------------------------------------------------------------
@@ -730,6 +889,9 @@ class FleetIngestConfig:
     # skip advantage; fori's batched while_loop runs max(n_completed)
     # iterations across the fleet (1 in steady state).
     slot_impl: str = "fori"
+    # fixed-point de-skew + sweep reconstruction (ops/deskew.py); every
+    # lane carries its own ring/profile/motion planes when set
+    deskew: Optional[DeskewConfig] = None
 
 
 def fleet_ingest_config_for(
@@ -741,6 +903,7 @@ def fleet_ingest_config_for(
     max_revs: int = 2,
     emit_nodes: bool = False,
     slot_impl: str = "fori",
+    deskew: Optional[DeskewConfig] = None,
 ) -> FleetIngestConfig:
     """Build the static config for one (format set, timing desc, chain)."""
     ats = tuple(Ans(a) for a in dict.fromkeys(formats))
@@ -757,6 +920,7 @@ def fleet_ingest_config_for(
         emit_nodes=emit_nodes,
         filter=filter_cfg,
         slot_impl=slot_impl,
+        deskew=deskew,
     )
 
 
@@ -776,6 +940,7 @@ def create_fleet_ingest_state(
         filter_state = jax.tree_util.tree_map(
             lambda x: jnp.tile(x[None], (streams,) + (1,) * x.ndim), per
         )
+    dsk = cfg.deskew
     return IngestState(
         filter=filter_state,
         partial=jnp.zeros((streams, cfg.max_nodes, 4), jnp.int32),
@@ -788,6 +953,22 @@ def create_fleet_ingest_state(
         have_prev=jnp.zeros((streams,), bool),
         scans_completed=jnp.zeros((streams,), jnp.int32),
         revs_dropped=jnp.zeros((streams,), jnp.int32),
+        recon_ring=(
+            jnp.full(
+                (streams, dsk.recon_window, dsk.recon_beams),
+                RECON_EMPTY, jnp.int32,
+            ) if dsk is not None else None
+        ),
+        recon_pos=(
+            jnp.zeros((streams,), jnp.int32) if dsk is not None else None
+        ),
+        deskew_prof=(
+            jnp.full((streams, dsk.profile_beams), RECON_EMPTY, jnp.int32)
+            if dsk is not None else None
+        ),
+        deskew_motion=(
+            jnp.zeros((streams, 3), jnp.int32) if dsk is not None else None
+        ),
     )
 
 
@@ -806,6 +987,19 @@ def _reset_stream_decode(state: IngestState, reset) -> IngestState:
     def rz(a):
         return jnp.where(reset, jnp.zeros_like(a), a)
 
+    def re(a):
+        # sub-sweep ring / motion profile reset to the EMPTY sentinel
+        # (a zero cell would decode as a live dist-0 return): the cache
+        # restarts with the decode carries — a format switch or
+        # quarantine rejoin must never stitch reconstructed sweeps
+        # across the discontinuity
+        return None if a is None else jnp.where(
+            reset, jnp.full_like(a, RECON_EMPTY), a
+        )
+
+    def rz_opt(a):
+        return None if a is None else rz(a)
+
     return dataclasses.replace(
         state,
         partial=rz(state.partial),
@@ -816,6 +1010,10 @@ def _reset_stream_decode(state: IngestState, reset) -> IngestState:
         dist_carry=rz(state.dist_carry),
         prev_frame=rz(state.prev_frame),
         have_prev=state.have_prev & ~reset,
+        recon_ring=re(state.recon_ring),
+        recon_pos=rz_opt(state.recon_pos),
+        deskew_prof=re(state.deskew_prof),
+        deskew_motion=rz_opt(state.deskew_motion),
     )
 
 
@@ -987,10 +1185,12 @@ def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
         have_prev=new_have,
         scans_completed=state.scans_completed + core.n_completed,
         revs_dropped=state.revs_dropped + core.drop_head,
+        recon_ring=core.recon_ring,
+        recon_pos=core.recon_pos,
+        deskew_prof=core.deskew_prof,
+        deskew_motion=core.deskew_motion,
     )
-    if not cfg.emit_nodes:
-        return new_state, core.meta, core.out_wires
-    return new_state, core.meta, core.out_wires, core.nodes, core.node_ts
+    return (new_state,) + _core_outputs(cfg, core)
 
 
 def _fleet_tick(cfg: FleetIngestConfig, state: IngestState, frames, aux):
@@ -1071,11 +1271,14 @@ def super_fleet_ingest_step(
     return (state,) + tuple(stacked)
 
 
-def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
+def _parse_fleet_rows(
+    meta, wires, nodes_all, ts_all, cfg, recon_all=None, rpts_all=None
+) -> list:
     """One :class:`IngestBatchResult` per stream row of one tick's
     materialized result planes (the shared tail of the fleet and
     super-step unpackers)."""
     r = cfg.max_revs
+    doff = _META + 3 * r
     out = []
     for i in range(meta.shape[0]):
         mrow = meta[i]
@@ -1089,6 +1292,19 @@ def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
         outputs = [
             unpack_output_wire(wires[i, k], cfg.filter) for k in range(n)
         ]
+        recon_kw = {}
+        if cfg.deskew is not None:
+            recon_kw = {
+                "recon_pushed": bool(mrow[doff] > 0.5),
+                "recon_valid": int(mrow[doff + 1]),
+                # graftlint: policed — deskew meta rides the f32 plane
+                # by wire contract (unpack_ingest_result note)
+                "deskew_motion": mrow[doff + 2 : doff + 5].astype(np.int32),
+                "recon_plane": (
+                    recon_all[i] if recon_all is not None else None
+                ),
+                "recon_pts": rpts_all[i] if rpts_all is not None else None,
+            }
         out.append(IngestBatchResult(
             n_completed=n,
             revs_dropped=int(mrow[1]),
@@ -1105,6 +1321,7 @@ def _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg) -> list:
                 if nodes_all is not None else None
             ),
             node_ts=ts_all[i][:n] if ts_all is not None else None,
+            **recon_kw,
         ))
     return out
 
@@ -1125,11 +1342,22 @@ def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
     wires = None
     if (meta[:, 0] > 0).any():
         wires = np.asarray(res[1])
+    idx = 2
+    recon_all = rpts_all = None
+    if cfg.deskew is not None:
+        # the reconstruction planes are the every-tick surface (the
+        # mapper feed), so they materialize unconditionally — still one
+        # fetch per array per tick, independent of fleet size
+        recon_all = np.asarray(res[idx])
+        rpts_all = np.asarray(res[idx + 1])
+        idx += 2
     nodes_all = ts_all = None
     if cfg.emit_nodes:
-        nodes_all = np.asarray(res[2])
-        ts_all = np.asarray(res[3])
-    return _parse_fleet_rows(meta, wires, nodes_all, ts_all, cfg)
+        nodes_all = np.asarray(res[idx])
+        ts_all = np.asarray(res[idx + 1])
+    return _parse_fleet_rows(
+        meta, wires, nodes_all, ts_all, cfg, recon_all, rpts_all
+    )
 
 
 def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
@@ -1148,10 +1376,16 @@ def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
     wires = None
     if (meta[:, :, 0] > 0).any():
         wires = np.asarray(res[1])
+    idx = 2
+    recon_all = rpts_all = None
+    if cfg.deskew is not None:
+        recon_all = np.asarray(res[idx])
+        rpts_all = np.asarray(res[idx + 1])
+        idx += 2
     nodes_all = ts_all = None
     if cfg.emit_nodes:
-        nodes_all = np.asarray(res[2])
-        ts_all = np.asarray(res[3])
+        nodes_all = np.asarray(res[idx])
+        ts_all = np.asarray(res[idx + 1])
     return [
         _parse_fleet_rows(
             meta[t],
@@ -1159,6 +1393,8 @@ def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
             nodes_all[t] if nodes_all is not None else None,
             ts_all[t] if ts_all is not None else None,
             cfg,
+            recon_all[t] if recon_all is not None else None,
+            rpts_all[t] if rpts_all is not None else None,
         )
         for t in range(meta.shape[0])
     ]

@@ -214,6 +214,38 @@ class ShardedFilterService:
         self.last_poses = self.mapper.submit(outs)
         return outs
 
+    def _map_tick_recon(self) -> None:
+        """The de-skew/reconstruction mapper seam: feed the attached
+        mapper this tick's FRESH reconstructed sweeps
+        (driver/ingest.FleetFusedIngest.take_recon) instead of waiting
+        for completed revolutions — one mapper update per DATA TICK per
+        stream, multiplying the effective scan-to-map update rate by
+        the ticks-per-revolution ratio at an unchanged dispatch count
+        (the config-16 claim).  Streams with no fresh reconstruction
+        this tick pass through idle."""
+        if self.mapper is None or self.fleet_ingest is None:
+            return
+        recons = self.fleet_ingest.take_recon()
+        if not any(r is not None for r in recons):
+            # no fresh reconstruction anywhere: clear the stash like the
+            # per-revolution seam does (mapper.submit overwrites it every
+            # tick there) — an idle tick must never republish the
+            # previous tick's poses as current
+            self.last_poses = [None] * self.streams
+            return
+        b = self.cfg.beams
+        points = np.zeros((self.streams, b, 2), np.float32)
+        masks = np.zeros((self.streams, b), bool)
+        live = np.zeros((self.streams,), np.int32)
+        for i, rec in enumerate(recons):
+            if rec is None:
+                continue
+            _plane, pts = rec
+            points[i] = pts[:, :2]
+            masks[i] = pts[:, 2] > 0.5
+            live[i] = 1
+        self.last_poses = self.mapper.submit_points(points, masks, live)
+
     # -- fault tolerance seam -----------------------------------------------
 
     def attach_health(
@@ -365,6 +397,20 @@ class ShardedFilterService:
                     beams=self.cfg.beams, capacity=self.capacity, **kw,
                 )
             return
+        if getattr(self.params, "deskew_enable", False):
+            # the sub-sweep cache lives inside the fused program's
+            # device state; the host decode path cannot run it.  The
+            # config validator can only see the FIELDS (a 'fused'
+            # spelled into the OTHER seam passes it) — this is where
+            # the ACTIVE seam is known, so a silently-skewed map is
+            # refused here, loudly
+            raise ValueError(
+                "deskew_enable requires the fused fleet ingest backend; "
+                f"this service resolved fleet_ingest_backend="
+                f"{self.fleet_ingest_backend!r} — pin it to 'fused' "
+                "(the host decode path has no device-resident sub-sweep "
+                "cache to reconstruct from)"
+            )
         if self._host_ingest is None:
             from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
             from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
@@ -454,7 +500,14 @@ class ShardedFilterService:
                 self.fleet_ingest.submit_pipelined(items)
                 if pipelined else self.fleet_ingest.submit(items)
             )
-            return self._map_tick([o[-1][0] if o else None for o in outs])
+            result = [o[-1][0] if o else None for o in outs]
+            if self.fleet_ingest._deskew is not None:
+                # reconstruction active: the mapper consumes the
+                # every-tick reconstructed sweeps, not the once-per-
+                # revolution chain outputs (which still publish)
+                self._map_tick_recon()
+                return result
+            return self._map_tick(result)
         scans = self._host_decode_tick(items)
         if pipelined:
             return self.submit_pipelined(scans)
@@ -500,6 +553,16 @@ class ShardedFilterService:
         if self.fleet_ingest_backend == "fused":
             outs = self.fleet_ingest.submit_backlog(ticks)
             results = [[o for (o, _ts0, _dur) in s] for s in outs]
+            if self.mapper is not None and (
+                self.fleet_ingest._deskew is not None
+            ):
+                # reconstruction active: a catch-up drain collapses to
+                # ONE mapper update per stream — the newest
+                # reconstructed sweep (per-tick sweeps inside a drain
+                # are already stale history; the live seam resumes the
+                # per-tick cadence next tick)
+                self._map_tick_recon()
+                return results
             if self.mapper is not None:
                 # feed the drained revolutions to the mapper in
                 # per-stream order.  Grouping by index rather than by

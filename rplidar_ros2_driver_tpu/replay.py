@@ -342,6 +342,13 @@ def replay_raw_fused(
     per-dispatch cap (raise ``max_revs`` or lower ``frames_per_tick``)
     — a silent drop would break the parity contract.
 
+    With ``params.deskew_enable`` the drained revolutions are
+    DE-SKEWED (ops/deskew.py) before the filter — the host-parity
+    twin is then the de-skewing host path (ops/deskew_ref.
+    DeskewHostTwin + chain), not the raw ``replay_through_chain``;
+    every reconstructed sweep the drain emits lands in
+    ``stats["recon_history"]`` bit-exact against that twin.
+
     Returns ``(ranges, state, stats)``: per-scan (K, beams) float32
     median range images, the final FilterState (stream axis squeezed —
     comparable to :func:`replay_through_chain`'s), and a stats dict
@@ -381,6 +388,11 @@ def replay_raw_fused(
         max_queue=1 << 30,  # offline: every wire must survive to the drain
         buckets=(frames_per_tick,), super_tick_max=super_ticks,
     )
+    # de-skew/reconstruction active (params.deskew_enable): log every
+    # reconstructed sweep the drain emits — the offline analog of the
+    # live mapper seam, and the surface the host-golden parity replay
+    # compares bit-for-bit (tests/test_deskew.py)
+    eng.recon_log = eng._deskew is not None
     outs = eng.submit_backlog(ticks)[0] if ticks else []
     if eng.revs_dropped:
         raise ValueError(
@@ -403,6 +415,9 @@ def replay_raw_fused(
         "frames": n_frames,
         "scans": len(outs),
     }
+    if eng._deskew is not None:
+        stats["recon_sweeps"] = len(eng.recon_history[0])
+        stats["recon_history"] = eng.recon_history[0]
     return ranges, state, stats
 
 

@@ -47,6 +47,7 @@ def _records(paths: list[str]):
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
+    "deskew_ab",
 )
 
 
@@ -305,6 +306,47 @@ def analyze(records: list[dict]) -> dict:
                     "survivor_steady_ratio", "shards", "streams",
                     "ratio_clamped",
                 ) if k in fov
+            })
+
+        # config 16: the de-skew + sweep-reconstruction A/B
+        # (deskew_enable default).  TWO gates on top of the device=tpu
+        # rule: the clamp (one arm under the timer floor) and a
+        # tick-ratio floor — the R× update multiplication is
+        # architectural (asserted in the bench), so the flip question
+        # is only whether the extra per-tick mapper work keeps the
+        # fleet rate; a >= 2x multiplier with the tick ratio >= 0.90
+        # is a win by construction.  Floor-style strength (the
+        # failover_ab discipline): a clean record carries parity
+        # strength so an above-parity noise record can never outweigh
+        # committed evidence AGAINST the flip.
+        dab = rec.get("deskew_ab")
+        if isinstance(dab, dict):
+            mult = dab.get("update_multiplier")
+            ratio = dab.get("steady_tick_ratio")
+            if (
+                isinstance(mult, (int, float))
+                and isinstance(ratio, (int, float))
+                and not dab.get("ratio_clamped")
+            ):
+                flip = mult >= 2.0 and ratio >= 0.90
+                recommend("deskew_enable.tpu", {
+                    "current": "false",
+                    "recommended": "true" if flip else "false",
+                    "flip": flip,
+                    "key": "config16 update_multiplier + steady_tick_ratio",
+                    "value": 1.0 if flip else float(min(ratio, 1.0)),
+                    "measured": {
+                        "update_multiplier": float(mult),
+                        "steady_tick_ratio": float(ratio),
+                    },
+                    "margin": 0.90,
+                    "source": "deskew_ab",
+                })
+            out["evidence"].setdefault("deskew_ab", []).append({
+                k: dab[k] for k in (
+                    "update_multiplier", "steady_tick_ratio",
+                    "ratio_clamped",
+                ) if k in dab
             })
 
         # ablation: resample + voxel kernels

@@ -29,6 +29,7 @@ def test_metric_names_stable():
     assert bench.metric_name(13) == "chaos_degraded_fleet_scans_per_sec"
     assert bench.metric_name(14) == "pallas_match_kernel_scans_per_sec"
     assert bench.metric_name(15) == "shard_failover_survivor_scans_per_sec"
+    assert bench.metric_name(16) == "deskew_recon_map_updates_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -36,7 +37,7 @@ def test_graded_table_well_formed():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
-            "pallas_match", "failover",
+            "pallas_match", "failover", "deskew",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1080,6 +1081,103 @@ def test_bench_smoke_failover():
     assert "survivor_steady_ratio" in out["failover_ab"]
     assert isinstance(out["failover_ab"]["ratio_clamped"], bool)
     assert "ceiling_analysis" in out
+
+
+def test_bench_smoke_deskew():
+    """`bench.py --smoke-deskew` — the tier-1 gate for the de-skew +
+    sweep-reconstruction stage (config-16 A/B at seconds-scale CPU
+    geometry).  The structural claims are what matters: one ingest
+    dispatch per tick PER ARM (the de-skew/reconstruction stages ride
+    inside the existing fused program), >= 2x map-update multiplication
+    per physical revolution, zero-motion identity on the static scene,
+    and bit-exact host-twin replay of the reconstructed sweeps and the
+    de-skewed revolutions (the bench itself raises on violation; this
+    gate pins that the asserted artifact lands).  The tick-time ratio
+    is 1.5-core-CI weather and unasserted; the bit-exact de-skew
+    contract across every lowering lives in tests/test_deskew.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-deskew"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(16)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claims, re-checked from the artifact
+    s = out["structural"]
+    assert s["one_dispatch_per_tick"] is True
+    assert s["zero_recompiles"] is True
+    assert s["zero_implicit_transfers"] is True
+    assert s["update_multiplication"] is True
+    assert s["zero_motion_identity"] is True
+    assert s["host_twin_bit_exact"] is True
+    # the R× claim the config exists for: the reconstruct arm delivered
+    # at least 2 updates per revolution while the arms completed the
+    # SAME revolutions (the bench asserts equality)
+    assert out["updates"]["multiplier"] >= 2.0
+    assert out["updates"]["reconstruct"] >= 2 * out["revolutions"]
+    assert out["value"] > 0
+    # the decision key rides with its clamp flag
+    assert "update_multiplier" in out["deskew_ab"]
+    assert "steady_tick_ratio" in out["deskew_ab"]
+    assert isinstance(out["deskew_ab"]["ratio_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_deskew_key():
+    """The deskew_enable recommendation flips from config-16 evidence
+    alone: an unclamped TPU record with the update multiplier >= 2x AND
+    the paired tick ratio >= 0.90 recommends the flip; CPU records,
+    clamped ratios, sub-2x multipliers and below-floor ratios never
+    flip."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, mult, ratio, clamped=False):
+        return {
+            "device": dev,
+            "deskew_ab": {
+                "update_multiplier": mult,
+                "steady_tick_ratio": ratio,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    # clean TPU record above both bars -> flip
+    got = db.analyze([rec("tpu", 2.5, 0.97)])
+    r = got["recommendations"]["deskew_enable.tpu"]
+    assert r["flip"] is True and r["recommended"] == "true"
+    # CPU record: reported, never flips
+    got = db.analyze([rec("cpu", 3.0, 1.0)])
+    assert "deskew_enable.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 2.5, 0.97, clamped=True)])
+    assert "deskew_enable.tpu" not in got["recommendations"]
+    # sub-2x multiplier: no flip
+    got = db.analyze([rec("tpu", 1.5, 0.99)])
+    assert got["recommendations"]["deskew_enable.tpu"]["flip"] is False
+    # below the tick-ratio floor: no flip (the extra mapper work is
+    # eating the fleet rate)
+    got = db.analyze([rec("tpu", 2.5, 0.7)])
+    assert got["recommendations"]["deskew_enable.tpu"]["flip"] is False
+    # floor-asymmetric strength: a committed degradation record is not
+    # displaced by a later clean record's parity strength alone when
+    # the degradation evidence is stronger
+    got = db.analyze([rec("tpu", 2.5, 0.5), rec("tpu", 2.5, 0.97)])
+    assert got["recommendations"]["deskew_enable.tpu"]["flip"] is False
 
 
 def test_decide_backends_failover_key():

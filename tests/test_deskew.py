@@ -1,0 +1,866 @@
+"""De-skew + sweep reconstruction (ops/deskew.py) — parity suite.
+
+Pins the contract that makes the stage shippable inside the fused
+ingest core (ops/ingest._segment_filter_core):
+
+  * every fixed-point building block is BIT-EXACT between the jnp
+    lowering and the NumPy twin (ops/deskew_ref.py) — int32 end to end,
+    so equality is byte-level, not tolerance;
+  * zero motion is the exact identity (a stationary platform's outputs
+    are untouched, estimator and applicator both);
+  * the motion estimator recovers synthetic rotations/translations with
+    the documented sign conventions;
+  * the full streaming surface — reconstructed sweep planes, motion
+    estimates, de-skewed revolution outputs — is bit-exact between the
+    host twin and ALL fused lowerings: single-stream, fleet 1/3/8,
+    super-tick T∈{1,2,8};
+  * the cache respects the engine seams: ring invalidation on a
+    mid-backlog format switch, decode-carry reset on a quarantine-style
+    rejoin (the ring restarts with the engines, like PR 9's
+    ``_streaming`` flag), bit-exact continuation through whole-fleet
+    and per-stream snapshot/restore.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.ops import wire
+from rplidar_ros2_driver_tpu.ops.deskew import (
+    RECON_EMPTY,
+    DeskewConfig,
+    apply_deskew,
+    combine_ring,
+    deskew_config_from_params,
+    estimate_motion,
+    profile_from_nodes,
+    profile_trig,
+    push_ring,
+    rasterize_subsweep,
+)
+from rplidar_ros2_driver_tpu.ops.deskew_ref import (
+    DeskewHostTwin,
+    HostDeskewStream,
+    apply_deskew_np,
+    combine_ring_np,
+    estimate_motion_np,
+    profile_from_nodes_np,
+    rasterize_subsweep_np,
+    wire_clamp_np,
+)
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+BEAMS = 256
+ANS = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+DSK = DeskewConfig(
+    recon_beams=BEAMS, profile_beams=64, shift_window=4, recon_window=3
+)
+
+
+def _params(**over):
+    base = dict(
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=32,
+        ingest_backend="fused",
+        deskew_enable=True,
+        sweep_reconstruct_window=3,
+        deskew_profile_beams=64,
+        deskew_shift_window=4,
+    )
+    base.update(over)
+    return DriverParams(**base)
+
+
+def _dense_frames(revs: int, ppr: int = 400, drift_per_rev: float = 0.0,
+                  seed: int = 0):
+    """Dense-capsule wire stream: ``revs`` revolutions of a sinusoidal
+    room, with an optional radial drift per revolution (a "moving
+    platform" whose motion the estimator must pick up)."""
+    rng = np.random.default_rng(seed)
+    frames = []
+    idx = 0
+    first = True
+    while idx < revs * ppr:
+        theta = 360.0 * (idx % ppr) / ppr
+        pts = (np.arange(40) + idx) % ppr
+        dists = (
+            2000.0 + 500.0 * np.sin(2 * np.pi * pts / ppr)
+            + drift_per_rev * (idx / ppr)
+            + rng.uniform(0.0, 0.25)
+        )
+        frames.append(wire.encode_dense_capsule(
+            int(theta * 64) & 0x7FFF, first, dists.astype(int)
+        ))
+        idx += 40
+        first = False
+    return frames
+
+
+def _chunks(frames, run=4):
+    return [frames[i : i + run] for i in range(0, len(frames), run)]
+
+
+def _feed_single(cfg_deskew, frames, run=4, max_nodes=1024, max_revs=2):
+    """Drive the raw single-stream fused step over ``frames``; returns
+    the per-dispatch IngestBatchResult list."""
+    from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
+    from rplidar_ros2_driver_tpu.ops.ingest import (
+        create_ingest_state,
+        fused_ingest_step,
+        ingest_config_for,
+        unpack_ingest_result,
+    )
+    from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+
+    fcfg = FilterConfig(window=4, beams=BEAMS, grid=32)
+    cfg = ingest_config_for(
+        ANS, timingmod.TimingDesc(), fcfg,
+        max_nodes=max_nodes, max_revs=max_revs, deskew=cfg_deskew,
+    )
+    st = create_ingest_state(cfg)
+    outs = []
+    t = 100.0
+    prev_base = None
+    for ch in _chunks(frames, run):
+        m = len(ch)
+        stamps = []
+        for _ in ch:
+            t += 0.00125
+            stamps.append(t)
+        base = stamps[0]
+        buf = np.zeros((run, cfg.frame_bytes), np.uint8)
+        buf[:m] = np.frombuffer(b"".join(ch), np.uint8).reshape(m, -1)
+        aux = np.zeros((2 * run + 2,), np.float32)
+        aux[:m] = [s - base for s in stamps]
+        aux[-2] = 0.0 if prev_base is None else prev_base - base
+        aux[-1] = m
+        prev_base = base
+        st, *res = fused_ingest_step(st, buf, aux, cfg=cfg)
+        outs.append(unpack_ingest_result(res, cfg))
+    return outs, st, cfg
+
+
+# ---------------------------------------------------------------------------
+# fixed-point building blocks: jnp vs numpy, byte-for-byte
+# ---------------------------------------------------------------------------
+
+
+def _rand_nodes(rng, n=600):
+    angle = rng.integers(0, 65536, n).astype(np.int32)
+    dist = rng.integers(0, 0x3FFFF, n).astype(np.int32)
+    dist[rng.random(n) < 0.1] = 0  # no-return markers
+    quality = rng.integers(0, 256, n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+    return angle, dist, quality, valid
+
+
+def test_block_parity_random():
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        angle, dist, quality, valid = _rand_nodes(rng)
+        pj = np.asarray(profile_from_nodes(angle, dist, valid, DSK))
+        pn = profile_from_nodes_np(angle, dist, valid, DSK)
+        assert np.array_equal(pj, pn)
+
+        a2, d2, _q, v2 = _rand_nodes(rng)
+        p2 = profile_from_nodes_np(a2, d2, v2, DSK)
+        mj = np.asarray(estimate_motion(pn, p2, DSK))
+        mn = estimate_motion_np(pn, p2, DSK)
+        assert np.array_equal(mj, mn)
+
+        aj, dj = apply_deskew(angle, dist, valid, mn, DSK)
+        an, dn = apply_deskew_np(angle, dist, valid, mn, DSK)
+        assert np.array_equal(np.asarray(aj), an)
+        assert np.array_equal(np.asarray(dj), dn)
+
+        sj = np.asarray(rasterize_subsweep(angle, dist, quality, valid, DSK))
+        sn = rasterize_subsweep_np(angle, dist, quality, valid, DSK)
+        assert np.array_equal(sj, sn)
+
+
+def test_ring_combine_parity_and_newest_wins():
+    rng = np.random.default_rng(5)
+    import jax.numpy as jnp
+
+    ring = np.full((DSK.recon_window, BEAMS), RECON_EMPTY, np.int32)
+    pos = 0
+    jring = jnp.asarray(ring)
+    jpos = jnp.asarray(0, jnp.int32)
+    for k in range(7):
+        angle, dist, quality, valid = _rand_nodes(rng, 200)
+        seg = rasterize_subsweep_np(angle, dist, quality, valid, DSK)
+        ring[pos % DSK.recon_window] = seg
+        pos += 1
+        jring, jpos = push_ring(
+            jring, jpos, jnp.asarray(seg), jnp.asarray(True)
+        )
+        cj = np.asarray(combine_ring(jring, jpos))
+        cn = combine_ring_np(ring, pos)
+        assert np.array_equal(cj, cn)
+        # newest-wins: every beam the NEWEST segment touched shows its
+        # value, regardless of what older segments held there
+        touched = seg != RECON_EMPTY
+        assert np.array_equal(cn[touched], seg[touched])
+    # an un-pushed tick leaves ring and position untouched
+    jring2, jpos2 = push_ring(
+        jring, jpos, jnp.asarray(seg), jnp.asarray(False)
+    )
+    assert np.array_equal(np.asarray(jring2), np.asarray(jring))
+    assert int(jpos2) == int(jpos)
+
+
+# ---------------------------------------------------------------------------
+# estimator semantics: identity, rotation, translation
+# ---------------------------------------------------------------------------
+
+
+def _room_profile(cfg) -> np.ndarray:
+    d = cfg.profile_beams
+    return (
+        4000 + 1500 * np.sin(2 * np.pi * np.arange(d) / d * 3.0)
+    ).astype(np.int32)
+
+
+def test_zero_motion_identity_units():
+    prof = _room_profile(DSK)
+    m = estimate_motion_np(prof, prof.copy(), DSK)
+    assert np.array_equal(m, np.zeros(3, np.int32))
+    rng = np.random.default_rng(11)
+    angle, dist, _q, valid = _rand_nodes(rng)
+    a2, d2 = apply_deskew_np(angle, dist, valid, np.zeros(3, np.int32), DSK)
+    assert np.array_equal(a2, angle) and np.array_equal(d2, dist)
+    # featureless tie (all shifts score equally): |s|-ordered candidates
+    # make first-min-wins prefer the identity
+    flat = np.full((DSK.profile_beams,), 5000, np.int32)
+    assert np.array_equal(
+        estimate_motion_np(flat, flat.copy(), DSK), np.zeros(3, np.int32)
+    )
+
+
+def test_estimator_recovers_rotation():
+    prof = _room_profile(DSK)
+    d = DSK.profile_beams
+    for s0 in (-3, -1, 1, 3):
+        # sensor rotated by dθ = s0 beams: a feature at beam b in the
+        # previous revolution appears at beam b - s0 now, i.e.
+        # cur[b] = prev[b + s0]
+        cur = np.roll(prof, -s0)
+        m = estimate_motion_np(prof, cur, DSK)
+        assert m[2] == s0 * (65536 // d), (s0, m)
+
+
+def test_estimator_recovers_translation():
+    prof = _room_profile(DSK)
+    trig = profile_trig(DSK)
+    for dx, dy in ((300, 0), (0, -250), (200, 150)):
+        radial = (dx * trig[:, 0] + dy * trig[:, 1] + (1 << 13)) >> 14
+        cur = (prof - radial).astype(np.int32)
+        m = estimate_motion_np(prof, cur, DSK)
+        assert m[2] == 0
+        # diagonal least squares on a 3-lobed room: expect the right
+        # sign and magnitude within ~25%
+        for est, true in ((m[0], dx), (m[1], dy)):
+            if true == 0:
+                assert abs(int(est)) <= 64
+            else:
+                assert np.sign(est) == np.sign(true)
+                assert abs(int(est) - true) <= abs(true) * 0.25 + 32
+
+
+def test_apply_deskew_phase_fraction():
+    motion = np.asarray([0, 0, 512], np.int32)  # dθ = 2 profile beams
+    angle = np.asarray([0, 32768, 65535], np.int32)  # phase 0, ½, ~1
+    dist = np.full(3, 8000, np.int32)
+    a2, _d2 = apply_deskew_np(angle, dist, np.ones(3, bool), motion, DSK)
+    # full remaining motion at phase 0, half at phase ½, ~none at the end
+    assert a2[0] == (0 - 512) % 65536
+    assert a2[1] == (32768 - 256) % 65536
+    assert a2[2] == 65535
+    # pure translation: range shrinks by the remaining radial component
+    motion = np.asarray([400, 0, 0], np.int32)
+    _a2, d2 = apply_deskew_np(angle, dist, np.ones(3, bool), motion, DSK)
+    assert d2[0] == 8000 - 400      # cos(0)=1, full phase remaining
+    assert d2[1] == 8000 + 200      # cos(π)=-1, half remaining
+    assert d2[2] == 8000            # no motion left
+    # a no-return node is never resurrected
+    _a3, d3 = apply_deskew_np(
+        np.zeros(1, np.int32), np.zeros(1, np.int32), np.ones(1, bool),
+        motion, DSK,
+    )
+    assert d3[0] == 0
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        DeskewConfig(recon_beams=BEAMS, profile_beams=48)  # not 2^k
+    with pytest.raises(ValueError):
+        DeskewConfig(recon_beams=BEAMS, shift_window=0)
+    with pytest.raises(ValueError):
+        DeskewConfig(recon_beams=BEAMS, recon_window=1)
+    with pytest.raises(ValueError):
+        DeskewConfig(recon_beams=BEAMS, max_trans_q2=1 << 14)
+    with pytest.raises(ValueError):
+        _params(filter_chain=()).validate()
+    with pytest.raises(ValueError):
+        _params(ingest_backend="host", fleet_ingest_backend="host").validate()
+    with pytest.raises(ValueError):
+        _params(sweep_reconstruct_window=1).validate()
+    with pytest.raises(ValueError):
+        _params(deskew_profile_beams=100).validate()
+    with pytest.raises(ValueError):
+        _params(deskew_shift_window=99).validate()
+    p = _params()
+    p.validate()
+    dsk = deskew_config_from_params(p, BEAMS)
+    assert dsk is not None and dsk.recon_beams == BEAMS
+    assert deskew_config_from_params(
+        DriverParams(), BEAMS
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# streaming surface: host twin vs every fused lowering
+# ---------------------------------------------------------------------------
+
+
+def test_single_stream_vs_host_twin_moving_scene():
+    """The whole streaming surface — recon planes, motion estimates,
+    per-revolution de-skewed chain outputs — bit-exact between the
+    single-stream fused engine and the NumPy twin + golden chain, on a
+    scene with real inter-revolution motion (nonzero estimates)."""
+    from rplidar_ros2_driver_tpu.driver.ingest import FusedIngest
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+
+    params = _params()
+    frames = _dense_frames(6, drift_per_rev=60.0)
+    eng = FusedIngest(params, beams=BEAMS, capacity=1024, max_revs=2,
+                      buckets=(4,))
+    eng.recon_log = True
+    twin = DeskewHostTwin(deskew_config_from_params(params, BEAMS),
+                          max_nodes=1024)
+    chain = ScanFilterChain(params, beams=BEAMS, warmup=False)
+
+    t = 100.0
+    twin_recons, twin_ranges = [], []
+    for ch in _chunks(frames, 4):
+        items = []
+        for f in ch:
+            t += 0.00125
+            items.append((f, t))
+        eng.on_measurement_batch(ANS, list(items))
+        combined, pushed, revs = twin.tick(ANS, items)
+        if pushed:
+            twin_recons.append(combined)
+        for a2, d2, scan in revs:
+            out = chain.process_raw(a2, d2, scan["quality"], scan["flag"])
+            twin_ranges.append(np.asarray(out.ranges).copy())
+    fused_outs = eng.flush()
+
+    assert len(eng.recon_history) == len(twin_recons) > 0
+    for k, ((plane, pts), tw) in enumerate(
+        zip(eng.recon_history, twin_recons)
+    ):
+        assert np.array_equal(plane, tw), f"recon plane {k} diverged"
+        assert pts.shape == (BEAMS, 3)
+    assert len(fused_outs) == len(twin_ranges) > 0
+    moved = False
+    for k, ((out, _ts0, _dur), tr) in enumerate(
+        zip(fused_outs, twin_ranges)
+    ):
+        assert np.array_equal(np.asarray(out.ranges), tr), (
+            f"revolution {k} de-skewed output diverged"
+        )
+    # the drifting scene must actually exercise the estimator
+    assert (twin.stream.motion != 0).any()
+
+
+@pytest.mark.parametrize("streams", [1, 3, 8])
+def test_fleet_vs_single_stream(streams):
+    """Fleet lanes are bit-exact vs the single-stream fused path: same
+    per-tick recon planes, motion meta and revolution outputs for every
+    lane fed the same bytes."""
+    from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
+    from rplidar_ros2_driver_tpu.ops.ingest import (
+        create_fleet_ingest_state,
+        fleet_aux_len,
+        fleet_fused_ingest_step,
+        fleet_ingest_config_for,
+        unpack_fleet_ingest_result,
+    )
+    from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+
+    frames = _dense_frames(4, drift_per_rev=60.0)
+    run = 4
+    single, _st, _cfg = _feed_single(DSK, frames, run=run)
+
+    fcfg = FilterConfig(window=4, beams=BEAMS, grid=32)
+    cfg = fleet_ingest_config_for(
+        (ANS,), timingmod.TimingDesc(), fcfg,
+        max_nodes=1024, max_revs=2, deskew=DSK,
+    )
+    st = create_fleet_ingest_state(cfg, streams)
+    t0s = [100.0 + 50.0 * i for i in range(streams)]
+    prevb = [None] * streams
+    for ci, ch in enumerate(_chunks(frames, run)):
+        m = len(ch)
+        buf = np.zeros((streams, run, cfg.frame_bytes), np.uint8)
+        aux = np.zeros((streams, fleet_aux_len(run)), np.float32)
+        for i in range(streams):
+            stamps = [t0s[i] + 0.00125 * (ci * run + j + 1) for j in range(m)]
+            base = stamps[0]
+            buf[i, :m] = np.frombuffer(b"".join(ch), np.uint8).reshape(m, -1)
+            aux[i, :m] = [s - base for s in stamps]
+            aux[i, 2 * run] = 0.0 if prevb[i] is None else prevb[i] - base
+            aux[i, 2 * run + 1] = m
+            prevb[i] = base
+        st, *res = fleet_fused_ingest_step(st, buf, aux, cfg=cfg)
+        rows = unpack_fleet_ingest_result(res, cfg)
+        ref = single[ci]
+        for i in range(streams):
+            assert rows[i].recon_pushed == ref.recon_pushed
+            assert np.array_equal(rows[i].recon_plane, ref.recon_plane)
+            assert np.array_equal(rows[i].recon_pts, ref.recon_pts)
+            assert np.array_equal(rows[i].deskew_motion, ref.deskew_motion)
+            assert rows[i].n_completed == ref.n_completed
+            for k in range(ref.n_completed):
+                assert np.array_equal(
+                    rows[i].outputs[k].ranges, ref.outputs[k].ranges
+                )
+
+
+@pytest.mark.parametrize("super_t", [1, 2, 8])
+def test_super_tick_vs_per_tick(super_t):
+    """The T-tick super-step carries the de-skew/reconstruction planes
+    through its lax.scan bit-exactly: same recon planes and outputs as
+    T sequential per-tick dispatches."""
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+
+    frames = _dense_frames(4, drift_per_rev=60.0)
+    run = 4
+
+    def drive(stm):
+        eng = FleetFusedIngest(
+            _params(fleet_ingest_backend="fused"), 2, beams=BEAMS,
+            capacity=1024, max_revs=2, buckets=(run,), super_tick_max=stm,
+        )
+        eng.recon_log = True
+        ticks = []
+        t = [100.0, 150.0]
+        for ch in _chunks(frames, run):
+            tick = []
+            for s in range(2):
+                batch = []
+                for f in ch:
+                    t[s] += 0.00125
+                    batch.append((f, t[s]))
+                tick.append((ANS, batch))
+            ticks.append(tick)
+        outs = eng.submit_backlog(ticks)
+        return eng, outs
+
+    eng1, outs1 = drive(1)
+    engT, outsT = drive(super_t)
+    for i in range(2):
+        assert len(eng1.recon_history[i]) == len(engT.recon_history[i]) > 0
+        for (p1, x1), (pt, xt) in zip(
+            eng1.recon_history[i], engT.recon_history[i]
+        ):
+            assert np.array_equal(p1, pt)
+            assert np.array_equal(x1, xt)
+        assert len(outs1[i]) == len(outsT[i]) > 0
+        for (o1, _t1, _d1), (oT, _tT, _dT) in zip(outs1[i], outsT[i]):
+            assert np.array_equal(
+                np.asarray(o1.ranges), np.asarray(oT.ranges)
+            )
+    if super_t > 1:
+        assert engT.super_dispatches > 0
+
+
+# ---------------------------------------------------------------------------
+# cache seams: format switch, snapshot/restore, rejoin reset
+# ---------------------------------------------------------------------------
+
+
+def test_ring_invalidation_on_format_switch():
+    """A mid-backlog format switch resets the sub-sweep ring with the
+    decode carries: the first post-switch reconstruction contains ONLY
+    post-switch data (bit-exact vs a FRESH twin fed only the post-
+    switch ticks)."""
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+
+    params = _params(fleet_ingest_backend="fused")
+    dense = _dense_frames(2)
+    run = 4
+    eng = FleetFusedIngest(params, 1, beams=BEAMS, capacity=1024,
+                           max_revs=2, buckets=(run,))
+    eng.recon_log = True
+    # normal-measurement frames after the switch (1 node per frame)
+    normal = []
+    ppr = 64
+    for k in range(ppr * 2):
+        a_deg = 360.0 * (k % ppr) / ppr
+        normal.append(wire.encode_normal_node(
+            int(a_deg * 64) & 0x7FFF, (3000 + 10 * (k % ppr)) * 4,
+            40, k % ppr == 0,
+        ))
+    ticks = []
+    t = [100.0]
+
+    def mk(ans, ch):
+        batch = []
+        for f in ch:
+            t[0] += 0.00125
+            batch.append((f, t[0]))
+        return [(ans, batch)]
+
+    for ch in _chunks(dense, run):
+        ticks.append(mk(ANS, ch))
+    switch_at = len(ticks)
+    for ch in _chunks(normal, run):
+        ticks.append(mk(int(Ans.MEASUREMENT), ch))
+    eng.submit_backlog(ticks)
+
+    # the twin sees only the post-switch stream from a fresh state
+    twin = DeskewHostTwin(
+        deskew_config_from_params(params, BEAMS), max_nodes=1024
+    )
+    twin_recons = []
+    for tk in ticks[switch_at:]:
+        combined, pushed, _revs = twin.tick(tk[0][0], tk[0][1])
+        if pushed:
+            twin_recons.append(combined)
+    post = eng.recon_history[0][-len(twin_recons):]
+    assert len(twin_recons) > 0
+    for (plane, _pts), tw in zip(post, twin_recons):
+        assert np.array_equal(plane, tw)
+    # and the first post-switch plane holds strictly fewer live beams
+    # than the dense cache had (the old ring is GONE, not overlaid)
+    pre_plane = eng.recon_history[0][switch_at - 1][0]
+    assert (post[0][0] != RECON_EMPTY).sum() < (
+        pre_plane != RECON_EMPTY
+    ).sum()
+
+
+def test_snapshot_restore_continuation():
+    """Whole-fleet snapshot -> restore into a fresh engine continues
+    the reconstruction bit-exactly (the ring is state, not cache)."""
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+
+    params = _params(fleet_ingest_backend="fused")
+    frames = _dense_frames(4, drift_per_rev=60.0)
+    run = 4
+    chunks = _chunks(frames, run)
+    half = len(chunks) // 2
+
+    def ticks_of(chs, t0):
+        t = [t0]
+        out = []
+        for ch in chs:
+            batch = []
+            for f in ch:
+                t[0] += 0.00125
+                batch.append((f, t[0]))
+            out.append([(ANS, batch)])
+        return out
+
+    def fresh():
+        e = FleetFusedIngest(params, 1, beams=BEAMS, capacity=1024,
+                             max_revs=2, buckets=(run,))
+        e.recon_log = True
+        return e
+
+    ref = fresh()
+    ref.submit_backlog(ticks_of(chunks, 100.0))
+
+    a = fresh()
+    a.submit_backlog(ticks_of(chunks[:half], 100.0))
+    snap = a.snapshot()
+    assert any(k == "ingest.recon_ring" for k in snap)
+    b = fresh()
+    assert b.restore(snap)
+    b.recon_history = [[]]
+    b.submit_backlog(
+        ticks_of(chunks[half:], 100.0 + 0.00125 * half * run)
+    )
+    tail = ref.recon_history[0][-len(b.recon_history[0]):]
+    assert len(b.recon_history[0]) > 0
+    for (pb, _xb), (pr, _xr) in zip(b.recon_history[0], tail):
+        assert np.array_equal(pb, pr)
+    # a deskew-off snapshot must be rejected by a deskew-on engine
+    # (ingest plane mismatch), state untouched
+    off = FleetFusedIngest(
+        DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=4,
+            voxel_grid_size=32, filter_backend="cpu",
+            fleet_ingest_backend="fused",
+        ),
+        1, beams=BEAMS, capacity=1024, max_revs=2, buckets=(run,),
+    )
+    off.submit_backlog(ticks_of(chunks[:2], 100.0))
+    assert not fresh().restore(off.snapshot())
+
+
+def test_stream_snapshot_roundtrip_and_rejoin_reset():
+    """Per-stream snapshot/restore (the quarantine checkpoint / shard
+    migration unit): ``restore_decode=True`` continues the ring
+    bit-exactly; the DEFAULT rejoin path resets it with the decode
+    carries — the cache restarts with the engines."""
+    from rplidar_ros2_driver_tpu.driver.ingest import (
+        INGEST_STREAM_SNAPSHOT_VERSION,
+        FleetFusedIngest,
+    )
+
+    params = _params(fleet_ingest_backend="fused")
+    frames = _dense_frames(4, drift_per_rev=60.0)
+    run = 4
+    chunks = _chunks(frames, run)
+    half = len(chunks) // 2
+
+    def ticks_of(chs, t0):
+        t = [t0]
+        out = []
+        for ch in chs:
+            batch = []
+            for f in ch:
+                t[0] += 0.00125
+                batch.append((f, t[0]))
+            out.append([(ANS, batch)])
+        return out
+
+    def fresh():
+        e = FleetFusedIngest(params, 1, beams=BEAMS, capacity=1024,
+                             max_revs=2, buckets=(run,))
+        e.recon_log = True
+        return e
+
+    ref = fresh()
+    ref.submit_backlog(ticks_of(chunks, 100.0))
+
+    a = fresh()
+    a.submit_backlog(ticks_of(chunks[:half], 100.0))
+    snap = a.snapshot_stream(0)
+    assert int(snap["version"]) == INGEST_STREAM_SNAPSHOT_VERSION == 2
+    assert "ingest.recon_ring" in snap
+
+    # migration-style restore: decode rows included -> bit-exact tail
+    b = fresh()
+    assert b.restore_stream(0, snap, restore_decode=True)
+    b.recon_history = [[]]
+    b.submit_backlog(ticks_of(chunks[half:], 100.0 + 0.00125 * half * run))
+    tail = ref.recon_history[0][-len(b.recon_history[0]):]
+    for (pb, _xb), (pr, _xr) in zip(b.recon_history[0], tail):
+        assert np.array_equal(pb, pr)
+
+    # rejoin-style restore (default): decode carries + ring reset — the
+    # first reconstruction afterwards is a FRESH twin's, not a stitched
+    # continuation of the pre-quarantine cache
+    c = fresh()
+    c.submit_backlog(ticks_of(chunks[:half], 100.0))
+    assert c.restore_stream(0, snap)
+    c.recon_history = [[]]
+    c.submit_backlog(ticks_of(chunks[half:], 500.0))
+    twin = DeskewHostTwin(
+        deskew_config_from_params(params, BEAMS), max_nodes=1024
+    )
+    t = [500.0]
+    twin_recons = []
+    for ch in chunks[half:]:
+        items = []
+        for f in ch:
+            t[0] += 0.00125
+            items.append((f, t[0]))
+        combined, pushed, _revs = twin.tick(ANS, items)
+        if pushed:
+            twin_recons.append(combined)
+    assert len(c.recon_history[0]) == len(twin_recons) > 0
+    for (pc, _xc), tw in zip(c.recon_history[0], twin_recons):
+        assert np.array_equal(pc, tw)
+
+    # version skew is rejected with state untouched
+    bad = dict(snap)
+    bad["version"] = np.asarray(1, np.int32)
+    assert not fresh().restore_stream(0, bad)
+
+
+def test_meta_and_result_arity():
+    from rplidar_ros2_driver_tpu.ops.filters import FilterConfig
+    from rplidar_ros2_driver_tpu.ops.ingest import (
+        ingest_config_for,
+        ingest_meta_len,
+    )
+    from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+
+    fcfg = FilterConfig(window=4, beams=BEAMS, grid=32)
+    base = ingest_config_for(ANS, timingmod.TimingDesc(), fcfg, max_revs=2)
+    dsk = ingest_config_for(
+        ANS, timingmod.TimingDesc(), fcfg, max_revs=2, deskew=DSK
+    )
+    assert ingest_meta_len(dsk) == ingest_meta_len(base) + 5
+    # and the result tuple grows by exactly the two recon planes
+    outs, _st, _cfg = _feed_single(DSK, _dense_frames(2))
+    assert outs[0].recon_plane is not None
+    assert outs[0].recon_pts is not None
+    outs2, _st2, _cfg2 = _feed_single(None, _dense_frames(2))
+    assert outs2[0].recon_plane is None
+
+
+def test_rasterize_clip_mirrors_chain_enable_clip():
+    """The rasterizer's clip fold follows the CHAIN's clip stage: with
+    'clip' absent from filter_chain the reconstruction keeps the
+    out-of-range returns the filter keeps (review-driven — the
+    'reconstructed sweep keeps exactly the returns the filter keeps'
+    contract must hold in both directions)."""
+    angle = np.asarray([100, 20000], np.int32)
+    dist = np.asarray([45 * 4000, 8000], np.int32)  # 45 m: beyond clip
+    quality = np.asarray([50, 50], np.int32)
+    valid = np.ones(2, bool)
+    clip_on = deskew_config_from_params(_params(), BEAMS)
+    clip_off = deskew_config_from_params(
+        _params(filter_chain=("median", "voxel")), BEAMS
+    )
+    assert clip_on.enable_clip and not clip_off.enable_clip
+    s_on = rasterize_subsweep_np(angle, dist, quality, valid, clip_on)
+    s_off = rasterize_subsweep_np(angle, dist, quality, valid, clip_off)
+    assert (s_on != RECON_EMPTY).sum() == 1   # 45 m return clipped
+    assert (s_off != RECON_EMPTY).sum() == 2  # kept, like the filter
+    # jnp twin agrees on both configs
+    for c in (clip_on, clip_off):
+        assert np.array_equal(
+            np.asarray(rasterize_subsweep(angle, dist, quality, valid, c)),
+            rasterize_subsweep_np(angle, dist, quality, valid, c),
+        )
+
+
+def test_restore_stream_rejects_deskew_off_snapshot():
+    """A deskew-off per-stream snapshot must be REJECTED by a deskew-on
+    engine's migration restore (restore_decode=True): silently skipping
+    the missing planes would leave the lane's previous occupant's
+    sub-sweep cache attributed to the migrated stream (review-driven)."""
+    from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+
+    run = 4
+    frames = _dense_frames(2)
+
+    def ticks_of(chs, t0):
+        t = [t0]
+        out = []
+        for ch in chs:
+            batch = []
+            for f in ch:
+                t[0] += 0.00125
+                batch.append((f, t[0]))
+            out.append([(ANS, batch)])
+        return out
+
+    off = FleetFusedIngest(
+        DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=4,
+            voxel_grid_size=32, filter_backend="cpu",
+            fleet_ingest_backend="fused",
+        ),
+        1, beams=BEAMS, capacity=1024, max_revs=2, buckets=(run,),
+    )
+    off.submit_backlog(ticks_of(_chunks(frames, run)[:2], 100.0))
+    snap_off = off.snapshot_stream(0)
+
+    on = FleetFusedIngest(
+        _params(fleet_ingest_backend="fused"), 1, beams=BEAMS,
+        capacity=1024, max_revs=2, buckets=(run,),
+    )
+    on.submit_backlog(ticks_of(_chunks(frames, run)[:2], 100.0))
+    assert not on.restore_stream(0, snap_off, restore_decode=True)
+    # and the symmetric direction: deskew-on snapshot into a deskew-off
+    # engine is rejected too
+    snap_on = on.snapshot_stream(0)
+    assert not off.restore_stream(0, snap_on, restore_decode=True)
+
+
+def test_idle_tick_clears_last_poses():
+    """An all-idle tick through the recon mapper seam clears last_poses
+    (review-driven: the stash must never republish the previous tick's
+    poses as current, matching the per-revolution seam's overwrite)."""
+    from rplidar_ros2_driver_tpu.parallel.service import (
+        ShardedFilterService,
+    )
+
+    params = _params(
+        fleet_ingest_backend="fused",
+        map_enable=True, map_backend="host", map_grid=64, map_cell_m=0.1,
+    )
+    svc = ShardedFilterService(
+        params, 2, beams=BEAMS, capacity=1024, fleet_ingest_buckets=(4,)
+    )
+    svc._ensure_byte_ingest()
+    mapper = svc.attach_mapper()
+    frames = _dense_frames(2)
+    t = [100.0]
+    for ch in _chunks(frames, 4):
+        batch = []
+        for f in ch:
+            t[0] += 0.00125
+            batch.append((f, t[0]))
+        svc.submit_bytes([(ANS, batch), (ANS, list(batch))])
+    assert any(p is not None for p in svc.last_poses)
+    svc.submit_bytes([None, None])  # idle tick: nothing fresh
+    assert all(p is None for p in svc.last_poses)
+    assert mapper.matches >= 0  # mapper untouched by the idle tick
+
+
+def test_active_host_seam_refuses_deskew():
+    """The validator can only see the param FIELDS; the seams that know
+    their ACTIVE backend refuse deskew_enable loudly instead of
+    silently building skew-uncorrected maps (review-driven): a service
+    whose fleet backend resolved host, and a node whose ingest seam
+    resolved host, both raise."""
+    from rplidar_ros2_driver_tpu.node.node import RPlidarNode
+    from rplidar_ros2_driver_tpu.parallel.service import (
+        ShardedFilterService,
+    )
+
+    # passes validate() — 'fused' is spelled into the OTHER seam
+    params = _params(
+        ingest_backend="fused", fleet_ingest_backend="host"
+    )
+    params.validate()
+    svc = ShardedFilterService(
+        params, 2, beams=BEAMS, capacity=1024
+    )
+    with pytest.raises(ValueError, match="fused fleet ingest backend"):
+        svc._ensure_byte_ingest()
+
+    node_params = _params(
+        ingest_backend="host", fleet_ingest_backend="fused",
+        dummy_mode=True,
+    )
+    node_params.validate()
+    node = RPlidarNode(node_params)
+    with pytest.raises(ValueError, match="resolve fused"):
+        node._resolve_fused_ingest()
+
+
+def test_recon_points_decode_matches_filters():
+    """The reconstructed sweep's f32 decode is the chain's own helpers:
+    a plane pushed through ops/deskew.recon_points equals _grid_decode
+    + polar_to_cartesian applied directly."""
+    import jax.numpy as jnp
+
+    from rplidar_ros2_driver_tpu.ops.deskew import recon_points
+    from rplidar_ros2_driver_tpu.ops.filters import (
+        _grid_decode,
+        polar_to_cartesian,
+    )
+
+    rng = np.random.default_rng(9)
+    angle, dist, quality, valid = _rand_nodes(rng)
+    plane = rasterize_subsweep_np(angle, dist, quality, valid, DSK)
+    ranges, xy, mask = recon_points(jnp.asarray(plane))
+    r2, _i2 = _grid_decode(jnp.asarray(plane))
+    xy2, m2 = polar_to_cartesian(r2, BEAMS)
+    assert np.array_equal(np.asarray(ranges), np.asarray(r2))
+    assert np.array_equal(np.asarray(xy), np.asarray(xy2))
+    assert np.array_equal(np.asarray(mask), np.asarray(m2))
