@@ -200,6 +200,7 @@ GRADED = {
     14: ("pallas_match", POINTS, dict(window=WINDOW)),  # matcher kernel xla-vs-pallas A/B
     15: ("failover", POINTS, dict(window=WINDOW)),  # shard-loss failover pod A/B
     16: ("deskew", POINTS, dict(window=WINDOW)),  # de-skew + sweep-recon A/B
+    17: ("loop_close", POINTS, dict(window=WINDOW)),  # SLAM back-end loop-closure A/B
 }
 
 
@@ -3042,6 +3043,364 @@ def bench_deskew(smoke: bool = False) -> dict:
     }
 
 
+class _DriftingFrontEnd:
+    """Scripted SLAM front-end for the config-17 back-end A/B: maps are
+    rasterized at CALLER-SUPPLIED (drift-injected) poses with no
+    correlative matching — the controlled stand-in for a front-end
+    whose odometry drifts (a clean synthetic scene cannot produce
+    organic front-end drift: pure scan matching re-corrects any nudge
+    against its own self-consistent map, which is exactly why the
+    back-end exists for the scenes that DO break that assumption).
+    The grid resets at each submap epoch so every finalized plane
+    carries only its own epoch's frame, the way real submaps do.
+
+    Implements the mapper surface slam/loop.LoopClosureEngine consumes
+    (``cfg``/``streams``/``device``/``last_inputs``/
+    ``snapshot_stream``/``reanchor_stream``); tests/test_loop_close.py
+    reuses it."""
+
+    def __init__(self, params, streams, beams, window_revs):
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            map_config_from_params,
+        )
+
+        self.cfg = map_config_from_params(params, beams)
+        self.streams = streams
+        self.device = None
+        self.window_revs = window_revs
+        g = self.cfg.grid
+        self.log_odds = np.zeros((streams, g, g), np.int32)
+        self.pose = np.zeros((streams, 3), np.int32)
+        self.rev = np.zeros(streams, np.int64)
+        self.last_inputs = None
+
+    def submit(self, pts, masks, poses_q):
+        from rplidar_ros2_driver_tpu.mapping.mapper import PoseEstimate
+        from rplidar_ros2_driver_tpu.ops.scan_match import pose_to_metric
+        from rplidar_ros2_driver_tpu.ops.scan_match_ref import (
+            quantize_points_np,
+            update_map_np,
+        )
+
+        live = np.ones(self.streams, np.int32)
+        self.last_inputs = (pts, masks, live)
+        ests = []
+        for i in range(self.streams):
+            if self.rev[i] % self.window_revs == 0:
+                self.log_odds[i] = 0  # windowed submap epoch
+            self.pose[i] = poses_q[i]
+            self.rev[i] += 1
+            pq, ok = quantize_points_np(pts[i], masks[i], self.cfg)
+            self.log_odds[i] = update_map_np(
+                self.log_odds[i], self.pose[i], pq, ok, self.cfg
+            )
+            x, y, th = pose_to_metric(self.pose[i], self.cfg)
+            ests.append(PoseEstimate(
+                x_m=x, y_m=y, theta_rad=th, score=1,
+                matched_points=int(ok.sum()), revision=int(self.rev[i]),
+                pose_q=self.pose[i].copy(),
+            ))
+        return ests
+
+    def snapshot_stream(self, i):
+        return {
+            "log_odds": self.log_odds[i].copy(),
+            "pose": self.pose[i].copy(),
+        }
+
+    def reanchor_stream(self, i, pose_q):
+        self.pose[i] = np.asarray(pose_q, np.int32)
+
+
+def _loop_drift_trace(streams, beams, n_revs, drift_sub, cell):
+    """Return-to-start trace with injected per-revolution drift: the
+    square-room fixture observed from TRUE poses that go out and come
+    back, plus a per-stream drifted-pose script (true + k·drift_sub
+    subcells along x) — the odometry the scripted front-end rasterizes
+    at.  Returns per-rev (pts, masks, drifted_q, true_end_q)."""
+    from rplidar_ros2_driver_tpu.ops.scan_match import SUB
+
+    half_room = 2.5
+    t = np.linspace(0, 2 * np.pi, beams, endpoint=False)
+    dx, dy = np.cos(t), np.sin(t)
+    with np.errstate(divide="ignore"):
+        r_wall = np.minimum(
+            np.where(np.abs(dx) > 1e-12, half_room / np.abs(dx), np.inf),
+            np.where(np.abs(dy) > 1e-12, half_room / np.abs(dy), np.inf),
+        )
+    wx, wy = dx * r_wall, dy * r_wall
+    sub_per_m = SUB / cell
+    h = n_revs // 2
+
+    def true_x(s, k):
+        # the LAST revolution (k = n_revs - 1) must sit exactly back at
+        # the start, or the fixture's own offset is charged against the
+        # 2-cell correction bar
+        out = 0.8 * (1 + 0.1 * s)
+        return out * (k / h if k <= h else max(n_revs - 1 - k, 0) / h)
+
+    revs = []
+    for k in range(n_revs):
+        pts = np.zeros((streams, beams, 2), np.float32)
+        drifted = np.zeros((streams, 3), np.int32)
+        for s in range(streams):
+            x0 = true_x(s, k)
+            pts[s, :, 0] = wx - x0
+            pts[s, :, 1] = wy
+            drifted[s] = (
+                int(round(x0 * sub_per_m)) + drift_sub * (k + 1), 0, 0,
+            )
+        revs.append((pts, drifted))
+    masks = np.ones((streams, beams), bool)
+    true_end = np.zeros((streams, 3), np.int32)  # trace returns to start
+    return revs, masks, true_end
+
+
+def bench_loop_close(smoke: bool = False) -> dict:
+    """Config 17 — the SLAM back-end A/B: a return-to-start trace with
+    injected per-revolution drift (``_loop_drift_trace``) through the
+    scripted front-end three ways, tick-paired over identical inputs:
+
+      * off   — front-end only: the published end pose carries the full
+        injected drift (the unbounded-baseline arm);
+      * host  — LoopClosureEngine on the NumPy reference backend;
+      * fused — the device backend: candidate match -> gates ->
+        constraint -> pose-graph relaxation in ONE vmapped dispatch per
+        closure check (ops/loop_close.fleet_loop_close_step).
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      1. DRIFT BOUNDED — the pose-graph-corrected end pose error is
+         <= 2 map cells while the baseline error equals the injected
+         drift, grows with trace length, and exceeds 4 cells (the
+         ISSUE-11 acceptance bar).
+      2. STRUCTURAL — the engine issues exactly ONE dispatch per
+         closure-check tick (and one per submap install), independent
+         of fleet size; zero recompiles / zero implicit transfers
+         under utils/guards.steady_state across the whole fused run
+         after precompile.
+      3. PARITY — host and fused arms land byte-identical closure
+         wires, corrected poses and final LoopState.
+
+    The artifact carries the clamped ``loop_close_ab`` decision key:
+    ``backend_speedup`` (host/fused wall ratio — recommends
+    ``loop_backend`` on TPU records only) and the loop-on-vs-off
+    ``steady_tick_ratio`` + accuracy pair (recommends ``loop_enable``
+    when correction lands within bar at < 10% tick cost).  ``smoke``
+    shrinks geometry to a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.ops.scan_match import SUB
+    from rplidar_ros2_driver_tpu.slam.loop import LoopClosureEngine
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        grid, cell, beams, streams, n_revs = 64, 0.1, 256, 2, 24
+    else:
+        grid, cell, beams, streams, n_revs = 128, 0.05, 1024, 4, 48
+    # injected drift per revolution: 1/4 cell — aggressive odometry
+    # error, but below the submap-window blur threshold (a 4-rev
+    # window at rate r accumulates 4r of intra-plane blur; past ~1/2
+    # cell/rev the candidate match's constraint carries a cell-scale
+    # bias no solver can remove, which is a scenario property, not a
+    # back-end defect)
+    drift_sub = SUB // 4
+    submap_revs, check_revs = 4, 2
+
+    def make_params(loop_backend: str) -> DriverParams:
+        return DriverParams(
+            filter_chain=("clip", "median", "voxel"),
+            map_enable=True, map_backend="host",
+            map_grid=grid, map_cell_m=cell,
+            loop_enable=True, loop_backend=loop_backend,
+            loop_submap_revs=submap_revs, loop_check_revs=check_revs,
+            loop_max_submaps=8 if smoke else 16,
+            loop_candidates=2, loop_weight=8,
+            pose_graph_max_constraints=32,
+            # relaxation sweeps scale with graph depth (damped Jacobi
+            # converges in O(nodes^2) sweeps): 96 covers the smoke's
+            # 8-node chain, the 16-node full graph plateaus at 192 —
+            # 256 holds margin at trivial cost (the loop is in-program)
+            pose_graph_iters=96 if smoke else 256,
+        )
+
+    revs, masks, true_end = _loop_drift_trace(
+        streams, beams, n_revs, drift_sub, cell
+    )
+
+    def run_arm(loop_backend):
+        p = make_params(loop_backend or "host")
+        fe = _DriftingFrontEnd(p, streams, beams, submap_revs)
+        eng = None
+        if loop_backend is not None:
+            eng = LoopClosureEngine(p, fe)
+            eng.precompile()
+        wires = []
+        check_ticks = 0
+        t0 = time.perf_counter()
+        with guards.steady_state(tag=f"loop-close {loop_backend}"):
+            for pts, drifted in revs:
+                ests = fe.submit(pts, masks, drifted)
+                if eng is not None:
+                    sts = eng.observe(ests)
+                    if any(s is not None for s in sts):
+                        check_ticks += 1
+                    wires.append([
+                        None if s is None else (
+                            s.accepted, s.candidate, s.score,
+                            tuple(int(v) for v in s.corrected_q),
+                        )
+                        for s in sts
+                    ])
+        dt = time.perf_counter() - t0
+        end_err = np.zeros((streams,), np.float64)
+        corr_err = np.zeros((streams,), np.float64)
+        for s in range(streams):
+            end = fe.pose[s]
+            end_err[s] = (
+                abs(int(end[0]) - int(true_end[s][0]))
+                + abs(int(end[1]) - int(true_end[s][1]))
+            ) / SUB
+            if eng is not None:
+                cor = eng.corrected_pose_q(s, end)
+                corr_err[s] = (
+                    abs(int(cor[0]) - int(true_end[s][0]))
+                    + abs(int(cor[1]) - int(true_end[s][1]))
+                ) / SUB
+        return {
+            "dt_s": dt, "eng": eng, "wires": wires,
+            "check_ticks": check_ticks,
+            "end_err_cells": end_err, "corr_err_cells": corr_err,
+            "snap": None if eng is None else eng.snapshot(),
+        }
+
+    # interleave the arms x2, best-of (1.5-core load drifts ~2x across
+    # seconds — docs/BENCHMARKS.md discipline); the smoke gate is
+    # structural/accuracy, one round respects the tier-1 budget
+    off_best = host_best = fused_best = None
+    for _ in range(1 if smoke else 2):
+        for name in ("off", "host", "fused"):
+            arm = run_arm(None if name == "off" else name)
+            best = {"off": off_best, "host": host_best,
+                    "fused": fused_best}[name]
+            if best is None or arm["dt_s"] < best["dt_s"]:
+                if name == "off":
+                    off_best = arm
+                elif name == "host":
+                    host_best = arm
+                else:
+                    fused_best = arm
+
+    # -- claim 1: bounded corrected drift vs unbounded baseline --
+    base_err = float(off_best["end_err_cells"].max())
+    corr_err = float(fused_best["corr_err_cells"].max())
+    injected_half = drift_sub * (n_revs // 2) / SUB
+    if corr_err > 2.0:
+        raise RuntimeError(
+            f"pose-graph correction missed the bar: corrected end-pose "
+            f"error {corr_err:.2f} cells > 2"
+        )
+    if not (base_err >= 4.0 and base_err > injected_half):
+        raise RuntimeError(
+            f"baseline drift scenario degenerate: end error "
+            f"{base_err:.2f} cells (expected growth past "
+            f"{injected_half:.2f} and >= 4)"
+        )
+    # -- claim 2: one dispatch per closure check, at most --
+    if fused_best["eng"].dispatch_count != fused_best["check_ticks"]:
+        raise RuntimeError(
+            f"loop engine dispatched {fused_best['eng'].dispatch_count} "
+            f"times for {fused_best['check_ticks']} closure-check ticks "
+            "(expected one per check tick)"
+        )
+    if host_best["eng"].dispatch_count != 0:
+        raise RuntimeError(
+            "host loop backend issued device dispatches (the reference "
+            "arm must stay host-only)"
+        )
+    # -- claim 3: bit-exact host/fused parity --
+    if host_best["wires"] != fused_best["wires"]:
+        raise RuntimeError("loop-closure parity broke: wires differ")
+    for k in host_best["snap"]:
+        if not np.array_equal(host_best["snap"][k], fused_best["snap"][k]):
+            raise RuntimeError(f"loop-closure parity broke: state {k!r}")
+
+    scans = n_revs * streams
+    off_sps = scans / off_best["dt_s"]
+    fused_sps = scans / fused_best["dt_s"]
+    tick_ratio = off_best["dt_s"] / max(fused_best["dt_s"], 1e-9)
+    backend_speedup = host_best["dt_s"] / max(fused_best["dt_s"], 1e-9)
+    clamped = fused_best["dt_s"] <= off_best["dt_s"]
+    eng = fused_best["eng"]
+    return {
+        "metric": metric_name(17),
+        "value": round(fused_sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(
+            fused_sps / (streams * BASELINE_SCANS_PER_SEC), 3
+        ),
+        "streams": streams,
+        "revs": n_revs,
+        "drift_sub_per_rev": drift_sub,
+        "baseline_end_err_cells": round(base_err, 3),
+        "corrected_end_err_cells": round(corr_err, 3),
+        "closures_accepted": int(eng.closures_accepted.sum()),
+        "closures_rejected": int(eng.closures_rejected.sum()),
+        "submaps": [int(c) for c in eng._count],
+        "off": {
+            "scans_per_sec": round(off_sps, 2),
+            "drain_ms": round(off_best["dt_s"] * 1e3, 3),
+        },
+        "host": {
+            "drain_ms": round(host_best["dt_s"] * 1e3, 3),
+            "dispatches": 0,
+        },
+        "fused": {
+            "scans_per_sec": round(fused_sps, 2),
+            "drain_ms": round(fused_best["dt_s"] * 1e3, 3),
+            "dispatches": eng.dispatch_count,
+            "check_ticks": fused_best["check_ticks"],
+            "installs": eng.installs,
+        },
+        "structural": {
+            "one_dispatch_per_check_holds": True,   # asserted above
+            "bit_exact_parity_holds": True,          # asserted above
+            "drift_bounded_holds": True,             # asserted above
+        },
+        # the decide_backends decision key (TPU records only carry
+        # weight there; both ratios clamp together)
+        "loop_close_ab": {
+            "backend_speedup": round(backend_speedup, 3),
+            "steady_tick_ratio": round(min(tick_ratio, 1.0), 3)
+            if clamped else round(tick_ratio, 3),
+            "corrected_end_err_cells": round(corr_err, 3),
+            "baseline_end_err_cells": round(base_err, 3),
+            "overhead_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the drift claim is structural: the corrected end pose "
+            "lands within 2 map cells of truth from a baseline that "
+            "drifts linearly without bound — that holds identically "
+            "on-chip because the whole back-end is bit-exact integer "
+            "math.  On a linkless CPU rig the host/fused wall ratio "
+            "measures XLA-vs-numpy kernel throughput plus dispatch "
+            "floor, not the architectural win; the structural claim a "
+            "chip inherits is ONE vmapped dispatch per closure check "
+            "(matcher through solver), so per-check host<->device "
+            "traffic is O(1) in fleet size.  The on-chip capture "
+            "queued in scripts/rig_recapture.sh is where the headline "
+            "lands."
+        ),
+        "grid": grid,
+        "cell_m": cell,
+        "beams": beams,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def metric_name(config: int) -> str:
     """The one config -> metric-name mapping (success AND failure records
     of a config must share a name to land in the same series)."""
@@ -3059,6 +3418,7 @@ def metric_name(config: int) -> str:
         14: "pallas_match_kernel_scans_per_sec",
         15: "shard_failover_survivor_scans_per_sec",
         16: "deskew_recon_map_updates_per_sec",
+        17: "loop_close_corrected_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -3084,6 +3444,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_failover()
     if kind == "deskew":
         return bench_deskew()
+    if kind == "loop_close":
+        return bench_loop_close()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -3398,7 +3760,9 @@ if __name__ == "__main__":
         "throughput with K faulty streams quarantined, 14=correlative-"
         "matcher kernel A/B, xla vs VMEM-tiled pallas lowering, "
         "15=shard-loss failover pod A/B, kill/evacuate/re-admit vs an "
-        "unkilled tick-paired baseline pod)",
+        "unkilled tick-paired baseline pod, 16=de-skew + sweep-"
+        "reconstruction A/B, 17=SLAM back-end loop-closure A/B, "
+        "drift-corrected vs front-end-only baseline)",
     )
     ap.add_argument(
         "--smoke-ingest",
@@ -3471,6 +3835,18 @@ if __name__ == "__main__":
         "map-update multiplication, zero-motion identity and bit-exact "
         "host-twin replay under the steady-state guard — the tier-1 "
         "regression gate for the de-skew/reconstruction stage",
+    )
+    ap.add_argument(
+        "--smoke-loop-close",
+        action="store_true",
+        help="seconds-scale CPU run of the config-17 SLAM back-end A/B "
+        "(small geometry, forced CPU backend, no tunnel probe): asserts "
+        "bounded pose-graph-corrected end-pose drift on a return-to-"
+        "start trace vs an unbounded front-end-only baseline, one "
+        "dispatch per closure check at most, bit-exact host/fused "
+        "parity and zero recompiles/transfers under the steady-state "
+        "guard — the tier-1 regression gate for the loop-closure "
+        "subsystem",
     )
     ap.add_argument(
         "--xla-cache",
@@ -3560,6 +3936,13 @@ if __name__ == "__main__":
         # structural gate must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_deskew(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_loop_close:
+        # same CPU-only discipline: the loop-closure drift/structural
+        # gate must run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_loop_close(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -187,7 +187,9 @@ def _cmd_replay(args) -> int:
         )
     if args.fused:
         _replay_fused_report(args, per_stream, runs_per_path)
-    if args.map:
+    if args.map or args.loop_close:
+        # --loop-close implies the map report (the back-end IS the
+        # map/trajectory pipeline plus correction)
         _replay_map_report(args, per_stream)
     return 0
 
@@ -213,12 +215,23 @@ def _replay_map_report(args, per_stream) -> None:
         filter_chain=("clip", "median", "voxel"),
         map_enable=True,
         map_backend=args.map_backend,
+        loop_enable=bool(args.loop_close),
     )
     for i, (path, revs) in enumerate(zip(args.recordings, per_stream)):
         if not revs:
             print(f"{path}: --map skipped (no complete revolutions)")
             continue
-        traj, scores, mapper = replay_with_map(revs, params)
+        corrected = engine = None
+        if args.loop_close:
+            from rplidar_ros2_driver_tpu.replay import (
+                replay_with_loop_closure,
+            )
+
+            traj, corrected, scores, mapper, engine = (
+                replay_with_loop_closure(revs, params)
+            )
+        else:
+            traj, scores, mapper = replay_with_map(revs, params)
         snap = mapper.snapshot()
         occupied = int(np.sum(snap["log_odds"][0] > 0))
         matched = int(np.sum(scores > 0))
@@ -232,7 +245,22 @@ def _replay_map_report(args, per_stream) -> None:
         img = draw_trajectory(
             map_to_image(snap["log_odds"][0], mapper.cfg.clamp_q),
             traj[:, :2], mapper.cfg.cell_m,
+            value=200 if corrected is not None else 255,
         )
+        if corrected is not None:
+            st = engine.status()
+            cx, cy, cth = corrected[-1]
+            print(
+                f"  loop closure ({engine.backend} backend): "
+                f"{st['accepted']} accepted / {st['rejected']} rejected, "
+                f"{st['submaps'][0]} submaps, corrected final pose "
+                f"({cx:+.3f} m, {cy:+.3f} m, {np.degrees(cth):+.2f} deg)"
+            )
+            # corrected trajectory overlaid BRIGHTER than the raw one,
+            # same grid/orientation conventions (raw 200, corrected 255)
+            img = draw_trajectory(
+                img, corrected[:, :2], mapper.cfg.cell_m, value=255
+            )
         if args.map_pgm:
             out = (
                 args.map_pgm if len(per_stream) == 1
@@ -484,6 +512,15 @@ def main(argv=None) -> int:
         "front-end (correlative scan-to-map matching + log-odds map, "
         "replay.replay_with_map): prints trajectory + map summary and "
         "an ASCII map preview",
+    )
+    replay.add_argument(
+        "--loop-close",
+        action="store_true",
+        help="with --map: run the FULL SLAM back-end too (submap "
+        "library + loop-closure candidate matching + fixed-point "
+        "pose-graph relaxation, replay.replay_with_loop_closure) and "
+        "write the corrected trajectory next to the raw one in the "
+        "overlay (raw 200, corrected 255)",
     )
     replay.add_argument(
         "--map-pgm",

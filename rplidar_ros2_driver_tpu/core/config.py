@@ -188,6 +188,55 @@ class DriverParams:
     map_log_odds_hit: float = 0.9     # increment per endpoint hit
     map_log_odds_miss: float = -0.4   # decrement per free-space pass
     map_log_odds_clamp: float = 8.0   # saturation bound (±)
+    # -- SLAM back-end: loop closure + pose graph (slam/loop.
+    # LoopClosureEngine + ops/loop_close.py + ops/pose_graph.py) --
+    # attach the loop-closure engine beside the mapper: every
+    # loop_submap_revs revolutions the stream's MapState finalizes into
+    # a quantized submap (library capped at loop_max_submaps,
+    # cap-and-hold); every loop_check_revs revolutions the current scan
+    # is matched against the loop_candidates nearest submaps in ONE
+    # dispatch (candidate scoring reuses the correlative matcher's
+    # score-volume engines, so match_backend routes it through the XLA
+    # or Pallas kernels), and accepted closures feed the fixed-point
+    # pose-graph relaxation whose corrected pose is republished.
+    # Requires map_enable (the back-end closes the front-end's loop).
+    loop_enable: bool = False
+    # loop backend seam: "host" = the NumPy golden reference
+    # (ops/loop_close_ref.py — the bit-exact oracle); "fused" = the
+    # device path (N streams check N submap libraries in ONE compiled
+    # vmapped dispatch, ops/loop_close.fleet_loop_close_step); "auto"
+    # resolves per the standing decision procedure (slam/loop.
+    # resolve_loop_backend — host until an on-chip config-17 artifact
+    # clears the bar; scripts/decide_backends.py reads `loop_close_ab`).
+    loop_backend: str = "auto"
+    loop_submap_revs: int = 8         # revolutions between finalizations
+    loop_max_submaps: int = 8         # submap library capacity (= graph nodes)
+    loop_check_revs: int = 4          # revolutions between closure checks
+    loop_candidates: int = 2          # nearest submaps scored per check
+    loop_window_cells: int = 4        # candidate coarse search radius (coarse cells)
+    loop_theta_window: int = 8        # candidate search: ± rotation-table steps
+    loop_min_points: int = 32         # overlap gate: valid endpoints required
+    # absolute acceptance gate as a right shift of the per-point score
+    # CEILING (clamp_q-after-quantization x the bilinear weight sum):
+    # accept needs a mean per-point score above ceiling >> shift — 3 =
+    # 1/8 of a perfectly saturated perfectly aligned match.  A shift
+    # keeps the bar geometry-independent (the raw score scale moves
+    # with quant_shift, which min_quant_shift derives from beams).
+    loop_accept_shift: int = 3
+    loop_peak_shift: int = 3          # contrast gate: peak-floor >= best>>s
+    loop_weight: int = 4              # loop-constraint weight (odometry = 1)
+    # rewrite the submap anchors AND the front-end pose to the corrected
+    # solution on an accepted closure (map re-anchoring): subsequent
+    # revolutions rasterize in the corrected frame.  Off by default —
+    # corrected poses are republished either way; re-anchoring
+    # additionally mutates the front-end trajectory.
+    loop_reanchor: bool = False
+    # fixed relaxation sweeps per solve (compile-time constant: the
+    # solver is a lax.fori_loop, one program per graph bucket)
+    pose_graph_iters: int = 96
+    # loop-constraint plane capacity (dense padded; the solver plane is
+    # loop_max_submaps odometry rows + this many loop rows)
+    pose_graph_max_constraints: int = 16
     # -- de-skew + sweep reconstruction (ops/deskew.py, fused ingest) --
     # per-revolution range-only de-skew + caching-aware sweep
     # reconstruction INSIDE the fused ingest core
@@ -456,6 +505,51 @@ class DriverParams:
             raise ValueError(
                 "map_log_odds_clamp must be >= map_log_odds_hit (a clamp "
                 "below one hit increment can never mark a cell occupied)"
+            )
+        if self.loop_backend not in ("auto", "host", "fused"):
+            raise ValueError(
+                "loop_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.loop_enable and not self.map_enable:
+            raise ValueError(
+                "loop_enable requires map_enable (the loop-closure "
+                "back-end closes the front-end mapper's loop — there is "
+                "no trajectory to correct without it)"
+            )
+        if self.loop_submap_revs < 1:
+            raise ValueError("loop_submap_revs must be >= 1")
+        if not (2 <= self.loop_max_submaps <= 64):
+            raise ValueError(
+                "loop_max_submaps must be within [2, 64] (one submap to "
+                "close against, one to close from; the cap sizes the "
+                "pose graph)"
+            )
+        if self.loop_check_revs < 1:
+            raise ValueError("loop_check_revs must be >= 1")
+        if not (1 <= self.loop_candidates <= self.loop_max_submaps):
+            raise ValueError(
+                "loop_candidates must be within [1, loop_max_submaps]"
+            )
+        if self.loop_window_cells < 1:
+            raise ValueError("loop_window_cells must be >= 1")
+        if self.loop_theta_window < 1:
+            raise ValueError("loop_theta_window must be >= 1")
+        if self.loop_min_points < 1:
+            raise ValueError("loop_min_points must be >= 1")
+        if not (0 <= self.loop_accept_shift <= 20):
+            raise ValueError("loop_accept_shift must be within [0, 20]")
+        if not (0 <= self.loop_peak_shift <= 30):
+            raise ValueError("loop_peak_shift must be within [0, 30]")
+        if not (1 <= self.loop_weight <= 16):
+            raise ValueError(
+                "loop_weight must be within [1, 16] (the pose-graph "
+                "weight clamp — and the int32 accumulator bound)"
+            )
+        if self.pose_graph_iters < 1:
+            raise ValueError("pose_graph_iters must be >= 1")
+        if not (1 <= self.pose_graph_max_constraints <= 256):
+            raise ValueError(
+                "pose_graph_max_constraints must be within [1, 256]"
             )
 
     @classmethod

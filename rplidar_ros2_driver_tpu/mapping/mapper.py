@@ -182,6 +182,7 @@ class FleetMapper:
         self.dispatch_count = 0
         self.matches = 0
         self.last_estimates: list[Optional[PoseEstimate]] = [None] * streams
+        self.last_inputs: Optional[tuple] = None  # (points, masks, live)
 
     # -- state construction -------------------------------------------------
 
@@ -294,6 +295,11 @@ class FleetMapper:
         endpoints + (N, B) validity + (N,) live flags.  One fused
         dispatch (or N host-reference steps) per call."""
         live = np.asarray(live, np.int32)
+        # stash the tick's input planes for downstream consumers that
+        # ride the same revolution (slam/loop.LoopClosureEngine matches
+        # the CURRENT scan window against its submap library — one
+        # packing, one input contract, whatever the attach topology)
+        self.last_inputs = (points, np.asarray(masks, bool), live)
         with self._lock:
             self.ticks += 1
             if self.backend == "fused":
@@ -533,6 +539,38 @@ class FleetMapper:
                     st = self._states_np[k]
                     st[i] = np.asarray(snap[k], st.dtype)
         return True
+
+    def reanchor_stream(self, i: int, pose_q) -> None:
+        """Re-anchor stream ``i``'s front-end pose to a pose-graph-
+        corrected value (slam/loop.LoopClosureEngine, ``loop_reanchor``)
+        with the map grid and every other stream untouched: subsequent
+        revolutions rasterize at the corrected pose, so the front-end
+        trajectory follows the back-end's correction.  Fused-backend
+        traffic is row-sized (one gather, one explicit put of the (3,)
+        pose, one scatter — the quarantine checkpoint's discipline,
+        guard-safe in steady state)."""
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        pose = np.asarray(pose_q, np.int32).reshape(3)
+        lim = self.cfg.t_limit_sub
+        pose = np.asarray([
+            np.clip(pose[0], -lim, lim),
+            np.clip(pose[1], -lim, lim),
+            np.mod(pose[2], self.cfg.theta_divisions),
+        ], np.int32)
+        with self._lock:
+            if self.backend == "fused":
+                gather, scatter = self._row_ops()
+                idx = self._jax.device_put(
+                    np.asarray(i, np.int32), self.device
+                )
+                row = gather(self._states, idx)
+                row = dataclasses.replace(
+                    row, pose=self._jax.device_put(pose, self.device)
+                )
+                self._states = scatter(self._states, row, idx)
+            else:
+                self._states_np["pose"][i] = pose
 
     # -- sharded (Orbax) checkpointing --------------------------------------
 

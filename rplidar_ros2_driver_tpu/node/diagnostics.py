@@ -60,6 +60,7 @@ class DiagnosticsUpdater:
         latency_p99_ms: Optional[dict[str, float]] = None,
         rx_scheduling: Optional[int] = None,
         map_status: Optional[dict] = None,
+        loop_status: Optional[dict] = None,
         reconnect: Optional[dict] = None,
         stream_health: Optional[list] = None,
         shard_topology: Optional[dict] = None,
@@ -91,6 +92,33 @@ class DiagnosticsUpdater:
                 values["Map Pose"] = f"{x:+.3f} {y:+.3f} {th:+.4f}"
                 values["Map Match Score"] = str(map_status.get("score", 0))
                 values["Map Revision"] = str(map_status.get("revision", 0))
+        # SLAM back-end drift/loop-closure observability (slam/loop.
+        # LoopClosureEngine.status()): accepted/rejected closures, the
+        # per-stream submap library fill, the tick of the last accepted
+        # closure, and the standing pose-correction magnitude — the
+        # drift-bounded-or-not view at a glance (tests/test_loop_close.py
+        # pins the rendering, like the shard-topology group)
+        if loop_status:
+            values["Loop Closures"] = (
+                f"{loop_status.get('accepted', 0)} accepted / "
+                f"{loop_status.get('rejected', 0)} rejected"
+            )
+            values["Loop Submaps"] = ",".join(
+                str(c) for c in loop_status.get("submaps", [])
+            )
+            values["Loop Constraints"] = str(
+                loop_status.get("constraints", 0)
+            )
+            last = loop_status.get("last_closure_tick")
+            values["Last Closure Tick"] = (
+                "n/a" if last is None else str(last)
+            )
+            corr = loop_status.get("correction_m")
+            if corr is not None:
+                cx, cy, cth = corr
+                values["Pose Correction"] = (
+                    f"{cx:+.3f} {cy:+.3f} {cth:+.4f}"
+                )
         # reconnect observability (scan-loop FSM capped backoff +
         # driver-level connect counters): how hard the node is having to
         # fight for its link, and how long until the next attempt

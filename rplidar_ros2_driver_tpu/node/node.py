@@ -80,6 +80,11 @@ class RPlidarNode(LifecycleNode):
         # through, so the mapper sees each revolution exactly once
         self.mapper = None
         self._mapper_snapshot = None
+        # SLAM back-end (loop_enable): submap library + loop-closure
+        # detection + pose-graph correction beside the mapper; observes
+        # every mapper tick and republishes the corrected pose
+        self.loop = None
+        self._loop_snapshot = None
         self.diagnostics: Optional[DiagnosticsUpdater] = None
         self.tracer = StageTimer()
         self._param_lock = threading.Lock()
@@ -215,6 +220,16 @@ class RPlidarNode(LifecycleNode):
                     # rather than re-warning every configure (the chain's
                     # stale-snapshot policy)
                     self._mapper_snapshot = None
+            if self.params.loop_enable:
+                from rplidar_ros2_driver_tpu.slam.loop import (
+                    LoopClosureEngine,
+                )
+
+                self.loop = LoopClosureEngine(self.params, self.mapper)
+                self.loop.precompile()
+                if self._loop_snapshot is not None:
+                    if not self.loop.restore(self._loop_snapshot):
+                        self._loop_snapshot = None
         self.diagnostics = DiagnosticsUpdater(
             hardware_id=f"rplidar-{self.params.serial_port}",
             publisher=self.publisher,
@@ -306,6 +321,8 @@ class RPlidarNode(LifecycleNode):
             self._chain_snapshot = self.chain.snapshot()
         if self.mapper is not None:
             self._mapper_snapshot = self.mapper.snapshot()
+        if self.loop is not None:
+            self._loop_snapshot = self.loop.snapshot()
         self._update_diagnostics()
         return True
 
@@ -314,6 +331,7 @@ class RPlidarNode(LifecycleNode):
         self.chain = None
         self.fused_ingest = None
         self.mapper = None
+        self.loop = None
         # _chain_snapshot / _mapper_snapshot intentionally survive
         # cleanup: they are the checkpoint/resume surface (SURVEY.md §5)
         # — a later configure restores the rolling window and the map.
@@ -325,6 +343,7 @@ class RPlidarNode(LifecycleNode):
         starts cold)."""
         self._chain_snapshot = None
         self._mapper_snapshot = None
+        self._loop_snapshot = None
 
     # keys of the mapper's MapState inside the combined node checkpoint:
     # "mapper." prefixed, schema-versioned by the mapper's own "version"
@@ -332,13 +351,24 @@ class RPlidarNode(LifecycleNode):
     # restarts across format revisions — a future-format checkpoint is
     # rejected at restore, never misread
     _MAPPER_KEY_PREFIX = "mapper."
+    # the loop-closure engine's LoopState rides the same combined file
+    # under "loop." keys, schema-versioned by its own "version" entry
+    # (ops/loop_close.LOOP_STATE_VERSION)
+    _LOOP_KEY_PREFIX = "loop."
 
-    def _split_checkpoint(self, snap: dict) -> tuple[dict, Optional[dict]]:
-        """(chain keys, mapper keys or None) of a combined checkpoint."""
-        p = self._MAPPER_KEY_PREFIX
-        chain = {k: v for k, v in snap.items() if not k.startswith(p)}
-        mapper = {k[len(p):]: v for k, v in snap.items() if k.startswith(p)}
-        return chain, (mapper or None)
+    def _split_checkpoint(
+        self, snap: dict
+    ) -> tuple[dict, Optional[dict], Optional[dict]]:
+        """(chain keys, mapper keys or None, loop keys or None) of a
+        combined checkpoint."""
+        mp, lp = self._MAPPER_KEY_PREFIX, self._LOOP_KEY_PREFIX
+        chain = {
+            k: v for k, v in snap.items()
+            if not k.startswith(mp) and not k.startswith(lp)
+        }
+        mapper = {k[len(mp):]: v for k, v in snap.items() if k.startswith(mp)}
+        loop = {k[len(lp):]: v for k, v in snap.items() if k.startswith(lp)}
+        return chain, (mapper or None), (loop or None)
 
     def save_checkpoint(self, path: str) -> bool:
         """Persist the filter-chain state — and, when the mapper is
@@ -362,6 +392,13 @@ class RPlidarNode(LifecycleNode):
         if mapper_snap is not None:
             for k, v in mapper_snap.items():
                 snap[self._MAPPER_KEY_PREFIX + k] = v
+        loop_snap = (
+            self.loop.snapshot() if self.loop is not None
+            else self._loop_snapshot
+        )
+        if loop_snap is not None:
+            for k, v in loop_snap.items():
+                snap[self._LOOP_KEY_PREFIX + k] = v
         save_checkpoint(path, snap, extra={"node": self.name})
         return True
 
@@ -385,16 +422,25 @@ class RPlidarNode(LifecycleNode):
         if loaded is None:
             return False
         snap, _meta = loaded
-        snap, mapper_snap = self._split_checkpoint(snap)
+        snap, mapper_snap, loop_snap = self._split_checkpoint(snap)
 
         def stage_mapper() -> None:
-            if mapper_snap is None:
-                return
-            if self.mapper is not None:
-                if self.mapper.restore(mapper_snap):
+            if mapper_snap is not None:
+                if self.mapper is not None:
+                    if self.mapper.restore(mapper_snap):
+                        self._mapper_snapshot = mapper_snap
+                elif FleetMapper.snapshot_compatible(self.params, mapper_snap):
                     self._mapper_snapshot = mapper_snap
-            elif FleetMapper.snapshot_compatible(self.params, mapper_snap):
-                self._mapper_snapshot = mapper_snap
+            if loop_snap is not None:
+                if self.loop is not None:
+                    if self.loop.restore(loop_snap):
+                        self._loop_snapshot = loop_snap
+                else:
+                    # no live engine yet: stage for the next configure,
+                    # whose restore() validates geometry/schema (derived
+                    # state — an incompatible library is dropped there
+                    # with the chain/map still restored)
+                    self._loop_snapshot = loop_snap
 
         if self.chain is not None:
             if not self.chain.restore(snap):  # rejects mismatch untouched
@@ -555,16 +601,32 @@ class RPlidarNode(LifecycleNode):
         if self.mapper is not None:
             with self.tracer.stage("map"):
                 est = self.mapper.submit([out])[0]
+                if self.loop is not None:
+                    # the back-end observes every mapper tick: submap
+                    # finalization + (when due) ONE closure-check
+                    # dispatch; the published pose below becomes the
+                    # pose-graph-corrected one
+                    self.loop.observe([est])
             if est is not None:
                 from rplidar_ros2_driver_tpu.node.messages import PoseHost
 
+                x_m, y_m, theta_rad = est.x_m, est.y_m, est.theta_rad
+                if self.loop is not None:
+                    from rplidar_ros2_driver_tpu.ops.scan_match import (
+                        pose_to_metric,
+                    )
+
+                    x_m, y_m, theta_rad = pose_to_metric(
+                        self.loop.corrected_pose_q(0, est.pose_q),
+                        self.mapper.cfg,
+                    )
                 self.publisher.publish_pose(PoseHost(
                     stamp=stamp,
                     frame_id="map",
                     child_frame_id=params.frame_id,
-                    x_m=est.x_m,
-                    y_m=est.y_m,
-                    theta_rad=est.theta_rad,
+                    x_m=x_m,
+                    y_m=y_m,
+                    theta_rad=theta_rad,
                     score=est.score,
                     matched_points=est.matched_points,
                     map_revision=est.revision,
@@ -616,6 +678,7 @@ class RPlidarNode(LifecycleNode):
             latency_p99_ms=lat or None,
             rx_scheduling=rx_sched,
             map_status=map_status,
+            loop_status=self.loop.status() if self.loop is not None else None,
             reconnect=reconnect,
         )
 

@@ -1,0 +1,436 @@
+"""On-device loop closure: submap library + batched candidate matching.
+
+The SLAM back-end's front half (ROADMAP item 2, after "A Universal
+LiDAR SLAM Accelerator System on Low-cost FPGA"): the correlative
+matcher (ops/scan_match.py) is a front-end only — pose drift is
+unbounded — so every ``submap_revs`` revolutions a stream's MapState is
+FINALIZED into a quantized submap plane (``clip(log_odds, 0, clamp) >>
+quant_shift`` — the exact match-map form the matcher's score engines
+consume, whose coarse max-pooled pyramid level the engines already
+materialize in-kernel at ops/scan_match.py:384) and stored in a
+device-resident library with its anchor pose.  A closure check then
+matches the CURRENT scan window against the K nearest submaps in ONE
+vmapped dispatch, reusing the matcher's score-volume engines verbatim —
+``match_backend`` routes each candidate through either the XLA arm or
+the PR 8 VMEM-tiled Pallas kernels (interpret mode on CPU), so the
+candidate scorer inherits the kernel A/B for free.
+
+Acceptance gates (all integer, all policed):
+
+  * overlap   — ``n_valid >= min_points`` quantized endpoints entered;
+  * absolute  — ``best >= n_valid * accept_q`` (a per-point score bar;
+    ``accept_q * beams < 2^31`` is validated so the product is safe);
+  * contrast  — ``best - min(volume) >= best >> peak_shift``: a
+    saturated or featureless submap scores FLAT across the whole
+    (dθ, dx, dy) volume, so peak-minus-floor contrast rejects the
+    false-positive class an absolute bar cannot (the degenerate suite
+    pins this).
+
+An accepted match becomes an inter-pose constraint between the newest
+submap anchor and the matched one (the transient current pose is
+eliminated through the local odometry leg, so the graph lives over the
+fixed submap node set), appended into the dense padded constraint
+plane; the fixed-point pose-graph relaxation (ops/pose_graph.py) then
+runs INSIDE THE SAME compiled program — a closure check costs exactly
+one dispatch, matcher through solver.
+
+Everything is int32 end to end in the established Q-format discipline
+(subcell translations, 2^14 rotation tables, explicit overflow bounds),
+so the NumPy twin (ops/loop_close_ref.py) is BIT-EXACT against the
+single-stream and vmapped fleet lowerings — not close, byte-equal
+(tests/test_loop_close.py, fleet sizes 1/3/8, snapshot/restore paths).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rplidar_ros2_driver_tpu.ops.pose_graph import (
+    PoseGraphConfig,
+    pose_compose,
+    pose_relative,
+    rel_inverse,
+    solve_pose_graph_impl,
+)
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    MapConfig,
+    match_scan_volumes,
+    quantize_points,
+    rotation_table,
+)
+
+LOOP_STATE_VERSION = 1
+ODOM_WEIGHT = 1                # odometry-chain constraint weight
+WIRE_LEN = 9                   # per-stream closure-check wire row length
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopConfig:
+    """Static loop-closure configuration.  ``match`` is the DERIVED
+    candidate-match MapConfig (quant_shift 0, clamp at the stored
+    plane's ceiling — submap planes are pre-quantized at finalize, so
+    the matcher's in-kernel ``clip >> shift`` is the identity on them);
+    ``graph`` sizes the solver's dense padded planes."""
+
+    match: MapConfig
+    graph: PoseGraphConfig
+    submap_revs: int = 8       # revolutions between submap finalizations
+    max_submaps: int = 8       # library capacity (= pose-graph nodes)
+    check_revs: int = 4        # revolutions between closure checks
+    candidates: int = 2        # K nearest submaps scored per check
+    max_constraints: int = 16  # loop-constraint plane capacity
+    exclude_recent: int = 1    # newest submaps never offered as candidates
+    min_points: int = 32       # overlap gate: quantized endpoints required
+    accept_q: int = 60000      # absolute gate: per-point score bar
+    peak_shift: int = 3        # contrast gate: best-minus-floor >= best>>s
+    weight: int = 4            # loop-constraint weight (odometry is 1)
+    reanchor: bool = False     # rewrite anchors/front-end pose on accept
+
+    def __post_init__(self):
+        if self.submap_revs < 1:
+            raise ValueError("submap_revs must be >= 1")
+        if self.max_submaps < 2:
+            raise ValueError(
+                "loop closure needs >= 2 submap slots (one to close "
+                "against, one to close from)"
+            )
+        if self.check_revs < 1:
+            raise ValueError("check_revs must be >= 1")
+        if not (1 <= self.candidates <= self.max_submaps):
+            raise ValueError(
+                "candidates must be within [1, max_submaps]"
+            )
+        if self.exclude_recent < 1:
+            raise ValueError(
+                "exclude_recent must be >= 1 (a scan always matches the "
+                "submap it was just absorbed into)"
+            )
+        if self.min_points < 1:
+            raise ValueError("min_points must be >= 1")
+        if self.accept_q < 1:
+            raise ValueError("accept_q must be positive")
+        # absolute-gate overflow bound: n_valid * accept_q in int32
+        if self.accept_q * self.match.beams >= 2**31:
+            raise ValueError(
+                "accept gate can overflow int32: accept_q * beams "
+                f"({self.accept_q} * {self.match.beams}) >= 2^31"
+            )
+        if not (0 <= self.peak_shift <= 30):
+            raise ValueError("peak_shift must be within [0, 30]")
+        if not (1 <= self.weight <= self.graph.weight_max):
+            raise ValueError(
+                "loop weight must be within [1, graph.weight_max]"
+            )
+        if self.max_constraints < 1:
+            raise ValueError("max_constraints must be >= 1")
+        if self.graph.max_nodes != self.max_submaps:
+            raise ValueError(
+                "pose-graph nodes must equal the submap capacity (the "
+                "graph lives over the submap anchor set)"
+            )
+        if self.graph.max_constraints != self.max_submaps + self.max_constraints:
+            raise ValueError(
+                "graph.max_constraints must equal max_submaps + "
+                "max_constraints (odometry chain rows + loop rows form "
+                "one dense solver plane)"
+            )
+        if self.graph.theta_divisions != self.match.theta_divisions:
+            raise ValueError(
+                "solver and matcher must share one rotation table"
+            )
+
+
+def derive_match_config(
+    map_cfg: MapConfig, *, theta_window: int, window_cells: int
+) -> MapConfig:
+    """The one base-map -> candidate-match MapConfig derivation: submap
+    planes are stored ALREADY quantized (finalize applies ``clip(·, 0,
+    clamp_q) >> quant_shift``), so the candidate config sets
+    ``quant_shift=0`` with the clamp at the stored ceiling — the score
+    engines' in-kernel quantization becomes the identity and the
+    existing int32 score bound holds with the same margin.  The wider
+    θ/translation windows are the loop-closure search radii (drift at
+    re-visit time exceeds the front-end's per-revolution window)."""
+    return dataclasses.replace(
+        map_cfg,
+        clamp_q=max(map_cfg.clamp_q >> map_cfg.quant_shift, 1),
+        quant_shift=0,
+        # unused by scoring, but MapConfig validates hit > 0 > miss and
+        # clamp >= hit — the stored-plane ceiling can sit below the
+        # base hit_q, so pin the increments to the minimal legal pair
+        hit_q=1,
+        miss_q=-1,
+        theta_window=theta_window,
+        window_cells=window_cells,
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LoopState:
+    """Device-resident per-stream loop-closure state, threaded
+    functionally like MapState.  Dense padded planes throughout — one
+    compiled program per (streams, max_submaps, max_constraints)
+    bucket, whatever the live fill level."""
+
+    planes: jax.Array   # (K, G, G) int32 quantized submap match planes
+    anchors: jax.Array  # (K, 3) int32 anchor poses (tx_sub, ty_sub, θ_idx)
+    odom: jax.Array     # (K, 3) int32 measured prev-anchor -> anchor
+    valid: jax.Array    # (K,) int32 0/1 slot occupancy
+    count: jax.Array    # () int32 submaps finalized
+    cons: jax.Array     # (C, 6) int32 loop constraints [i,j,zx,zy,zθ,w]
+    ncons: jax.Array    # () int32 appended loop constraints
+    dropped: jax.Array  # () int32 accepts dropped at the C cap
+
+    @staticmethod
+    def shapes(cfg: "LoopConfig") -> dict[str, tuple[int, ...]]:
+        """Array shapes — host-side, no allocation (checkpoint
+        pre-validation, like MapState.shapes)."""
+        k, g = cfg.max_submaps, cfg.match.grid
+        c = cfg.max_constraints
+        return {
+            "planes": (k, g, g),
+            "anchors": (k, 3),
+            "odom": (k, 3),
+            "valid": (k,),
+            "count": (),
+            "cons": (c, 6),
+            "ncons": (),
+            "dropped": (),
+        }
+
+    @classmethod
+    def create(cls, cfg: "LoopConfig") -> "LoopState":
+        shapes = cls.shapes(cfg)
+        return cls(**{
+            k: jnp.zeros(v, jnp.int32) for k, v in shapes.items()
+        })
+
+
+# ---------------------------------------------------------------------------
+# submap install (finalize lands here; the quantize itself is host-side
+# in mapping/submap.py so both backends share ONE finalization path)
+# ---------------------------------------------------------------------------
+
+
+def _install_submap_impl(state: LoopState, plane, anchor, cfg: LoopConfig):
+    """Install one finalized submap into the next free slot: plane +
+    anchor stored, the odometry leg from the previous anchor recorded
+    (slot 0 records identity — node 0 is the gauge anchor).  A full
+    library freezes (cap-and-hold): the graph's node indices must stay
+    stable for the constraints that reference them."""
+    k = cfg.max_submaps
+    div = cfg.match.theta_divisions
+    table = jnp.asarray(rotation_table(div))
+    room = state.count < k
+    slot = jnp.clip(state.count, 0, k - 1)
+    prev = jnp.take(
+        state.anchors, jnp.clip(state.count - 1, 0, k - 1), axis=0
+    )
+    first = state.count == 0
+    odom_leg = jnp.where(
+        first, jnp.zeros((3,), jnp.int32),
+        pose_relative(prev, anchor, table, div),
+    )
+    sel = room
+
+    def upd(arr, row):
+        return jnp.where(sel, arr.at[slot].set(row), arr)
+
+    return LoopState(
+        planes=upd(state.planes, plane),
+        anchors=upd(state.anchors, anchor),
+        odom=upd(state.odom, odom_leg),
+        valid=jnp.where(
+            sel, state.valid.at[slot].set(1), state.valid
+        ),
+        count=state.count + sel,
+        cons=state.cons,
+        ncons=state.ncons,
+        dropped=state.dropped,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fleet_install_submap(
+    states: LoopState, idx, plane, anchor, cfg: LoopConfig
+):
+    """Install one stream's finalized submap into the stacked fleet
+    state: row gather at device-scalar ``idx`` (one compiled program
+    for every lane, utils/rowops discipline), the single-stream
+    install, one dynamic-index row scatter (state donated)."""
+    from jax import lax
+
+    row = jax.tree_util.tree_map(
+        lambda a: lax.dynamic_index_in_dim(a, idx, 0, keepdims=False),
+        states,
+    )
+    row = _install_submap_impl(row, plane, anchor, cfg)
+    return jax.tree_util.tree_map(
+        lambda a, r: lax.dynamic_update_index_in_dim(a, r, idx, 0),
+        states, row,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the closure check: batched candidate match -> gates -> constraint ->
+# pose-graph relaxation, ONE program
+# ---------------------------------------------------------------------------
+
+
+def _loop_close_step_impl(
+    state: LoopState, points_xy, mask, pose, cand_idx, check, cfg: LoopConfig,
+):
+    """One closure check for one stream.  ``cand_idx`` is the (Kc,)
+    int32 host-selected candidate slot list (-1 = none — selection is a
+    pure function of the anchor poses, host-side in both backends so it
+    cannot diverge); ``check`` (int32 0/1) gates the whole step like
+    the mapper's ``live``: a non-due stream's state passes through and
+    its wire reads all-zero.
+
+    Returns ``(new_state, wire, corrected)``: the threaded state, the
+    (WIRE_LEN,) int32 wire row [accept, best_slot, best_score, n_valid,
+    cur_x, cur_y, cur_θ, ncons, dropped] (cur_* = the pose-graph-
+    corrected CURRENT pose), and the (K, 3) corrected anchor plane."""
+    m = cfg.match
+    k = cfg.max_submaps
+    div = m.theta_divisions
+    lim = m.t_limit_sub
+    table = jnp.asarray(rotation_table(div))
+
+    pq, ok = quantize_points(points_xy, mask, m)
+    ok = ok & (check > 0)
+    n_valid = jnp.sum(ok.astype(jnp.int32))
+
+    # -- batched candidate matching: K nearest submaps, one vmap ------------
+    slots = jnp.clip(cand_idx, 0, k - 1)
+    cvalid = (cand_idx >= 0) & (jnp.take(state.valid, slots) > 0)
+    planes = jnp.take(state.planes, slots, axis=0)              # (Kc, G, G)
+
+    def one(plane):
+        return match_scan_volumes(plane, pose, pq, ok, m)
+
+    dposes, bests, minvs = jax.vmap(one)(planes)
+    masked = jnp.where(cvalid, bests, jnp.int32(-(2**31) + 1))
+    kc = jnp.argmax(masked).astype(jnp.int32)                   # first-max-wins
+    best = jnp.take(masked, kc)
+    dpose = jnp.take(dposes, kc, axis=0)
+    minv = jnp.take(minvs, kc)
+    best_slot = jnp.take(slots, kc)
+    has_cand = jnp.any(cvalid)
+
+    # -- acceptance gates (module docstring) --------------------------------
+    accept = (
+        (check > 0)
+        & has_cand
+        & (n_valid >= cfg.min_points)
+        & (best > 0)
+        & (best >= n_valid * cfg.accept_q)
+        & ((best - minv) >= (best >> cfg.peak_shift))
+    )
+
+    # -- constraint emission: eliminate the transient current pose ----------
+    # matched current pose in the submap's (world) frame
+    p_m = jnp.stack([
+        jnp.clip(pose[0] + dpose[0], -lim, lim),
+        jnp.clip(pose[1] + dpose[1], -lim, lim),
+        jnp.mod(pose[2] + dpose[2], div),
+    ])
+    last = jnp.clip(state.count - 1, 0, k - 1)
+    a_last = jnp.take(state.anchors, last, axis=0)
+    a_best = jnp.take(state.anchors, best_slot, axis=0)
+    o_cur = pose_relative(a_last, pose, table, div)             # odometry leg
+    z_jc = pose_relative(a_best, p_m, table, div)               # measured leg
+    z_ij = pose_compose(                                        # last -> best
+        o_cur, rel_inverse(z_jc, table, div), table, div
+    )
+    room = state.ncons < cfg.max_constraints
+    do_append = accept & room
+    row = jnp.concatenate([
+        last[None], best_slot[None], z_ij,
+        jnp.asarray([cfg.weight], jnp.int32),
+    ]).astype(jnp.int32)
+    slot_c = jnp.clip(state.ncons, 0, cfg.max_constraints - 1)
+    cons = jnp.where(
+        do_append, state.cons.at[slot_c].set(row), state.cons
+    )
+    ncons = state.ncons + do_append
+    dropped = state.dropped + (accept & ~room)
+
+    # -- pose-graph relaxation, same program --------------------------------
+    ks = jnp.arange(k, dtype=jnp.int32)
+    odom_w = ((ks >= 1) & (ks < state.count)).astype(jnp.int32) * ODOM_WEIGHT
+    odom_rows = jnp.stack([
+        jnp.maximum(ks - 1, 0), ks,
+        state.odom[:, 0], state.odom[:, 1], state.odom[:, 2], odom_w,
+    ], axis=1)                                                  # (K, 6)
+    all_cons = jnp.concatenate([odom_rows, cons], axis=0)
+    corrected = solve_pose_graph_impl(state.anchors, all_cons, cfg.graph)
+
+    # corrected CURRENT pose: hang the local odometry leg off the
+    # corrected newest anchor (identity when the library is empty)
+    cur_c = pose_compose(
+        jnp.take(corrected, last, axis=0), o_cur, table, div
+    )
+    cur_c = jnp.stack([
+        jnp.clip(cur_c[0], -lim, lim),
+        jnp.clip(cur_c[1], -lim, lim),
+        cur_c[2],
+    ])
+    cur_c = jnp.where(state.count > 0, cur_c, pose)
+
+    anchors = state.anchors
+    if cfg.reanchor:
+        # accepted closure rewrites the stored anchors to the corrected
+        # solution (a warm start for the next solve — the constraint
+        # set, not the initialization, determines the fixed point)
+        anchors = jnp.where(accept, corrected, anchors)
+
+    new_state = LoopState(
+        planes=state.planes, anchors=anchors, odom=state.odom,
+        valid=state.valid, count=state.count,
+        cons=cons, ncons=ncons, dropped=dropped,
+    )
+    wire = jnp.concatenate([
+        accept.astype(jnp.int32)[None],
+        jnp.where(has_cand, best_slot, -1)[None],
+        jnp.where(has_cand, jnp.maximum(best, 0), 0)[None],
+        n_valid[None],
+        cur_c,
+        ncons[None],
+        dropped[None],
+    ]).astype(jnp.int32)
+    return new_state, wire, corrected
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def loop_close_step(
+    state: LoopState, points_xy, mask, pose, cand_idx, check,
+    cfg: LoopConfig,
+):
+    """Single-stream fused closure check: one donated dispatch runs the
+    batched candidate match, the gates, the constraint append and the
+    pose-graph relaxation (tests' parity twin of the fleet lowering)."""
+    return _loop_close_step_impl(
+        state, points_xy, mask, pose, cand_idx, check, cfg
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def fleet_loop_close_step(
+    states: LoopState, points_xy, masks, poses, cand_idx, check,
+    cfg: LoopConfig,
+):
+    """The fleet lowering: N streams check N libraries in ONE compiled
+    vmapped dispatch (stream-stacked LoopState donated in place) —
+    candidate match through solver, bit-exact vs N independent host
+    reference steps."""
+
+    def one(st, p, mk, ps, ci, ck):
+        return _loop_close_step_impl(st, p, mk, ps, ci, ck, cfg)
+
+    return jax.vmap(one)(states, points_xy, masks, poses, cand_idx, check)

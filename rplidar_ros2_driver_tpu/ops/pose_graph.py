@@ -1,0 +1,221 @@
+"""Fixed-point 2-D pose-graph relaxation — the SLAM back-end solver.
+
+The loop-closure subsystem (ops/loop_close.py) turns accepted submap
+matches into inter-pose constraints; this module relaxes the resulting
+graph ON DEVICE in the matcher's established int32/Q-format discipline
+("An FPGA Acceleration and Optimization Techniques for 2D LiDAR SLAM
+Algorithm" builds custom hardware for exactly this iterative relaxation
+— on TPU it is a fixed-iteration ``lax.fori_loop`` over dense padded
+constraint planes, one compiled program per (nodes, constraints)
+bucket).
+
+Representation (shared with ops/scan_match.py):
+
+  * a NODE is a pose (tx_sub, ty_sub, theta_idx) int32 — translation in
+    SUB-subcell units, heading an index into the ``theta_divisions``
+    rotation table (2^14-scale int32 cos/sin, numpy-built once);
+  * a CONSTRAINT row is (i, j, zx_sub, zy_sub, ztheta_steps, weight)
+    int32 — "node j observed from node i at relative pose z", weight 0
+    = padding (dense planes, so fleet graphs of any fill level share
+    one compiled program).
+
+The solver is damped Gauss–Newton relaxation with the rotation Jacobian
+applied through the exact integer rotation core (rotate_rows): each
+iteration predicts every constraint's node-j pose from node i, forms
+the weighted residual, accumulates ± corrections per node with integer
+scatter-adds (associative — ANY evaluation order is bit-identical),
+and steps each node by the truncated half-mean correction.  Truncating
+division toward zero (not floor) keeps the update bias-free around
+zero: a ±1-subcell rounding residual must decay to a fixed point, not
+walk the graph one subcell per iteration.
+
+Node 0 is the gauge anchor and never moves; nodes touched by no
+constraint have zero degree and zero accumulated correction, so
+padding nodes pass through untouched by construction.
+
+Arithmetic bounds (int32, explicit like the matcher's): translations
+clamp to ±t_limit_sub <= 2^14 (grid <= 1024) and constraint z terms to
+±2·t_limit_sub, so a residual is < 5·t_limit_sub, a weighted residual
+< 5·t_limit_sub·weight_max, and a node's accumulator over every
+constraint < 5·t_limit_sub·weight_max·max_constraints — the config
+validates this product < 2^31.  The NumPy twin
+(ops/pose_graph_ref.py) is BIT-EXACT, not close; the randomized-graph
+parity suite (tests/test_loop_close.py) pins it byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    rotate_rows,
+    rotation_table,
+)
+
+POSE_GRAPH_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PoseGraphConfig:
+    """Static (compile-time) solver configuration.  ``max_nodes`` /
+    ``max_constraints`` are the dense padded plane sizes — one compiled
+    program per bucket, whatever the live fill level."""
+
+    max_nodes: int
+    max_constraints: int
+    iters: int = 96
+    theta_divisions: int = 720
+    t_limit_sub: int = 4096     # ± translation clamp (subcells)
+    weight_max: int = 16        # constraint weight clamp
+
+    def __post_init__(self):
+        if self.max_nodes < 1:
+            raise ValueError("pose graph needs at least one node")
+        if self.max_constraints < 1:
+            raise ValueError("pose graph needs a constraint plane")
+        if self.iters < 1:
+            raise ValueError("pose_graph_iters must be >= 1")
+        if self.theta_divisions < 4:
+            raise ValueError("theta_divisions must be >= 4")
+        if self.t_limit_sub < 1:
+            raise ValueError("t_limit_sub must be positive")
+        if self.weight_max < 1:
+            raise ValueError("weight_max must be >= 1")
+        # int32 accumulator bound (module docstring): every node sums
+        # <= max_constraints weighted residuals of < 5·t_limit each
+        if 5 * self.t_limit_sub * self.weight_max * self.max_constraints >= 2**31:
+            raise ValueError(
+                "pose-graph accumulator can overflow int32: shrink "
+                "max_constraints, weight_max or t_limit_sub "
+                f"(5*{self.t_limit_sub}*{self.weight_max}"
+                f"*{self.max_constraints} >= 2^31)"
+            )
+
+
+# ---------------------------------------------------------------------------
+# exact SE(2) fixed-point composition helpers (each has a literal numpy
+# mirror in ops/pose_graph_ref.py — keep them in lockstep)
+# ---------------------------------------------------------------------------
+
+
+def wrap_steps(d, div: int):
+    """Wrap a rotation-table step delta into [-div/2, div/2)."""
+    half = div // 2
+    return jnp.mod(d + half, div) - half
+
+
+def pose_compose(p, z, table, div: int):
+    """p ∘ z: apply relative transform ``z`` in ``p``'s frame
+    (t = t_p + R(θ_p)·z_t, θ = θ_p + z_θ mod div).  Broadcasts over
+    leading axes; the rotation rides the shared integer core."""
+    cos_q = jnp.take(table[:, 0], p[..., 2])
+    sin_q = jnp.take(table[:, 1], p[..., 2])
+    rx, ry = rotate_rows(z[..., 0], z[..., 1], cos_q, sin_q)
+    return jnp.stack(
+        [p[..., 0] + rx, p[..., 1] + ry, jnp.mod(p[..., 2] + z[..., 2], div)],
+        axis=-1,
+    )
+
+
+def pose_relative(a, b, table, div: int):
+    """b ⊖ a: the relative transform from ``a`` to ``b`` in ``a``'s
+    frame (z_t = R(-θ_a)·(t_b - t_a), z_θ = θ_b - θ_a mod div) —
+    R(-θ) is the same table row with the sine negated, so no second
+    table is ever built."""
+    cos_q = jnp.take(table[:, 0], a[..., 2])
+    sin_q = jnp.take(table[:, 1], a[..., 2])
+    rx, ry = rotate_rows(
+        b[..., 0] - a[..., 0], b[..., 1] - a[..., 1], cos_q, -sin_q
+    )
+    return jnp.stack(
+        [rx, ry, jnp.mod(b[..., 2] - a[..., 2], div)], axis=-1
+    )
+
+
+def rel_inverse(z, table, div: int):
+    """z⁻¹ of a relative transform: (−R(−θ_z)·t_z, −θ_z)."""
+    inv_th = jnp.mod(-z[..., 2], div)
+    cos_q = jnp.take(table[:, 0], inv_th)
+    sin_q = jnp.take(table[:, 1], inv_th)
+    rx, ry = rotate_rows(z[..., 0], z[..., 1], cos_q, sin_q)
+    return jnp.stack([-rx, -ry, inv_th], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# the relaxation core
+# ---------------------------------------------------------------------------
+
+
+def solve_pose_graph_impl(nodes0, cons, cfg: PoseGraphConfig):
+    """Relax one graph: ``nodes0`` (M, 3) int32 initial poses, ``cons``
+    (C, 6) int32 dense constraint plane (weight 0 = padding).  Returns
+    the corrected (M, 3) int32 node poses after ``cfg.iters`` damped
+    relaxation sweeps.  Pure function of its inputs — callers embed it
+    in their own jitted programs (ops/loop_close.py runs it INSIDE the
+    closure-check dispatch, so a check costs one dispatch total)."""
+    m, div = cfg.max_nodes, cfg.theta_divisions
+    table = jnp.asarray(rotation_table(div))
+    lim = cfg.t_limit_sub
+    ci = jnp.clip(cons[:, 0], 0, m - 1)
+    cj = jnp.clip(cons[:, 1], 0, m - 1)
+    wgt = jnp.clip(cons[:, 5], 0, cfg.weight_max)               # (C,)
+    # z clamp: the residual bound the config validated assumes it
+    zx = jnp.clip(cons[:, 2], -2 * lim, 2 * lim)
+    zy = jnp.clip(cons[:, 3], -2 * lim, 2 * lim)
+    zth = cons[:, 4]
+    movable = (jnp.arange(m, dtype=jnp.int32) > 0)[:, None]     # gauge anchor
+
+    def body(_, nodes):
+        pi = jnp.take(nodes, ci, axis=0)                        # (C, 3)
+        pj = jnp.take(nodes, cj, axis=0)
+        cos_q = jnp.take(table[:, 0], pi[:, 2])
+        sin_q = jnp.take(table[:, 1], pi[:, 2])
+        rx, ry = rotate_rows(zx, zy, cos_q, sin_q)
+        res = jnp.stack([
+            (pi[:, 0] + rx - pj[:, 0]) * wgt,
+            (pi[:, 1] + ry - pj[:, 1]) * wgt,
+            wrap_steps(pi[:, 2] + zth - pj[:, 2], div) * wgt,
+        ], axis=1)                                              # (C, 3)
+        acc = (
+            jnp.zeros((m, 3), jnp.int32)
+            .at[cj].add(res, mode="drop")
+            .at[ci].add(-res, mode="drop")
+        )
+        deg = (
+            jnp.zeros((m,), jnp.int32)
+            .at[cj].add(wgt, mode="drop")
+            .at[ci].add(wgt, mode="drop")
+        )
+        den = 2 * jnp.maximum(deg, 1)                           # damping 1/2
+        # truncating (toward-zero) division: bias-free around zero, so
+        # ±1-subcell rounding residuals decay instead of walking
+        corr = jnp.sign(acc) * (jnp.abs(acc) // den[:, None])
+        nodes = jnp.where(movable, nodes + corr, nodes)
+        return jnp.stack([
+            jnp.clip(nodes[:, 0], -lim, lim),
+            jnp.clip(nodes[:, 1], -lim, lim),
+            jnp.mod(nodes[:, 2], div),
+        ], axis=1)
+
+    return jax.lax.fori_loop(0, cfg.iters, body, nodes0)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def solve_pose_graph(nodes0, cons, cfg: PoseGraphConfig):
+    """Standalone jitted single-graph solve (tests and offline tools;
+    the live path embeds :func:`solve_pose_graph_impl` in the fused
+    closure-check program instead)."""
+    return solve_pose_graph_impl(nodes0, cons, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def fleet_solve_pose_graph(nodes0, cons, cfg: PoseGraphConfig):
+    """Fleet lowering: N graphs relax in ONE compiled vmapped dispatch
+    ((N, M, 3) nodes, (N, C, 6) constraint planes)."""
+    return jax.vmap(lambda n, c: solve_pose_graph_impl(n, c, cfg))(
+        nodes0, cons
+    )

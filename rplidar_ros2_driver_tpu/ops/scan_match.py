@@ -454,24 +454,23 @@ def match_fine_scores(
     )                                                           # (T, F, F)
 
 
-def match_scan(
+def match_scan_volumes(
     log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
     cfg: MapConfig,
 ):
-    """Dense multi-resolution correlative match of one quantized scan
-    against the map, searching a (dθ, dx, dy) lattice around ``pose``:
-    the coarse translation sweep (:func:`match_coarse_scores`), a
-    first-max-wins argmax seed, the joint full-resolution refinement
-    (:func:`match_fine_scores`), and the accept/assemble epilogue.
-    ``cfg.match_backend`` selects the score-volume lowering (XLA arm or
-    the VMEM-tiled Pallas kernels, ops/pallas_scan_match.py); both arms
-    land bit-identical volumes, and the argmaxes live HERE in shared
-    code, so tie-breaking is structurally backend-independent.
+    """The matcher's shared score-volume core: coarse translation sweep
+    (:func:`match_coarse_scores`), first-max-wins argmax seed, joint
+    full-resolution refinement (:func:`match_fine_scores`), raw-delta
+    decode.  ``cfg.match_backend`` selects the lowering (XLA arm or the
+    VMEM-tiled Pallas kernels); both arms land bit-identical volumes,
+    and the argmaxes live HERE in shared code, so tie-breaking is
+    structurally backend-independent.
 
-    Returns (dpose (3,) int32 [dx_sub, dy_sub, dθ_steps], score, n_valid).
-    An empty or informationless window (best score ≤ 0 — e.g. a fresh
-    map, or an all-invalid scan) yields the identity delta.
-    """
+    Returns ``(dpose_raw, best, minv)``: the UNGATED argmax delta
+    ((3,) int32 [dx_sub, dy_sub, dθ_steps]), the best fine score, and
+    the fine volume's minimum — the peak-contrast statistic the
+    loop-closure gates consume (ops/loop_close.py); :func:`match_scan`
+    applies the front-end accept epilogue on top."""
     c = cfg.coarse
     w = cfg.window_cells
     r = cfg.fine_radius
@@ -492,17 +491,32 @@ def match_scan(
     du = (fbest // nf) % nf - r
     dv = fbest % nf - r
     best = jnp.max(score_f)
+    minv = jnp.min(score_f)
 
+    dpose_raw = jnp.stack([
+        (u_best * c + du) * SUB,
+        (v_best * c + dv) * SUB,
+        jnp.take(dth, t_best),
+    ])
+    return dpose_raw, best, minv
+
+
+def match_scan(
+    log_odds: jax.Array, pose: jax.Array, pq: jax.Array, ok: jax.Array,
+    cfg: MapConfig,
+):
+    """Dense multi-resolution correlative match of one quantized scan
+    against the map, searching a (dθ, dx, dy) lattice around ``pose``
+    (:func:`match_scan_volumes`) with the front-end accept/assemble
+    epilogue.
+
+    Returns (dpose (3,) int32 [dx_sub, dy_sub, dθ_steps], score, n_valid).
+    An empty or informationless window (best score ≤ 0 — e.g. a fresh
+    map, or an all-invalid scan) yields the identity delta.
+    """
+    dpose_raw, best, _minv = match_scan_volumes(log_odds, pose, pq, ok, cfg)
     accept = best > 0
-    dpose = jnp.where(
-        accept,
-        jnp.stack([
-            (u_best * c + du) * SUB,
-            (v_best * c + dv) * SUB,
-            jnp.take(dth, t_best),
-        ]),
-        jnp.zeros((3,), jnp.int32),
-    )
+    dpose = jnp.where(accept, dpose_raw, jnp.zeros((3,), jnp.int32))
     n_valid = jnp.sum(ok.astype(jnp.int32))
     return dpose, jnp.where(accept, best, 0), n_valid
 

@@ -82,7 +82,11 @@ def cell_hits_np(cells_x, cells_y, inb, grid: int) -> np.ndarray:
     return counts.reshape(grid, grid)
 
 
-def match_scan_np(log_odds, pose, pq, ok, cfg: MapConfig):
+def match_scan_volumes_np(log_odds, pose, pq, ok, cfg: MapConfig):
+    """Literal twin of ops/scan_match.match_scan_volumes: the shared
+    score-volume core returning the UNGATED argmax delta, the best fine
+    score and the fine volume's minimum (the loop-closure gates'
+    peak-contrast statistic)."""
     g, c = cfg.grid, cfg.coarse
     gc = g // c
     clog = int(math.log2(c))
@@ -147,17 +151,22 @@ def match_scan_np(log_odds, pose, pq, ok, cfg: MapConfig):
     du = (fbest // nf) % nf - r
     dv = fbest % nf - r
     best = int(np.max(score_f))
+    minv = int(np.min(score_f))
 
-    if best > 0:
-        dpose = np.asarray([
-            (u_best * c + du) * SUB,
-            (v_best * c + dv) * SUB,
-            int(dth[t_best]),
-        ], np.int32)
-        score = best
+    dpose_raw = np.asarray([
+        (u_best * c + du) * SUB,
+        (v_best * c + dv) * SUB,
+        int(dth[t_best]),
+    ], np.int32)
+    return dpose_raw, np.int32(best), np.int32(minv)
+
+
+def match_scan_np(log_odds, pose, pq, ok, cfg: MapConfig):
+    dpose_raw, best, _minv = match_scan_volumes_np(log_odds, pose, pq, ok, cfg)
+    if int(best) > 0:
+        dpose, score = dpose_raw, int(best)
     else:
-        dpose = np.zeros((3,), np.int32)
-        score = 0
+        dpose, score = np.zeros((3,), np.int32), 0
     return dpose, np.int32(score), np.int32(np.sum(ok))
 
 

@@ -133,6 +133,15 @@ class ShardedFilterService:
         # the per-stream pose estimates land in ``last_poses``
         self.mapper = None
         self.last_poses: list = [None] * streams
+        # SLAM back-end seam (slam/loop.LoopClosureEngine): when
+        # attached (requires the mapper), every mapper tick is observed
+        # — submap finalizations plus, when due, ONE batched closure-
+        # check dispatch — and the per-stream loop statuses land in
+        # ``last_loop`` with corrected poses in
+        # ``last_corrected_poses``
+        self.loop = None
+        self.last_loop: list = [None] * streams
+        self.last_corrected_poses: list = [None] * streams
         # fleet fault-tolerance seam (driver/health.py FleetHealth):
         # when attached, every live byte tick runs the per-stream health
         # FSMs — quarantined streams are masked onto the existing idle
@@ -206,12 +215,59 @@ class ShardedFilterService:
             self._warm_quarantine_path()
         return mapper
 
+    def attach_loop_closure(self, engine=None) -> "object":
+        """Attach a LoopClosureEngine (built here from this service's
+        params when not given) so every mapper tick runs the SLAM
+        back-end: submap lifecycle, batched loop-closure candidate
+        matching and the fixed-point pose-graph correction
+        (slam/loop.py).  Requires an attached mapper — the back-end
+        closes the front-end's loop.  Returns the attached engine (its
+        snapshot/restore surface is the caller's to drive)."""
+        if self.mapper is None:
+            self.attach_mapper()
+        if engine is None:
+            from rplidar_ros2_driver_tpu.slam.loop import LoopClosureEngine
+
+            engine = LoopClosureEngine(self.params, self.mapper)
+        if engine.streams != self.streams:
+            raise ValueError(
+                f"loop engine has {engine.streams} streams, service has "
+                f"{self.streams}"
+            )
+        # warm the check/install/re-anchor programs NOW (the mapper
+        # precompile discipline): a first finalize or closure check in
+        # a guarded steady-state loop must never pay an XLA compile
+        engine.precompile()
+        self.loop = engine
+        return engine
+
+    def _loop_tick(self) -> None:
+        """Feed the attached loop engine this mapper tick's estimates
+        (no-op without one); stashes per-stream statuses + corrected
+        poses."""
+        if self.loop is None:
+            return
+        self.last_loop = self.loop.observe(self.last_poses)
+        corrected = []
+        for i, est in enumerate(self.last_poses):
+            corrected.append(
+                None if est is None
+                else self.loop.corrected_pose_q(i, est.pose_q)
+            )
+        self.last_corrected_poses = corrected
+
+    def loop_status(self) -> Optional[dict]:
+        """The /diagnostics loop-closure value group's payload (None
+        when no engine is attached)."""
+        return None if self.loop is None else self.loop.status()
+
     def _map_tick(self, outs: list) -> list:
         """Feed one materialized tick to the attached mapper (no-op
         without one); stashes and returns the per-stream estimates."""
         if self.mapper is None or outs is None:
             return outs
         self.last_poses = self.mapper.submit(outs)
+        self._loop_tick()
         return outs
 
     def _map_tick_recon(self) -> None:
@@ -245,6 +301,7 @@ class ShardedFilterService:
             masks[i] = pts[:, 2] > 0.5
             live[i] = 1
         self.last_poses = self.mapper.submit_points(points, masks, live)
+        self._loop_tick()
 
     # -- fault tolerance seam -----------------------------------------------
 
@@ -342,6 +399,8 @@ class ShardedFilterService:
             eng._reset_next[0] = False
         if self.mapper is not None and self.mapper.ticks == 0:
             self.mapper.restore_stream(0, self.mapper.snapshot_stream(0))
+        if self.loop is not None and self.loop.ticks == 0:
+            self.loop.restore_stream(0, self.loop.snapshot_stream(0))
 
     def _quarantine_stream(self, i: int) -> None:
         """Health-FSM hook: stream i just entered QUARANTINED — freeze
@@ -355,6 +414,8 @@ class ShardedFilterService:
             snap["ingest"] = self.fleet_ingest.snapshot_stream(i)
         if self.mapper is not None:
             snap["map"] = self.mapper.snapshot_stream(i)
+        if self.loop is not None:
+            snap["loop"] = self.loop.snapshot_stream(i)
         self.stream_checkpoints[i] = snap
         self.quarantines += 1
         logger.warning("stream %d quarantined (state checkpointed)", i)
@@ -370,6 +431,8 @@ class ShardedFilterService:
                 self.fleet_ingest.restore_stream(i, snap["ingest"])
             if "map" in snap and self.mapper is not None:
                 self.mapper.restore_stream(i, snap["map"])
+            if "loop" in snap and self.loop is not None:
+                self.loop.restore_stream(i, snap["loop"])
         self.rejoins += 1
         logger.info("stream %d rejoining (state restored from checkpoint)", i)
 
@@ -1339,6 +1402,12 @@ class ElasticFleetService:
                 sh.mapper is None
             ):
                 sh.attach_mapper()
+            if getattr(self.params, "loop_enable", False) and (
+                sh.loop is None
+            ):
+                # the back-end rides each shard's mapper; its per-stream
+                # rows migrate with the map rows on shard loss
+                sh.attach_loop_closure()
             sh._warm_quarantine_path()
         if self._fresh_snap is None:
             # engines are fresh here (precompile before traffic), so
@@ -1348,6 +1417,10 @@ class ElasticFleetService:
             if self.shards[0].mapper is not None:
                 self._fresh_snap["map"] = (
                     self.shards[0].mapper.snapshot_stream(0)
+                )
+            if self.shards[0].loop is not None:
+                self._fresh_snap["loop"] = (
+                    self.shards[0].loop.snapshot_stream(0)
                 )
 
     # -- chaos seam --------------------------------------------------------

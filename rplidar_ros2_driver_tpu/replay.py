@@ -256,6 +256,60 @@ def replay_fleet(
     )
 
 
+def _replay_map_core(
+    revolutions: list[dict],
+    params,
+    *,
+    beams: int | None,
+    capacity: int,
+    chunk: int,
+    with_loop: bool,
+):
+    """The ONE offline SLAM replay loop both map entry points share:
+    chain replay, numpy beam-grid projection (the host mirror of
+    ops/filters.polar_to_cartesian — derived once, so backend choice
+    cannot change the mapper's inputs), one mapper tick per scan, and —
+    when ``with_loop`` — a loop-closure engine observing every tick
+    with the corrected trajectory recorded next to the raw one."""
+    from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+    b = beams or DEFAULT_BEAMS
+    ranges, _state = replay_through_chain(
+        revolutions, params, beams=b, capacity=capacity, chunk=chunk
+    )
+    theta = ((np.arange(b) + 0.5) * (2.0 * np.pi / b)).astype(np.float32)
+    cos_t, sin_t = np.cos(theta), np.sin(theta)
+    mapper = FleetMapper(params, 1, beams=b)
+    engine = None
+    if with_loop:
+        from rplidar_ros2_driver_tpu.ops.scan_match import pose_to_metric
+        from rplidar_ros2_driver_tpu.slam.loop import LoopClosureEngine
+
+        engine = LoopClosureEngine(params, mapper)
+        engine.precompile()
+    k_total = ranges.shape[0]
+    traj = np.zeros((k_total, 3), np.float64)
+    corrected = np.zeros((k_total, 3), np.float64) if with_loop else None
+    scores = np.zeros((k_total,), np.int32)
+    for k in range(k_total):
+        finite = np.isfinite(ranges[k])
+        r = np.where(finite, ranges[k], 0.0).astype(np.float32)
+        pts = np.stack([r * cos_t, r * sin_t], axis=1).astype(np.float32)
+        ests = mapper.submit_points(
+            pts[None], finite[None], np.ones((1,), np.int32)
+        )
+        est = ests[0]
+        if engine is not None:
+            engine.observe(ests)
+            corrected[k] = pose_to_metric(
+                engine.corrected_pose_q(0, est.pose_q), mapper.cfg
+            )
+        traj[k] = (est.x_m, est.y_m, est.theta_rad)
+        scores[k] = est.score
+    return traj, corrected, scores, mapper, engine
+
+
 def replay_with_map(
     revolutions: list[dict],
     params,
@@ -270,38 +324,44 @@ def replay_with_map(
     correlative scan-to-map matching + log-odds occupancy accumulation —
     yielding the estimated trajectory and the final map.
 
-    The per-scan Cartesian endpoints are derived ONCE (numpy beam-grid
-    projection, the host mirror of ops/filters.polar_to_cartesian) and
-    fed to whichever map backend ``params.map_backend`` resolves to, so
-    backend choice cannot change the mapper's inputs.
-
     Returns ``(trajectory, scores, mapper)``: (K, 3) float64 [x_m, y_m,
     theta_rad] per-scan pose estimates, (K,) int32 match scores, and the
     mapper (whose ``snapshot()`` is the final map; render it with
     tools/viz.map_to_image).
     """
-    from rplidar_ros2_driver_tpu.filters.chain import DEFAULT_BEAMS
-    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
-
-    b = beams or DEFAULT_BEAMS
-    ranges, _state = replay_through_chain(
-        revolutions, params, beams=b, capacity=capacity, chunk=chunk
+    traj, _corrected, scores, mapper, _engine = _replay_map_core(
+        revolutions, params, beams=beams, capacity=capacity, chunk=chunk,
+        with_loop=False,
     )
-    theta = ((np.arange(b) + 0.5) * (2.0 * np.pi / b)).astype(np.float32)
-    cos_t, sin_t = np.cos(theta), np.sin(theta)
-    mapper = FleetMapper(params, 1, beams=b)
-    traj = np.zeros((ranges.shape[0], 3), np.float64)
-    scores = np.zeros((ranges.shape[0],), np.int32)
-    for k in range(ranges.shape[0]):
-        finite = np.isfinite(ranges[k])
-        r = np.where(finite, ranges[k], 0.0).astype(np.float32)
-        pts = np.stack([r * cos_t, r * sin_t], axis=1).astype(np.float32)
-        est = mapper.submit_points(
-            pts[None], finite[None], np.ones((1,), np.int32)
-        )[0]
-        traj[k] = (est.x_m, est.y_m, est.theta_rad)
-        scores[k] = est.score
     return traj, scores, mapper
+
+
+def replay_with_loop_closure(
+    revolutions: list[dict],
+    params,
+    *,
+    beams: int | None = None,
+    capacity: int = 4096,
+    chunk: int = 256,
+):
+    """Offline SLAM replay through the FULL back-end: the capture's
+    revolutions through the fused filter chain and the mapper exactly
+    like :func:`replay_with_map`, with a loop-closure engine
+    (slam/loop.LoopClosureEngine) observing every revolution — submap
+    finalizations, batched candidate matching, fixed-point pose-graph
+    relaxation — so the corrected trajectory is recovered next to the
+    raw one.
+
+    Returns ``(traj, corrected, scores, mapper, engine)``: the raw
+    front-end (K, 3) float64 trajectory, the pose-graph-corrected
+    (K, 3) trajectory (identical until the first accepted closure),
+    (K,) int32 match scores, the mapper and the engine (whose
+    ``status()`` carries the closure counters the CLI report prints).
+    """
+    return _replay_map_core(
+        revolutions, params, beams=beams, capacity=capacity, chunk=chunk,
+        with_loop=True,
+    )
 
 
 def replay_raw_fused(

@@ -1,0 +1,15 @@
+"""SLAM back-end: loop-closure detection + pose-graph correction.
+
+The subsystem that bounds pose drift (ROADMAP item 2): the front-end
+(mapping/mapper.FleetMapper) matches scan-to-map per revolution; this
+package closes the loop — submap library lifecycle, batched candidate
+matching against it, and fixed-point pose-graph relaxation, all riding
+the ops-layer kernels (ops/loop_close.py, ops/pose_graph.py).
+"""
+
+from rplidar_ros2_driver_tpu.slam.loop import (  # noqa: F401
+    LoopClosureEngine,
+    LoopStatus,
+    loop_config_from_params,
+    resolve_loop_backend,
+)

@@ -47,7 +47,7 @@ def _records(paths: list[str]):
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
-    "deskew_ab",
+    "deskew_ab", "loop_close_ab",
 )
 
 
@@ -347,6 +347,57 @@ def analyze(records: list[dict]) -> dict:
                     "update_multiplier", "steady_tick_ratio",
                     "ratio_clamped",
                 ) if k in dab
+            })
+
+        # config 17: the SLAM back-end loop-closure A/B.  TWO mappings
+        # ride one key: `loop_backend` flips host -> fused on the wall
+        # ratio (clamped like every other overhead decomposition), and
+        # `loop_enable` flips on the accuracy + cost pair — correction
+        # within the 2-cell bar at < 10% steady-tick cost (the
+        # deskew_ab decision shape)
+        lab = rec.get("loop_close_ab")
+        if isinstance(lab, dict):
+            v = lab.get("backend_speedup")
+            if isinstance(v, (int, float)) and not lab.get(
+                "overhead_clamped"
+            ):
+                recommend("loop_backend.tpu", ratio_entry(
+                    "host", "fused",
+                    "config17 loop_close backend_speedup",
+                    float(v), "loop_close_ab",
+                ))
+            err = lab.get("corrected_end_err_cells")
+            ratio = lab.get("steady_tick_ratio")
+            if isinstance(err, (int, float)) and isinstance(
+                ratio, (int, float)
+            ):
+                # a clamped decomposition (back-end measured "free" —
+                # below the timing floor) records evidence but must
+                # never flip: the ratio's magnitude is the clamp's
+                flip = (
+                    err <= 2.0 and ratio >= 0.90
+                    and not lab.get("overhead_clamped")
+                )
+                recommend("loop_enable.tpu", {
+                    "current": "false",
+                    "recommended": "true" if flip else "false",
+                    "flip": flip,
+                    "key": "config17 corrected_end_err_cells + "
+                           "steady_tick_ratio",
+                    "value": 1.0 if flip else float(min(ratio, 1.0)),
+                    "measured": {
+                        "corrected_end_err_cells": float(err),
+                        "steady_tick_ratio": float(ratio),
+                    },
+                    "margin": 0.90,
+                    "source": "loop_close_ab",
+                })
+            out["evidence"].setdefault("loop_close_ab", []).append({
+                k: lab[k] for k in (
+                    "backend_speedup", "steady_tick_ratio",
+                    "corrected_end_err_cells", "baseline_end_err_cells",
+                    "overhead_clamped",
+                ) if k in lab
             })
 
         # ablation: resample + voxel kernels

@@ -30,6 +30,7 @@ def test_metric_names_stable():
     assert bench.metric_name(14) == "pallas_match_kernel_scans_per_sec"
     assert bench.metric_name(15) == "shard_failover_survivor_scans_per_sec"
     assert bench.metric_name(16) == "deskew_recon_map_updates_per_sec"
+    assert bench.metric_name(17) == "loop_close_corrected_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -37,7 +38,7 @@ def test_graded_table_well_formed():
         assert kind in (
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
-            "pallas_match", "failover", "deskew",
+            "pallas_match", "failover", "deskew", "loop_close",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1128,6 +1129,101 @@ def test_bench_smoke_deskew():
     assert "steady_tick_ratio" in out["deskew_ab"]
     assert isinstance(out["deskew_ab"]["ratio_clamped"], bool)
     assert "ceiling_analysis" in out
+
+
+def test_bench_smoke_loop_close():
+    """`bench.py --smoke-loop-close` — the tier-1 gate for the SLAM
+    back-end (config-17 A/B at seconds-scale CPU geometry).  The
+    structural/accuracy claims are what matters: pose-graph-corrected
+    end-pose error <= 2 map cells on the drift-injected
+    return-to-start trace while the front-end-only baseline carries
+    the full injected drift, exactly one engine dispatch per
+    closure-check tick, bit-exact host/fused parity, and zero
+    recompiles / implicit transfers under the steady-state guard (the
+    bench itself raises on violation; this gate pins that the asserted
+    artifact lands).  The wall ratios are 1.5-core-CI weather; the
+    bit-exact back-end contract lives in tests/test_loop_close.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-loop-close"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(17)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    # the structural claims, re-checked from the artifact
+    s = out["structural"]
+    assert s["one_dispatch_per_check_holds"] is True
+    assert s["bit_exact_parity_holds"] is True
+    assert s["drift_bounded_holds"] is True
+    # the accuracy pair the config exists for
+    assert out["corrected_end_err_cells"] <= 2.0
+    assert out["baseline_end_err_cells"] >= 4.0
+    assert out["closures_accepted"] > 0
+    assert out["fused"]["dispatches"] == out["fused"]["check_ticks"]
+    assert out["value"] > 0
+    # the decision key rides with its clamp flag
+    ab = out["loop_close_ab"]
+    assert "backend_speedup" in ab and "steady_tick_ratio" in ab
+    assert isinstance(ab["overhead_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_loop_close_key():
+    """The config-17 key drives TWO mappings: `loop_backend` flips
+    host -> fused on an unclamped TPU wall ratio over the margin, and
+    `loop_enable` flips only when the corrected error meets the 2-cell
+    bar at a >= 0.90 tick ratio — CPU records and clamped ratios never
+    flip either."""
+    import importlib
+    import sys as _sys
+
+    _sys.modules.pop("decide_backends", None)
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, speedup, err, ratio, clamped=False):
+        return {
+            "device": dev,
+            "loop_close_ab": {
+                "backend_speedup": speedup,
+                "corrected_end_err_cells": err,
+                "steady_tick_ratio": ratio,
+                "baseline_end_err_cells": 12.0,
+                "overhead_clamped": clamped,
+            },
+        }
+
+    # clean TPU record: backend flips on the ratio, enable on the pair
+    got = db.analyze([rec("tpu", 5.5, 1.2, 0.95)])
+    r = got["recommendations"]["loop_backend.tpu"]
+    assert r["flip"] is True and r["recommended"] == "fused"
+    r = got["recommendations"]["loop_enable.tpu"]
+    assert r["flip"] is True and r["recommended"] == "true"
+    # CPU record: reported, never flips
+    got = db.analyze([rec("cpu", 9.9, 0.5, 1.0)])
+    assert "loop_backend.tpu" not in got["recommendations"]
+    assert "loop_enable.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped: evidence only — neither mapping flips
+    got = db.analyze([rec("tpu", 5.5, 1.2, 0.95, clamped=True)])
+    assert "loop_backend.tpu" not in got["recommendations"]
+    assert got["recommendations"]["loop_enable.tpu"]["flip"] is False
+    # correction missing the 2-cell bar: loop_enable stays off
+    got = db.analyze([rec("tpu", 5.5, 3.0, 0.95)])
+    assert got["recommendations"]["loop_enable.tpu"]["flip"] is False
+    # tick ratio below the floor: loop_enable stays off
+    got = db.analyze([rec("tpu", 5.5, 1.2, 0.5)])
+    assert got["recommendations"]["loop_enable.tpu"]["flip"] is False
 
 
 def test_decide_backends_deskew_key():
