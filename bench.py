@@ -201,6 +201,7 @@ GRADED = {
     15: ("failover", POINTS, dict(window=WINDOW)),  # shard-loss failover pod A/B
     16: ("deskew", POINTS, dict(window=WINDOW)),  # de-skew + sweep-recon A/B
     17: ("loop_close", POINTS, dict(window=WINDOW)),  # SLAM back-end loop-closure A/B
+    18: ("fused_mapping", POINTS, dict(window=WINDOW)),  # one-dispatch stack A/B
 }
 
 
@@ -3043,6 +3044,296 @@ def bench_deskew(smoke: bool = False) -> dict:
     }
 
 
+def bench_fused_mapping(smoke: bool = False) -> dict:
+    """Config 18 — the one-dispatch stack A/B (PR 13): two identical
+    fused fleets (deskew + mapping enabled) advance TICK-PAIRED over
+    the same byte stream in groups of T ticks; the FUSED arm runs
+    ``fused_mapping_backend='fused'`` + ``super_tick_max=T`` (MapState
+    threaded through the ingest scan carry — bytes -> decode ->
+    de-skewed sweep -> pose -> map update in ONE compiled dispatch per
+    T-tick group), the BASELINE arm the two-dispatch host route (one
+    ingest dispatch per tick plus one separate fused-FleetMapper
+    dispatch per mapping tick — the pre-PR-13 stack).
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      * dispatch collapse T+T -> 1, MAPPING INCLUDED (engine + mapper
+        counters): the fused arm issues exactly ceil(ticks/T) compiled
+        dispatches and ZERO mapper dispatches for the whole run, while
+        the baseline pays one ingest dispatch per tick plus one mapper
+        dispatch per mapping tick — asserted for T∈{1,T} via the
+        per-tick warm group and the grouped drain;
+      * zero recompiles / zero implicit transfers across both timed
+        loops (utils/guards.steady_state wraps the paired loop);
+      * byte-equal trajectories + maps: the two arms' revolution
+        outputs, drain-boundary poses and final MapStates are
+        byte-identical (int32 datapath end to end — equality, not
+        tolerance).
+
+    The artifact carries the clamped ``fused_mapping_ab`` decision key
+    (scripts/decide_backends.py: TPU records only — on this linkless
+    CPU rig the saved dispatch is host-overhead weather, so CPU
+    evidence can never flip ``fused_mapping_backend``).  ``smoke``
+    shrinks geometry to a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``.
+    """
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, grid = 4, 256, 32
+        points_per_rev, revs, capacity = 800, 12, 1024
+        streams, run, map_grid, T = 2, 8, 64, 4
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 24, CAPACITY
+        streams, run, map_grid, T = 4, 16, 128, 8
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    frames = _denseboost_wire_frames(revs, points_per_rev)
+
+    def build(route: str, stm: int):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused", super_tick_max=stm,
+            deskew_enable=True, sweep_reconstruct_window=4,
+            deskew_profile_beams=128, deskew_shift_window=4,
+            map_enable=True,
+            map_backend="fused" if route == "host" else "host",
+            fused_mapping_backend=route,
+            map_grid=map_grid, map_cell_m=0.1,
+        )
+        svc = ShardedFilterService(
+            params, streams, beams=beams, capacity=capacity,
+            fleet_ingest_buckets=(run,),
+        )
+        svc._ensure_byte_ingest()
+        svc.fleet_ingest.precompile([ans])
+        svc.attach_mapper()
+        return svc
+
+    # baseline = the two-dispatch stack: per-tick ingest + a separate
+    # FUSED FleetMapper (it must actually dispatch for the T+T claim to
+    # be counted, not inferred — the numpy host mapper dispatches
+    # nothing); fused = the one-dispatch stack at super_tick_max=T
+    base_svc = build("host", 1)
+    fused_svc = build("fused", T)
+    ticks = _paced_fleet_byte_ticks(frames, run, streams, ans)
+    # group the scene into T-tick drains, dropping the ragged tail so
+    # every timed fused drain is exactly one compiled dispatch
+    n_groups = len(ticks) // T
+    if n_groups < 3:
+        raise RuntimeError("scene too short for a warm + timed drain")
+    groups = [ticks[g * T : (g + 1) * T] for g in range(n_groups)]
+    warm = 1
+
+    outputs = {"base": [], "fused": []}   # (tick, stream, ranges)
+    poses = {"base": [], "fused": []}     # drain-boundary pose rows
+
+    def advance(name, svc, group, t_base):
+        if name == "base":
+            for k, t in enumerate(group):
+                res = svc.submit_bytes(t)
+                for i in range(streams):
+                    if res[i] is not None:
+                        outputs[name].append(
+                            (t_base + k, i,
+                             np.asarray(res[i].ranges).copy())
+                        )
+        else:
+            res = svc.submit_bytes_backlog(group)
+            for i, s in enumerate(res):
+                for k, out in enumerate(s):
+                    outputs[name].append(
+                        # per-stream drain order; the parity compare
+                        # below is per stream, so the tick label only
+                        # needs to be monotone within a stream
+                        (t_base + k, i, np.asarray(out.ranges).copy())
+                    )
+        poses[name].append([
+            None if p is None else (
+                tuple(int(v) for v in p.pose_q), p.score, p.revision
+            )
+            for p in svc.last_poses
+        ])
+
+    for g in range(warm):
+        advance("base", base_svc, groups[g], g * T)
+        advance("fused", fused_svc, groups[g], g * T)
+    outputs = {"base": [], "fused": []}
+    poses = {"base": [], "fused": []}
+    d0b = base_svc.fleet_ingest.dispatch_count
+    d0m = base_svc.mapper.dispatch_count
+    d0f = fused_svc.fleet_ingest.dispatch_count
+    # warm-group updates baseline: the headline divides TIMED updates
+    # by TIMED wall time, so the warm group's revisions must not
+    # inflate the rate (the dispatch-counter discipline above)
+    rev0 = int(np.asarray(
+        fused_svc.mapper.snapshot()["revision"]
+    ).sum())
+    base_s: list[float] = []
+    fused_s: list[float] = []
+    with guards.steady_state(tag="fused-mapping A/B pair"):
+        for g, group in enumerate(groups[warm:]):
+            tb = g * T
+            # alternate which arm goes first (config 13 discipline)
+            if g % 2 == 0:
+                x0 = time.perf_counter()
+                advance("base", base_svc, group, tb)
+                x1 = time.perf_counter()
+                advance("fused", fused_svc, group, tb)
+                x2 = time.perf_counter()
+                base_s.append(x1 - x0)
+                fused_s.append(x2 - x1)
+            else:
+                x0 = time.perf_counter()
+                advance("fused", fused_svc, group, tb)
+                x1 = time.perf_counter()
+                advance("base", base_svc, group, tb)
+                x2 = time.perf_counter()
+                fused_s.append(x1 - x0)
+                base_s.append(x2 - x1)
+
+    timed_groups = len(groups) - warm
+    # -- structural claims: violations are bugs, not weather --
+    got_f = fused_svc.fleet_ingest.dispatch_count - d0f
+    if got_f != timed_groups:
+        raise RuntimeError(
+            f"fused arm: {got_f} dispatches over {timed_groups} T-tick "
+            "groups — not ONE dispatch per super-tick with mapping"
+        )
+    if fused_svc.mapper.dispatch_count != 0:
+        raise RuntimeError(
+            "fused arm issued separate mapper dispatches — mapping did "
+            "not ride the ingest program"
+        )
+    got_b = base_svc.fleet_ingest.dispatch_count - d0b
+    if got_b != timed_groups * T:
+        raise RuntimeError(
+            f"baseline arm: {got_b} ingest dispatches over "
+            f"{timed_groups * T} ticks — not one per tick"
+        )
+    got_bm = base_svc.mapper.dispatch_count - d0m
+    if got_bm <= 0:
+        raise RuntimeError(
+            "baseline arm's mapper never dispatched — the two-dispatch "
+            "baseline is not measuring the pre-fusion stack"
+        )
+    # byte-equal trajectories (per stream, drain order) + drain poses
+    for i in range(streams):
+        a = [r for (_t, s, r) in outputs["base"] if s == i]
+        b = [r for (_t, s, r) in outputs["fused"] if s == i]
+        if len(a) != len(b) or not all(
+            np.array_equal(x, y) for x, y in zip(a, b)
+        ):
+            raise RuntimeError(
+                f"stream {i}: revolution outputs diverged between the "
+                "one-dispatch and two-dispatch arms"
+            )
+    if poses["base"] != poses["fused"]:
+        raise RuntimeError(
+            "drain-boundary poses diverged between the arms"
+        )
+    sb = base_svc.mapper.snapshot()
+    sf = fused_svc.mapper.snapshot()
+    for k in ("log_odds", "pose", "origin_xy", "revision"):
+        if not np.array_equal(np.asarray(sb[k]), np.asarray(sf[k])):
+            raise RuntimeError(
+                f"final MapState ({k}) diverged between the arms"
+            )
+    # T=1 corner of the acceptance bar: a SINGLE live tick through the
+    # fused arm is still exactly one dispatch with mapping included
+    # (the per-tick program, not the super-step) and zero mapper
+    # dispatches — the collapse holds at every super-tick depth
+    d1 = fused_svc.fleet_ingest.dispatch_count
+    fused_svc.submit_bytes(ticks[n_groups * T - 1])
+    if fused_svc.fleet_ingest.dispatch_count - d1 != 1:
+        raise RuntimeError(
+            "fused arm: a single tick was not exactly one dispatch"
+        )
+    if fused_svc.mapper.dispatch_count != 0:
+        raise RuntimeError(
+            "fused arm: the T=1 tick issued a separate mapper dispatch"
+        )
+
+    updates = int(np.asarray(sf["revision"]).sum()) - rev0
+    base_dt = float(np.sum(base_s))
+    fused_dt = float(np.sum(fused_s))
+    pair_ratio = np.asarray(base_s) / np.maximum(np.asarray(fused_s), 1e-9)
+    steady_ratio = float(np.percentile(pair_ratio, 50))
+    value = updates / max(fused_dt, 1e-9)
+    # EITHER arm under the 50 us/group floor: the ratio's magnitude is
+    # the timer's, not the rig's (config-16 discipline)
+    clamped = min(
+        float(np.percentile(base_s, 50)), float(np.percentile(fused_s, 50))
+    ) < 50e-6
+    return {
+        "metric": metric_name(18),
+        "value": round(value, 2),
+        "unit": "updates/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "super_tick": T,
+        "groups": timed_groups,
+        "updates": updates,
+        "dispatches": {
+            "fused_total": got_f,
+            "baseline_ingest": got_b,
+            "baseline_mapper": got_bm,
+            "collapse": f"{got_b}+{got_bm} -> {got_f}",
+        },
+        "baseline_updates_per_sec": round(updates / max(base_dt, 1e-9), 2),
+        "steady_group_ratio": round(steady_ratio, 4),
+        "base_group_p50_ms": round(
+            float(np.percentile(base_s, 50)) * 1e3, 3
+        ),
+        "fused_group_p50_ms": round(
+            float(np.percentile(fused_s, 50)) * 1e3, 3
+        ),
+        "structural": {
+            "one_dispatch_per_super_tick": True,   # asserted above
+            "zero_mapper_dispatches": True,        # asserted above
+            "zero_recompiles": True,               # steady_state guard
+            "zero_implicit_transfers": True,       # steady_state guard
+            "byte_equal_trajectories": True,       # asserted above
+            "byte_equal_maps": True,               # asserted above
+        },
+        # the decide_backends decision key for the
+        # fused_mapping_backend recommendation: TPU records only, the
+        # clamp honored — the dispatch collapse is structural
+        # everywhere, but only on-chip wall time can price it
+        "fused_mapping_ab": {
+            "steady_group_ratio": round(steady_ratio, 4),
+            "dispatch_collapse": round(
+                (got_b + got_bm) / max(got_f, 1), 2
+            ),
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the dispatch collapse is structural: T ticks of ingest + "
+            "T mapper dispatches become ceil(T/super_tick_max) "
+            "compiled dispatches with the MapState riding the scan "
+            "carry — asserted by counters, not inferred from wall "
+            "time.  The group-time ratio records what the collapse is "
+            "worth on THIS rig; on a linkless 1.5-core CPU a dispatch "
+            "costs microseconds of Python, so the ratio here prices "
+            "host overhead, not the per-dispatch device round-trip the "
+            "fusion removes — the on-chip capture queued in "
+            "scripts/rig_recapture.sh is where the latency claim "
+            "lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 class _DriftingFrontEnd:
     """Scripted SLAM front-end for the config-17 back-end A/B: maps are
     rasterized at CALLER-SUPPLIED (drift-injected) poses with no
@@ -3419,6 +3710,7 @@ def metric_name(config: int) -> str:
         15: "shard_failover_survivor_scans_per_sec",
         16: "deskew_recon_map_updates_per_sec",
         17: "loop_close_corrected_scans_per_sec",
+        18: "fused_mapping_stack_updates_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -3446,6 +3738,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_deskew()
     if kind == "loop_close":
         return bench_loop_close()
+    if kind == "fused_mapping":
+        return bench_fused_mapping()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -3762,7 +4056,9 @@ if __name__ == "__main__":
         "15=shard-loss failover pod A/B, kill/evacuate/re-admit vs an "
         "unkilled tick-paired baseline pod, 16=de-skew + sweep-"
         "reconstruction A/B, 17=SLAM back-end loop-closure A/B, "
-        "drift-corrected vs front-end-only baseline)",
+        "drift-corrected vs front-end-only baseline, 18=one-dispatch "
+        "stack A/B, mapping fused into the ingest super-tick vs the "
+        "two-dispatch route)",
     )
     ap.add_argument(
         "--smoke-ingest",
@@ -3847,6 +4143,16 @@ if __name__ == "__main__":
         "parity and zero recompiles/transfers under the steady-state "
         "guard — the tier-1 regression gate for the loop-closure "
         "subsystem",
+    )
+    ap.add_argument(
+        "--smoke-fused-mapping",
+        action="store_true",
+        help="seconds-scale CPU run of the config-18 one-dispatch-stack "
+        "A/B (small geometry, forced CPU backend, no tunnel probe): "
+        "asserts the T+T->1 dispatch collapse INCLUDING mapping, zero "
+        "recompiles/implicit transfers, and byte-equal trajectories + "
+        "maps vs the two-dispatch baseline — the tier-1 regression "
+        "gate for the fused mapping route",
     )
     ap.add_argument(
         "--xla-cache",
@@ -3943,6 +4249,13 @@ if __name__ == "__main__":
         # gate must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_loop_close(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_fused_mapping:
+        # same CPU-only discipline: the T+T->1 structural gate must
+        # run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_fused_mapping(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -180,6 +180,23 @@ class DriverParams:
     # reads `pallas_match_ab`, TPU records only, interpret-mode runs
     # carry no weight).
     match_backend: str = "auto"
+    # fused mapping route (PR 13 "one dispatch for the whole stack"):
+    # "fused" threads the per-stream MapState through the fused ingest
+    # carry (ops/ingest cfg.mapping) so bytes -> decode -> de-skewed
+    # sweep -> pose -> map update is ONE compiled program per
+    # (super-)tick per shard — T ticks of ingest+mapping collapse from
+    # T+T dispatches to 1; "host" keeps the two-dispatch golden
+    # reference (the ingest dispatch plus a separate FleetMapper
+    # dispatch fed from take_recon()); "auto" resolves per the standing
+    # decision procedure (mapping/mapper.resolve_fused_mapping_backend
+    # — host until an on-chip config-18 artifact clears the bar;
+    # scripts/decide_backends.py reads `fused_mapping_ab`, TPU records
+    # only).  Requires map_enable + deskew_enable + the fused fleet
+    # ingest seam (the in-program mapper consumes the reconstructed
+    # sweep; both routes are byte-identical — tests/test_fused_mapping
+    # pins trajectories, wires and final MapState across T x fleet x
+    # matcher-backend arms).
+    fused_mapping_backend: str = "auto"
     map_grid: int = 256               # cells per side of the log-odds map
     map_cell_m: float = 0.05          # metres per map cell
     map_match_window: float = 0.4     # translation search radius (m)
@@ -264,6 +281,17 @@ class DriverParams:
     # ± dθ search radius in profile-beam steps
     deskew_profile_beams: int = 256
     deskew_shift_window: int = 8
+    # de-skew kernel lowering (ops/deskew.DeskewConfig.backend): "xla"
+    # = the jnp dense tiled-min / shift-search arms; "pallas" = the
+    # VMEM-tiled kernels (ops/pallas_deskew.py — the sub-sweep
+    # rasterizer's beam-min and the profile shift search, the two
+    # intra-program hot loops the PR 13 fusion exposes; interpret mode
+    # off-TPU so CPU configs stay runnable).  Bit-exact either way
+    # (int32 min/sum are evaluation-order independent;
+    # tests/test_pallas_deskew.py).  "auto" resolves per the standing
+    # decision procedure (ops/deskew.resolve_deskew_backend — xla
+    # until on-chip evidence; CPU interpret-mode runs carry no weight).
+    deskew_backend: str = "auto"
     # -- fleet fault tolerance (driver/health.py + parallel/service.py) --
     # attach the per-stream health FSM supervisor to the fleet byte-tick
     # seams (ShardedFilterService.submit_bytes*): HEALTHY -> SUSPECT ->
@@ -475,10 +503,42 @@ class DriverParams:
                     "fused program's device state — the host decode "
                     "path has nowhere to keep it"
                 )
+        if self.deskew_backend not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                "deskew_backend must be 'auto', 'xla' or 'pallas'"
+            )
         if self.map_backend not in ("auto", "host", "fused"):
             raise ValueError(
                 "map_backend must be 'auto', 'host' or 'fused'"
             )
+        if self.fused_mapping_backend not in ("auto", "host", "fused"):
+            raise ValueError(
+                "fused_mapping_backend must be 'auto', 'host' or 'fused'"
+            )
+        if self.fused_mapping_backend == "fused":
+            if not self.map_enable:
+                raise ValueError(
+                    "fused_mapping_backend='fused' requires map_enable "
+                    "(there is no map to thread through the carry)"
+                )
+            if not self.deskew_enable:
+                raise ValueError(
+                    "fused_mapping_backend='fused' requires deskew_enable "
+                    "(the in-program mapper consumes the reconstructed "
+                    "sweep the de-skew stage emits every tick)"
+                )
+            if self.fleet_ingest_backend != "fused":
+                # the map rides the FLEET engine's carry: the
+                # single-stream fused seam satisfies the deskew check
+                # above but never builds cfg.mapping, so an 'auto' (or
+                # host) fleet seam here would silently run with no
+                # in-program map anywhere
+                raise ValueError(
+                    "fused_mapping_backend='fused' requires "
+                    "fleet_ingest_backend='fused' (spelled, not 'auto' "
+                    "— the MapState rides the fleet ingest carry, and "
+                    "only that engine builds the in-program mapper)"
+                )
         if self.match_backend not in ("auto", "xla", "pallas"):
             raise ValueError(
                 "match_backend must be 'auto', 'xla' or 'pallas'"

@@ -58,7 +58,15 @@ _FUSED_BUCKETS = (4, 64)
 #       deskew_prof, deskew_motion) join the ingest.* key space when
 #       ``deskew_enable`` is set; None leaves are omitted, so a
 #       deskew-off snapshot still carries exactly the v1 keys.
-INGEST_STREAM_SNAPSHOT_VERSION = 2
+#   v3: optional in-program mapping planes (map_log_odds, map_pose,
+#       map_origin_xy, map_revision) join the ingest.* key space when
+#       the fused mapping route is active (fused_mapping_backend) —
+#       the MapState rides the ingest carry, so the per-stream
+#       failover/quarantine transport now moves the map rows WITH the
+#       decode/filter rows.  Same omit-when-None discipline; the bump
+#       keeps a v2 restore from silently installing a snapshot whose
+#       key-space contract predates the carry layout.
+INGEST_STREAM_SNAPSHOT_VERSION = 3
 
 
 class FusedIngest:
@@ -100,7 +108,9 @@ class FusedIngest:
             deskew_config_from_params,
         )
 
-        self._deskew = deskew_config_from_params(params, self.cfg.beams)
+        self._deskew = deskew_config_from_params(
+            params, self.cfg.beams, platform=self.device.platform
+        )
         # newest reconstructed sweep surfaced by _parse (per dispatch
         # that pushed a sub-sweep): (recon_plane (B,) i32, recon_pts
         # (B, 3) f32).  ``recon_log=True`` additionally appends every
@@ -513,7 +523,36 @@ class FleetFusedIngest:
             deskew_config_from_params,
         )
 
-        self._deskew = deskew_config_from_params(params, self.cfg.beams)
+        self._deskew = deskew_config_from_params(
+            params, self.cfg.beams, platform=platform
+        )
+        # in-program SLAM front-end (ops/ingest cfg.mapping): when the
+        # fused mapping route is active the per-stream MapState rides
+        # the ingest carry and the map update runs INSIDE the one fleet
+        # program — the engine surfaces the per-tick pose wires here
+        # (mapping/mapper.CarriedFleetMapper is the host-facing view)
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            fused_mapping_map_config,
+        )
+
+        self._mapping = fused_mapping_map_config(
+            params, self.cfg.beams, platform
+        )
+        if self._mapping is not None and self._deskew is None:
+            # the validator only sees the fields; THIS seam knows the
+            # reconstruction stage is absent — refuse loudly instead of
+            # building a program with no sweep for the mapper to absorb
+            raise ValueError(
+                "fused_mapping_backend='fused' requires deskew_enable "
+                "(the in-program mapper consumes the reconstructed "
+                "sweep the de-skew stage emits every tick)"
+            )
+        # newest per-stream (7,) int32 map wires from parsed dispatches
+        # ([live, tx, ty, θidx, score, n_valid, revision]);
+        # ``take_map_wires()`` drains the FRESH ones for the service's
+        # mapping seam, exactly like ``take_recon()``
+        self.last_map_wires: list = [None] * streams
+        self._map_fresh: list = [False] * streams
         # per-stream reconstructed-sweep surface (see FusedIngest):
         # ``last_recon[i]`` holds stream i's newest (plane, pts) pair,
         # ``take_recon()`` drains the ticks' FRESH reconstructions for
@@ -614,7 +653,7 @@ class FleetFusedIngest:
             fleet_ingest_config_for(
                 (Ans.MEASUREMENT,), self.timing, self.cfg,
                 max_nodes=self.max_nodes, max_revs=self.max_revs,
-                deskew=self._deskew,
+                deskew=self._deskew, mapping=self._mapping,
             ),
             self.streams,
         ))
@@ -659,9 +698,12 @@ class FleetFusedIngest:
             self._pending.clear()
             # the sub-sweep cache dies with the engines (the PR 9
             # `_streaming`-flag discipline: host mirrors of wiped
-            # device state restart with it)
+            # device state restart with it) — and so do the map wires:
+            # the in-carry MapState was just wiped with everything else
             self.last_recon = [None] * self.streams
             self._recon_fresh = [False] * self.streams
+            self.last_map_wires = [None] * self.streams
+            self._map_fresh = [False] * self.streams
 
     def _put_staging(self, buf, aux, *, super_step: bool = False) -> tuple:
         """EXPLICIT H2D staging of one dispatch's input planes — the
@@ -706,7 +748,7 @@ class FleetFusedIngest:
             tuple(sorted(have | set(need))), self.timing, self.cfg,
             max_nodes=self.max_nodes, max_revs=self.max_revs,
             emit_nodes=self.emit_nodes, slot_impl=self.slot_impl,
-            deskew=self._deskew,
+            deskew=self._deskew, mapping=self._mapping,
         )
 
     def precompile(self, formats, buckets: Optional[tuple] = None) -> None:
@@ -1032,6 +1074,12 @@ class FleetFusedIngest:
                     self._recon_fresh[i] = True
                     if self.recon_log:
                         self.recon_history[i].append(self.last_recon[i])
+                if res.map_wire is not None:
+                    # every in-program mapping tick emits a wire (an
+                    # idle tick's carries live=0): newest wins, the
+                    # freshness flag gates take_map_wires
+                    self.last_map_wires[i] = res.map_wire
+                    self._map_fresh[i] = True
                 self.nodes_decoded += res.nodes_appended
                 self.scans_completed += res.n_completed
                 self.revs_dropped += res.revs_dropped
@@ -1072,6 +1120,23 @@ class FleetFusedIngest:
                     self.last_recon[i] if self._recon_fresh[i] else None
                 )
                 self._recon_fresh[i] = False
+        return out
+
+    def take_map_wires(self) -> list:
+        """Drain the FRESH in-program map wires since the last call:
+        one (7,) int32 ``[live, tx_sub, ty_sub, theta_idx, score,
+        n_valid, revision]`` or None per stream (None = no mapping tick
+        parsed since — distinct from a parsed tick whose ``live`` flag
+        is 0, which the service must surface as "no pose this tick"
+        rather than republishing a stale one).  The mapping analog of
+        :meth:`take_recon`."""
+        out = []
+        with self._lock:
+            for i in range(self.streams):
+                out.append(
+                    self.last_map_wires[i] if self._map_fresh[i] else None
+                )
+                self._map_fresh[i] = False
         return out
 
     def submit(self, items) -> list:
@@ -1143,6 +1208,11 @@ class FleetFusedIngest:
             self._reset_next = [True] * self.streams
             self.last_recon = [None] * self.streams
             self._recon_fresh = [False] * self.streams
+            # the in-carry maps SURVIVE a stream reset (host-route
+            # semantics: scan stop/start resets decode, not the map) —
+            # only the stale wire stash is dropped
+            self.last_map_wires = [None] * self.streams
+            self._map_fresh = [False] * self.streams
 
     # -- checkpoint surface ------------------------------------------------
 
@@ -1468,3 +1538,102 @@ class FleetFusedIngest:
                 self._bases[i] = None
                 self._reset_next[i] = True
         return True
+
+    # -- in-program map surface (mapping/mapper.CarriedFleetMapper) --------
+
+    _MAP_KEYS = ("log_odds", "pose", "origin_xy", "revision")
+
+    def _require_mapping(self) -> None:
+        if self._mapping is None:
+            raise RuntimeError(
+                "this engine carries no in-program map (the fused "
+                "mapping route is off — fused_mapping_backend)"
+            )
+
+    def map_snapshot(self) -> dict:
+        """Host copy of every stream's in-carry MapState, in the
+        FleetMapper snapshot key space (stream-batched ``log_odds`` /
+        ``pose`` / ``origin_xy`` / ``revision``) so carried and
+        host-route map checkpoints interoperate."""
+        self._require_mapping()
+        with self._lock:
+            st = self._state
+            return {
+                k: np.asarray(getattr(st, f"map_{k}"))
+                for k in self._MAP_KEYS
+            }
+
+    def map_restore(self, core: dict) -> None:
+        """Install stream-batched MapState planes into the carry (shape
+        pre-validated by the caller — the carried mapper view mirrors
+        FleetMapper's reject-don't-crash contract).  Each leaf is an
+        explicit put at the live leaf's own sharding."""
+        self._require_mapping()
+        with self._lock:
+            st = self._state
+            leaves = {}
+            for k in self._MAP_KEYS:
+                cur = getattr(st, f"map_{k}")
+                leaves[f"map_{k}"] = self._jax.device_put(
+                    np.asarray(core[k]).astype(cur.dtype, copy=False),
+                    cur.sharding,
+                )
+            self._state = dataclasses.replace(st, **leaves)
+
+    def map_snapshot_stream(self, i: int) -> dict:
+        """One stream's in-carry MapState row (FleetMapper key space) —
+        one row gather + one explicit row fetch, the quarantine-
+        checkpoint discipline."""
+        self._require_mapping()
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        gather, _ = self._row_ops()
+        with self._lock:
+            row = self._jax.device_get(
+                gather(self._state, self._put_row_index(i))
+            )
+        return {
+            k: np.array(getattr(row, f"map_{k}")) for k in self._MAP_KEYS
+        }
+
+    def map_restore_stream(self, i: int, core: dict) -> None:
+        """Install one stream's MapState row into the carry with every
+        other stream — and the decode/filter rows of THIS stream —
+        untouched (row gather, explicit row puts, row scatter: the same
+        warmed programs the per-stream checkpoint path runs)."""
+        self._require_mapping()
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        gather, scatter = self._row_ops()
+        with self._lock:
+            idx = self._put_row_index(i)
+            cur = gather(self._state, idx)
+            rows = {}
+            for k in self._MAP_KEYS:
+                leaf = getattr(cur, f"map_{k}")
+                rows[f"map_{k}"] = self._jax.device_put(
+                    np.asarray(core[k]).astype(leaf.dtype, copy=False),
+                    leaf.sharding,
+                )
+            self._state = scatter(
+                self._state, dataclasses.replace(cur, **rows), idx
+            )
+
+    def map_reanchor_stream(self, i: int, pose: np.ndarray) -> None:
+        """Rewrite one stream's in-carry front-end pose (the loop-
+        closure re-anchor path, FleetMapper.reanchor_stream's carried
+        twin): row gather, one explicit (3,) put, row scatter."""
+        self._require_mapping()
+        if not (0 <= i < self.streams):
+            raise IndexError(f"stream {i} out of range [0, {self.streams})")
+        gather, scatter = self._row_ops()
+        with self._lock:
+            idx = self._put_row_index(i)
+            cur = gather(self._state, idx)
+            row = dataclasses.replace(
+                cur,
+                map_pose=self._jax.device_put(
+                    np.asarray(pose, np.int32), cur.map_pose.sharding
+                ),
+            )
+            self._state = scatter(self._state, row, idx)

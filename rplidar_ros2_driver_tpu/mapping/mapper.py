@@ -82,6 +82,41 @@ def resolve_match_backend(
     return "xla"
 
 
+def resolve_fused_mapping_backend(
+    requested: str, platform: Optional[str] = None
+) -> str:
+    """Resolve the ``auto`` fused-mapping route (the PR 13 seam:
+    ``host`` keeps the two-dispatch golden path — ingest dispatch, then
+    a separate FleetMapper dispatch fed from ``take_recon()`` — while
+    ``fused`` threads the MapState through the ingest carry so bytes ->
+    decode -> de-skewed sweep -> pose -> map update is ONE compiled
+    program per super-tick per shard).  Explicit requests pass through;
+    ``auto`` stays on the host route until an on-chip
+    ``fused_mapping_ab`` artifact (bench.py --config 18) clears the
+    standing decision bar — on a linkless CPU rig the saved dispatch is
+    microseconds of overhead weather, so CPU evidence can never flip
+    it (scripts/decide_backends.py clamps the key to TPU records)."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "host"
+
+
+def fused_mapping_map_config(
+    params, beams: int, platform: Optional[str] = None
+) -> Optional[MapConfig]:
+    """The in-program mapper's MapConfig, or None when the fused
+    mapping route is off (the one place the seam resolution meets the
+    params -> MapConfig mapping, so the ingest engines and the service
+    cannot drift on geometry)."""
+    backend = resolve_fused_mapping_backend(
+        getattr(params, "fused_mapping_backend", "auto"), platform
+    )
+    if backend != "fused" or not getattr(params, "map_enable", False):
+        return None
+    return map_config_from_params(params, beams, platform=platform)
+
+
 def map_config_from_params(
     params, beams: int = 2048, platform: Optional[str] = None
 ) -> MapConfig:
@@ -115,6 +150,67 @@ def map_config_from_params(
             getattr(params, "match_backend", "auto"), platform
         ),
     )
+
+
+def recon_input_planes(recons, streams: int, beams: int):
+    """The ONE reconstructed-sweep -> mapper-input assembly (points /
+    masks / live from a ``take_recon()`` drain), shared by the host
+    mapping route (ShardedFilterService._map_tick_recon feeding
+    submit_points) and the fused route's loop-tap stash
+    (CarriedFleetMapper.absorb_wires) — the two routes must see the
+    IDENTICAL scan windows, so the layout/threshold lives exactly
+    once."""
+    points = np.zeros((streams, beams, 2), np.float32)
+    masks = np.zeros((streams, beams), bool)
+    live = np.zeros((streams,), np.int32)
+    for i, rec in enumerate(recons):
+        if rec is None:
+            continue
+        _plane, pts = rec
+        points[i] = pts[:, :2]
+        masks[i] = pts[:, 2] > 0.5
+        live[i] = 1
+    return points, masks, live
+
+
+def clamp_pose_q(pose_q, cfg: MapConfig) -> np.ndarray:
+    """The ONE host-side pose normalization (clip translation into the
+    map, wrap heading onto the rotation table) — shared by both mapper
+    faces' ``reanchor_stream`` so the two mapping routes can never
+    re-anchor to different quantized poses after the same closure."""
+    pose = np.asarray(pose_q, np.int32).reshape(3)
+    lim = cfg.t_limit_sub
+    return np.asarray([
+        np.clip(pose[0], -lim, lim),
+        np.clip(pose[1], -lim, lim),
+        np.mod(pose[2], cfg.theta_divisions),
+    ], np.int32)
+
+
+def is_carried(mapper) -> bool:
+    """Is this mapper face the dispatch-free carried view (its map rows
+    live inside the ingest carry)?  THE one spelling of the convention
+    — every checkpoint/failover site that must skip the duplicate
+    mapper-side row pull tests through here, so a tag rename or a
+    second carried face cannot silently re-enable the double
+    transport."""
+    return getattr(mapper, "backend", None) == "carried"
+
+
+def carried_map_row(ingest_snap: dict) -> dict:
+    """Rekey one per-stream INGEST snapshot's in-carry map planes
+    (``ingest.map_*``, snapshot v3) into the FleetMapper stream-row
+    checkpoint format — the failover/quarantine transport carries the
+    map INSIDE the ingest unit on the fused route, so consumers that
+    need the mapper-format row (ElasticFleetService._restore_into)
+    derive it instead of pulling the same planes from the device a
+    second time."""
+    row = {
+        k: np.asarray(ingest_snap[f"ingest.map_{k}"])
+        for k in ("log_odds", "pose", "origin_xy", "revision")
+    }
+    row["version"] = np.asarray(MAP_STATE_VERSION, np.int32)
+    return row
 
 
 @dataclasses.dataclass(frozen=True)
@@ -551,13 +647,7 @@ class FleetMapper:
         guard-safe in steady state)."""
         if not (0 <= i < self.streams):
             raise IndexError(f"stream {i} out of range [0, {self.streams})")
-        pose = np.asarray(pose_q, np.int32).reshape(3)
-        lim = self.cfg.t_limit_sub
-        pose = np.asarray([
-            np.clip(pose[0], -lim, lim),
-            np.clip(pose[1], -lim, lim),
-            np.mod(pose[2], self.cfg.theta_divisions),
-        ], np.int32)
+        pose = clamp_pose_q(pose_q, self.cfg)
         with self._lock:
             if self.backend == "fused":
                 gather, scatter = self._row_ops()
@@ -608,3 +698,189 @@ class FleetMapper:
         with self._lock:
             self._states = self._jax.device_put(got, self.device)
         return True
+
+
+class CarriedFleetMapper:
+    """The mapper face of the FUSED mapping route (PR 13,
+    ``fused_mapping_backend='fused'``): the per-stream MapState lives
+    INSIDE the fleet ingest carry (ops/ingest ``map_*`` leaves) and the
+    match+update step runs inside the one compiled ingest program — so
+    this class dispatches nothing.  It exists so every consumer that
+    speaks FleetMapper — the loop-closure engine's observation tap, the
+    quarantine/rejoin checkpoints, the elastic pod's failover
+    transport, /diagnostics — keeps working unchanged against the
+    in-carry map:
+
+      * ``absorb_wires`` turns the engine's per-tick map wires
+        (FleetFusedIngest.take_map_wires) into the PoseEstimates the
+        host route's ``submit_points`` would have returned, and stashes
+        the reconstructed-sweep inputs for the loop tap exactly like
+        ``submit_points`` stashes its own;
+      * the checkpoint surface (snapshot/restore, full and per-stream)
+        reads and writes the carry through the engine's row ops, in the
+        SAME key space + schema version as FleetMapper — carried and
+        host-route map checkpoints interoperate byte-for-byte;
+      * ``reanchor_stream`` rewrites the in-carry pose row (the
+        loop-closure re-anchor path).
+
+    ``submit``/``submit_points`` raise: with the fused route the hot
+    path has no separate mapper dispatch to drive.
+    """
+
+    backend = "carried"
+
+    def __init__(self, params, engine, *, beams: Optional[int] = None):
+        if engine._mapping is None:
+            raise ValueError(
+                "CarriedFleetMapper needs an engine built with the "
+                "fused mapping route active (fused_mapping_backend="
+                "'fused' + map_enable)"
+            )
+        self.engine = engine
+        self.streams = engine.streams
+        self.cfg: MapConfig = engine._mapping
+        self.device = engine.device  # None on a mesh (loop picks its own)
+        if beams is not None and beams != self.cfg.beams:
+            raise ValueError(
+                f"carried mapper beams {self.cfg.beams} != service "
+                f"beams {beams}"
+            )
+        self.ticks = 0
+        self.dispatch_count = 0  # structural: mapping rides ingest dispatches
+        self.matches = 0
+        self.last_estimates: list[Optional[PoseEstimate]] = (
+            [None] * self.streams
+        )
+        self.last_inputs: Optional[tuple] = None
+
+    def precompile(self) -> None:
+        """No-op: the mapping program is the ingest program, warmed by
+        FleetFusedIngest.precompile."""
+
+    # -- hot path (fed by the service from the engine wires) ----------------
+
+    def submit(self, outputs) -> list:
+        raise RuntimeError(
+            "the carried mapper has no submit path: mapping runs inside "
+            "the fused ingest program (absorb_wires consumes its wires)"
+        )
+
+    def submit_points(self, points, masks, live) -> list:
+        raise RuntimeError(
+            "the carried mapper has no submit path: mapping runs inside "
+            "the fused ingest program (absorb_wires consumes its wires)"
+        )
+
+    def absorb_wires(
+        self, wires: list, recons: list
+    ) -> list[Optional[PoseEstimate]]:
+        """One service tick of the fused mapping route: ``wires`` is
+        FleetFusedIngest.take_map_wires()'s drain, ``recons``
+        take_recon()'s.  Returns one Optional[PoseEstimate] per stream
+        — None where no mapping tick was parsed OR the parsed tick's
+        ``live`` flag is 0 (an all-idle tick must never republish the
+        previous tick's poses as current — the PR 10 ``last_poses``
+        fix, extended to the in-program path), and stashes the
+        reconstructed endpoints as ``last_inputs`` so the loop-closure
+        tap sees exactly the scan window the in-program matcher saw."""
+        if len(wires) != self.streams or len(recons) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} wires + recons, got "
+                f"{len(wires)}/{len(recons)}"
+            )
+        self.last_inputs = recon_input_planes(
+            recons, self.streams, self.cfg.beams
+        )
+        self.ticks += 1
+        estimates: list[Optional[PoseEstimate]] = []
+        for i, w in enumerate(wires):
+            if w is None or int(w[0]) == 0:
+                estimates.append(None)
+                continue
+            pose_q = np.asarray(w[1:4], np.int32)
+            x, y, th = pose_to_metric(pose_q, self.cfg)
+            est = PoseEstimate(
+                x_m=x, y_m=y, theta_rad=th,
+                score=int(w[4]),
+                matched_points=int(w[5]),
+                revision=int(w[6]),
+                pose_q=pose_q,
+            )
+            estimates.append(est)
+            self.last_estimates[i] = est
+            if est.score > 0:
+                self.matches += 1
+        return estimates
+
+    # -- checkpoint surface (FleetMapper's formats, carried state) ----------
+
+    _STREAM_KEYS = FleetMapper._STREAM_KEYS
+
+    def reset(self) -> None:
+        """Cold reset of every stream's in-carry map and pose (the
+        host-route mapper.reset() analog; fresh MapState is all-zero,
+        so the restore is one placed zero-fill per plane)."""
+        g = self.cfg.grid
+        self.engine.map_restore({
+            "log_odds": np.zeros((self.streams, g, g), np.int32),
+            "pose": np.zeros((self.streams, 3), np.int32),
+            "origin_xy": np.zeros((self.streams, 2), np.float32),
+            "revision": np.zeros((self.streams,), np.int32),
+        })
+        self.last_estimates = [None] * self.streams
+
+    def snapshot(self) -> dict[str, np.ndarray]:
+        snap = self.engine.map_snapshot()
+        snap["version"] = np.asarray(MAP_STATE_VERSION, np.int32)
+        return snap
+
+    def restore(self, snap: Optional[dict]) -> bool:
+        if snap is None:
+            self.reset()
+            return False
+        if int(np.asarray(snap.get("version", -1))) != MAP_STATE_VERSION:
+            log.warning(
+                "rejecting map snapshot with schema version %s (want %d)",
+                snap.get("version"), MAP_STATE_VERSION,
+            )
+            return False
+        if FleetMapper._shape_mismatch(
+            snap, self.streams, self.cfg.grid
+        ) is not None:
+            log.warning("rejecting incompatible carried-map snapshot")
+            return False
+        self.engine.map_restore({
+            k: np.asarray(snap[k]) for k in self._STREAM_KEYS
+        })
+        return True
+
+    def snapshot_stream(self, i: int) -> dict:
+        snap = self.engine.map_snapshot_stream(i)
+        snap["version"] = np.asarray(MAP_STATE_VERSION, np.int32)
+        return snap
+
+    def restore_stream(self, i: int, snap: dict) -> bool:
+        if int(np.asarray(snap.get("version", -1))) != MAP_STATE_VERSION:
+            log.warning(
+                "rejecting stream map snapshot with schema version %s "
+                "(want %d)", snap.get("version"), MAP_STATE_VERSION,
+            )
+            return False
+        expected = MapState.shapes(self.cfg.grid)
+        got = {
+            k: tuple(np.asarray(v).shape)
+            for k, v in snap.items() if k != "version"
+        }
+        if expected != got:
+            log.warning(
+                "rejecting incompatible stream map snapshot (%s != %s)",
+                got, expected,
+            )
+            return False
+        self.engine.map_restore_stream(
+            i, {k: np.asarray(snap[k]) for k in self._STREAM_KEYS}
+        )
+        return True
+
+    def reanchor_stream(self, i: int, pose_q) -> None:
+        self.engine.map_reanchor_stream(i, clamp_pose_q(pose_q, self.cfg))

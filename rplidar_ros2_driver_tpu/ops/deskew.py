@@ -100,8 +100,22 @@ class DeskewConfig:
     range_min_m: float = 0.15
     range_max_m: float = 40.0
     intensity_min: float = 0.0
+    # kernel lowering of the two dense hot loops (the sub-sweep
+    # rasterizer / profile beam-min and the shift-search SAD): "xla" =
+    # the jnp arms below, "pallas" = the VMEM-tiled kernels
+    # (ops/pallas_deskew.py, interpret mode off-TPU).  Bit-exact either
+    # way — int32 min/sum are evaluation-order independent — so the
+    # seam is purely a performance choice (resolve_deskew_backend
+    # holds the auto mapping and its evidence bar).
+    backend: str = "xla"
 
     def __post_init__(self):
+        if self.backend not in ("xla", "pallas"):
+            raise ValueError(
+                "deskew backend must be 'xla' or 'pallas' once resolved "
+                "(the 'auto' spelling resolves in resolve_deskew_backend "
+                "before DeskewConfig is built)"
+            )
         d = self.profile_beams
         if d < 64 or d > 1024 or d & (d - 1):
             raise ValueError(
@@ -133,7 +147,25 @@ class DeskewConfig:
             raise ValueError("deskew min_valid must be >= 1")
 
 
-def deskew_config_from_params(params, beams: int) -> Optional[DeskewConfig]:
+def resolve_deskew_backend(
+    requested: str, platform: Optional[str] = None
+) -> str:
+    """Resolve the ``auto`` de-skew kernel lowering (mirrors
+    mapping/mapper.resolve_match_backend; explicit requests pass
+    through).  ``auto`` stays on the XLA arm until an on-chip artifact
+    clears the standing decision bar — off-TPU the Pallas arm runs in
+    INTERPRET mode (ops/pallas_kernels._lowering_dispatch), which
+    measures the emulator, not the datapath, so CPU evidence can never
+    flip this."""
+    if requested != "auto":
+        return requested
+    del platform
+    return "xla"
+
+
+def deskew_config_from_params(
+    params, beams: int, platform: Optional[str] = None
+) -> Optional[DeskewConfig]:
     """The one params -> DeskewConfig mapping (None when disabled), so
     the engines, the service, replay and the bench cannot drift on
     geometry.  The clip fold mirrors the chain's clip params — the
@@ -149,6 +181,9 @@ def deskew_config_from_params(params, beams: int) -> Optional[DeskewConfig]:
         range_min_m=float(params.range_clip_min_m),
         range_max_m=float(params.range_clip_max_m),
         intensity_min=float(params.intensity_min),
+        backend=resolve_deskew_backend(
+            getattr(params, "deskew_backend", "auto"), platform
+        ),
     )
 
 
@@ -197,10 +232,22 @@ def profile_from_nodes(angle, dist, valid, cfg: DeskewConfig, block: int = 64):
     (RECON_EMPTY where no return).  Dense tiled masked-min, the fused
     path's scatter-free formulation (ops/filters.grid_resample_batch):
     min is order-independent over int32, so any evaluation order — XLA,
-    vmap, numpy — lands the identical profile."""
+    vmap, numpy, the Pallas kernel — lands the identical profile.
+    ``cfg.backend`` routes the min through the VMEM-tiled kernel
+    (ops/pallas_deskew.beam_min_pallas) or the jnp arm below."""
     d = cfg.profile_beams
     b = beam_of(angle, d)
     live = valid & (dist > 0)
+    if cfg.backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_deskew import (
+            beam_min_pallas,
+        )
+
+        # a dead node contributes the EMPTY min-identity whatever its
+        # beam — value masking is exactly the jnp arm's compare mask
+        return beam_min_pallas(
+            b, jnp.where(live, dist, RECON_EMPTY), d
+        )
     outs = []
     for t0 in range(0, d, block):
         bt = jnp.arange(t0, min(t0 + block, d), dtype=jnp.int32)
@@ -249,7 +296,20 @@ def estimate_motion(prev_prof, cur_prof, cfg: DeskewConfig):
         )
 
     # static unroll over the (small) candidate set: scores in |s| order
-    scores = jnp.stack([sad_of(int(s)) for s in cands_np])
+    # (the rolls are static slices either way — building the (C, D)
+    # rolled plane in shared code keeps the candidate order, and
+    # therefore first-min-wins tie-breaking, backend-independent)
+    if cfg.backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_deskew import (
+            shift_sad_pallas,
+        )
+
+        rolled = jnp.stack([
+            jnp.roll(cur_prof, int(s)) for s in cands_np
+        ])
+        scores = shift_sad_pallas(prev_prof, rolled, cfg.min_valid, mt)
+    else:
+        scores = jnp.stack([sad_of(int(s)) for s in cands_np])
     k = jnp.argmin(scores).astype(jnp.int32)   # first-min-wins: ties -> s=0
     s_best = jnp.take(cands, k)
     usable = jnp.take(scores, k) != RECON_EMPTY
@@ -333,6 +393,12 @@ def rasterize_subsweep(angle, dist, quality, valid, cfg: DeskewConfig,
     beam = beam_of(angle, b)
     packed = (dist << _QUAL_BITS) | jnp.clip(quality, 0, 255)
     packed = jnp.where(ok, packed, RECON_EMPTY)
+    if cfg.backend == "pallas":
+        from rplidar_ros2_driver_tpu.ops.pallas_deskew import (
+            beam_min_pallas,
+        )
+
+        return beam_min_pallas(beam, packed, b)
     outs = []
     for t0 in range(0, b, block):
         bt = jnp.arange(t0, min(t0 + block, b), dtype=jnp.int32)

@@ -69,6 +69,11 @@ import numpy as np
 from rplidar_ros2_driver_tpu.core.types import MAX_SCAN_NODES, ScanBatch
 from rplidar_ros2_driver_tpu.ops import deskew as deskewmod
 from rplidar_ros2_driver_tpu.ops.deskew import RECON_EMPTY, DeskewConfig
+from rplidar_ros2_driver_tpu.ops.scan_match import (
+    MapConfig,
+    MapState,
+    _map_match_step_impl,
+)
 from rplidar_ros2_driver_tpu.ops.filters import (
     FilterConfig,
     FilterOutput,
@@ -112,6 +117,35 @@ class IngestConfig:
     # keeps the core byte-identical to the pre-deskew program (no extra
     # state planes, no extra outputs)
     deskew: Optional[DeskewConfig] = None
+    # in-program SLAM front-end (ops/scan_match.py): when set, every
+    # tick's reconstructed sweep is matched against the stream's
+    # log-odds map and the map updated INSIDE this program — the
+    # MapState rides the ingest carry, so bytes -> decode -> de-skewed
+    # sweep -> pose -> map update is one dispatch (and one scan carry
+    # through the super-tick).  Requires ``deskew`` — the reconstructed
+    # sweep IS the mapper feed.
+    mapping: Optional[MapConfig] = None
+
+    def __post_init__(self):
+        _check_mapping_geometry(self.mapping, self.deskew)
+
+
+def _check_mapping_geometry(mapping, deskew) -> None:
+    """Shared ingest-config invariant: the in-program mapper consumes
+    the reconstructed sweep, so it needs the de-skew/reconstruction
+    stage AND the same beam grid the sweep is rasterized on."""
+    if mapping is None:
+        return
+    if deskew is None:
+        raise ValueError(
+            "ingest mapping requires the de-skew/reconstruction stage "
+            "(cfg.deskew): the reconstructed sweep is the mapper feed"
+        )
+    if mapping.beams != deskew.recon_beams:
+        raise ValueError(
+            f"ingest mapping beam grid ({mapping.beams}) must equal the "
+            f"reconstruction beam grid ({deskew.recon_beams})"
+        )
 
 
 def ingest_config_for(
@@ -124,6 +158,7 @@ def ingest_config_for(
     emit_nodes: bool = False,
     slot_impl: str = "auto",
     deskew: Optional[DeskewConfig] = None,
+    mapping: Optional[MapConfig] = None,
 ) -> IngestConfig:
     """Build the static config for one (answer type, timing desc, chain)."""
     at = Ans(ans_type)
@@ -141,6 +176,7 @@ def ingest_config_for(
         filter=filter_cfg,
         slot_impl=slot_impl,
         deskew=deskew,
+        mapping=mapping,
     )
 
 
@@ -168,6 +204,15 @@ class IngestState:
     recon_pos: Optional[jax.Array] = None     # int32 cumulative push count
     deskew_prof: Optional[jax.Array] = None   # (D,) int32 prev-rev profile
     deskew_motion: Optional[jax.Array] = None  # (3,) int32 [dx,dy,dθ_q16]
+    # in-program SLAM front-end planes (cfg.mapping; None otherwise) —
+    # the MapState of ops/scan_match.py flattened into the ingest carry
+    # so the map update rides the same donated scan state the decode
+    # carries do (key names mirror MapState's fields behind the "map_"
+    # prefix: the per-stream snapshot transport rekeys them 1:1)
+    map_log_odds: Optional[jax.Array] = None   # (G, G) int32 Q10
+    map_pose: Optional[jax.Array] = None       # (3,) int32 [tx, ty, θidx]
+    map_origin_xy: Optional[jax.Array] = None  # (2,) float32
+    map_revision: Optional[jax.Array] = None   # () int32
 
 
 def create_ingest_state(
@@ -206,6 +251,26 @@ def create_ingest_state(
         deskew_motion=(
             jnp.zeros((3,), jnp.int32) if dsk is not None else None
         ),
+        **_fresh_map_leaves(cfg.mapping),
+    )
+
+
+def _fresh_map_leaves(mcfg: Optional[MapConfig], streams: int = 0) -> dict:
+    """Fresh in-carry MapState leaves (MapState.create's exact values —
+    all zeros), stream-batched when ``streams`` > 0; all-None when the
+    in-program mapper is off (the state structure stays jit/donation-
+    stable per compiled config, like the de-skew planes)."""
+    if mcfg is None:
+        return dict(
+            map_log_odds=None, map_pose=None,
+            map_origin_xy=None, map_revision=None,
+        )
+    lead = (streams,) if streams else ()
+    return dict(
+        map_log_odds=jnp.zeros(lead + (mcfg.grid, mcfg.grid), jnp.int32),
+        map_pose=jnp.zeros(lead + (3,), jnp.int32),
+        map_origin_xy=jnp.zeros(lead + (2,), jnp.float32),
+        map_revision=jnp.zeros(lead, jnp.int32),
     )
 
 
@@ -225,6 +290,8 @@ def create_ingest_state(
 #       motion_dx_q2, motion_dy_q2, motion_dθ_q16]
 #   out_wires: (R, wire_output_len(filter)) float32
 #   (cfg.deskew only) recon_plane (B,) int32 + recon_pts (B, 3) f32
+#   (cfg.mapping only) map_wire (7,) int32:
+#     [live, tx_sub, ty_sub, theta_idx, score, n_valid, revision]
 #   (emit_nodes only) nodes (R, max_nodes, 4) f32 + node_ts (R, max_nodes)
 
 _META = 4
@@ -258,6 +325,9 @@ class IngestBatchResult:
     recon_pushed: bool = False       # this dispatch appended a sub-sweep
     recon_valid: int = 0             # beams carrying a return in the sweep
     deskew_motion: Optional[np.ndarray] = None  # (3,) int32 estimate
+    # in-program mapping surface (cfg.mapping only): the tick's map
+    # wire [live, tx_sub, ty_sub, theta_idx, score, n_valid, revision]
+    map_wire: Optional[np.ndarray] = None  # (7,) int32
 
 
 def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
@@ -302,6 +372,10 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
         recon_plane = np.asarray(res[idx])
         recon_pts = np.asarray(res[idx + 1])
         idx += 2
+    map_wire = None
+    if cfg.mapping is not None:
+        map_wire = np.asarray(res[idx])
+        idx += 1
     nodes = node_ts = None
     if cfg.emit_nodes:
         # graftlint: policed — debug node planes ride f32 by wire
@@ -324,6 +398,7 @@ def unpack_ingest_result(res, cfg: IngestConfig) -> IngestBatchResult:
         recon_pushed=recon_pushed,
         recon_valid=recon_valid,
         deskew_motion=motion,
+        map_wire=map_wire,
     )
 
 
@@ -419,6 +494,7 @@ class _CoreResult(NamedTuple):
     deskew_motion: Optional[jax.Array] = None
     recon_plane: Optional[jax.Array] = None
     recon_pts: Optional[jax.Array] = None
+    recon_pushed: Optional[jax.Array] = None  # bool — sub-sweep appended
 
 
 def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResult:
@@ -709,18 +785,72 @@ def _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift) -> _CoreResul
         deskew_motion=new_motion,
         recon_plane=recon_plane,
         recon_pts=recon_pts,
+        recon_pushed=recon_pushed,
     )
 
 
-def _core_outputs(cfg, core: _CoreResult) -> tuple:
+def _map_update_tick(cfg, state: IngestState, core: _CoreResult):
+    """The in-program SLAM front-end tick (cfg.mapping): match the
+    tick's reconstructed sweep against the stream's in-carry map and
+    absorb it — ops/scan_match._map_match_step_impl, the SAME step the
+    host-route FleetMapper dispatches separately, gated on this tick
+    actually pushing a sub-sweep (``live = recon_pushed``, exactly the
+    freshness contract of FleetFusedIngest.take_recon: an idle tick's
+    map and pose pass through untouched, so the fused and host mapping
+    routes land byte-identical MapState trajectories).  The Cartesian
+    endpoints are ``core.recon_pts`` — the very planes the host route
+    fetches and feeds back, decoded by the same jitted helpers — so the
+    one f32 quantizing multiply downstream sees identical inputs on
+    both routes.
+
+    Returns the advanced MapState and the (7,) int32 map wire
+    ``[live, tx_sub, ty_sub, theta_idx, score, n_valid, revision]``.
+    """
+    mstate = MapState(
+        log_odds=state.map_log_odds,
+        pose=state.map_pose,
+        origin_xy=state.map_origin_xy,
+        revision=state.map_revision,
+    )
+    live = core.recon_pushed.astype(jnp.int32)
+    pts = core.recon_pts
+    mstate, wire5 = _map_match_step_impl(
+        mstate, pts[:, :2], pts[:, 2] > 0.5, live, cfg.mapping
+    )
+    map_wire = jnp.concatenate([
+        live[None], wire5, mstate.revision[None]
+    ]).astype(jnp.int32)
+    return mstate, map_wire
+
+
+def _map_state_leaves(mstate: Optional[MapState]) -> dict:
+    """MapState -> the flat ``map_*`` IngestState leaves (all-None when
+    the in-program mapper is off)."""
+    if mstate is None:
+        return dict(
+            map_log_odds=None, map_pose=None,
+            map_origin_xy=None, map_revision=None,
+        )
+    return dict(
+        map_log_odds=mstate.log_odds,
+        map_pose=mstate.pose,
+        map_origin_xy=mstate.origin_xy,
+        map_revision=mstate.revision,
+    )
+
+
+def _core_outputs(cfg, core: _CoreResult, map_wire=None) -> tuple:
     """The one result-arity rule, shared by the single-stream step and
     every fleet lane: ``(meta, out_wires[, recon_plane, recon_pts]
-    [, nodes, node_ts])`` — reconstruction planes appear iff
-    ``cfg.deskew``, the debug node surface iff ``cfg.emit_nodes``.  The
-    unpackers invert this ordering; keep them in lockstep."""
+    [, map_wire][, nodes, node_ts])`` — reconstruction planes appear
+    iff ``cfg.deskew``, the map wire iff ``cfg.mapping``, the debug
+    node surface iff ``cfg.emit_nodes``.  The unpackers invert this
+    ordering; keep them in lockstep."""
     out = [core.meta, core.out_wires]
     if cfg.deskew is not None:
         out += [core.recon_plane, core.recon_pts]
+    if cfg.mapping is not None:
+        out += [map_wire]
     if cfg.emit_nodes:
         out += [core.nodes, core.node_ts]
     return tuple(out)
@@ -818,6 +948,10 @@ def fused_ingest_step(
     ts_c = ts2[order].reshape(n)
 
     core = _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift)
+    map_wire = None
+    mstate = None
+    if cfg.mapping is not None:
+        mstate, map_wire = _map_update_tick(cfg, state, core)
     new_state = IngestState(
         filter=core.filter,
         partial=core.partial,
@@ -834,8 +968,9 @@ def fused_ingest_step(
         recon_pos=core.recon_pos,
         deskew_prof=core.deskew_prof,
         deskew_motion=core.deskew_motion,
+        **_map_state_leaves(mstate),
     )
-    return (new_state,) + _core_outputs(cfg, core)
+    return (new_state,) + _core_outputs(cfg, core, map_wire)
 
 
 # ---------------------------------------------------------------------------
@@ -892,6 +1027,14 @@ class FleetIngestConfig:
     # fixed-point de-skew + sweep reconstruction (ops/deskew.py); every
     # lane carries its own ring/profile/motion planes when set
     deskew: Optional[DeskewConfig] = None
+    # in-program SLAM front-end (see IngestConfig.mapping): every lane
+    # carries its own MapState planes and the per-tick map update runs
+    # inside the one fleet program — one dispatch per (super-)tick per
+    # shard covers ingest AND mapping.  Requires ``deskew``.
+    mapping: Optional[MapConfig] = None
+
+    def __post_init__(self):
+        _check_mapping_geometry(self.mapping, self.deskew)
 
 
 def fleet_ingest_config_for(
@@ -904,6 +1047,7 @@ def fleet_ingest_config_for(
     emit_nodes: bool = False,
     slot_impl: str = "fori",
     deskew: Optional[DeskewConfig] = None,
+    mapping: Optional[MapConfig] = None,
 ) -> FleetIngestConfig:
     """Build the static config for one (format set, timing desc, chain)."""
     ats = tuple(Ans(a) for a in dict.fromkeys(formats))
@@ -921,6 +1065,7 @@ def fleet_ingest_config_for(
         filter=filter_cfg,
         slot_impl=slot_impl,
         deskew=deskew,
+        mapping=mapping,
     )
 
 
@@ -969,6 +1114,7 @@ def create_fleet_ingest_state(
         deskew_motion=(
             jnp.zeros((streams, 3), jnp.int32) if dsk is not None else None
         ),
+        **_fresh_map_leaves(cfg.mapping, streams),
     )
 
 
@@ -1173,6 +1319,10 @@ def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
     ts_c = ts2.reshape(-1)[order]
 
     core = _segment_filter_core(cfg, state, batch4, ts_c, nv, base_shift)
+    map_wire = None
+    mstate = None
+    if cfg.mapping is not None:
+        mstate, map_wire = _map_update_tick(cfg, state, core)
     new_state = IngestState(
         filter=core.filter,
         partial=core.partial,
@@ -1189,8 +1339,9 @@ def _fleet_stream_step(cfg: FleetIngestConfig, state: IngestState, frames, aux):
         recon_pos=core.recon_pos,
         deskew_prof=core.deskew_prof,
         deskew_motion=core.deskew_motion,
+        **_map_state_leaves(mstate),
     )
-    return (new_state,) + _core_outputs(cfg, core)
+    return (new_state,) + _core_outputs(cfg, core, map_wire)
 
 
 def _fleet_tick(cfg: FleetIngestConfig, state: IngestState, frames, aux):
@@ -1272,7 +1423,8 @@ def super_fleet_ingest_step(
 
 
 def _parse_fleet_rows(
-    meta, wires, nodes_all, ts_all, cfg, recon_all=None, rpts_all=None
+    meta, wires, nodes_all, ts_all, cfg, recon_all=None, rpts_all=None,
+    map_all=None,
 ) -> list:
     """One :class:`IngestBatchResult` per stream row of one tick's
     materialized result planes (the shared tail of the fleet and
@@ -1305,6 +1457,8 @@ def _parse_fleet_rows(
                 ),
                 "recon_pts": rpts_all[i] if rpts_all is not None else None,
             }
+        if cfg.mapping is not None and map_all is not None:
+            recon_kw["map_wire"] = np.asarray(map_all[i], np.int32)
         out.append(IngestBatchResult(
             n_completed=n,
             revs_dropped=int(mrow[1]),
@@ -1351,12 +1505,19 @@ def unpack_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
         recon_all = np.asarray(res[idx])
         rpts_all = np.asarray(res[idx + 1])
         idx += 2
+    map_all = None
+    if cfg.mapping is not None:
+        # the in-program mapping surface (one small (streams, 7) int32
+        # plane — the pose/score wires the host route used to fetch
+        # from its separate mapper dispatch)
+        map_all = np.asarray(res[idx])
+        idx += 1
     nodes_all = ts_all = None
     if cfg.emit_nodes:
         nodes_all = np.asarray(res[idx])
         ts_all = np.asarray(res[idx + 1])
     return _parse_fleet_rows(
-        meta, wires, nodes_all, ts_all, cfg, recon_all, rpts_all
+        meta, wires, nodes_all, ts_all, cfg, recon_all, rpts_all, map_all
     )
 
 
@@ -1382,6 +1543,10 @@ def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
         recon_all = np.asarray(res[idx])
         rpts_all = np.asarray(res[idx + 1])
         idx += 2
+    map_all = None
+    if cfg.mapping is not None:
+        map_all = np.asarray(res[idx])
+        idx += 1
     nodes_all = ts_all = None
     if cfg.emit_nodes:
         nodes_all = np.asarray(res[idx])
@@ -1395,6 +1560,7 @@ def unpack_super_fleet_ingest_result(res, cfg: FleetIngestConfig) -> list:
             cfg,
             recon_all[t] if recon_all is not None else None,
             rpts_all[t] if rpts_all is not None else None,
+            map_all[t] if map_all is not None else None,
         )
         for t in range(meta.shape[0])
     ]

@@ -1,0 +1,235 @@
+"""Pallas TPU kernels for the de-skew/reconstruction hot loops.
+
+The PR 13 fusion (mapping threaded through the ingest carry) makes the
+de-skew stage's two dense loops the ingest program's exposed hot spots:
+
+  * the **sub-sweep rasterizer / profile beam-min** — a per-beam
+    masked min over every node of the tick (ops/deskew.
+    rasterize_subsweep and profile_from_nodes share the formulation):
+    the XLA arm materializes (block, n) compare planes per beam block
+    in HBM; this kernel tiles the beam axis over VMEM and streams the
+    node planes through in chunks, so each (TB, n) compare never exists
+    outside the vector unit — the same VMEM-residency move as the PR 8
+    matcher kernels (ops/pallas_scan_match.py);
+  * the **de-skew shift search** — the (C, D) circular-shift SAD score
+    of ops/deskew.estimate_motion: one VMEM pass computes every
+    candidate's clamped mean-|Δ| score (the rolls are cheap static
+    slices and stay in shared jnp code so the candidate set cannot
+    drift between backends).
+
+EXACTNESS: both kernels are int32 min/sum/compare end to end — any
+evaluation order is bit-identical, so the Pallas arms are byte-equal to
+the XLA arms and the NumPy twins (ops/deskew_ref.py) by construction;
+tests/test_pallas_deskew.py pins all three.  ``DeskewConfig.backend``
+selects the lowering; every entry point rides ``_lowering_dispatch``
+(compiled on TPU, interpret mode off-TPU — CPU CI smokes the exact
+kernel code path), the GL010 discipline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from rplidar_ros2_driver_tpu.ops.filters import _INT_INF
+from rplidar_ros2_driver_tpu.ops.pallas_kernels import _lowering_dispatch
+
+_LANES = 128
+_EMPTY = _INT_INF  # == ops/deskew.RECON_EMPTY (aliased, not re-declared)
+
+
+def _pad_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+# ---------------------------------------------------------------------------
+# beam-min: per-beam masked min over one tick's node stream
+# ---------------------------------------------------------------------------
+
+
+def _beam_min_kernel(chunk: int, beam_ref, val_ref, out_ref):
+    """One (TB,) beam tile: min over every node whose beam index lands
+    in the tile.  The node planes ride VMEM whole (two int32 rows); the
+    (TB, chunk) compare lives only in registers/VPU per chunk."""
+    tb = out_ref.shape[1]
+    i = pl.program_id(0)
+    bt = i * tb + jax.lax.broadcasted_iota(jnp.int32, (tb, 1), 0)
+    n_pad = beam_ref.shape[1]
+
+    def body(k, acc):
+        b = beam_ref[0, pl.ds(k * chunk, chunk)]
+        v = val_ref[0, pl.ds(k * chunk, chunk)]
+        m = jnp.where(b[None, :] == bt, v[None, :], _EMPTY)
+        return jnp.minimum(acc, jnp.min(m, axis=1))
+
+    acc = jax.lax.fori_loop(
+        0, n_pad // chunk, body,
+        jnp.full((tb,), _EMPTY, jnp.int32),
+    )
+    out_ref[0, :] = acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nbeams", "block_beams", "chunk", "interpret")
+)
+def _beam_min_call(beam, values, nbeams, block_beams, chunk, interpret):
+    n_pad = beam.shape[1]
+    grid = (nbeams // block_beams,)
+    return pl.pallas_call(
+        functools.partial(_beam_min_kernel, chunk),
+        grid=grid,
+        in_specs=[
+            # constant index maps: the node planes load into VMEM once
+            # and stay resident across every beam tile (the PR 8
+            # fine-stage trick)
+            pl.BlockSpec(
+                (1, n_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, n_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_beams), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, nbeams), jnp.int32),
+        interpret=interpret,
+    )(beam, values)[0]
+
+
+def beam_min_pallas(
+    beam: jax.Array,
+    values: jax.Array,
+    nbeams: int,
+    *,
+    block_beams: int = 256,
+    chunk: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """(nbeams,) int32 per-beam min of ``values`` grouped by ``beam``
+    (RECON_EMPTY where no node touched a beam) — the Pallas twin of the
+    dense tiled min in ops/deskew.rasterize_subsweep /
+    profile_from_nodes.  ``beam`` is (n,) int32 in [0, nbeams) and
+    ``values`` (n,) int32 with RECON_EMPTY already marking dropped
+    nodes (min is order-independent over int32, so any tiling is
+    bit-identical).
+
+    ``interpret=None`` (default) resolves per LOWERING platform
+    (``_lowering_dispatch``), so the same traced function is correct on
+    a TPU target and a CPU target alike."""
+    n = beam.shape[0]
+    # node padding: beam -1 never matches a tile row, value EMPTY is
+    # the min identity — either alone suffices, both keep it obvious
+    n_pad = max(_pad_to(n, chunk), chunk)
+    b2 = jnp.full((1, n_pad), -1, jnp.int32)
+    b2 = jax.lax.dynamic_update_slice(b2, beam.astype(jnp.int32)[None, :], (0, 0))
+    v2 = jnp.full((1, n_pad), _EMPTY, jnp.int32)
+    v2 = jax.lax.dynamic_update_slice(v2, values.astype(jnp.int32)[None, :], (0, 0))
+
+    def _impl(b2, v2, interpret):
+        tb = min(block_beams, nbeams) if interpret else max(
+            min(block_beams, nbeams), _LANES
+        )
+        bp = _pad_to(nbeams, tb)
+        out = _beam_min_call(b2, v2, bp, tb, chunk, interpret)
+        return out[:nbeams]
+
+    if interpret is None:
+        return _lowering_dispatch(
+            functools.partial(_impl, interpret=False),
+            functools.partial(_impl, interpret=True),
+            b2, v2,
+        )
+    return _impl(b2, v2, interpret)
+
+
+# ---------------------------------------------------------------------------
+# shift search: the (C, D) circular-shift SAD score plane
+# ---------------------------------------------------------------------------
+
+
+def _shift_sad_kernel(min_valid: int, max_trans: int, prev_ref, rolled_ref,
+                      out_ref):
+    """All candidates in one VMEM pass: per row, the clamped mean-|Δ|
+    score over beams valid in BOTH profiles (ops/deskew.estimate_motion
+    `sad_of`, vectorized over the candidate axis)."""
+    prev = prev_ref[0, :][None, :]                  # (1, D)
+    rolled = rolled_ref[:]                          # (C, D)
+    both = (prev != _EMPTY) & (rolled != _EMPTY)
+    diff = jnp.clip(
+        jnp.where(both, rolled - prev, 0), -max_trans, max_trans
+    )
+    sad = jnp.sum(jnp.abs(diff), axis=1, keepdims=True)       # (C, 1)
+    cnt = jnp.sum(both.astype(jnp.int32), axis=1, keepdims=True)
+    score = jnp.where(
+        cnt >= min_valid, sad // jnp.maximum(cnt, 1), _EMPTY
+    )
+    out_ref[:] = jnp.broadcast_to(score, out_ref.shape)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("min_valid", "max_trans", "interpret")
+)
+def _shift_sad_call(prev, rolled, min_valid, max_trans, interpret):
+    cp, dp = rolled.shape
+    return pl.pallas_call(
+        functools.partial(_shift_sad_kernel, min_valid, max_trans),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((cp, dp), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        # lane-broadcast output: a (C, 1) int32 block trips the same
+        # XLA/Mosaic tiled-layout mismatch the median kernels hit on
+        # bare 1-D outputs, so the score broadcasts across one lane
+        # group and the host reads column 0
+        out_specs=pl.BlockSpec(
+            (cp, _LANES), lambda i: (0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((cp, _LANES), jnp.int32),
+        interpret=interpret,
+    )(prev, rolled)
+
+
+def shift_sad_pallas(
+    prev_prof: jax.Array,
+    rolled: jax.Array,
+    min_valid: int,
+    max_trans: int,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """(C,) int32 shift-candidate scores — the Pallas twin of the SAD
+    stack in ops/deskew.estimate_motion.  ``rolled`` is the (C, D)
+    plane of circularly shifted current profiles (the rolls are static
+    slices built by the caller, so the |s|-ordered candidate set — and
+    therefore first-min-wins tie-breaking — stays in shared code);
+    RECON_EMPTY marks invalid beams in both inputs and is the returned
+    "no estimate" score, exactly the XLA arm's convention."""
+    c, d = rolled.shape
+    # pad beams with EMPTY (invalid in `both` — contributes nothing)
+    # and candidates with EMPTY rows (score EMPTY, sliced off)
+    dp = _pad_to(max(d, _LANES), _LANES)
+    cp = _pad_to(max(c, 8), 8)
+    p2 = jnp.full((1, dp), _EMPTY, jnp.int32)
+    p2 = jax.lax.dynamic_update_slice(
+        p2, prev_prof.astype(jnp.int32)[None, :], (0, 0)
+    )
+    r2 = jnp.full((cp, dp), _EMPTY, jnp.int32)
+    r2 = jax.lax.dynamic_update_slice(r2, rolled.astype(jnp.int32), (0, 0))
+
+    def _impl(p2, r2, interpret):
+        out = _shift_sad_call(p2, r2, min_valid, max_trans, interpret)
+        return out[:c, 0]
+
+    if interpret is None:
+        return _lowering_dispatch(
+            functools.partial(_impl, interpret=False),
+            functools.partial(_impl, interpret=True),
+            p2, r2,
+        )
+    return _impl(p2, r2, interpret)

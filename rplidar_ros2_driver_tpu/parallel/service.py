@@ -123,6 +123,20 @@ class ShardedFilterService:
             getattr(params, "fleet_ingest_backend", "auto"),
             mesh.devices.flat[0].platform,
         )
+        # fused mapping route (PR 13): "fused" threads the MapState
+        # through the ingest carry so one compiled program per
+        # (super-)tick per shard covers bytes -> decode -> de-skewed
+        # sweep -> pose -> map update; "host" keeps the two-dispatch
+        # golden reference (ingest dispatch + a separate FleetMapper
+        # dispatch fed from take_recon()).
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            resolve_fused_mapping_backend,
+        )
+
+        self.fused_mapping_backend = resolve_fused_mapping_backend(
+            getattr(params, "fused_mapping_backend", "auto"),
+            mesh.devices.flat[0].platform,
+        )
         self.fleet_ingest = None        # FleetFusedIngest (fused backend)
         self._fleet_ingest_buckets = fleet_ingest_buckets
         self._host_ingest = None        # per-stream (decoder, latest-slot)
@@ -185,12 +199,39 @@ class ShardedFilterService:
         per-stream correlative scan-to-map match + log-odds map update,
         one mapper tick per filter tick.  Idle streams pass through.
         Returns the attached mapper (its snapshot/restore surface is the
-        caller's to drive, like ``fleet_ingest``'s)."""
-        if mapper is None:
+        caller's to drive, like ``fleet_ingest``'s).
+
+        With the FUSED mapping route (``fused_mapping_backend``) the
+        attached face is a CarriedFleetMapper instead: the MapState
+        lives inside the fleet ingest carry, the match+update runs in
+        the ingest program itself, and this service feeds the view from
+        the engine's per-tick wires (:meth:`_map_tick_fused`) — same
+        checkpoint formats, same loop-closure tap, zero extra
+        dispatches."""
+        if mapper is None and self.fused_mapping_backend == "fused":
+            from rplidar_ros2_driver_tpu.mapping.mapper import (
+                CarriedFleetMapper,
+            )
+
+            self._ensure_byte_ingest()
+            mapper = CarriedFleetMapper(
+                self.params, self.fleet_ingest, beams=self.cfg.beams
+            )
+        elif mapper is None:
             from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
 
             mapper = FleetMapper(
                 self.params, self.streams, beams=self.cfg.beams
+            )
+        elif self.fused_mapping_backend == "fused" and not hasattr(
+            mapper, "absorb_wires"
+        ):
+            # a dispatching FleetMapper beside the in-carry map would
+            # keep a SECOND diverging map per stream — refuse loudly
+            raise ValueError(
+                "this service resolved fused_mapping_backend='fused' "
+                "(the map rides the ingest carry); attach no mapper, or "
+                "a CarriedFleetMapper over this service's engine"
             )
         if mapper.streams != self.streams:
             raise ValueError(
@@ -289,19 +330,33 @@ class ShardedFilterService:
             # previous tick's poses as current
             self.last_poses = [None] * self.streams
             return
-        b = self.cfg.beams
-        points = np.zeros((self.streams, b, 2), np.float32)
-        masks = np.zeros((self.streams, b), bool)
-        live = np.zeros((self.streams,), np.int32)
-        for i, rec in enumerate(recons):
-            if rec is None:
-                continue
-            _plane, pts = rec
-            points[i] = pts[:, :2]
-            masks[i] = pts[:, 2] > 0.5
-            live[i] = 1
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            recon_input_planes,
+        )
+
+        points, masks, live = recon_input_planes(
+            recons, self.streams, self.cfg.beams
+        )
         self.last_poses = self.mapper.submit_points(points, masks, live)
         self._loop_tick()
+
+    def _map_tick_fused(self) -> None:
+        """The FUSED mapping seam (fused_mapping_backend='fused'): the
+        map update already ran INSIDE this tick's ingest program — no
+        mapper dispatch here.  Drain the engine's fresh map wires +
+        reconstructed sweeps, turn them into per-stream PoseEstimates
+        (CarriedFleetMapper.absorb_wires), and run the loop-closure
+        tap on exactly the scan window the in-program matcher saw.  An
+        all-idle tick (no fresh wire anywhere, or every wire's live
+        flag 0) lands ``last_poses = [None] * streams`` — the PR 10
+        stale-pose fix, extended to the in-program path."""
+        if self.mapper is None or self.fleet_ingest is None:
+            return
+        wires = self.fleet_ingest.take_map_wires()
+        recons = self.fleet_ingest.take_recon()
+        self.last_poses = self.mapper.absorb_wires(wires, recons)
+        if any(p is not None for p in self.last_poses):
+            self._loop_tick()
 
     # -- fault tolerance seam -----------------------------------------------
 
@@ -412,7 +467,14 @@ class ShardedFilterService:
         snap: dict = {}
         if self.fleet_ingest is not None:
             snap["ingest"] = self.fleet_ingest.snapshot_stream(i)
-        if self.mapper is not None:
+        from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
+
+        if self.mapper is not None and not is_carried(self.mapper):
+            # the carried route's map rows already ride snap["ingest"]
+            # (v3 key space) — a second row gather + fetch of the same
+            # (G, G) planes would double the checkpoint traffic; the
+            # rejoin path leaves the masked lane's in-carry map in
+            # place, so nothing needs the duplicate
             snap["map"] = self.mapper.snapshot_stream(i)
         if self.loop is not None:
             snap["loop"] = self.loop.snapshot_stream(i)
@@ -564,6 +626,11 @@ class ShardedFilterService:
                 if pipelined else self.fleet_ingest.submit(items)
             )
             result = [o[-1][0] if o else None for o in outs]
+            if self.fleet_ingest._mapping is not None:
+                # FUSED mapping route: the map update already ran
+                # inside the ingest dispatch — just surface its wires
+                self._map_tick_fused()
+                return result
             if self.fleet_ingest._deskew is not None:
                 # reconstruction active: the mapper consumes the
                 # every-tick reconstructed sweeps, not the once-per-
@@ -616,6 +683,15 @@ class ShardedFilterService:
         if self.fleet_ingest_backend == "fused":
             outs = self.fleet_ingest.submit_backlog(ticks)
             results = [[o for (o, _ts0, _dur) in s] for s in outs]
+            if self.fleet_ingest._mapping is not None:
+                # FUSED mapping route: every drained tick's map update
+                # ran in-program, in tick order (unlike the host
+                # route's newest-sweep collapse below — the fused drain
+                # absorbs the true per-tick sequence at the same ONE
+                # dispatch per super-tick); the wires drained here are
+                # the NEWEST tick's, the poses current at drain end
+                self._map_tick_fused()
+                return results
             if self.mapper is not None and (
                 self.fleet_ingest._deskew is not None
             ):
@@ -1412,9 +1488,14 @@ class ElasticFleetService:
         if self._fresh_snap is None:
             # engines are fresh here (precompile before traffic), so
             # lane 0's rows ARE the fresh-lane template
+            from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
+
             eng = self.shards[0].fleet_ingest
             self._fresh_snap = {"ingest": eng.snapshot_stream(0)}
-            if self.shards[0].mapper is not None:
+            if self.shards[0].mapper is not None and not is_carried(
+                self.shards[0].mapper
+            ):
+                # carried maps ride the ingest snapshot (v3)
                 self._fresh_snap["map"] = (
                     self.shards[0].mapper.snapshot_stream(0)
                 )
@@ -1572,8 +1653,13 @@ class ElasticFleetService:
             return None
         s, lane = got
         sh = self.shards[s]
+        from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
+
         snap = {"ingest": sh.fleet_ingest.snapshot_stream(lane)}
-        if sh.mapper is not None:
+        if sh.mapper is not None and not is_carried(sh.mapper):
+            # carried route: the map rows already ride the ingest
+            # snapshot (v3) — _restore_into rekeys them instead of
+            # pulling the same planes from the device twice
             snap["map"] = sh.mapper.snapshot_stream(lane)
         return snap
 
@@ -1623,7 +1709,25 @@ class ElasticFleetService:
                 f"{dst} lane {lane} (schema/geometry drift)"
             )
         if sh.mapper is not None:
-            if "map" not in use or not sh.mapper.restore_stream(
+            from rplidar_ros2_driver_tpu.mapping.mapper import (
+                carried_map_row,
+                is_carried,
+            )
+
+            if is_carried(sh.mapper):
+                # the map row travels INSIDE the ingest snapshot on the
+                # fused route (v3 ingest.map_* keys); the default
+                # (rejoin-style) ingest restore above touches only the
+                # filter rows, so the carried row is installed here —
+                # the destination lane may hold a previous tenant's map
+                if not sh.mapper.restore_stream(
+                    lane, carried_map_row(use["ingest"])
+                ):
+                    raise RuntimeError(
+                        f"stream {stream}: carried map row rejected by "
+                        f"shard {dst} lane {lane} (schema/geometry drift)"
+                    )
+            elif "map" not in use or not sh.mapper.restore_stream(
                 lane, use["map"]
             ):
                 raise RuntimeError(
@@ -1742,7 +1846,14 @@ class ElasticFleetService:
                     "ingest": self.shards[src].fleet_ingest
                     .snapshot_stream(src_lane),
                 }
-                if self.shards[src].mapper is not None:
+                from rplidar_ros2_driver_tpu.mapping.mapper import (
+                    is_carried,
+                )
+
+                if self.shards[src].mapper is not None and not is_carried(
+                    self.shards[src].mapper
+                ):
+                    # carried maps ride the ingest snapshot (v3)
                     snap["map"] = self.shards[src].mapper.snapshot_stream(
                         src_lane
                     )

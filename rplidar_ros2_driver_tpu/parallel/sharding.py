@@ -151,7 +151,11 @@ def _ring_all_reduce(x: jax.Array, axis_name: str) -> jax.Array:
     all-reduce, and on a (G, G) grid the latency-optimal choice is the
     compiler's to make.
     """
-    n = jax.lax.axis_size(axis_name)
+    # the axis size as a concrete host int: psum of a Python scalar
+    # const-folds to size * x at trace time on every jax this repo
+    # supports (jax.lax.axis_size only exists from jax 0.5 — calling it
+    # here was an AttributeError on the pinned 0.4.x)
+    n = int(jax.lax.psum(1, axis_name))
     if n == 1:
         return x
     perm = [(i, (i + 1) % n) for i in range(n)]

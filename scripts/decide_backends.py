@@ -47,7 +47,7 @@ def _records(paths: list[str]):
 _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
-    "deskew_ab", "loop_close_ab",
+    "deskew_ab", "loop_close_ab", "fused_mapping_ab",
 )
 
 
@@ -398,6 +398,44 @@ def analyze(records: list[dict]) -> dict:
                     "corrected_end_err_cells", "baseline_end_err_cells",
                     "overhead_clamped",
                 ) if k in lab
+            })
+
+        # config 18: the one-dispatch stack A/B (fused_mapping_backend
+        # default).  The T+T -> 1 dispatch collapse is structural
+        # (asserted in the bench), so the flip question is only whether
+        # the in-program map update keeps the group rate: a steady
+        # group ratio >= 0.95 is a win by construction (the collapse
+        # removes a device round-trip per tick for free).  The clamp
+        # (either arm under the timer floor) records evidence but must
+        # never flip — the ratio's magnitude is the clamp's, and the
+        # floor-asymmetric strength merge keeps an above-parity noise
+        # record from displacing committed degradation evidence (the
+        # failover_ab discipline).
+        fmab = rec.get("fused_mapping_ab")
+        if isinstance(fmab, dict):
+            ratio = fmab.get("steady_group_ratio")
+            if isinstance(ratio, (int, float)) and not fmab.get(
+                "ratio_clamped"
+            ):
+                flip = ratio >= 0.95
+                recommend("fused_mapping_backend.tpu", {
+                    "current": "host",
+                    "recommended": "fused" if flip else "host",
+                    "flip": flip,
+                    "key": "config18 steady_group_ratio",
+                    "value": 1.0 if flip else float(min(ratio, 1.0)),
+                    "measured": {
+                        "steady_group_ratio": float(ratio),
+                        "dispatch_collapse": fmab.get("dispatch_collapse"),
+                    },
+                    "margin": 0.95,
+                    "source": "fused_mapping_ab",
+                })
+            out["evidence"].setdefault("fused_mapping_ab", []).append({
+                k: fmab[k] for k in (
+                    "steady_group_ratio", "dispatch_collapse",
+                    "ratio_clamped",
+                ) if k in fmab
             })
 
         # ablation: resample + voxel kernels

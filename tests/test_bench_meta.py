@@ -31,6 +31,7 @@ def test_metric_names_stable():
     assert bench.metric_name(15) == "shard_failover_survivor_scans_per_sec"
     assert bench.metric_name(16) == "deskew_recon_map_updates_per_sec"
     assert bench.metric_name(17) == "loop_close_corrected_scans_per_sec"
+    assert bench.metric_name(18) == "fused_mapping_stack_updates_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -39,6 +40,7 @@ def test_graded_table_well_formed():
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
+            "fused_mapping",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1224,6 +1226,98 @@ def test_decide_backends_loop_close_key():
     # tick ratio below the floor: loop_enable stays off
     got = db.analyze([rec("tpu", 5.5, 1.2, 0.5)])
     assert got["recommendations"]["loop_enable.tpu"]["flip"] is False
+
+
+def test_bench_smoke_fused_mapping():
+    """`bench.py --smoke-fused-mapping` — the tier-1 gate for the
+    one-dispatch stack (config-18 A/B at seconds-scale CPU geometry).
+    The structural claims are what matters: T ticks of ingest + T
+    mapper dispatches collapse to ceil(ticks/T) compiled dispatches
+    with ZERO separate mapper dispatches (mapping rides the ingest
+    scan carry), zero recompiles/implicit transfers under the
+    steady-state guard, and byte-equal trajectories + final maps vs
+    the two-dispatch baseline (the bench itself raises on violation;
+    this gate pins that the asserted artifact lands).  The group-time
+    ratio is 1.5-core-CI weather and unasserted; the bit-exact
+    route-parity contract across every lowering lives in
+    tests/test_fused_mapping.py."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-fused-mapping"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(18)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    assert s["one_dispatch_per_super_tick"] is True
+    assert s["zero_mapper_dispatches"] is True
+    assert s["zero_recompiles"] is True
+    assert s["zero_implicit_transfers"] is True
+    assert s["byte_equal_trajectories"] is True
+    assert s["byte_equal_maps"] is True
+    # the collapse the config exists for: T+T baseline dispatches per
+    # group against exactly one fused dispatch per group
+    d = out["dispatches"]
+    assert d["fused_total"] == out["groups"]
+    assert d["baseline_ingest"] == out["groups"] * out["super_tick"]
+    assert d["baseline_mapper"] > 0
+    assert out["updates"] > 0 and out["value"] > 0
+    # the decision key rides with its clamp flag
+    assert "steady_group_ratio" in out["fused_mapping_ab"]
+    assert "dispatch_collapse" in out["fused_mapping_ab"]
+    assert isinstance(out["fused_mapping_ab"]["ratio_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_fused_mapping_key():
+    """The fused_mapping_backend recommendation flips from config-18
+    evidence alone: an unclamped TPU record with the steady group
+    ratio >= 0.95 recommends the flip (the dispatch collapse is
+    structural — parity throughput IS the win); CPU records, clamped
+    ratios and below-floor ratios never flip."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, ratio, clamped=False):
+        return {
+            "device": dev,
+            "fused_mapping_ab": {
+                "steady_group_ratio": ratio,
+                "dispatch_collapse": 16.0,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    got = db.analyze([rec("tpu", 1.02)])
+    r = got["recommendations"]["fused_mapping_backend.tpu"]
+    assert r["flip"] is True and r["recommended"] == "fused"
+    # CPU record: reported, never flips
+    got = db.analyze([rec("cpu", 1.3)])
+    assert "fused_mapping_backend.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 1.3, clamped=True)])
+    assert "fused_mapping_backend.tpu" not in got["recommendations"]
+    # below the floor: the in-program update is eating the group rate
+    got = db.analyze([rec("tpu", 0.7)])
+    assert got["recommendations"]["fused_mapping_backend.tpu"]["flip"] is False
+    # floor-asymmetric strength merge: committed degradation evidence
+    # outweighs a later clean record's parity strength
+    got = db.analyze([rec("tpu", 0.5), rec("tpu", 1.0)])
+    assert got["recommendations"]["fused_mapping_backend.tpu"]["flip"] is False
 
 
 def test_decide_backends_deskew_key():

@@ -1,0 +1,576 @@
+"""One-dispatch stack (PR 13) — fused mapping route parity suite.
+
+Pins the contract that lets the SLAM front-end ride the ingest carry
+(``fused_mapping_backend='fused'``: MapState threaded as a donated
+``lax.scan`` carry through ops/ingest, the match+update inside the one
+compiled program per super-tick per shard):
+
+  * the in-program mapping path is BYTE-EQUAL to the two-dispatch host
+    route — ranges, per-tick poses/scores/revisions, final MapState —
+    over T∈{1,2,8} super-ticks x fleet 1/3/8 x both matcher backends
+    (int32 datapath end to end, so equality is byte-level);
+  * T ticks of ingest+mapping collapse from T+T dispatches to
+    ceil(T/super_tick_max) — with ZERO separate mapper dispatches;
+  * an all-idle fused-mapping tick does not republish stale poses
+    (the PR 10 ``last_poses`` fix, extended to the in-program path);
+  * the map rows ride the per-stream failover transport from the new
+    carry layout (ingest snapshot v3), version bump rejected on skew,
+    and the carried map checkpoint format interoperates with
+    FleetMapper's byte-for-byte;
+  * a mid-backlog format switch resets decode (and the sub-sweep ring)
+    without perturbing the carried map — both routes agree;
+  * snapshot/restore mid super-tick continues bit-exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.core.config import DriverParams
+from rplidar_ros2_driver_tpu.ops import wire
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+BEAMS = 256
+DENSE = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+
+def _params(route="fused", **over):
+    base = dict(
+        filter_backend="cpu",
+        filter_chain=("clip", "median", "voxel"),
+        filter_window=4,
+        voxel_grid_size=16,
+        fleet_ingest_backend="fused",
+        deskew_enable=True,
+        sweep_reconstruct_window=3,
+        deskew_profile_beams=64,
+        deskew_shift_window=4,
+        map_enable=True,
+        map_backend="host",
+        fused_mapping_backend=route,
+        map_grid=32,
+        map_cell_m=0.2,
+    )
+    base.update(over)
+    return DriverParams(**base)
+
+
+def _dense_frames(revs: int, ppr: int = 400, drift_per_rev: float = 40.0,
+                  seed: int = 0):
+    """Dense-capsule wire stream with radial drift (a moving platform,
+    so the de-skew estimator and the matcher both do real work)."""
+    rng = np.random.default_rng(seed)
+    frames = []
+    idx = 0
+    first = True
+    while idx < revs * ppr:
+        theta = 360.0 * (idx % ppr) / ppr
+        pts = (np.arange(40) + idx) % ppr
+        dists = (
+            2000.0 + 500.0 * np.sin(2 * np.pi * pts / ppr)
+            + drift_per_rev * (idx / ppr)
+            + rng.uniform(0.0, 0.25)
+        )
+        frames.append(wire.encode_dense_capsule(
+            int(theta * 64) & 0x7FFF, first, dists.astype(int)
+        ))
+        idx += 40
+        first = False
+    return frames
+
+
+def _byte_ticks(frames, streams: int, run: int = 4, t0: float = 100.0,
+                ans: int = DENSE):
+    """Per-stream byte ticks (every stream the same frames on its own
+    stamp lane — the bench's paced-scene discipline)."""
+    ticks = []
+    t = [t0 + 5.0 * s for s in range(streams)]
+    for i in range(0, len(frames), run):
+        tick = []
+        for s in range(streams):
+            batch = []
+            for f in frames[i : i + run]:
+                t[s] += 1.25e-3
+                batch.append((f, t[s]))
+            tick.append((ans, batch))
+        ticks.append(tick)
+    return ticks
+
+
+def _build(route, streams, match_backend="xla", super_tick_max=1, **over):
+    from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    params = _params(
+        route, match_backend=match_backend,
+        super_tick_max=super_tick_max, **over,
+    )
+    svc = ShardedFilterService(
+        params, streams, mesh=make_mesh(1), beams=BEAMS, capacity=1024,
+        fleet_ingest_buckets=(4,),
+    )
+    svc._ensure_byte_ingest()
+    svc.attach_mapper()
+    return svc
+
+
+def _pose_row(svc):
+    return [
+        None if p is None
+        else (tuple(int(v) for v in p.pose_q), p.score,
+              p.matched_points, p.revision)
+        for p in svc.last_poses
+    ]
+
+
+def _map_snap(svc):
+    return svc.mapper.snapshot()
+
+
+def _assert_maps_equal(a, b):
+    for k in ("log_odds", "pose", "origin_xy", "revision"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]))
+
+
+# ---------------------------------------------------------------------------
+# ops-level parity: super-tick in-program mapping vs the per-tick host
+# mapper golden, the full T x fleet cross
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fleet", [1, 3, 8])
+@pytest.mark.parametrize("T", [1, 2, 8])
+def test_ops_super_tick_mapping_vs_host_golden(T, fleet):
+    """The tentpole claim at the ops layer: a T-tick super-step with
+    cfg.mapping lands byte-identical map wires and final MapState to T
+    per-tick dispatches WITHOUT mapping whose reconstructed sweeps feed
+    the NumPy host mapper (ops/scan_match_ref) tick by tick — the exact
+    two-dispatch route the fusion replaces."""
+    import jax.numpy as jnp
+
+    from rplidar_ros2_driver_tpu.filters.chain import config_from_params
+    from rplidar_ros2_driver_tpu.mapping.mapper import map_config_from_params
+    from rplidar_ros2_driver_tpu.ops.deskew import deskew_config_from_params
+    from rplidar_ros2_driver_tpu.ops.ingest import (
+        create_fleet_ingest_state,
+        fleet_aux_len,
+        fleet_ingest_config_for,
+        super_fleet_ingest_step,
+        unpack_super_fleet_ingest_result,
+    )
+    from rplidar_ros2_driver_tpu.ops.scan_match_ref import map_match_step_np
+    from rplidar_ros2_driver_tpu.protocol import timing as timingmod
+
+    params = _params()
+    fcfg = config_from_params(params, BEAMS, platform="cpu")
+    dsk = deskew_config_from_params(params, BEAMS)
+    mcfg = map_config_from_params(params, BEAMS)
+    run = 4
+    frames = _dense_frames(3, seed=T * 10 + fleet)
+    chunks = [frames[i : i + run] for i in range(0, len(frames), run)]
+    # pad the chunk list to a T multiple with idle ticks
+    while len(chunks) % T:
+        chunks.append([])
+
+    def staging(chunk_group, t_clock):
+        fb = cfg_map.frame_bytes
+        buf = np.zeros((T, fleet, run, fb), np.uint8)
+        aux = np.zeros((T, fleet, fleet_aux_len(run)), np.float32)
+        for t, ch in enumerate(chunk_group):
+            m = len(ch)
+            for s in range(fleet):
+                if m:
+                    buf[t, s, :m, :] = np.frombuffer(
+                        b"".join(ch), np.uint8
+                    ).reshape(m, -1)
+                stamps = [t_clock[s] + 1.25e-3 * (j + 1) for j in range(m)]
+                if m:
+                    base = stamps[0]
+                    aux[t, s, :m] = [x - base for x in stamps]
+                    aux[t, s, 2 * run] = (
+                        0.0 if prev_base[s] is None else prev_base[s] - base
+                    )
+                    aux[t, s, 2 * run + 1] = m
+                    prev_base[s] = base
+                    t_clock[s] = stamps[-1]
+        return buf, aux
+
+    cfg_map = fleet_ingest_config_for(
+        (DENSE,), timingmod.TimingDesc(), fcfg,
+        max_nodes=1024, deskew=dsk, mapping=mcfg,
+    )
+    cfg_plain = dataclasses.replace(cfg_map, mapping=None)
+
+    # fused arm: T-tick super-steps with in-program mapping
+    prev_base = [None] * fleet
+    t_clock = [100.0 + 5 * s for s in range(fleet)]
+    st = create_fleet_ingest_state(cfg_map, fleet)
+    fused_wires = []
+    for g in range(0, len(chunks), T):
+        buf, aux = staging(chunks[g : g + T], t_clock)
+        st, *res = super_fleet_ingest_step(
+            st, jnp.asarray(buf), jnp.asarray(aux), cfg=cfg_map
+        )
+        for tick_rows in unpack_super_fleet_ingest_result(res, cfg_map):
+            fused_wires.append([r.map_wire.copy() for r in tick_rows])
+
+    # host arm: the same T-grouped staging through the mapping-less
+    # program (identical byte/aux planes), recon planes into the NumPy
+    # mapper per tick — the separate-dispatch route
+    prev_base = [None] * fleet
+    t_clock = [100.0 + 5 * s for s in range(fleet)]
+    st_h = create_fleet_ingest_state(cfg_plain, fleet)
+    g = mcfg.grid
+    host_states = [
+        {
+            "log_odds": np.zeros((g, g), np.int32),
+            "pose": np.zeros((3,), np.int32),
+            "origin_xy": np.zeros((2,), np.float32),
+            "revision": np.zeros((), np.int32),
+        }
+        for _ in range(fleet)
+    ]
+    host_wires = []
+    for g in range(0, len(chunks), T):
+        buf, aux = staging(chunks[g : g + T], t_clock)
+        st_h, *res = super_fleet_ingest_step(
+            st_h, jnp.asarray(buf), jnp.asarray(aux), cfg=cfg_plain
+        )
+        for tick_rows in unpack_super_fleet_ingest_result(res, cfg_plain):
+            row_wires = []
+            for i, r in enumerate(tick_rows):
+                live = 1 if r.recon_pushed else 0
+                if live:
+                    pts = r.recon_pts
+                    new, w5 = map_match_step_np(
+                        host_states[i], pts[:, :2].astype(np.float32),
+                        pts[:, 2] > 0.5, 1, mcfg,
+                    )
+                    host_states[i] = new
+                else:
+                    w5 = np.concatenate([
+                        host_states[i]["pose"], [0], [0]
+                    ]).astype(np.int32)
+                row_wires.append(np.concatenate(
+                    [[live], w5, [host_states[i]["revision"]]]
+                ).astype(np.int32))
+            host_wires.append(row_wires)
+
+    assert len(fused_wires) == len(host_wires)
+    for t, (fw, hw) in enumerate(zip(fused_wires, host_wires)):
+        for i in range(fleet):
+            # idle ticks: the host golden's wire repeats the held pose,
+            # the fused wire likewise carries the untouched state —
+            # compare whole wires either way
+            np.testing.assert_array_equal(fw[i], hw[i], err_msg=f"t={t} s={i}")
+    for i in range(fleet):
+        np.testing.assert_array_equal(
+            np.asarray(st.map_log_odds)[i], host_states[i]["log_odds"]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st.map_pose)[i], host_states[i]["pose"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# service-level route parity (both matcher backends)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("match_backend", ["xla", "pallas"])
+def test_service_route_parity(match_backend):
+    """Host route vs fused route through ShardedFilterService, tick by
+    tick: outputs, per-tick poses and final maps byte-equal; the fused
+    route issues ZERO mapper dispatches."""
+    streams = 3
+    h = _build("host", streams, match_backend)
+    f = _build("fused", streams, match_backend)
+    ticks = _byte_ticks(_dense_frames(3), streams)
+    for t in ticks:
+        rh = h.submit_bytes(t)
+        rf = f.submit_bytes(t)
+        for i in range(streams):
+            assert (rh[i] is None) == (rf[i] is None)
+            if rh[i] is not None:
+                np.testing.assert_array_equal(
+                    np.asarray(rh[i].ranges), np.asarray(rf[i].ranges)
+                )
+        assert _pose_row(h) == _pose_row(f)
+    _assert_maps_equal(_map_snap(h), _map_snap(f))
+    assert f.mapper.dispatch_count == 0
+    assert h.mapper.ticks > 0 and f.mapper.ticks > 0
+
+
+def test_backlog_drain_dispatch_collapse():
+    """T ticks of ingest+mapping in ceil(T/super_tick_max) compiled
+    dispatches — mapping included, no separate mapper dispatch — with
+    the final map byte-equal to the per-tick host route."""
+    streams, T = 3, 4
+    h = _build("host", streams)
+    f = _build("fused", streams, super_tick_max=T)
+    ticks = _byte_ticks(_dense_frames(3), streams)
+    for t in ticks:
+        h.submit_bytes(t)
+    d0 = f.fleet_ingest.dispatch_count
+    f.submit_bytes_backlog(ticks)
+    got = f.fleet_ingest.dispatch_count - d0
+    assert got == -(-len(ticks) // T), (got, len(ticks))
+    assert f.mapper.dispatch_count == 0
+    _assert_maps_equal(_map_snap(h), _map_snap(f))
+
+
+def test_mid_backlog_format_switch():
+    """Stream 0 switches scan modes mid-backlog: the decode reset (and
+    ring invalidation) land at its own tick inside the super-step, the
+    carried map SURVIVES the switch (host-route semantics), and both
+    routes agree byte-for-byte."""
+    streams = 2
+    dense = _dense_frames(2)
+    hq_rev = []
+    idx = 0
+    ppr = 384  # 4 HQ capsules (96 nodes each) per revolution
+    while idx < 2 * ppr:
+        pts = (np.arange(96) + idx) % ppr
+        dists = 2000.0 + 500.0 * np.sin(2 * np.pi * pts / ppr)
+        angle_q14 = (pts * 65536) // ppr
+        flags = np.where(pts == 0, 1, 0)
+        hq_rev.append(wire.encode_hq_capsule(
+            angle_q14, (dists * 4).astype(np.int64),
+            np.full(96, 190), flags,
+        ))
+        idx += 96
+    ticks = _byte_ticks(dense, streams)
+    hq_ticks = _byte_ticks(
+        hq_rev, streams, t0=200.0, ans=int(Ans.MEASUREMENT_HQ)
+    )
+    # stream 1 stays dense-idle during the switch ticks
+    for t in hq_ticks:
+        t[1] = None
+    scene = ticks + hq_ticks
+
+    h = _build("host", streams)
+    f = _build("fused", streams, super_tick_max=4)
+    for t in scene:
+        h.submit_bytes(t)
+    f.submit_bytes_backlog(scene)
+    _assert_maps_equal(_map_snap(h), _map_snap(f))
+    # the map absorbed updates on both sides of the switch
+    assert int(np.asarray(_map_snap(f)["revision"])[0]) > 0
+
+
+def test_all_idle_tick_does_not_republish_stale_poses():
+    """PR 10's ``last_poses``-clearing fix, extended to the in-program
+    mapping path: a tick that pushes no sub-sweep anywhere must land
+    ``last_poses = [None] * streams`` even though the previous tick
+    published real estimates."""
+    streams = 2
+    f = _build("fused", streams)
+    ticks = _byte_ticks(_dense_frames(2), streams)
+    for t in ticks:
+        f.submit_bytes(t)
+    assert any(p is not None for p in f.last_poses)
+    idle = [None] * streams
+    f.submit_bytes(idle)
+    assert f.last_poses == [None] * streams
+
+
+# ---------------------------------------------------------------------------
+# snapshot / failover transport from the new carry layout
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_restore_mid_super_tick():
+    """Mid-run per-stream snapshot (ingest v3 — map rows inside the
+    carry) restored into a FRESH service resumes bit-exactly: the
+    migration restore (restore_decode=True) moves decode, filter AND
+    map rows in one transport unit."""
+    streams = 2
+    ticks = _byte_ticks(_dense_frames(4), streams)
+    cut = len(ticks) // 2
+
+    ref = _build("fused", streams, super_tick_max=2)
+    for t in ticks[:cut]:
+        ref.submit_bytes(t)
+    snaps = [
+        ref.fleet_ingest.snapshot_stream(i) for i in range(streams)
+    ]
+    assert any(k.startswith("ingest.map_") for k in snaps[0])
+
+    dst = _build("fused", streams, super_tick_max=2)
+    for i, snap in enumerate(snaps):
+        assert dst.fleet_ingest.restore_stream(i, snap, restore_decode=True)
+    for t in ticks[cut:]:
+        ref.submit_bytes(t)
+        dst.submit_bytes(t)
+        assert _pose_row(ref) == _pose_row(dst)
+    _assert_maps_equal(_map_snap(ref), _map_snap(dst))
+
+
+def test_snapshot_version_skew_rejected():
+    """A v2-stamped (pre-carry-layout) snapshot is rejected with the
+    state untouched, and a mapping-off snapshot cannot restore_decode
+    into a mapping-on engine (ingest key-space mismatch)."""
+    streams = 2
+    svc = _build("fused", streams)
+    for t in _byte_ticks(_dense_frames(1), streams):
+        svc.submit_bytes(t)
+    snap = svc.fleet_ingest.snapshot_stream(0)
+    bad = dict(snap)
+    bad["version"] = np.asarray(2, np.int32)
+    assert not svc.fleet_ingest.restore_stream(0, bad)
+    assert not svc.fleet_ingest.restore_stream(0, bad, restore_decode=True)
+    # mapping-off key space (map rows stripped) into a mapping-on
+    # engine: the exact-key check refuses the migration restore
+    stripped = {
+        k: v for k, v in snap.items() if not k.startswith("ingest.map_")
+    }
+    assert not svc.fleet_ingest.restore_stream(0, stripped, restore_decode=True)
+    # the plain rejoin restore ignores ingest rows and still works
+    assert svc.fleet_ingest.restore_stream(0, stripped)
+
+
+def test_carried_map_checkpoint_interops_with_fleetmapper():
+    """The carried view's per-stream map snapshot is FleetMapper's
+    format byte-for-byte: a row pulled from the carry restores into a
+    host-backend FleetMapper and back."""
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+    streams = 2
+    f = _build("fused", streams)
+    for t in _byte_ticks(_dense_frames(2), streams):
+        f.submit_bytes(t)
+    row = f.mapper.snapshot_stream(0)
+    assert int(np.asarray(row["revision"])) > 0
+
+    host = FleetMapper(_params("host"), streams, beams=BEAMS)
+    assert host.restore_stream(1, row)
+    back = host.snapshot_stream(1)
+    for k in ("log_odds", "pose", "origin_xy", "revision"):
+        np.testing.assert_array_equal(
+            np.asarray(row[k]), np.asarray(back[k])
+        )
+    # and back into the carry
+    assert f.mapper.restore_stream(1, back)
+    row1 = f.mapper.snapshot_stream(1)
+    for k in ("log_odds", "pose", "origin_xy", "revision"):
+        np.testing.assert_array_equal(
+            np.asarray(row[k]), np.asarray(row1[k])
+        )
+    # version skew rejected by the carried view too
+    bad = dict(row)
+    bad["version"] = np.asarray(99, np.int32)
+    assert not f.mapper.restore_stream(0, bad)
+
+
+# ---------------------------------------------------------------------------
+# loop-closure tap + seam validation
+# ---------------------------------------------------------------------------
+
+
+def test_failover_transport_carried_map():
+    """The elastic pod on the fused route: a chaos shard kill's victims
+    restore onto survivors WITH their in-carry map rows — the map
+    travels inside the v3 ingest snapshot (no duplicate mapper-side
+    pull; the snapshot store's entries carry no separate "map" key),
+    and the evacuated stream's map revision survives the migration."""
+    from rplidar_ros2_driver_tpu.driver.chaos import (
+        ShardChaosConfig,
+        ShardChaosSchedule,
+    )
+    from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+
+    streams, shards = 2, 2
+    params = _params(
+        "fused",
+        shard_count=shards, shard_lanes=2,
+        failover_snapshot_ticks=2,
+        shard_backoff_base_s=0.45, shard_backoff_max_s=2.0,
+        shard_backoff_jitter=0.0, shard_probation_ticks=2,
+    )
+    fake = {"now": 0.0}
+    pod = ElasticFleetService(
+        params, streams, shards=shards, beams=BEAMS, capacity=1024,
+        fleet_ingest_buckets=(4,), clock=lambda: fake["now"],
+    )
+    pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+        kills=((1, 8, 10),),
+    )))
+    pod.precompile([DENSE])
+    assert pod.shards[0].mapper.backend == "carried"
+    ticks = _byte_ticks(_dense_frames(4), streams)
+    for tick in ticks:
+        pod.submit_bytes(tick)
+        fake["now"] += 0.1
+    kinds = [e[1] for e in pod.events]
+    assert "lost" in kinds and "evacuated" in kinds
+    # the snapshot store never carried a duplicate mapper-side row
+    for _t, snap in pod._snap.values():
+        assert "map" not in snap
+        assert any(k.startswith("ingest.map_") for k in snap["ingest"])
+    # the evacuated stream kept a live map on its new lane: revision
+    # positive and still advancing post-migration
+    victim = pod.events[[i for i, e in enumerate(pod.events)
+                         if e[1] == "evacuated"][0]][2]
+    got = pod.topology.placement(victim)
+    assert got is not None
+    s, lane = got
+    row = pod.shards[s].mapper.snapshot_stream(lane)
+    assert int(np.asarray(row["revision"])) > 0
+
+
+def test_loop_closure_tap_parity():
+    """The loop engine observes the fused route exactly as it observes
+    the host route: same submap finalizations, same check cadence, same
+    corrected poses (the carried mapper feeds it the identical scan
+    windows and estimates)."""
+    streams = 2
+    over = dict(
+        loop_enable=True, loop_backend="host",
+        loop_submap_revs=2, loop_check_revs=2, loop_max_submaps=4,
+        loop_candidates=1, loop_min_points=4, pose_graph_iters=16,
+    )
+    h = _build("host", streams, **over)
+    f = _build("fused", streams, **over)
+    h.attach_loop_closure()
+    f.attach_loop_closure()
+    for t in _byte_ticks(_dense_frames(4), streams):
+        h.submit_bytes(t)
+        f.submit_bytes(t)
+        assert [
+            None if c is None else tuple(int(v) for v in c)
+            for c in h.last_corrected_poses
+        ] == [
+            None if c is None else tuple(int(v) for v in c)
+            for c in f.last_corrected_poses
+        ]
+    assert f.loop.installs == h.loop.installs
+    assert f.loop.installs > 0
+    assert f.loop.checks == h.loop.checks
+
+
+def test_seam_validation():
+    """Config + attach validation: the fused route refuses to build
+    half-wired."""
+    from rplidar_ros2_driver_tpu.mapping.mapper import FleetMapper
+
+    with pytest.raises(ValueError, match="requires map_enable"):
+        _params("fused", map_enable=False).validate()
+    with pytest.raises(ValueError, match="requires deskew_enable"):
+        _params("fused", deskew_enable=False).validate()
+    # the fleet seam must be SPELLED fused: the single-stream fused
+    # seam satisfies the deskew check but never builds cfg.mapping
+    with pytest.raises(ValueError, match="fleet_ingest_backend"):
+        _params(
+            "fused", fleet_ingest_backend="auto", ingest_backend="fused"
+        ).validate()
+    _params("fused").validate()
+    # an explicit dispatching FleetMapper beside the carry is refused
+    svc = _build("fused", 2)
+    with pytest.raises(ValueError, match="fused_mapping_backend"):
+        svc.attach_mapper(FleetMapper(_params("host"), 2, beams=BEAMS))
+    # and the carried view has no submit path
+    with pytest.raises(RuntimeError, match="absorb_wires"):
+        svc.mapper.submit([None, None])
