@@ -202,6 +202,7 @@ GRADED = {
     16: ("deskew", POINTS, dict(window=WINDOW)),  # de-skew + sweep-recon A/B
     17: ("loop_close", POINTS, dict(window=WINDOW)),  # SLAM back-end loop-closure A/B
     18: ("fused_mapping", POINTS, dict(window=WINDOW)),  # one-dispatch stack A/B
+    19: ("elastic_serving", POINTS, dict(window=WINDOW)),  # traffic-shaped serving A/B
 }
 
 
@@ -3334,6 +3335,528 @@ def bench_fused_mapping(smoke: bool = False) -> dict:
     }
 
 
+def _stream_data_ticks(frames, run: int, ans: int, t0: float):
+    """One stream's paced data-tick list (``run`` wire frames per tick,
+    1.25 ms/frame — the `_paced_fleet_byte_ticks` pacing, per stream so
+    the config-19 arrival generator can give streams different RATES)."""
+    ticks, t = [], t0
+    for i in range(0, len(frames), run):
+        batch = []
+        for f in frames[i : i + run]:
+            t += 1.25e-3
+            batch.append((f, t))
+        ticks.append((ans, batch))
+    return ticks
+
+
+def _storm_wall_schedule(
+    per_stream_ticks, rates, *, stall_period, stall_frames, phase,
+    storm_at, storm_len,
+):
+    """The config-19 heavy-tailed arrival trace: each stream's source
+    produces ``rates[s]`` data ticks per wall tick, but delivery rides
+    the PR 6 chaos stall schedule (driver/chaos.ChaosSchedule, per-
+    stream phase offsets) — a stalled wall tick buffers at the source,
+    and the first open tick delivers the whole buffer at once, exactly
+    a reconnect storm flushing a wedged device's queue.  ``storm_at``/
+    ``storm_len`` add one fleet-wide outage on top (every stream
+    buffers for ``storm_len`` wall ticks — the admission-shed forcing
+    event).  Returns wall ticks in the ``offer_bytes`` layout
+    (``items[s]``: None or a list of queued data ticks), with a tail
+    that flushes every buffer."""
+    from rplidar_ros2_driver_tpu.driver.chaos import (
+        FAULT_STALL,
+        ChaosConfig,
+        ChaosSchedule,
+    )
+
+    streams = len(per_stream_ticks)
+    sched = ChaosSchedule(ChaosConfig(
+        stall_period=stall_period, stall_frames=stall_frames,
+    ))
+    pos = [0] * streams
+    buf: list = [[] for _ in range(streams)]
+    wall = []
+    t = 0
+    while True:
+        producing = any(
+            pos[s] < len(per_stream_ticks[s]) for s in range(streams)
+        )
+        if not producing and not any(buf):
+            break
+        items: list = []
+        for s in range(streams):
+            take = per_stream_ticks[s][pos[s] : pos[s] + rates[s]]
+            pos[s] += rates[s]
+            buf[s].extend(take)
+            stalled = (
+                storm_at <= t < storm_at + storm_len
+                or sched.plan(t + phase * s) == FAULT_STALL
+            )
+            if stalled and producing:
+                items.append(None)
+            elif buf[s]:
+                items.append(buf[s])
+                buf[s] = []
+            else:
+                items.append(None)
+        wall.append(items)
+        t += 1
+    return wall
+
+
+def bench_elastic_serving(smoke: bool = False) -> dict:
+    """Config 19 — the traffic-shaped elastic serving A/B (ROADMAP item
+    4): two identical multi-shard pods (parallel/service.
+    ElasticFleetService + parallel/scheduler.TrafficShaper) serve the
+    SAME heavy-tailed arrival trace tick-paired; the ADAPTIVE arm's
+    scheduler picks the super-tick drain rung per shard per drain from
+    measured backlog depth (``sched_rungs`` ladder, hysteresis), the
+    STATIC arm is pinned to the rung-1 baseline (one compiled dispatch
+    per queued tick — the pre-scheduler serving plane).  Arrivals are
+    generated from the PR 6 chaos stall schedule: stalled wall ticks
+    buffer at the source and the first open tick delivers the buffer as
+    one burst (a reconnect storm), plus one fleet-wide outage long
+    enough to overflow the admission bound.  Mid-run a chaos shard kill
+    exercises the byte-rate-weighted evacuation (hot victims land
+    first, on the least weighted-loaded survivors).
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      * per-rung dispatch accounting: every engine's
+        ``rung_dispatches`` sums to its ``dispatch_count``; the static
+        arm dispatched ONLY rung 1; the adaptive arm reached the top
+        rung and issued strictly fewer total dispatches over the same
+        trace (the burst collapse);
+      * bounded per-stream backlog: the observed queue depth never
+        exceeds ``admission_max_backlog_ticks``, the fleet-wide outage
+        forces oldest-tick sheds whose counters match an independent
+        shadow simulation of the admission policy, and both arms shed
+        IDENTICALLY (admission happens at offer time — the policy
+        chooses when work dispatches, never what is admitted);
+      * byte-equal trajectories: the two arms' per-stream revolution
+        outputs are byte-identical across the WHOLE run — rung
+        sequence, evacuation included — and the pre-kill outputs are
+        byte-identical to N independent host decoder+assembler+chain
+        golden paths over each stream's admitted tick sequence;
+      * zero recompiles / zero implicit transfers across the whole
+        serving cycle — rung switches, snapshot pulls, the kill and
+        evacuation — under utils/guards.steady_state (every ladder
+        rung is pre-warmed at precompile);
+      * p99 drain latency: the adaptive arm beats the static baseline
+        on the paired per-wall-tick drain p99 (the burst ticks ARE the
+        tail), asserted with a timer-floor clamp on BOTH arms.
+
+    The artifact carries the clamped ``elastic_serving_ab`` decision
+    key (scripts/decide_backends.py: TPU records only; on this
+    linkless CPU rig a dispatch costs microseconds of Python, so CPU
+    evidence can never flip the ladder default).  ``smoke`` shrinks
+    geometry to a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+    from rplidar_ros2_driver_tpu.driver.chaos import (
+        ShardChaosConfig,
+        ShardChaosSchedule,
+    )
+    from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, grid = 4, 256, 32
+        points_per_rev, revs, capacity = 800, 10, 1024
+        streams, shards, run = 4, 2, 8
+        rungs, cap = (1, 2, 4), 6
+        stall_period, stall_frames, storm_len = 7, 4, 8
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, revs, capacity = POINTS, 16, CAPACITY
+        streams, shards, run = 8, 4, 16
+        rungs, cap = (1, 2, 4, 8), 8
+        stall_period, stall_frames, storm_len = 9, 6, 10
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    # hot streams (the first quarter, >= 1) produce TWO data ticks per
+    # wall tick, the rest one — the byte-rate spread the weighted
+    # placement must see
+    hot = max(1, streams // 4)
+    rates = [2 if s < hot else 1 for s in range(streams)]
+    per_stream = [
+        _stream_data_ticks(
+            _denseboost_wire_frames(revs * rates[s], points_per_rev),
+            run, ans, 1000.0 + 7.0 * s,
+        )
+        for s in range(streams)
+    ]
+    wall = _storm_wall_schedule(
+        per_stream, rates,
+        stall_period=stall_period, stall_frames=stall_frames, phase=3,
+        storm_at=len(per_stream[hot]) // (2 * rates[hot]),
+        storm_len=storm_len,
+    )
+    warm = 2
+    kill_tick = len(wall) - max(4, len(wall) // 5)
+    if kill_tick <= warm + 4:
+        raise RuntimeError("scene too short for warm + timed + kill phases")
+
+    def build(arm_rungs):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused",
+            sched_rungs=arm_rungs, admission_max_backlog_ticks=cap,
+            shard_count=shards, failover_snapshot_ticks=4,
+            # the storm is TRAFFIC, not a device death: the fleet-wide
+            # outage plus the overlapping per-stream stall windows
+            # produce up to storm_len + stall_frames consecutive EMPTY
+            # drains, which the shard FSM (correctly) reads as
+            # starvation at its deployment defaults — the bench raises
+            # the threshold past its own trace so the ONLY loss is the
+            # scheduled chaos kill (the config-15 discipline of tuning
+            # FSM timings to the scenario under test)
+            shard_starvation_ticks=2 * (storm_len + stall_frames),
+        )
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=beams,
+            capacity=capacity, fleet_ingest_buckets=(run,),
+        )
+        pod.attach_scheduler()
+        pod.precompile([ans])
+        pod.attach_shard_chaos(ShardChaosSchedule(ShardChaosConfig(
+            kills=((1, kill_tick, 0),)
+        )))
+        return pod
+
+    static_pod = build((1,))
+    adaptive_pod = build(rungs)
+    outs = {
+        "static": [[] for _ in range(streams)],
+        "adaptive": [[] for _ in range(streams)],
+    }
+    pods = {"static": static_pod, "adaptive": adaptive_pod}
+    # shadow admission simulation: the independent check that the
+    # shaper's shed counters implement exactly the bounded-queue
+    # oldest-drop policy, and the host-golden input (admitted ticks per
+    # stream, sheds removed)
+    admitted: list = [[] for _ in range(streams)]
+    shadow: list = [[] for _ in range(streams)]
+    shadow_drops = [0] * streams
+    max_depth_seen = 0
+    n_before_kill = None
+    static_s: list = []
+    adaptive_s: list = []
+    weights_at_kill = None
+
+    def advance(name, items):
+        nonlocal max_depth_seen
+        pod = pods[name]
+        pod.offer_bytes(items)
+        # the bound is checked at its peak — post-admission, pre-drain
+        # (the drain empties the queues)
+        max_depth_seen = max(
+            max_depth_seen,
+            max(len(q) for q in pod.scheduler.queues),
+        )
+        t0 = time.perf_counter()
+        got = pod.drain_scheduled()
+        dt = time.perf_counter() - t0
+        for i, g in enumerate(got):
+            outs[name][i].extend(g)
+        return dt
+
+    def shadow_admit(items):
+        for s, item in enumerate(items):
+            if not item:
+                continue
+            for tick in item:
+                shadow[s].append(tick)
+                if len(shadow[s]) > cap:
+                    shadow[s].pop(0)
+                    shadow_drops[s] += 1
+
+    def run_tick(t, items, timed):
+        # alternate which arm drains first (config 13 discipline: this
+        # rig's whole-seconds load drift hits both lanes identically)
+        order = (
+            ("static", "adaptive") if t % 2 == 0
+            else ("adaptive", "static")
+        )
+        times = {}
+        for name in order:
+            times[name] = advance(name, items)
+        shadow_admit(items)
+        # drained = whatever the shaper admitted then popped this tick
+        for s in range(streams):
+            admitted[s].extend(shadow[s])
+            shadow[s].clear()
+        if timed:
+            static_s.append(times["static"])
+            adaptive_s.append(times["adaptive"])
+
+    for t, items in enumerate(wall[:warm]):
+        run_tick(t, items, False)
+    # timed-window scan baseline: the headline divides TIMED scans by
+    # TIMED drain time, so warm-up and post-kill completions must not
+    # inflate the rate (the config-18 counter discipline)
+    n_after_warm = [len(o) for o in outs["adaptive"]]
+    with guards.steady_state(tag="elastic-serving A/B pair"):
+        for t, items in enumerate(wall[warm:kill_tick]):
+            run_tick(warm + t, items, True)
+        n_before_kill = [len(o) for o in outs["adaptive"]]
+        for t, items in enumerate(wall[kill_tick:]):
+            run_tick(kill_tick + t, items, False)
+            if t == 0:
+                # the weights the evacuation actually sorted by: the
+                # kill tick's offer refreshed them (offer_bytes ->
+                # _refresh_weights) BEFORE its drain evacuated, and no
+                # further refresh runs inside the tick — sampling one
+                # tick earlier can land on an EWMA rank crossing and
+                # fail a correct heaviest-first plan
+                weights_at_kill = [
+                    adaptive_pod.topology.weight_of(s)
+                    for s in range(streams)
+                ]
+
+    # -- structural claims: violations are bugs, not weather --
+    rung_tables = {}
+    for name, pod in pods.items():
+        table: dict = {}
+        total = 0
+        for sh in pod.shards:
+            eng = sh.fleet_ingest
+            if sum(eng.rung_dispatches.values()) != eng.dispatch_count:
+                raise RuntimeError(
+                    f"{name}: per-rung dispatch counters do not sum to "
+                    "the engine dispatch count — the accounting leaks"
+                )
+            if eng.revs_dropped:
+                raise RuntimeError(
+                    f"{name}: {eng.revs_dropped} revolutions dropped "
+                    "(max_revs overflow) — the golden replay would "
+                    "diverge"
+                )
+            for r, n in eng.rung_dispatches.items():
+                table[r] = table.get(r, 0) + n
+            total += eng.dispatch_count
+        rung_tables[name] = {"per_rung": table, "total": total}
+    st_table = rung_tables["static"]["per_rung"]
+    if any(n for r, n in st_table.items() if r != 1):
+        raise RuntimeError(
+            "static arm dispatched above rung 1 — the baseline is not "
+            "the static-T serving plane"
+        )
+    ad_table = rung_tables["adaptive"]["per_rung"]
+    top = max(rungs)
+    if not ad_table.get(top):
+        raise RuntimeError(
+            f"adaptive arm never reached the top rung T={top} — the "
+            "storm did not exercise the ladder"
+        )
+    if rung_tables["adaptive"]["total"] >= rung_tables["static"]["total"]:
+        raise RuntimeError(
+            "adaptive arm did not collapse dispatches vs the static "
+            f"baseline ({rung_tables['adaptive']['total']} >= "
+            f"{rung_tables['static']['total']})"
+        )
+    # bounded backlog + shed parity (the admission contract)
+    if max_depth_seen > cap:
+        raise RuntimeError(
+            f"observed backlog depth {max_depth_seen} exceeds the "
+            f"admission bound {cap} — the queue is not bounded"
+        )
+    for name, pod in pods.items():
+        if list(pod.scheduler.admission_drops) != shadow_drops:
+            raise RuntimeError(
+                f"{name}: admission-shed counters "
+                f"{pod.scheduler.admission_drops} != shadow policy "
+                f"{shadow_drops}"
+            )
+    if sum(shadow_drops) == 0:
+        raise RuntimeError(
+            "the fleet-wide outage never forced a shed — the bound was "
+            "not exercised"
+        )
+    # byte-equal trajectories: arm vs arm (whole run, kill included)
+    for i in range(streams):
+        a, b = outs["adaptive"][i], outs["static"][i]
+        if len(a) != len(b) or not all(
+            np.array_equal(np.asarray(x.ranges), np.asarray(y.ranges))
+            and np.array_equal(np.asarray(x.voxel), np.asarray(y.voxel))
+            for x, y in zip(a, b)
+        ):
+            raise RuntimeError(
+                f"stream {i}: outputs diverged between the adaptive and "
+                "static arms — the rung sequence changed WHAT, not when"
+            )
+    # host golden: N independent decoder+assembler+chain paths over the
+    # admitted (post-shed) tick sequences; compared on the pre-kill
+    # prefix (post-kill, victims legitimately diverge from a full
+    # replay by their snapshot restore — that contract is config 15's)
+    for i in range(streams):
+        completed: list = []
+        asm = ScanAssembler(
+            max_nodes=capacity,
+            on_complete=lambda sc, c=completed: c.append(dict(sc)),
+        )
+        dec = BatchScanDecoder(asm)
+        for ans_t, frames in admitted[i]:
+            dec.on_measurement_batch(int(ans_t), list(frames))
+        chain = ScanFilterChain(
+            pods["adaptive"].params, beams=beams, warmup=False
+        )
+        golden = [
+            chain.process_raw(
+                sc["angle_q14"], sc["dist_q2"], sc["quality"], sc["flag"]
+            )
+            for sc in completed
+        ]
+        n = n_before_kill[i]
+        got = outs["adaptive"][i][:n]
+        if len(golden) < n or not all(
+            np.array_equal(np.asarray(g.ranges), np.asarray(o.ranges))
+            and np.array_equal(np.asarray(g.voxel), np.asarray(o.voxel))
+            for g, o in zip(golden[:n], got)
+        ):
+            raise RuntimeError(
+                f"stream {i}: pre-kill outputs diverged from the host "
+                "golden replay of the admitted tick sequence"
+            )
+    # weighted placement: the byte-rate EWMA separated hot from cold,
+    # and the evacuation placed the heaviest victim FIRST
+    if weights_at_kill[0] <= weights_at_kill[-1]:
+        raise RuntimeError(
+            f"hot stream weight {weights_at_kill[0]:.3f} did not exceed "
+            f"cold stream weight {weights_at_kill[-1]:.3f}"
+        )
+    # one ordering check PER evacuation event (a multi-loss run has
+    # several independent plans; only ordering WITHIN a plan is the
+    # topology's contract), grouped by the (tick, source-shard) the
+    # event rows carry
+    evac_groups: dict = {}
+    evac = []
+    for (et, ev, stream, *rest) in adaptive_pod.events:
+        if ev == "evacuated":
+            evac_groups.setdefault((et, rest[0]), []).append(stream)
+            evac.append(stream)
+    if not evac:
+        raise RuntimeError("the chaos kill never evacuated anyone")
+    for key, group in evac_groups.items():
+        w = [weights_at_kill[s] for s in group]
+        if w != sorted(w, reverse=True):
+            raise RuntimeError(
+                f"evacuation {key} order {group} is not heaviest-first "
+                f"(weights {w})"
+            )
+
+    # -- the latency claim --
+    p99_static = float(np.percentile(static_s, 99))
+    p99_adaptive = float(np.percentile(adaptive_s, 99))
+    p99_speedup = p99_static / max(p99_adaptive, 1e-9)
+    # EITHER arm under the 50 us/drain floor: the ratio's magnitude is
+    # the timer's, not the rig's (config-16/18 discipline)
+    clamped = min(
+        float(np.percentile(static_s, 50)),
+        float(np.percentile(adaptive_s, 50)),
+    ) < 50e-6
+    # smoke is a parity SANITY floor (at seconds-scale CPU geometry the
+    # per-tick compute dwarfs the dispatch overhead the deep rungs
+    # remove, and the lax.scan super-step costs the XLA:CPU loop a few
+    # percent — weather, not structure); the WIN bar applies to full
+    # runs, where config 11 already measured the drain collapse 1.68x
+    # on this rig and on-chip each amortized dispatch is a link round
+    # trip
+    bar = 0.85 if smoke else 1.05
+    if not clamped and p99_speedup < bar:
+        raise RuntimeError(
+            f"adaptive arm p99 {p99_adaptive * 1e3:.3f} ms did not beat "
+            f"the static baseline {p99_static * 1e3:.3f} ms (ratio "
+            f"{p99_speedup:.3f} < {bar})"
+        )
+    scans = sum(n_before_kill) - sum(n_after_warm)
+    dt = float(np.sum(adaptive_s))
+    value = scans / max(dt, 1e-9)
+    return {
+        "metric": metric_name(19),
+        "value": round(value, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "shards": shards,
+        "rungs": list(rungs),
+        "wall_ticks": len(wall),
+        "timed_ticks": len(static_s),
+        "scans": scans,
+        "p99_static_ms": round(p99_static * 1e3, 3),
+        "p99_adaptive_ms": round(p99_adaptive * 1e3, 3),
+        "p50_static_ms": round(
+            float(np.percentile(static_s, 50)) * 1e3, 3
+        ),
+        "p50_adaptive_ms": round(
+            float(np.percentile(adaptive_s, 50)) * 1e3, 3
+        ),
+        "rung_dispatches": {
+            name: {str(r): n for r, n in sorted(t["per_rung"].items())}
+            for name, t in rung_tables.items()
+        },
+        "dispatch_totals": {
+            name: t["total"] for name, t in rung_tables.items()
+        },
+        "admission": {
+            "bound_ticks": cap,
+            "max_depth_seen": max_depth_seen,
+            "sheds_per_stream": shadow_drops,
+            "sheds_total": sum(shadow_drops),
+        },
+        "weights_at_kill": [round(w, 3) for w in weights_at_kill],
+        "evacuated": evac,
+        "structural": {
+            "per_rung_accounting": True,       # asserted above
+            "static_arm_rung1_only": True,     # asserted above
+            "adaptive_reached_top_rung": True,  # asserted above
+            "dispatch_collapse": True,         # asserted above
+            "bounded_backlog": True,           # asserted above
+            "shed_policy_matches_shadow": True,  # asserted above
+            "byte_equal_arms": True,           # asserted above
+            "byte_equal_host_golden": True,    # asserted above
+            "weighted_evacuation": True,       # asserted above
+            "zero_recompiles": True,           # steady_state guard
+            "zero_implicit_transfers": True,   # steady_state guard
+        },
+        # the decide_backends decision key for the sched_rungs ladder
+        # default: TPU records only, the clamp honored — the dispatch
+        # collapse and the bounded backlog are structural everywhere,
+        # but only on-chip wall time can price the p99 win
+        "elastic_serving_ab": {
+            "p99_speedup": round(p99_speedup, 4),
+            "rungs": list(rungs),
+            "shards": shards,
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the burst collapse is structural: a depth-D backlog "
+            "drains in ceil(D/T) compiled dispatches instead of D, "
+            "asserted by per-rung counters, with byte-equal "
+            "trajectories for ANY rung sequence by construction (the "
+            "super-step's idle padding is a carry no-op).  The p99 "
+            "ratio records what the collapse is worth on THIS rig; on "
+            "a linkless CPU a dispatch costs microseconds of Python, "
+            "so the ratio here prices host overhead, not the "
+            "per-dispatch link round-trip the deep rungs amortize — "
+            "the on-chip capture queued in scripts/rig_recapture.sh "
+            "is where the latency claim lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 class _DriftingFrontEnd:
     """Scripted SLAM front-end for the config-17 back-end A/B: maps are
     rasterized at CALLER-SUPPLIED (drift-injected) poses with no
@@ -3711,6 +4234,7 @@ def metric_name(config: int) -> str:
         16: "deskew_recon_map_updates_per_sec",
         17: "loop_close_corrected_scans_per_sec",
         18: "fused_mapping_stack_updates_per_sec",
+        19: "elastic_serving_adaptive_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -3740,6 +4264,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_loop_close()
     if kind == "fused_mapping":
         return bench_fused_mapping()
+    if kind == "elastic_serving":
+        return bench_elastic_serving()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -4155,6 +4681,18 @@ if __name__ == "__main__":
         "gate for the fused mapping route",
     )
     ap.add_argument(
+        "--smoke-elastic-serving",
+        action="store_true",
+        help="seconds-scale CPU run of the config-19 traffic-shaped "
+        "serving A/B (small geometry, forced CPU backend, no tunnel "
+        "probe): asserts per-rung dispatch accounting, the burst "
+        "dispatch collapse, bounded per-stream backlog with shadow-"
+        "checked oldest-tick sheds, byte-equal trajectories across "
+        "arms + the host golden, byte-rate-weighted evacuation and "
+        "zero recompiles/implicit transfers across rung switches and "
+        "a shard kill — the tier-1 regression gate for the scheduler",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -4256,6 +4794,14 @@ if __name__ == "__main__":
         # run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_fused_mapping(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_elastic_serving:
+        # same CPU-only discipline: the scheduler's structural gate
+        # (rung accounting, bounded backlog, parity) must run
+        # anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_elastic_serving(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -342,6 +342,38 @@ class DriverParams:
     # — ticks since it are lost, so the cadence bounds the loss window.
     # 0 disables pulls (victims restore as fresh streams).
     failover_snapshot_ticks: int = 8
+    # -- traffic-shaped elastic serving (parallel/scheduler.py) --
+    # precompiled super-tick RUNG ladder for backlog drains: every
+    # listed depth T gets its own pre-warmed (T, bucket) executable at
+    # FleetFusedIngest.precompile, and the scheduler picks the rung per
+    # drain from measured backlog depth — a burst is swallowed in one
+    # deep dispatch, steady traffic stays on the low-latency shallow
+    # rungs, and a mid-run rung switch is a compile-cache hit by
+    # construction (zero recompiles, guards-pinned).  Must start at 1
+    # (the per-tick program is the floor the scheduler can always fall
+    # to) and ascend; each extra rung costs one compile per bucket at
+    # warmup.  The ladder is inert until a TrafficShaper is attached
+    # (ShardedFilterService.attach_scheduler / ElasticFleetService).
+    sched_rungs: tuple = (1, 2, 4, 8)
+    # consecutive drains at or below a LOWER rung's depth before the
+    # scheduler steps down one rung (stepping UP is immediate — a burst
+    # must be swallowed now, but easing back waits out the echo so a
+    # sawtooth backlog doesn't thrash the rung choice)
+    sched_hysteresis_ticks: int = 2
+    # per-shard drain deadline budget (ms): the rung choice is capped
+    # so the PREDICTED drain wall time (EWMA per-tick drain cost x
+    # rung depth) stays inside the budget — the SLO feeding the rung
+    # choice.  0 disables the cap (backlog depth alone picks the rung).
+    sched_deadline_ms: float = 0.0
+    # EWMA weight for the per-stream byte-rate estimate that feeds
+    # byte-rate-weighted placement (FleetTopology weights) and the
+    # /diagnostics scheduler group
+    sched_byte_rate_alpha: float = 0.2
+    # per-stream admission bound: a stream's queued backlog never
+    # exceeds this many ticks — beyond it the OLDEST queued tick is
+    # shed (counted per stream, surfaced on /diagnostics), never
+    # unbounded growth.  The SLO-aware admission policy's hard edge.
+    admission_max_backlog_ticks: int = 32
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -607,6 +639,40 @@ class DriverParams:
             )
         if self.pose_graph_iters < 1:
             raise ValueError("pose_graph_iters must be >= 1")
+        rungs = tuple(self.sched_rungs)
+        if not rungs or any(
+            not isinstance(r, int) or isinstance(r, bool) for r in rungs
+        ):
+            raise ValueError("sched_rungs must be a non-empty tuple of ints")
+        if rungs[0] != 1:
+            raise ValueError(
+                "sched_rungs must start at 1 (the per-tick program is "
+                "the floor the scheduler can always fall to)"
+            )
+        if any(b <= a for a, b in zip(rungs, rungs[1:])):
+            raise ValueError("sched_rungs must be strictly ascending")
+        if rungs[-1] > 64:
+            raise ValueError(
+                "sched_rungs depths must be <= 64 (every rung is one "
+                "more compiled super-step program per padding bucket)"
+            )
+        if self.sched_hysteresis_ticks < 1:
+            raise ValueError("sched_hysteresis_ticks must be >= 1")
+        if self.sched_deadline_ms < 0:
+            raise ValueError(
+                "sched_deadline_ms must be >= 0 (0 disables the "
+                "deadline cap on the rung choice)"
+            )
+        if not (0.0 < self.sched_byte_rate_alpha <= 1.0):
+            raise ValueError(
+                "sched_byte_rate_alpha must be within (0, 1]"
+            )
+        if self.admission_max_backlog_ticks < 1:
+            raise ValueError(
+                "admission_max_backlog_ticks must be >= 1 (the per-"
+                "stream backlog is BOUNDED by contract — unbounded "
+                "growth is the failure mode this knob exists to forbid)"
+            )
         if not (1 <= self.pose_graph_max_constraints <= 256):
             raise ValueError(
                 "pose_graph_max_constraints must be within [1, 256]"
@@ -621,6 +687,8 @@ class DriverParams:
         p = cls(**{k: v for k, v in d.items() if k in known})
         if isinstance(p.filter_chain, list):
             p.filter_chain = tuple(p.filter_chain)
+        if isinstance(p.sched_rungs, list):
+            p.sched_rungs = tuple(p.sched_rungs)
         p.validate()
         return p
 

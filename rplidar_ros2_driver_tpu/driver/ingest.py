@@ -487,6 +487,7 @@ class FleetFusedIngest:
         buckets: tuple = _FUSED_BUCKETS,
         slot_impl: str = "fori",
         super_tick_max: Optional[int] = None,
+        rungs: Optional[tuple] = None,
     ) -> None:
         import jax
 
@@ -571,6 +572,29 @@ class FleetFusedIngest:
         if super_tick_max < 1:
             raise ValueError("super_tick_max must be >= 1")
         self.super_tick_max = int(super_tick_max)
+        # super-tick RUNG ladder: the set of backlog-drain depths this
+        # engine pre-warms, so a scheduler (parallel/scheduler.py) can
+        # pick a different T per drain with every rung already in the
+        # compile cache — a mid-run rung switch is a cache hit by
+        # construction.  Depth 1 (the per-tick program) is always a
+        # rung; ``super_tick_max`` stays the default drain depth for
+        # unscheduled callers.  Every rung > 1 costs one compiled
+        # super-step program per padding bucket at precompile.
+        self.rungs = tuple(sorted(
+            {1, self.super_tick_max}
+            | {int(r) for r in (rungs or ())}
+        ))
+        if self.rungs[0] < 1:
+            raise ValueError("super-tick rungs must be >= 1")
+        # compiled drains per rung depth (the bench's per-rung
+        # dispatch accounting; depth 1 counts per-tick dispatches)
+        self.rung_dispatches: dict = {r: 0 for r in self.rungs}
+        # set once precompile has warmed the ladder: extending the
+        # rung set after that would hand out depths precompile never
+        # compiled (ensure_rungs refuses); cold-drain warnings fire
+        # once per depth
+        self._rungs_warmed = False
+        self._cold_rungs_warned: set = set()
         self._buckets = tuple(sorted(buckets))
         self._jax = jax
         self.timing = timingmod.TimingDesc()
@@ -751,6 +775,34 @@ class FleetFusedIngest:
             deskew=self._deskew, mapping=self._mapping,
         )
 
+    def ensure_rungs(self, rungs) -> None:
+        """Extend the warmed rung ladder (a scheduler attaching to an
+        already-constructed engine).  Must happen BEFORE precompile /
+        traffic: a new depth on a live engine would pay its compile
+        inside the serving loop, exactly what the ladder exists to
+        forbid."""
+        need = {1} | {int(r) for r in rungs}
+        if need <= set(self.rungs):
+            return
+        if self.ticks > 0 or self._rungs_warmed:
+            # after precompile the new depths would pass the
+            # `depth in self.rungs` check without any compiled
+            # executable behind them — the first deep drain would pay
+            # its compile inside the serving loop
+            raise RuntimeError(
+                f"cannot extend the rung ladder {self.rungs} with "
+                f"{sorted(need - set(self.rungs))} on an engine that "
+                "has already "
+                + ("ticked" if self.ticks > 0 else "precompiled")
+                + " — attach the scheduler BEFORE precompile/traffic"
+            )
+        if min(need) < 1:
+            raise ValueError("super-tick rungs must be >= 1")
+        self.rungs = tuple(sorted(set(self.rungs) | need))
+        self.rung_dispatches = {
+            r: self.rung_dispatches.get(r, 0) for r in self.rungs
+        }
+
     def precompile(self, formats, buckets: Optional[tuple] = None) -> None:
         """Warm the jit cache for EVERY padding bucket of the given format
         set on a throwaway state (motor-warmup analog of the single-stream
@@ -773,6 +825,7 @@ class FleetFusedIngest:
             icfg = self._icfg
         if icfg is None:
             return
+        self._rungs_warmed = True
         for b in buckets or self._buckets:
             st = self._place(create_fleet_ingest_state(icfg, self.streams))
             aux = np.zeros((self.streams, fleet_aux_len(b)), np.float32)
@@ -782,9 +835,13 @@ class FleetFusedIngest:
                 aux,
             )
             fleet_fused_ingest_step(st, dbuf, daux, cfg=icfg)
-            if self.super_tick_max > 1:
-                # the backlog-drain program: one compile per (T, bucket)
-                T = self.super_tick_max
+            for T in self.rungs:
+                if T <= 1:
+                    continue  # the per-tick program above IS rung 1
+                # the backlog-drain programs: one compile per
+                # (rung, bucket) — EVERY ladder depth is warmed here,
+                # so a scheduler switching rungs mid-run stays in the
+                # compile cache (tests/test_guards.py pins it)
                 st = self._place(
                     create_fleet_ingest_state(icfg, self.streams)
                 )
@@ -893,41 +950,70 @@ class FleetFusedIngest:
         lowering is enabled)."""
         self._dispatch_slices(self._tick_slices(items))
 
-    def _dispatch_slices(self, slices) -> None:
+    def _dispatch_slices(self, slices, depth: Optional[int] = None) -> None:
         """Dispatch a queue of tick slices: one per-tick program each
-        when the super-step is disabled (or a single slice is queued),
-        else groups of up to ``super_tick_max`` slices per ONE compiled
-        super-step dispatch."""
-        if self.super_tick_max <= 1:
+        at depth 1 (or for a single queued slice), else groups of up to
+        ``depth`` slices per ONE compiled super-step dispatch.  The
+        default depth is ``super_tick_max``; a scheduler picks a
+        different WARMED rung per drain — an unwarmed depth is refused
+        loudly, because it would pay its compile inside the serving
+        loop."""
+        if depth is None:
+            depth = self.super_tick_max
+        elif depth not in self.rungs:
+            raise ValueError(
+                f"drain depth {depth} is not a warmed rung "
+                f"{self.rungs} — extend sched_rungs (ensure_rungs) "
+                "before traffic"
+            )
+        elif (
+            depth > 1 and not self._rungs_warmed
+            and depth not in self._cold_rungs_warned
+        ):
+            # a LISTED rung on a never-precompiled engine still pays
+            # its compile here — fine for offline tools and parity
+            # tests, a latency spike in a serving loop, so say so
+            # (once per depth; the jit cache holds it afterwards)
+            self._cold_rungs_warned.add(depth)
+            log.warning(
+                "rung-%d drain on an engine precompile() never warmed "
+                "— this dispatch compiles in-line", depth,
+            )
+        if depth <= 1:
             for sl in slices:
                 self._dispatch_slice(sl)
             return
         off = 0
         while off < len(slices):
-            group = slices[off : off + self.super_tick_max]
+            group = slices[off : off + depth]
             if len(group) == 1:
                 self._dispatch_slice(group[0])
             else:
-                self._dispatch_super(group)
+                self._dispatch_super(group, depth)
             off += len(group)
 
-    def _staging_buffers(self, kind: str, mb: int) -> tuple:
-        """A (frames, aux) staging pair for one padding bucket: recycled
-        from the free list when a fetched dispatch has returned one of
-        the right shape (zeroed for reuse), freshly allocated otherwise
-        — shapes go stale when the active format set's payload width
-        moves, and stale pairs are simply not reused."""
+    def _staging_buffers(self, skey: tuple) -> tuple:
+        """A (frames, aux) staging pair for one staging key —
+        ``("tick", bucket)`` or ``("super", T, bucket)``, the rung depth
+        part of the key because each rung's planes carry a different
+        leading tick axis: recycled from the free list when a fetched
+        dispatch has returned one of the right shape (zeroed for
+        reuse), freshly allocated otherwise — shapes go stale when the
+        active format set's payload width moves, and stale pairs are
+        simply not reused."""
         from rplidar_ros2_driver_tpu.ops.ingest import fleet_aux_len
 
         fb = self._icfg.frame_bytes
+        mb = skey[-1]
         al = fleet_aux_len(mb)
-        if kind == "super":
-            shape_b = (self.super_tick_max, self.streams, mb, fb)
-            shape_a = (self.super_tick_max, self.streams, al)
+        if skey[0] == "super":
+            T = skey[1]
+            shape_b = (T, self.streams, mb, fb)
+            shape_a = (T, self.streams, al)
         else:
             shape_b = (self.streams, mb, fb)
             shape_a = (self.streams, al)
-        free = self._staging_free.setdefault((kind, mb), [])
+        free = self._staging_free.setdefault(skey, [])
         while free:
             entry = free.pop()
             if entry[0].shape == shape_b:
@@ -936,11 +1022,11 @@ class FleetFusedIngest:
                 return entry
         return (np.zeros(shape_b, np.uint8), np.zeros(shape_a, np.float32))
 
-    def _recycle_staging(self, kind: str, mb: int, pair) -> None:
+    def _recycle_staging(self, skey: tuple, pair) -> None:
         """Return a fetched entry's staging pair to the free list (its
         dispatch's results are host-side, so the inputs are provably
         consumed)."""
-        self._staging_free.setdefault((kind, mb), []).append(pair)
+        self._staging_free.setdefault(skey, []).append(pair)
 
     # graftlint: hot-loop
     def _stage_slice(self, sl, mb: int, buf, aux) -> None:
@@ -999,7 +1085,8 @@ class FleetFusedIngest:
         mb = self._bucket(max(
             (len(c[1]) for c in sl[0] if c), default=1
         ))
-        pair = self._staging_buffers("tick", mb)
+        skey = ("tick", mb)
+        pair = self._staging_buffers(skey)
         buf, aux = pair
         self._stage_slice(sl, mb, buf, aux)
         # explicit device_put staging (_put_staging) — 2 DECLARED
@@ -1011,27 +1098,31 @@ class FleetFusedIngest:
             self._state, dbuf, daux, cfg=icfg
         )
         self.dispatch_count += 1
+        self.rung_dispatches[1] = self.rung_dispatches.get(1, 0) + 1
         self.h2d_transfers += 2
         self._append_pending(
-            res, ("tick", tuple(res), icfg, list(self._bases), mb, pair)
+            res, ("tick", tuple(res), icfg, list(self._bases), skey, pair)
         )
 
     # graftlint: hot-loop
-    def _dispatch_super(self, group) -> None:
-        """Stage up to ``super_tick_max`` tick slices as one
+    def _dispatch_super(self, group, T: int) -> None:
+        """Stage up to ``T`` tick slices (a warmed rung depth) as one
         (T, streams, M, frame_bytes) plane and drain them in ONE
         compiled super-step dispatch (ops/ingest.super_fleet_ingest_step).
         The group is padded to the full T with all-idle tick planes —
         zeroed staging rows are exactly the idle-lane encoding (m=0,
         base_shift=0, no reset), which pass every carry through — so each
-        (T, bucket) pair compiles once, whatever the backlog length."""
+        (rung, bucket) pair compiles once, whatever the backlog length,
+        and any rung SEQUENCE lands byte-identical state (the pad ticks
+        are no-ops by construction)."""
         from rplidar_ros2_driver_tpu.ops.ingest import super_fleet_ingest_step
 
         icfg = self._icfg
         mb = self._bucket(max(
             (len(c[1]) for sl in group for c in sl[0] if c), default=1
         ))
-        pair = self._staging_buffers("super", mb)
+        skey = ("super", T, mb)
+        pair = self._staging_buffers(skey)
         buf, aux = pair
         bases_per_tick = []
         for t, sl in enumerate(group):
@@ -1047,9 +1138,10 @@ class FleetFusedIngest:
         self.dispatch_count += 1
         self.super_dispatches += 1
         self.ticks_super_fused += len(group)
+        self.rung_dispatches[T] = self.rung_dispatches.get(T, 0) + 1
         self.h2d_transfers += 2
         self._append_pending(
-            res, ("super", tuple(res), icfg, bases_per_tick, mb, pair)
+            res, ("super", tuple(res), icfg, bases_per_tick, skey, pair)
         )
 
     # -- consumer side -----------------------------------------------------
@@ -1092,7 +1184,7 @@ class FleetFusedIngest:
                     dur = max(float(res.end_ts[k]) - float(res.ts0[k]), 0.0)
                     out[i].append((res.outputs[k], ts0, dur))
 
-        for kind, arrays, icfg, bases, mb, pair in entries:
+        for kind, arrays, icfg, bases, skey, pair in entries:
             if kind == "super":
                 ticks = unpack_super_fleet_ingest_result(arrays, icfg)
                 for t, results in enumerate(ticks):
@@ -1103,7 +1195,7 @@ class FleetFusedIngest:
                 absorb(unpack_fleet_ingest_result(arrays, icfg), bases)
             # the unpack above fetched this dispatch's results, proving
             # its staged inputs consumed: the pair is safe to reuse
-            self._recycle_staging(kind, mb, pair)
+            self._recycle_staging(skey, pair)
         return out
 
     def take_recon(self) -> list:
@@ -1151,23 +1243,27 @@ class FleetFusedIngest:
             self._pending.clear()
             return self._parse_entries(entries)
 
-    def submit_backlog(self, ticks) -> list:
+    def submit_backlog(self, ticks, *, rung: Optional[int] = None) -> list:
         """Drain a BACKLOG of queued fleet ticks — frames that piled up
         behind a link stall or a slow consumer — in
-        ``ceil(len(ticks)/super_tick_max)`` compiled dispatches instead
-        of one per tick (one per tick when the super-step is disabled).
+        ``ceil(len(ticks)/T)`` compiled dispatches instead of one per
+        tick (one per tick when the super-step is disabled).  ``T`` is
+        ``super_tick_max`` by default; ``rung`` overrides it with
+        another WARMED ladder depth (parallel/scheduler.py picks it per
+        drain from measured backlog — an unwarmed depth is refused).
         ``ticks`` is a list of per-tick item lists, each with the
         :meth:`submit` layout; ticks are normalized IN ORDER (recorder
         tee, per-stream format switches and resets land at their own
         tick) and the whole queue is staged into T-tick super-step
         planes.  Returns every pending revolution as per-stream
         ``(FilterOutput, ts0, duration)`` lists, in tick order —
-        bit-exact against submitting the same ticks one by one."""
+        bit-exact against submitting the same ticks one by one, for ANY
+        rung sequence (the scheduler chooses when, never what)."""
         with self._lock:
             slices = []
             for items in ticks:
                 slices.extend(self._tick_slices(items))
-            self._dispatch_slices(slices)
+            self._dispatch_slices(slices, depth=rung)
             entries = list(self._pending)
             self._pending.clear()
             return self._parse_entries(entries)

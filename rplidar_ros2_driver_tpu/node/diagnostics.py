@@ -64,6 +64,7 @@ class DiagnosticsUpdater:
         reconnect: Optional[dict] = None,
         stream_health: Optional[list] = None,
         shard_topology: Optional[dict] = None,
+        scheduler: Optional[dict] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -170,6 +171,35 @@ class DiagnosticsUpdater:
             values["Last Migration Tick"] = (
                 "n/a" if last is None else str(last)
             )
+        # traffic-shaping scheduler (parallel/scheduler.TrafficShaper
+        # via service.scheduler_status()): the current drain rung(s),
+        # per-stream backlog depth + admission-shed counters (the
+        # bounded-backlog contract at a glance), per-rung compiled-
+        # dispatch accounting and the byte-rate placement weights —
+        # mirroring the shard-topology group (tests/test_scheduler.py
+        # pins the rendering)
+        if scheduler:
+            values["Sched Rung"] = ",".join(
+                str(r) for r in scheduler.get("rungs", [])
+            )
+            values["Sched Backlog"] = ",".join(
+                str(b) for b in scheduler.get("backlog", [])
+            )
+            values["Admission Drops"] = ",".join(
+                str(d) for d in scheduler.get("admission_drops", [])
+            )
+            values["Admission Shed Total"] = str(
+                scheduler.get("shed_total", 0)
+            )
+            rung_d = scheduler.get("rung_dispatches") or {}
+            values["Rung Dispatches"] = " ".join(
+                f"T{r}:{rung_d[r]}" for r in sorted(rung_d)
+            ) or "n/a"
+            weights = scheduler.get("weights")
+            if weights is not None:
+                values["Placement Weights"] = ",".join(
+                    f"{w:.2f}" for w in weights
+                )
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

@@ -1,0 +1,311 @@
+"""Traffic-shaping policy layer for the elastic serving plane.
+
+PR 9/13 built the *mechanism* — padding-bucket fleet programs, the
+T-tick super-step lowering, lane-relabeling topology — and left the
+*policy* static: drain depth T was a single compile-time knob and
+placement counted streams.  This module is the policy layer that makes
+the mechanism a service under bursty real-world traffic (ROADMAP item
+4; FAR-LIO frames the goal — high scan rates under tight latency
+budgets mean the scheduler, not the kernels, is the binding
+constraint):
+
+  * **backlog-adaptive super-tick depth** — a ladder of pre-warmed
+    drain rungs (``sched_rungs``; every depth compiled at
+    ``FleetFusedIngest.precompile``) and a per-shard
+    :class:`RungLadder` that picks the rung per drain from measured
+    backlog depth: stepping UP is immediate (a burst is swallowed in
+    one deep dispatch), stepping DOWN waits out
+    ``sched_hysteresis_ticks`` consecutive shallow drains so a
+    sawtooth backlog cannot thrash the choice.  Rung switches are
+    compile-cache hits by construction (tests/test_guards.py pins
+    zero recompiles across switches).
+  * **SLO-aware admission** — per-stream BOUNDED backlog queues: past
+    ``admission_max_backlog_ticks`` the OLDEST queued tick is shed
+    (counted per stream, surfaced on /diagnostics), never unbounded
+    growth; and a per-shard deadline budget (``sched_deadline_ms``)
+    caps the rung so the PREDICTED drain wall time (EWMA per-tick
+    drain cost x depth) stays inside the publish SLO.
+  * **byte-rate estimation** — a per-stream EWMA of offered bytes per
+    tick (``sched_byte_rate_alpha``) feeding byte-rate-weighted
+    placement (parallel/sharding.FleetTopology.set_weight): evacuation
+    and re-admission land hot streams on cold shards instead of
+    counting streams.
+
+The policy chooses *when* work dispatches, never *what* it computes:
+any rung sequence over the same admitted ticks lands byte-identical
+trajectories (the super-step's idle padding is a carry no-op), asserted
+by bench --config 19.  Host-side bookkeeping only: no jax, no device
+work — the device cost of a decision is zero.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """The ``sched_*`` / ``admission_*`` param surface (validated in
+    core/config.py; re-checked here so a hand-built config cannot skip
+    the contract)."""
+
+    rungs: tuple = (1, 2, 4, 8)
+    hysteresis_ticks: int = 2
+    deadline_ms: float = 0.0
+    byte_rate_alpha: float = 0.2
+    max_backlog_ticks: int = 32
+
+    def __post_init__(self) -> None:
+        rungs = tuple(int(r) for r in self.rungs)
+        object.__setattr__(self, "rungs", rungs)
+        if not rungs or rungs[0] != 1:
+            raise ValueError(
+                "scheduler rungs must start at 1 (the per-tick program "
+                "is the floor the ladder can always fall to)"
+            )
+        if any(b <= a for a, b in zip(rungs, rungs[1:])):
+            raise ValueError("scheduler rungs must be strictly ascending")
+        if self.hysteresis_ticks < 1:
+            raise ValueError("hysteresis_ticks must be >= 1")
+        if self.deadline_ms < 0:
+            raise ValueError("deadline_ms must be >= 0 (0 = no cap)")
+        if not (0.0 < self.byte_rate_alpha <= 1.0):
+            raise ValueError("byte_rate_alpha must be within (0, 1]")
+        if rungs[-1] > 64:
+            raise ValueError(
+                "scheduler rungs must be <= 64 (every rung is one more "
+                "compiled super-step program per padding bucket — the "
+                "core/config.py cap, re-checked for hand-built configs)"
+            )
+        if self.max_backlog_ticks < 1:
+            raise ValueError(
+                "max_backlog_ticks must be >= 1 (the backlog is "
+                "bounded by contract)"
+            )
+
+    @classmethod
+    def from_params(cls, params) -> "SchedulerConfig":
+        return cls(
+            rungs=tuple(getattr(params, "sched_rungs", (1, 2, 4, 8))),
+            hysteresis_ticks=int(
+                getattr(params, "sched_hysteresis_ticks", 2)
+            ),
+            deadline_ms=float(getattr(params, "sched_deadline_ms", 0.0)),
+            byte_rate_alpha=float(
+                getattr(params, "sched_byte_rate_alpha", 0.2)
+            ),
+            max_backlog_ticks=int(
+                getattr(params, "admission_max_backlog_ticks", 32)
+            ),
+        )
+
+
+class ByteRateEwma:
+    """Per-stream EWMA of offered bytes per tick — the load signal
+    weighted placement consumes.  ``note`` once per stream per offer
+    tick (0 for idle), so the estimate decays while a stream is quiet
+    instead of freezing at its last burst."""
+
+    def __init__(self, streams: int, alpha: float) -> None:
+        self.alpha = float(alpha)
+        self._rate: list = [None] * streams
+
+    def note(self, i: int, nbytes: int) -> None:
+        prev = self._rate[i]
+        self._rate[i] = (
+            float(nbytes) if prev is None
+            else (1.0 - self.alpha) * prev + self.alpha * float(nbytes)
+        )
+
+    def rates(self) -> list:
+        """Per-stream EWMA bytes/tick (0.0 before any observation —
+        a never-seen stream weighs nothing, like an idle one)."""
+        return [0.0 if r is None else r for r in self._rate]
+
+
+class RungLadder:
+    """One shard's rung state: hysteresis + the deadline budget.
+
+    ``pick(backlog)`` is called once per drain.  The demand target is
+    the smallest rung covering the backlog; moving UP to it is
+    immediate, moving DOWN one rung needs ``hysteresis_ticks``
+    consecutive drains whose target sat below the current rung.  The
+    deadline budget then CAPS (never raises) the picked rung so the
+    predicted drain wall time — EWMA per-tick drain cost x depth,
+    measured via ``note_drain`` — fits ``deadline_ms``; the cap leaves
+    the hysteresis state untouched, so demand memory survives a
+    temporarily tight budget."""
+
+    def __init__(self, cfg: SchedulerConfig) -> None:
+        self.cfg = cfg
+        self._idx = 0
+        self._low_streak = 0
+        self.tick_cost_ema: Optional[float] = None  # seconds/tick
+
+    def _target_idx(self, backlog: int) -> int:
+        for j, r in enumerate(self.cfg.rungs):
+            if r >= backlog:
+                return j
+        return len(self.cfg.rungs) - 1
+
+    def pick(self, backlog: int) -> int:
+        t = self._target_idx(max(int(backlog), 1))
+        if t > self._idx:
+            # a burst: swallow it in one deep dispatch NOW
+            self._idx = t
+            self._low_streak = 0
+        elif t < self._idx:
+            self._low_streak += 1
+            if self._low_streak >= self.cfg.hysteresis_ticks:
+                # eased for long enough: step down ONE rung (not to the
+                # target — a burst echo re-raises in one pick anyway)
+                self._idx -= 1
+                self._low_streak = 0
+        else:
+            self._low_streak = 0
+        idx = self._idx
+        if self.cfg.deadline_ms > 0 and self.tick_cost_ema:
+            budget_s = self.cfg.deadline_ms / 1e3
+            while idx > 0 and (
+                self.cfg.rungs[idx] * self.tick_cost_ema > budget_s
+            ):
+                idx -= 1
+        return self.cfg.rungs[idx]
+
+    # the deadline predictor's own smoothing constant — deliberately
+    # NOT cfg.byte_rate_alpha: that knob tunes placement-weight
+    # responsiveness, and retuning placement must not silently make
+    # the SLO predictor jittery (or vice versa)
+    DRAIN_COST_ALPHA = 0.2
+
+    def note_drain(self, n_ticks: int, seconds: float) -> None:
+        """Record a drain's measured cost (the deadline predictor's
+        input): EWMA of seconds per drained tick."""
+        if n_ticks <= 0 or seconds < 0:
+            return
+        per = seconds / n_ticks
+        a = self.DRAIN_COST_ALPHA
+        self.tick_cost_ema = (
+            per if self.tick_cost_ema is None
+            else (1.0 - a) * self.tick_cost_ema + a * per
+        )
+
+    @property
+    def rung(self) -> int:
+        """The current demand rung (pre-deadline-cap)."""
+        return self.cfg.rungs[self._idx]
+
+
+class TrafficShaper:
+    """The serving-plane policy object: per-stream admission queues +
+    byte-rate EWMA + one :class:`RungLadder` per shard.
+
+    ``offer_tick(items)`` admits one wall tick's arrivals (the
+    ``submit_bytes`` item layout; an entry may also be a LIST of queued
+    data ticks — a reconnect storm flushing a stalled device's buffer
+    delivers several at once).  ``drain_plan(shard, lane_streams)``
+    pops the hosted streams' queues front-aligned into global tick
+    lists and picks the shard's rung; the caller dispatches them via
+    ``submit_bytes_backlog(..., rung=...)`` and reports the measured
+    wall time back through ``note_drain``.  Shedding happens at ADMIT
+    time (bounded queues), so the drained tick sequence — and therefore
+    every trajectory — is independent of rung choices by construction.
+    """
+
+    def __init__(
+        self, streams: int, cfg: SchedulerConfig, *, shards: int = 1
+    ) -> None:
+        if streams < 1:
+            raise ValueError("need at least one stream")
+        if shards < 1:
+            raise ValueError("need at least one shard")
+        self.cfg = cfg
+        self.streams = streams
+        self.queues: list = [deque() for _ in range(streams)]
+        self.admission_drops = [0] * streams
+        self.shed_total = 0
+        self.admitted_ticks = 0
+        self.rates = ByteRateEwma(streams, cfg.byte_rate_alpha)
+        self.ladders = [RungLadder(cfg) for _ in range(shards)]
+        self.last_rungs = [cfg.rungs[0]] * shards
+
+    # -- admission ---------------------------------------------------------
+
+    def _admit(self, i: int, item) -> int:
+        """Queue one data tick for stream ``i``; returns its byte
+        count.  Past the bound the OLDEST queued tick is shed — the
+        freshest data is what the SLO wants served, and the partial
+        revolution the gap tears is exactly what the decode resync
+        machinery already absorbs (a real device buffer overrunning
+        drops the oldest frames the same way)."""
+        nbytes = sum(len(p) for p, _ts in item[1])
+        q = self.queues[i]
+        q.append(item)
+        self.admitted_ticks += 1
+        if len(q) > self.cfg.max_backlog_ticks:
+            q.popleft()
+            self.admission_drops[i] += 1
+            self.shed_total += 1
+        return nbytes
+
+    def offer_tick(self, items: Sequence) -> None:
+        """Admit one wall tick of arrivals: ``items[i]`` is None (idle),
+        one ``(ans_type, [(payload, ts), ...])`` data tick, or a list
+        of queued data ticks (a burst arriving at once)."""
+        if len(items) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} per-stream items, "
+                f"got {len(items)}"
+            )
+        for i, item in enumerate(items):
+            if not item:
+                self.rates.note(i, 0)
+                continue
+            burst = item if isinstance(item, list) else [item]
+            self.rates.note(i, sum(self._admit(i, it) for it in burst))
+
+    def backlog_depths(self) -> list:
+        return [len(q) for q in self.queues]
+
+    # -- drain planning ----------------------------------------------------
+
+    def drain_plan(
+        self, shard: int, stream_ids: Sequence[int]
+    ) -> tuple:
+        """Pop the given streams' whole queued backlog, front-aligned
+        into GLOBAL per-tick item lists (non-listed streams idle), and
+        pick the shard's rung for the dispatch grouping.  Returns
+        ``(ticks, rung)`` — ``([], rung)`` when nothing is queued (the
+        ladder still observes the empty drain, so it can step down)."""
+        ids = [i for i in stream_ids if i is not None]
+        depth = max((len(self.queues[i]) for i in ids), default=0)
+        rung = self.ladders[shard].pick(depth)
+        self.last_rungs[shard] = rung
+        if depth == 0:
+            return [], rung
+        ticks = []
+        for _ in range(depth):
+            tick: list = [None] * self.streams
+            for i in ids:
+                if self.queues[i]:
+                    tick[i] = self.queues[i].popleft()
+            ticks.append(tick)
+        return ticks, rung
+
+    def note_drain(self, shard: int, n_ticks: int, seconds: float) -> None:
+        self.ladders[shard].note_drain(n_ticks, seconds)
+
+    # -- observability -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The /diagnostics scheduler value group's payload
+        (node/diagnostics.py renders it; tests pin the rendering)."""
+        return {
+            "rungs": list(self.last_rungs),
+            "backlog": self.backlog_depths(),
+            "admission_drops": list(self.admission_drops),
+            "shed_total": self.shed_total,
+            "byte_rates": [round(r, 1) for r in self.rates.rates()],
+        }

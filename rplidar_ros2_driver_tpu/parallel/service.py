@@ -166,6 +166,11 @@ class ShardedFilterService:
         self.stream_checkpoints: dict = {}
         self.quarantines = 0
         self.rejoins = 0
+        # traffic-shaping seam (parallel/scheduler.TrafficShaper):
+        # when attached, offer_bytes/drain_scheduled run the serving
+        # plane — bounded per-stream admission queues, byte-rate EWMA,
+        # and the backlog-adaptive super-tick rung picked per drain
+        self.scheduler = None
         if getattr(params, "health_enable", False):
             self.attach_health()
 
@@ -503,6 +508,91 @@ class ShardedFilterService:
         (None when no supervisor is attached)."""
         return None if self.health is None else self.health.status()
 
+    # -- traffic-shaping seam ----------------------------------------------
+
+    def attach_scheduler(self, shaper=None) -> "object":
+        """Attach a TrafficShaper (built from this service's
+        ``sched_*``/``admission_*`` params when not given) over the
+        byte-tick seam: :meth:`offer_bytes` admits arrivals into
+        bounded per-stream queues (oldest-tick shed past the cap) and
+        :meth:`drain_scheduled` drains the whole backlog in ONE
+        compiled dispatch per rung group, the rung picked per drain
+        from measured backlog depth with hysteresis and the deadline
+        budget.  Fused backend only, and BEFORE precompile/traffic —
+        every ladder rung must be warmed or a mid-run rung switch
+        would pay an in-loop compile (the engine refuses late ladder
+        extensions).  Returns the attached shaper."""
+        from rplidar_ros2_driver_tpu.parallel.scheduler import (
+            SchedulerConfig,
+            TrafficShaper,
+        )
+
+        self._ensure_byte_ingest()
+        if self.fleet_ingest_backend != "fused":
+            raise ValueError(
+                "attach_scheduler needs fleet_ingest_backend='fused' "
+                "(the rung ladder is a set of compiled super-step "
+                "drain programs; the host path has none)"
+            )
+        if shaper is None:
+            shaper = TrafficShaper(
+                self.streams, SchedulerConfig.from_params(self.params)
+            )
+        if shaper.streams != self.streams:
+            raise ValueError(
+                f"shaper has {shaper.streams} streams, service has "
+                f"{self.streams}"
+            )
+        self.fleet_ingest.ensure_rungs(shaper.cfg.rungs)
+        self.scheduler = shaper
+        return shaper
+
+    def offer_bytes(self, items) -> None:
+        """Admit one wall tick of arrivals into the attached shaper's
+        bounded per-stream queues (``items[i]``: None, one
+        ``(ans_type, frames)`` data tick, or a LIST of data ticks — a
+        reconnect storm flushing a stalled buffer delivers several at
+        once).  Nothing dispatches here; :meth:`drain_scheduled` does."""
+        if self.scheduler is None:
+            raise RuntimeError("attach_scheduler() first")
+        self.scheduler.offer_tick(items)
+
+    def drain_scheduled(self) -> list[list[FilterOutput]]:
+        """Drain the whole admitted backlog at the rung the shaper
+        picks from its depth — ``ceil(depth/rung)`` compiled dispatches
+        — and feed the ladder's deadline predictor the measured wall
+        time.  Returns the :meth:`submit_bytes_backlog` per-stream
+        lists (all-empty when nothing was queued; the ladder still
+        observes the empty drain so it can step down)."""
+        if self.scheduler is None:
+            raise RuntimeError("attach_scheduler() first")
+        ticks, rung = self.scheduler.drain_plan(0, range(self.streams))
+        if not ticks:
+            # nothing queued: no poses are current this tick (the
+            # stale-pose discipline the mapping seams apply on all-idle
+            # ticks — an empty drain must not republish the previous
+            # drain's estimates)
+            self.last_poses = [None] * self.streams
+            return [[] for _ in range(self.streams)]
+        t0 = time.perf_counter()
+        outs = self.submit_bytes_backlog(ticks, rung=rung)
+        self.scheduler.note_drain(
+            0, len(ticks), time.perf_counter() - t0
+        )
+        return outs
+
+    def scheduler_status(self) -> Optional[dict]:
+        """The /diagnostics scheduler value group's payload (None when
+        no shaper is attached)."""
+        if self.scheduler is None:
+            return None
+        status = self.scheduler.status()
+        status["rung_dispatches"] = (
+            {} if self.fleet_ingest is None
+            else dict(self.fleet_ingest.rung_dispatches)
+        )
+        return status
+
     # -- raw-bytes ingest seam ----------------------------------------------
 
     def _ensure_byte_ingest(self):
@@ -653,7 +743,9 @@ class ShardedFilterService:
         staleness; the publish never waits on this tick's compute)."""
         return self.submit_bytes(items, pipelined=True)
 
-    def submit_bytes_backlog(self, ticks) -> list[list[FilterOutput]]:
+    def submit_bytes_backlog(
+        self, ticks, *, rung: Optional[int] = None
+    ) -> list[list[FilterOutput]]:
         """The catch-up seam: drain a BACKLOG of queued fleet byte ticks
         (frames that piled up behind a link stall or a slow consumer) in
         one call.  ``ticks`` is a list of per-tick item lists, each with
@@ -673,15 +765,26 @@ class ShardedFilterService:
         FilterOutput across the backlog, in tick order (unlike the
         per-tick seam's newest-only contract — a drain must not discard
         the queue it just caught up on).  The backends' window semantics
-        differ exactly as documented on :meth:`submit_bytes`."""
+        differ exactly as documented on :meth:`submit_bytes`.
+
+        ``rung`` overrides the drain's super-tick depth with another
+        warmed ladder rung (fused backend only — the scheduler's
+        backlog-adaptive depth pick; the host path has no compiled
+        drain program to pick between)."""
         self._ensure_byte_ingest()
+        if rung is not None and self.fleet_ingest_backend != "fused":
+            raise ValueError(
+                "a drain rung override needs the fused fleet ingest "
+                "backend (the host path dispatches per tick — there is "
+                "no super-step depth to pick)"
+            )
         if self.health is not None:
             # masking only: a catch-up drain is one event, not
             # len(ticks) of steady-state evidence — the health FSMs
             # advance on live ticks (driver/health.FleetHealth.mask)
             ticks = [self.health.mask(t) for t in ticks]
         if self.fleet_ingest_backend == "fused":
-            outs = self.fleet_ingest.submit_backlog(ticks)
+            outs = self.fleet_ingest.submit_backlog(ticks, rung=rung)
             results = [[o for (o, _ts0, _dur) in s] for s in outs]
             if self.fleet_ingest._mapping is not None:
                 # FUSED mapping route: every drained tick's map update
@@ -1458,6 +1561,10 @@ class ElasticFleetService:
         self.last_evacuation: Optional[dict] = None
         self._first_tick_pending = False
         self.last_poses: list = [None] * streams
+        # traffic-shaping seam (attach_scheduler): pod-level shaper +
+        # per-drain (tick, shard, rung, depth) log
+        self.scheduler = None
+        self.rung_log: list = []
 
     # -- warmup ------------------------------------------------------------
 
@@ -1549,27 +1656,9 @@ class ElasticFleetService:
 
         t = self.tick_no
         t0 = time.perf_counter()
-        # 1. chaos-driven kills.  The tick's FULL down set is forced
-        #    LOST before any evacuation runs: processing kills one at a
-        #    time would evacuate the first casualty's victims onto a
-        #    shard the schedule already marks down this tick, then
-        #    immediately re-evacuate them (double restore work, phantom
-        #    migration counts)
-        if self.chaos is not None:
-            downed = [
-                s for s, hs in enumerate(self.shard_health)
-                if hs.state is not ShardState.LOST
-                and self.chaos.down(s, t)
-            ]
-            for s in downed:
-                self.shard_health[s].force_lost("chaos: shard killed")
-            for s in downed:
-                self._on_lost(s, "chaos: shard killed")
-        # 2. re-admission polls (engines rebuilt + rebalance BEFORE
-        #    this tick's bytes flow — the evacuation contract's mirror)
-        for s, hs in enumerate(self.shard_health):
-            if hs.poll_readmit() is not None:
-                self._readmit_shard(s)
+        # 1 + 2: the tick-boundary fault order (kills, then re-admission
+        #    polls) shared with the scheduled drain seam
+        self._tick_faults()
         # 3. routed dispatches.  Routing is FROZEN before the loop: a
         #    heartbeat failure mid-loop evacuates its victims, but their
         #    bytes for THIS tick died with the dispatch that consumed
@@ -1641,6 +1730,220 @@ class ElasticFleetService:
             self._first_tick_pending = False
         self.tick_no += 1
         return outs
+
+    def _tick_faults(self) -> None:
+        """The tick-boundary fault handling every serving seam runs
+        first, in order: chaos-driven kills — the tick's FULL down set
+        is forced LOST before any evacuation runs (processing kills one
+        at a time would evacuate the first casualty's victims onto a
+        shard the schedule already marks down this tick, then
+        immediately re-evacuate them: double restore work, phantom
+        migration counts) — then re-admission polls (engines rebuilt +
+        rebalance BEFORE this tick's bytes flow, the evacuation
+        contract's mirror)."""
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        t = self.tick_no
+        if self.chaos is not None:
+            downed = [
+                s for s, hs in enumerate(self.shard_health)
+                if hs.state is not ShardState.LOST
+                and self.chaos.down(s, t)
+            ]
+            for s in downed:
+                self.shard_health[s].force_lost("chaos: shard killed")
+            for s in downed:
+                self._on_lost(s, "chaos: shard killed")
+        for s, hs in enumerate(self.shard_health):
+            if hs.poll_readmit() is not None:
+                self._readmit_shard(s)
+
+    # -- traffic-shaped serving seam ---------------------------------------
+
+    def attach_scheduler(self, shaper=None) -> "object":
+        """Attach a pod-level TrafficShaper (built from this pod's
+        ``sched_*``/``admission_*`` params when not given): per-STREAM
+        bounded admission queues (they follow a stream across
+        migrations — a victim's backlog survives its shard), one rung
+        ladder PER SHARD (each shard's drain depth tracks its own
+        backlog + deadline budget), and the byte-rate EWMA that
+        weights topology placement, so evacuation and re-admission
+        land hot streams on cold shards.  Must run BEFORE
+        :meth:`precompile` so every ladder rung is warmed on every
+        shard's engine (the engines refuse late extensions)."""
+        from rplidar_ros2_driver_tpu.parallel.scheduler import (
+            SchedulerConfig,
+            TrafficShaper,
+        )
+
+        if shaper is None:
+            shaper = TrafficShaper(
+                self.streams,
+                SchedulerConfig.from_params(self.params),
+                shards=len(self.shards),
+            )
+        if shaper.streams != self.streams or len(shaper.ladders) != len(
+            self.shards
+        ):
+            raise ValueError(
+                f"shaper geometry ({shaper.streams} streams, "
+                f"{len(shaper.ladders)} ladders) does not match the pod "
+                f"({self.streams} streams, {len(self.shards)} shards)"
+            )
+        for sh in self.shards:
+            sh._ensure_byte_ingest()
+            sh.fleet_ingest.ensure_rungs(shaper.cfg.rungs)
+        self.scheduler = shaper
+        self.rung_log: list = []
+        return shaper
+
+    def _refresh_weights(self) -> None:
+        """Feed the shaper's byte-rate EWMAs into the topology as
+        placement weights: ``1 + rate/mean`` — the constant term keeps
+        idle streams at the stream-count heuristic (and placement of a
+        cold fleet round-robin), the normalized term makes one hot
+        stream outweigh several cold ones."""
+        rates = self.scheduler.rates.rates()
+        live = [r for r in rates if r > 0]
+        if not live:
+            return
+        mean = sum(live) / len(live)
+        for i, r in enumerate(rates):
+            self.topology.set_weight(i, 1.0 + r / mean)
+
+    def offer_bytes(self, items) -> None:
+        """Admit one wall tick of pod arrivals (the
+        :meth:`submit_bytes` item layout; an entry may be a LIST of
+        data ticks — a reconnect storm flushing a stalled device
+        buffer delivers several at once).  Admission shed and the
+        byte-rate/weight refresh happen here; nothing dispatches until
+        :meth:`drain_scheduled`."""
+        if self.scheduler is None:
+            raise RuntimeError("attach_scheduler() first")
+        if len(items) != self.streams:
+            raise ValueError(
+                f"expected {self.streams} per-stream items, got {len(items)}"
+            )
+        self.scheduler.offer_tick(items)
+        self._refresh_weights()
+
+    def drain_scheduled(self) -> list:
+        """One scheduled pod drain: the tick-boundary fault order
+        (:meth:`_tick_faults`), then every hosting shard drains its
+        streams' whole queued backlog at the rung ITS ladder picks —
+        ``ceil(depth/rung)`` compiled dispatches per shard — with the
+        measured wall time fed back to the ladder's deadline
+        predictor.  A raised drain is the heartbeat failure: the shard
+        is LOST and evacuated; the consumed ticks died with the
+        dispatch (the per-tick seam's exclusion contract), but the
+        victims' QUEUES survive — their next backlog drains on the
+        survivor.  Returns per-GLOBAL-stream lists of FilterOutputs in
+        tick order (empty for idle/unhosted streams)."""
+        if self.scheduler is None:
+            raise RuntimeError("attach_scheduler() first")
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        t = self.tick_no
+        t0 = time.perf_counter()
+        self._tick_faults()
+        outs: list = [[] for _ in range(self.streams)]
+        for s, hs in enumerate(self.shard_health):
+            if not hs.hosting:
+                continue
+            lane_streams = self.topology.lane_streams(s)
+            ticks, rung = self.scheduler.drain_plan(s, lane_streams)
+            if not ticks:
+                # nothing queued: no poses are current this tick — the
+                # stale-pose discipline (PR 10/13) extended to the
+                # scheduled seam, which must not republish the previous
+                # drain's estimates
+                for stream in lane_streams:
+                    if stream is not None:
+                        self.last_poses[stream] = None
+                # the FSM still observes the empty drain (the per-tick
+                # seam's idle observe): probation completes through
+                # quiet drains, and a previously streaming shard whose
+                # source went silent still walks the starvation ladder
+                tr = hs.observe(False, 0)
+                if tr is not None and tr[1] is ShardState.LOST:
+                    self._on_lost(s, hs.last_reason)
+                continue
+            lane_ticks = [
+                self.topology.lane_items(s, tick) for tick in ticks
+            ]
+            offered = any(any(it for it in lt) for lt in lane_ticks)
+            x0 = time.perf_counter()
+            try:
+                shard_outs = self.shards[s].submit_bytes_backlog(
+                    lane_ticks, rung=rung
+                )
+            except Exception as e:  # noqa: BLE001 - heartbeat boundary
+                logger.exception("shard %d drain failed", s)
+                self._lose_shard(
+                    s, f"heartbeat: {type(e).__name__}: {e}"
+                )
+                # the popped ticks died with the dispatch: excluded via
+                # the PRE-loss lane table (_lose_shard just evacuated
+                # every victim, so streams_on(s) is empty by now)
+                for stream in lane_streams:
+                    if stream is not None:
+                        self._excluded[stream].add(t)
+                continue
+            self.scheduler.note_drain(
+                s, len(ticks), time.perf_counter() - x0
+            )
+            self.rung_log.append((t, s, rung, len(ticks)))
+            completed = 0
+            for lane, stream in enumerate(lane_streams):
+                if stream is None:
+                    continue
+                outs[stream].extend(shard_outs[lane])
+                self.last_poses[stream] = self.shards[s].last_poses[lane]
+                completed += len(shard_outs[lane])
+                if any(tick[stream] for tick in ticks):
+                    # one wall tick of un-snapshotted history, however
+                    # deep the drained backlog (the per-tick seam's
+                    # single append)
+                    self._since_snap[stream].append(t)
+            tr = hs.observe(offered, completed)
+            if tr is not None and tr[1] is ShardState.LOST:
+                self._on_lost(s, hs.last_reason)
+        # unhosted streams' queues keep building toward the admission
+        # bound (shed beyond it — bounded by contract); nothing to
+        # exclude here, the data is still queued, not lost
+        if self.snapshot_ticks > 0 and (t + 1) % self.snapshot_ticks == 0:
+            self._refresh_snapshots(t)
+        if self._first_tick_pending and self.last_evacuation is not None:
+            # the evacuation-latency decomposition's last leg, on the
+            # scheduled plane too (the per-tick seam's epilogue)
+            self.last_evacuation["first_tick_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 3
+            )
+            self._first_tick_pending = False
+        self.tick_no += 1
+        return outs
+
+    def scheduler_status(self) -> Optional[dict]:
+        """The /diagnostics scheduler value group's payload (None when
+        no shaper is attached): current rungs, per-stream backlog
+        depth, admission drops, byte rates, per-rung dispatch counts
+        summed over the pod's engines, and the topology's placement
+        weights."""
+        if self.scheduler is None:
+            return None
+        status = self.scheduler.status()
+        rung_d: dict = {}
+        for sh in self.shards:
+            if sh.fleet_ingest is None:
+                continue
+            for r, n in sh.fleet_ingest.rung_dispatches.items():
+                rung_d[r] = rung_d.get(r, 0) + n
+        status["rung_dispatches"] = rung_d
+        status["weights"] = [
+            round(self.topology.weight_of(i), 3)
+            for i in range(self.streams)
+        ]
+        return status
 
     # -- snapshots ---------------------------------------------------------
 

@@ -507,6 +507,14 @@ class FleetTopology:
         self.streams = streams
         self.shards = shards
         self.lanes = lanes
+        # per-stream placement weights (byte-rate-weighted placement,
+        # ROADMAP item 4): load is the SUM of hosted weights, so
+        # ``assign``/``evacuate``/``rebalance_into`` land hot streams
+        # on cold shards instead of counting streams.  Default 1.0 per
+        # stream — every load compare degrades to the original
+        # stream-count heuristic until a scheduler feeds measured
+        # rates (parallel/scheduler.ByteRateEwma via set_weight).
+        self._weights: dict[int, float] = {}
         # lane tables: _lane_map[shard][lane] = stream or None (idle)
         self._lane_map: list[list] = [
             [None] * lanes for _ in range(shards)
@@ -542,6 +550,40 @@ class FleetTopology:
             i for i in range(self.streams) if i not in self._placement
         ]
 
+    # -- weights -----------------------------------------------------------
+
+    def weight_of(self, stream: int) -> float:
+        """``stream``'s placement weight (1.0 until measured)."""
+        return self._weights.get(stream, 1.0)
+
+    def set_weight(self, stream: int, weight: float) -> None:
+        """Set one stream's placement weight (a measured byte-rate
+        signal, e.g. ``1.0 + ewma_bytes_per_tick / scale``).  Must be
+        positive — a zero weight would make a hot stream invisible to
+        every load compare; clamped to a small floor instead so an
+        idle stream still occupies *some* balance mass (pure-zero
+        weights would pile every idle stream onto one shard)."""
+        if not (0 <= stream < self.streams):
+            raise IndexError(
+                f"stream {stream} out of range [0, {self.streams})"
+            )
+        self._weights[stream] = max(float(weight), 1e-6)
+
+    def set_weights(self, weights) -> None:
+        """Bulk :meth:`set_weight` — ``weights`` is a per-stream
+        sequence or a ``{stream: weight}`` mapping."""
+        items = (
+            weights.items() if hasattr(weights, "items")
+            else enumerate(weights)
+        )
+        for i, w in items:
+            self.set_weight(i, w)
+
+    def shard_load(self, shard: int) -> float:
+        """``shard``'s weighted load: the sum of its hosted streams'
+        weights."""
+        return sum(self.weight_of(s) for s in self.streams_on(shard))
+
     # -- membership changes ------------------------------------------------
 
     def _free_lane(self, shard: int) -> Optional[int]:
@@ -570,15 +612,17 @@ class FleetTopology:
         self, stream: int, avoid: Sequence[int] = (),
     ) -> Optional[tuple[int, int]]:
         """Place an unhosted ``stream`` on the least-loaded shard not in
-        ``avoid``; returns the new (shard, lane) or None when no shard
-        has an idle lane."""
+        ``avoid`` — load is the WEIGHTED sum (:meth:`shard_load`), so a
+        shard hosting one hot stream counts as fuller than one hosting
+        two cold ones; returns the new (shard, lane) or None when no
+        shard has an idle lane."""
         if stream in self._placement:
             raise ValueError(f"stream {stream} is already hosted")
         best, best_load = None, None
         for shard in range(self.shards):
             if shard in avoid or self._free_lane(shard) is None:
                 continue
-            load = len(self.streams_on(shard))
+            load = self.shard_load(shard)
             if best_load is None or load < best_load:
                 best, best_load = shard, load
         if best is None:
@@ -601,7 +645,13 @@ class FleetTopology:
         victims = self.streams_on(shard)
         skip = frozenset(avoid) | {shard}
         plan = []
-        for stream in victims:
+        # heaviest victims place first (stable on ties, so equal-weight
+        # fleets keep the original lane order): each assign updates the
+        # weighted loads the next one compares, so the hot streams take
+        # the coldest shards before the cold ones fill the gaps
+        for stream in sorted(
+            victims, key=lambda s: -self.weight_of(s)
+        ):
             self.release(stream)
             got = self.assign(stream, avoid=skip)
             if got is not None:
@@ -610,30 +660,50 @@ class FleetTopology:
 
     def rebalance_into(self, shard: int) -> list[tuple[int, int, int, int, int]]:
         """Plan the migrations BACK onto a re-admitted (empty) ``shard``
-        until it is balanced: streams move from the most-loaded shards
-        while doing so strictly improves balance.  Returns
+        until it is balanced: streams move from the most-loaded shard
+        (by WEIGHTED load) while doing so strictly improves balance —
+        a move of weight w improves iff ``load[src] - load[dst] > w``
+        (it strictly decreases the sum of squared loads, so the loop
+        terminates), and among improving candidates the HEAVIEST
+        stream moves, landing hot streams on the cold re-admitted
+        shard first.  With all weights at the 1.0 default this is
+        exactly the original stream-count rule.  Returns
         ``[(stream, src_shard, src_lane, dst_shard, dst_lane), ...]``
         (src -1/-1 for streams that were unhosted — they need no
         migration source); the source lane rides along because the
         mover must snapshot the live state from it BEFORE the
         relabeling takes effect."""
         moves: list[tuple[int, int, int, int, int]] = []
-        for stream in self.unhosted():
+        for stream in sorted(
+            self.unhosted(), key=lambda s: -self.weight_of(s)
+        ):
             if self._free_lane(shard) is None:
                 break
             _, lane = self._place(stream, shard)
             moves.append((stream, -1, -1, shard, lane))
         while self._free_lane(shard) is not None:
-            loads = {
-                s: len(self.streams_on(s))
-                for s in range(self.shards) if s != shard
-            }
-            if not loads:
-                break
-            src = max(loads, key=lambda s: (loads[s], s))
-            if loads[src] <= len(self.streams_on(shard)) + 1:
-                break  # moving one more no longer improves balance
-            stream = self.streams_on(src)[-1]
+            dst_load = self.shard_load(shard)
+            # the best improving move across EVERY source shard — not
+            # just the most-loaded one, whose sole tenant may be too
+            # heavy to move while a lighter sibling still has improving
+            # candidates.  Preference order (heaviest stream, then
+            # most-loaded source, then highest shard index, then last
+            # lane) reproduces the original count rule exactly at
+            # equal weights.
+            best = None  # ((w, src_load, src, lane_pos), stream, src)
+            for s in range(self.shards):
+                if s == shard:
+                    continue
+                sl = self.shard_load(s)
+                for pos, stream in enumerate(self.streams_on(s)):
+                    w = self.weight_of(stream)
+                    if sl - dst_load > w:
+                        key = (w, sl, s, pos)
+                        if best is None or key > best[0]:
+                            best = (key, stream, s)
+            if best is None:
+                break  # no move improves balance any further
+            _, stream, src = best
             src_lane = self._placement[stream][1]
             self.release(stream)
             _, lane = self._place(stream, shard)
@@ -646,9 +716,15 @@ class FleetTopology:
         return list(self._lane_map[shard])
 
     def status(self) -> list[dict]:
-        """Per-shard host dicts (the /diagnostics topology surface)."""
+        """Per-shard host dicts (the /diagnostics topology surface);
+        ``load`` is the weighted placement load (== stream count until
+        a scheduler feeds measured byte rates)."""
         return [
-            {"streams": self.streams_on(s), "lanes": self.lanes}
+            {
+                "streams": self.streams_on(s),
+                "lanes": self.lanes,
+                "load": round(self.shard_load(s), 3),
+            }
             for s in range(self.shards)
         ]
 

@@ -118,7 +118,66 @@ def restore_sharded(path: str, like):
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), like
     )
     try:
+        if not _metadata_matches(path, template):
+            return None
         return _checkpointer().restore(path, template)
     except (ValueError, KeyError, FileNotFoundError) as e:
         log.warning("rejecting orbax checkpoint %s: %s", path, e)
         return None
+
+
+def _metadata_matches(path: str, template) -> bool:
+    """Explicit saved-vs-template geometry check.  Orbax's restore does
+    NOT reject a shape mismatch: given a template whose arrays are
+    smaller than the checkpointed ones it silently returns
+    template-shaped slices (observed on orbax 0.7.0), so a
+    wrong-window/beams/grid checkpoint would restore as truncated
+    garbage instead of failing cleanly.  The checkpoint's own metadata
+    carries the saved shapes/dtypes — compare leaf-by-leaf (key set
+    included) and reject on any drift, keeping the caller's state
+    untouched (the npz path's reject-don't-crash contract)."""
+    def norm(entries) -> str:
+        # one spelling for dataclass attrs, dict keys and sequence
+        # indices: the metadata tree comes back as name-keyed dicts
+        # while the template is the live pytree (e.g. a FilterState
+        # dataclass), so treedefs/keystr never compare equal even on a
+        # matching checkpoint — the NAMES do
+        parts = []
+        for e in entries:
+            for attr in ("name", "key", "idx"):
+                v = getattr(e, attr, None)
+                if v is not None:
+                    parts.append(str(v))
+                    break
+            else:
+                parts.append(str(e))
+        return "/".join(parts)
+
+    saved = _checkpointer().metadata(path)
+    t_leaves, _ = jax.tree_util.tree_flatten_with_path(template)
+    s_leaves, _ = jax.tree_util.tree_flatten_with_path(
+        saved, is_leaf=lambda x: hasattr(x, "shape")
+    )
+    want = {norm(p): (tuple(t.shape), t.dtype) for p, t in t_leaves}
+    got = {
+        norm(p): (
+            tuple(getattr(s, "shape", ()) or ()),
+            getattr(s, "dtype", None),
+        )
+        for p, s in s_leaves
+    }
+    if set(want) != set(got):
+        log.warning(
+            "rejecting orbax checkpoint %s: leaf set %s != %s",
+            path, sorted(got), sorted(want),
+        )
+        return False
+    for name, (shape, dtype) in want.items():
+        s_shape, s_dtype = got[name]
+        if shape != s_shape or (s_dtype is not None and dtype != s_dtype):
+            log.warning(
+                "rejecting orbax checkpoint %s: leaf %s saved as %s/%s, "
+                "want %s/%s", path, name, s_shape, s_dtype, shape, dtype,
+            )
+            return False
+    return True

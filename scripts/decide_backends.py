@@ -48,6 +48,7 @@ _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
     "deskew_ab", "loop_close_ab", "fused_mapping_ab",
+    "elastic_serving_ab",
 )
 
 
@@ -436,6 +437,50 @@ def analyze(records: list[dict]) -> dict:
                     "steady_group_ratio", "dispatch_collapse",
                     "ratio_clamped",
                 ) if k in fmab
+            })
+
+        # config 19: the traffic-shaped serving A/B (sched_rungs ladder
+        # default).  The burst dispatch collapse, bounded backlog and
+        # byte-equal-for-any-rung-sequence contract are structural
+        # (asserted in the bench), so the flip question is only whether
+        # the adaptive rung pick beats the static-T baseline on p99
+        # drain latency on-chip: >= 1.05 (the standing noise bar) flips
+        # the ladder on.  The clamp (either arm under the timer floor)
+        # records evidence but must never flip — the ratio's magnitude
+        # is the clamp's — and the floor-asymmetric strength merge
+        # keeps an above-parity noise record from displacing committed
+        # degradation evidence (the failover_ab discipline): a flipping
+        # record carries parity strength, a violating one its measured
+        # ratio.  CPU/interpret records carry no weight (device rule).
+        esb = rec.get("elastic_serving_ab")
+        if isinstance(esb, dict):
+            v = esb.get("p99_speedup")
+            if isinstance(v, (int, float)) and not esb.get(
+                "ratio_clamped"
+            ):
+                rungs_m = esb.get("rungs")
+                proposed = (
+                    ",".join(str(r) for r in rungs_m)
+                    if isinstance(rungs_m, list) and rungs_m
+                    else "1,2,4,8"
+                )
+                flip = v >= MARGIN
+                recommend("sched_rungs.tpu", {
+                    "current": "static (rung 1 only)",
+                    "recommended": (
+                        proposed if flip else "static (rung 1 only)"
+                    ),
+                    "flip": flip,
+                    "key": "config19 p99_speedup",
+                    "value": 1.0 if flip else float(min(v, 1.0)),
+                    "measured": float(v),
+                    "margin": MARGIN,
+                    "source": "elastic_serving_ab",
+                })
+            out["evidence"].setdefault("elastic_serving_ab", []).append({
+                k: esb[k] for k in (
+                    "p99_speedup", "rungs", "shards", "ratio_clamped",
+                ) if k in esb
             })
 
         # ablation: resample + voxel kernels

@@ -32,6 +32,7 @@ def test_metric_names_stable():
     assert bench.metric_name(16) == "deskew_recon_map_updates_per_sec"
     assert bench.metric_name(17) == "loop_close_corrected_scans_per_sec"
     assert bench.metric_name(18) == "fused_mapping_stack_updates_per_sec"
+    assert bench.metric_name(19) == "elastic_serving_adaptive_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -40,7 +41,7 @@ def test_graded_table_well_formed():
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
-            "fused_mapping",
+            "fused_mapping", "elastic_serving",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1318,6 +1319,113 @@ def test_decide_backends_fused_mapping_key():
     # outweighs a later clean record's parity strength
     got = db.analyze([rec("tpu", 0.5), rec("tpu", 1.0)])
     assert got["recommendations"]["fused_mapping_backend.tpu"]["flip"] is False
+
+
+def test_bench_smoke_elastic_serving():
+    """`bench.py --smoke-elastic-serving` — the tier-1 gate for the
+    traffic-shaped serving plane (config-19 A/B at seconds-scale CPU
+    geometry).  The structural claims are what matters: per-rung
+    dispatch accounting with the burst collapse (the adaptive arm
+    issues strictly fewer compiled dispatches over the same trace),
+    bounded per-stream backlog with shadow-checked oldest-tick sheds,
+    byte-equal trajectories across the adaptive/static arms AND the
+    host golden, byte-rate-weighted heaviest-first evacuation, and
+    zero recompiles/implicit transfers across rung switches and a
+    chaos shard kill (the bench itself raises on violation; this gate
+    pins that the asserted artifact lands).  The p99 ratio is
+    1.5-core-CI weather at smoke geometry and floor-checked only; the
+    asserted WIN bar applies to full runs."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-elastic-serving"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(19)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    for claim in (
+        "per_rung_accounting", "static_arm_rung1_only",
+        "adaptive_reached_top_rung", "dispatch_collapse",
+        "bounded_backlog", "shed_policy_matches_shadow",
+        "byte_equal_arms", "byte_equal_host_golden",
+        "weighted_evacuation", "zero_recompiles",
+        "zero_implicit_transfers",
+    ):
+        assert s[claim] is True, claim
+    # the collapse the config exists for: the static arm dispatched
+    # only rung 1, the adaptive arm strictly fewer dispatches total
+    assert set(out["rung_dispatches"]["static"]) == {"1"}
+    assert any(
+        int(r_) > 1 and n > 0
+        for r_, n in out["rung_dispatches"]["adaptive"].items()
+    )
+    assert (
+        out["dispatch_totals"]["adaptive"] < out["dispatch_totals"]["static"]
+    )
+    # the admission bound held and was exercised
+    adm = out["admission"]
+    assert adm["max_depth_seen"] <= adm["bound_ticks"]
+    assert adm["sheds_total"] > 0
+    assert out["scans"] > 0 and out["value"] > 0
+    # the decision key rides with its clamp flag
+    assert "p99_speedup" in out["elastic_serving_ab"]
+    assert isinstance(out["elastic_serving_ab"]["ratio_clamped"], bool)
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_elastic_serving_key():
+    """The sched_rungs ladder recommendation flips from config-19
+    evidence alone: an unclamped TPU record with p99_speedup above the
+    noise margin recommends the measured ladder; CPU records and
+    clamped ratios never flip, and the floor-asymmetric strength merge
+    keeps an above-parity noise record from displacing committed
+    degradation evidence (the failover_ab discipline)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, speedup, clamped=False):
+        return {
+            "device": dev,
+            "elastic_serving_ab": {
+                "p99_speedup": speedup,
+                "rungs": [1, 2, 4, 8],
+                "shards": 4,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    got = db.analyze([rec("tpu", 1.2)])
+    r = got["recommendations"]["sched_rungs.tpu"]
+    assert r["flip"] is True and r["recommended"] == "1,2,4,8"
+    assert r["measured"] == 1.2
+    # CPU record: reported, never flips
+    got = db.analyze([rec("cpu", 1.5)])
+    assert "sched_rungs.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 1.5, clamped=True)])
+    assert "sched_rungs.tpu" not in got["recommendations"]
+    assert got["evidence"]["elastic_serving_ab"]
+    # below the margin: keep the static default
+    got = db.analyze([rec("tpu", 1.01)])
+    assert got["recommendations"]["sched_rungs.tpu"]["flip"] is False
+    # floor-asymmetric strength merge: a committed degradation record
+    # outweighs a later above-parity noise record
+    got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
+    assert got["recommendations"]["sched_rungs.tpu"]["flip"] is False
 
 
 def test_decide_backends_deskew_key():

@@ -626,8 +626,8 @@ class TestFleetTopology:
         topo = FleetTopology(4, 2, 4)
         st = topo.status()
         assert st == [
-            {"streams": [0, 2], "lanes": 4},
-            {"streams": [1, 3], "lanes": 4},
+            {"streams": [0, 2], "lanes": 4, "load": 2.0},
+            {"streams": [1, 3], "lanes": 4, "load": 2.0},
         ]
 
 

@@ -171,6 +171,54 @@ class TestSuperTickSteadyState:
         assert sum(len(o) for o in outs) >= 1
 
 
+class TestAdaptiveRungSteadyState:
+    def test_rung_switches_stay_in_the_compile_cache(self):
+        """The adaptive scheduler's structural precondition
+        (parallel/scheduler.py): every ladder rung is warmed at
+        precompile — one compiled super-step per (rung, bucket) — so a
+        drain sequence that switches depth mid-run (shallow, deep,
+        shallow: the backlog-adaptive pick under bursty traffic) runs
+        with ZERO recompiles and ZERO implicit transfers.  A rung
+        switch is a compile-cache hit by construction, never a
+        compile."""
+        s = 2
+        eng = FleetFusedIngest(
+            _params(), s, beams=BEAMS, buckets=(4,), max_revs=6,
+            rungs=(1, 2, 4),
+        )
+        assert eng.rungs == (1, 2, 4)
+        eng.precompile([DENSE] * s)
+        streams = [
+            (DENSE, _make_stream(DENSE, 96, np.random.default_rng(20 + i),
+                                 syncs=(0, 17, 34, 51, 68, 85)))
+            for i in range(s)
+        ]
+        ticks = _mk_ticks(streams, np.random.default_rng(8), idle_prob=0.0)
+        cut = max(4, len(ticks) // 3)
+        eng.submit_backlog(ticks[:cut], rung=4)  # live-path warmup
+        before = dict(eng.rung_dispatches)
+        total = 0
+        with guards.steady_state(tag="adaptive rung switches"):
+            pos = cut
+            for rung in (1, 4, 2, 4, 1, 2):
+                if pos >= len(ticks):
+                    break
+                step = max(rung, 2)
+                outs = eng.submit_backlog(
+                    ticks[pos : pos + step], rung=rung
+                )
+                pos += step
+                total += sum(len(o) for o in outs)
+        # the guard run exercised MULTIPLE rungs, not a degenerate loop
+        moved = [
+            r for r in eng.rungs
+            if eng.rung_dispatches[r] > before.get(r, 0)
+        ]
+        assert len(moved) >= 2
+        assert total >= 1
+        assert sum(eng.rung_dispatches.values()) == eng.dispatch_count
+
+
 class TestFleetMapperSteadyState:
     @pytest.mark.parametrize("match_backend", ["xla", "pallas"])
     def test_zero_recompiles_zero_implicit_transfers(self, match_backend):

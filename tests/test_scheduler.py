@@ -1,0 +1,491 @@
+"""Traffic-shaped elastic serving: the policy layer
+(parallel/scheduler.py) and its wiring through the engines, the
+topology and the pod (ROADMAP item 4).
+
+The acceptance contract this suite pins:
+
+  * **Backlog-adaptive rung depth** — the RungLadder steps UP
+    immediately on a burst, DOWN only after the hysteresis streak, and
+    the deadline budget CAPS the pick from the measured per-tick drain
+    cost; the FleetFusedIngest rung ladder warms every depth at
+    precompile, refuses unwarmed depths and late extensions, and a
+    backlog drained at ANY rung sequence is byte-exact against the
+    per-tick host reference (the policy chooses when, never what).
+  * **SLO-aware admission** — per-stream queues are BOUNDED: past
+    ``admission_max_backlog_ticks`` the oldest tick is shed with
+    per-stream counters, never unbounded growth.
+  * **Byte-rate-weighted placement** — FleetTopology loads are
+    weighted sums; assign/evacuate/rebalance land hot streams on cold
+    shards, heaviest first, and degrade exactly to the stream-count
+    heuristic at the default weight 1.0.
+  * The serving seams (ShardedFilterService.offer_bytes/
+    drain_scheduled, the ElasticFleetService pod analog) and the
+    /diagnostics scheduler value-group rendering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
+from rplidar_ros2_driver_tpu.parallel.scheduler import (
+    ByteRateEwma,
+    RungLadder,
+    SchedulerConfig,
+    TrafficShaper,
+)
+from rplidar_ros2_driver_tpu.parallel.service import ShardedFilterService
+from rplidar_ros2_driver_tpu.parallel.sharding import FleetTopology
+from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+from test_fused_ingest import BEAMS, _params
+from test_fleet_fused_ingest import (
+    _assert_fleet_outputs_equal,
+    _host_reference,
+    _mk_ticks,
+)
+from test_live_decode import _make_stream
+
+DENSE = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+
+
+# ---------------------------------------------------------------------------
+# config + policy units (no device work)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerConfig:
+    def test_from_params_reads_the_sched_surface(self):
+        p = _params(
+            sched_rungs=(1, 3, 9), sched_hysteresis_ticks=5,
+            sched_deadline_ms=7.5, sched_byte_rate_alpha=0.5,
+            admission_max_backlog_ticks=11,
+        )
+        cfg = SchedulerConfig.from_params(p)
+        assert cfg.rungs == (1, 3, 9)
+        assert cfg.hysteresis_ticks == 5
+        assert cfg.deadline_ms == 7.5
+        assert cfg.byte_rate_alpha == 0.5
+        assert cfg.max_backlog_ticks == 11
+
+    @pytest.mark.parametrize("bad", [
+        dict(rungs=()),
+        dict(rungs=(2, 4)),          # must start at 1
+        dict(rungs=(1, 4, 2)),       # must ascend
+        dict(hysteresis_ticks=0),
+        dict(deadline_ms=-1.0),
+        dict(byte_rate_alpha=0.0),
+        dict(byte_rate_alpha=1.5),
+        dict(max_backlog_ticks=0),   # the backlog is bounded by contract
+        dict(rungs=(1, 128)),        # compile-cost cap (one program/bucket)
+    ])
+    def test_rejects_invalid(self, bad):
+        with pytest.raises(ValueError):
+            SchedulerConfig(**bad)
+
+
+class TestByteRateEwma:
+    def test_tracks_and_decays(self):
+        r = ByteRateEwma(2, alpha=0.5)
+        assert r.rates() == [0.0, 0.0]
+        r.note(0, 1000)
+        assert r.rates()[0] == 1000.0  # first observation seeds
+        r.note(0, 0)   # idle tick: the estimate decays
+        assert r.rates()[0] == 500.0
+        r.note(1, 100)
+        r.note(1, 300)
+        assert r.rates()[1] == 200.0
+
+
+class TestRungLadder:
+    def test_up_immediate_down_hysteresis(self):
+        lad = RungLadder(SchedulerConfig(
+            rungs=(1, 2, 4, 8), hysteresis_ticks=2,
+        ))
+        assert lad.pick(1) == 1
+        assert lad.pick(7) == 8          # burst: straight to the top
+        assert lad.pick(1) == 8          # low streak 1 of 2: hold
+        assert lad.pick(1) == 4          # streak complete: ONE step down
+        assert lad.pick(1) == 4          # streak reset by the step
+        assert lad.pick(1) == 2
+        assert lad.pick(3) == 4          # demand re-raises immediately
+
+    def test_sawtooth_does_not_thrash(self):
+        lad = RungLadder(SchedulerConfig(
+            rungs=(1, 2, 4), hysteresis_ticks=3,
+        ))
+        lad.pick(4)
+        # alternating 1/4 backlog: the low streak never completes
+        picks = [lad.pick(1), lad.pick(4), lad.pick(1), lad.pick(4)]
+        assert picks == [4, 4, 4, 4]
+
+    def test_deadline_budget_caps_the_pick(self):
+        lad = RungLadder(SchedulerConfig(
+            rungs=(1, 2, 4, 8), hysteresis_ticks=1, deadline_ms=10.0,
+        ))
+        # measured 3 ms/tick: 8 * 3 = 24 ms and 4 * 3 = 12 ms blow the
+        # 10 ms budget, 2 * 3 = 6 ms fits
+        lad.note_drain(4, 0.012)
+        assert lad.pick(8) == 2
+        # the demand level survived the cap: with a looser measured
+        # cost the same ladder serves the full rung again
+        lad.tick_cost_ema = 1e-4
+        assert lad.pick(8) == 8
+
+    def test_deadline_never_caps_below_the_floor_rung(self):
+        lad = RungLadder(SchedulerConfig(
+            rungs=(1, 4), hysteresis_ticks=1, deadline_ms=0.001,
+        ))
+        lad.note_drain(1, 10.0)  # 10 s/tick: nothing fits the budget
+        assert lad.pick(4) == 1
+
+
+class TestTrafficShaperAdmission:
+    def _tick(self, n=1):
+        return (DENSE, [(b"\xa5" * 84, 1.0 + 0.001 * k) for k in range(n)])
+
+    def test_bounded_queue_sheds_oldest_with_counters(self):
+        sh = TrafficShaper(2, SchedulerConfig(max_backlog_ticks=3))
+        first = self._tick(1)
+        sh.offer_tick([first, None])
+        sh.offer_tick([[self._tick(2), self._tick(3), self._tick(4)], None])
+        assert sh.backlog_depths() == [3, 0]
+        assert sh.admission_drops == [1, 0] and sh.shed_total == 1
+        # the OLDEST tick went: the queue's head is now the second
+        assert sh.queues[0][0] is not first
+        ticks, _ = sh.drain_plan(0, [0, 1])
+        assert len(ticks) == 3
+
+    def test_drain_plan_front_aligns_unequal_queues(self):
+        sh = TrafficShaper(3, SchedulerConfig(rungs=(1, 2, 4)))
+        sh.offer_tick([[self._tick(1), self._tick(2)], self._tick(3), None])
+        ticks, rung = sh.drain_plan(0, [0, 1, None])
+        assert rung == 2  # depth 2 -> the 2-rung
+        assert len(ticks) == 2
+        assert ticks[0][0] is not None and ticks[0][1] is not None
+        assert ticks[1][0] is not None and ticks[1][1] is None
+        assert ticks[0][2] is None  # stream 2 not on this shard
+        assert sh.backlog_depths() == [0, 0, 0]
+
+    def test_drain_plan_empty_still_walks_the_ladder_down(self):
+        sh = TrafficShaper(1, SchedulerConfig(
+            rungs=(1, 4), hysteresis_ticks=1,
+        ))
+        sh.offer_tick([[self._tick(1)] * 4])
+        _, rung = sh.drain_plan(0, [0])
+        assert rung == 4
+        _, rung = sh.drain_plan(0, [0])   # empty drain observed
+        assert rung == 1
+
+    def test_status_payload_shape(self):
+        sh = TrafficShaper(2, SchedulerConfig())
+        sh.offer_tick([self._tick(2), None])
+        st = sh.status()
+        assert st["backlog"] == [1, 0]
+        assert st["admission_drops"] == [0, 0]
+        assert st["shed_total"] == 0
+        assert len(st["byte_rates"]) == 2 and st["byte_rates"][0] > 0
+
+
+# ---------------------------------------------------------------------------
+# byte-rate-weighted placement
+# ---------------------------------------------------------------------------
+
+
+class TestWeightedTopology:
+    def test_default_weights_are_the_stream_count(self):
+        topo = FleetTopology(4, 2, 4)
+        assert topo.shard_load(0) == 2.0 == topo.shard_load(1)
+        assert topo.weight_of(3) == 1.0
+
+    def test_assign_prefers_the_weighted_cold_shard(self):
+        topo = FleetTopology(5, 2, 5)
+        # shard 0 hosts {0, 2, 4}, shard 1 hosts {1, 3}: by count the
+        # cold shard is 1 — but stream 1 is HOT, so shard 0 is colder
+        topo.set_weights({1: 5.0})
+        topo.release(4)
+        assert topo.assign(4)[0] == 0
+
+    def test_evacuate_places_heaviest_victims_first(self):
+        topo = FleetTopology(6, 3, 3)
+        # shard 1 hosts {1, 4}: make 4 the hot one
+        topo.set_weight(4, 10.0)
+        plan = topo.evacuate(1)
+        assert [p[0] for p in plan] == [4, 1]
+        # the hot victim landed alone; the cold one joined the rest
+        dst_hot = plan[0][1]
+        assert topo.shard_load(dst_hot) >= 10.0
+
+    def test_rebalance_moves_the_improving_heavy_stream(self):
+        topo = FleetTopology(6, 3, 3)
+        topo.set_weights({0: 4.0, 3: 1.0})
+        topo.evacuate(1)              # strand shard 1's tenants elsewhere
+        moves = topo.rebalance_into(1)
+        # the balance-improving movers land heaviest-first and every
+        # move strictly improves the spread
+        weights = [topo.weight_of(m[0]) for m in moves]
+        assert weights == sorted(weights, reverse=True)
+        loads = [topo.shard_load(s) for s in range(3)]
+        assert max(loads) - min(loads) <= max(weights + [1.0])
+
+    def test_rebalance_not_blocked_by_an_unmovable_heavy_shard(self):
+        """The most-loaded shard's sole tenant can be too heavy to move
+        (load[src] - load[dst] never exceeds w for a single hot
+        stream); rebalance must still take improving moves from the
+        LIGHTER siblings instead of leaving the re-admitted shard
+        empty."""
+        topo = FleetTopology(8, 3, 8)
+        # shard 0: streams {0, 3, 6}; make 0 a giant, strand the rest
+        topo.set_weight(0, 10.0)
+        for s in (3, 6):
+            topo.release(s)
+        for s in (1, 4, 7):   # move shard 1's tenants onto shard 2
+            topo.release(s)
+        for s in (3, 6, 1, 4, 7):
+            topo.assign(s, avoid=(0, 1))
+        assert topo.streams_on(1) == []
+        moves = topo.rebalance_into(1)
+        # the giant never moves (no improvement), but shard 2's
+        # weight-1 streams rebalance onto the empty shard
+        assert moves and all(m[1] == 2 for m in moves)
+        assert len(topo.streams_on(1)) >= 2
+
+    def test_equal_weights_degrade_to_the_original_rule(self):
+        a, b = FleetTopology(8, 4, 3), FleetTopology(8, 4, 3)
+        b.set_weights([1.0] * 8)
+        a.evacuate(1)
+        b.evacuate(1)
+        assert a.status() == b.status()
+        assert a.rebalance_into(1) == b.rebalance_into(1)
+
+    def test_weight_validation(self):
+        topo = FleetTopology(2, 1, 2)
+        with pytest.raises(IndexError):
+            topo.set_weight(7, 1.0)
+        topo.set_weight(0, -5.0)       # clamped, never zero/negative
+        assert topo.weight_of(0) > 0
+        # stream 1 still weighs its default 1.0; the clamped stream 0
+        # contributes its (tiny) floor, never a negative load
+        assert topo.status()[0]["load"] == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# engine rung ladder (device work: small geometry)
+# ---------------------------------------------------------------------------
+
+
+def _streams_frames(s, n=64, syncs=(0, 17, 34, 51)):
+    return [
+        (DENSE, _make_stream(DENSE, n, np.random.default_rng(40 + i),
+                             syncs=syncs))
+        for i in range(s)
+    ]
+
+
+class TestEngineRungLadder:
+    def test_any_rung_is_byte_exact_vs_host(self):
+        """One backlog drained at rungs 1, 2 and 4 (fresh engines) is
+        byte-identical to the independent host paths every time — the
+        rung picks WHEN ticks dispatch, never what they compute."""
+        s = 2
+        streams_frames = _streams_frames(s)
+        ticks = _mk_ticks(
+            streams_frames, np.random.default_rng(9), idle_prob=0.0
+        )
+        host = _host_reference(ticks, s)
+        for rung in (1, 2, 4):
+            eng = FleetFusedIngest(
+                _params(), s, beams=BEAMS, buckets=(4,), max_revs=6,
+                rungs=(1, 2, 4),
+            )
+            outs = eng.submit_backlog(ticks, rung=rung)
+            _assert_fleet_outputs_equal(host, outs)
+
+    def test_rung_dispatch_accounting(self):
+        s = 2
+        ticks = _mk_ticks(
+            _streams_frames(s), np.random.default_rng(3), idle_prob=0.0
+        )[:7]
+        eng = FleetFusedIngest(
+            _params(), s, beams=BEAMS, buckets=(4,), max_revs=6,
+            rungs=(1, 2, 4),
+        )
+        eng.submit_backlog(ticks, rung=4)
+        # 7 slices at rung 4: one full group of 4, one of 3 (padded
+        # super), i.e. 2 super dispatches and nothing at other rungs
+        assert eng.rung_dispatches[4] == 2
+        assert eng.rung_dispatches[1] == 0
+        assert sum(eng.rung_dispatches.values()) == eng.dispatch_count
+
+    def test_unwarmed_rung_refused(self):
+        eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, buckets=(4,), rungs=(1, 2),
+        )
+        ticks = _mk_ticks(
+            _streams_frames(1), np.random.default_rng(1), idle_prob=0.0
+        )
+        with pytest.raises(ValueError, match="not a warmed rung"):
+            eng.submit_backlog(ticks, rung=3)
+
+    def test_ensure_rungs_union_and_late_refusal(self):
+        eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, buckets=(4,), rungs=(1, 2),
+        )
+        eng.ensure_rungs((1, 4))
+        assert eng.rungs == (1, 2, 4)
+        ticks = _mk_ticks(
+            _streams_frames(1), np.random.default_rng(2), idle_prob=0.0
+        )
+        eng.submit_backlog(ticks[:1], rung=1)
+        eng.ensure_rungs((1, 2))  # subset: fine after traffic
+        with pytest.raises(RuntimeError, match="already ticked"):
+            eng.ensure_rungs((1, 8))
+
+    def test_ensure_rungs_refused_after_precompile(self):
+        """Extending the ladder AFTER precompile would hand out depths
+        with no compiled executable behind them — the first deep drain
+        would pay its compile inside the serving loop, so the engine
+        refuses even before any traffic."""
+        eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, buckets=(4,), rungs=(1, 2),
+        )
+        eng.precompile([DENSE])
+        eng.ensure_rungs((1, 2))  # subset: fine
+        with pytest.raises(RuntimeError, match="precompiled"):
+            eng.ensure_rungs((1, 4))
+
+
+# ---------------------------------------------------------------------------
+# service serving seam
+# ---------------------------------------------------------------------------
+
+
+def _svc_params(**over):
+    base = dict(
+        fleet_ingest_backend="fused", sched_rungs=(1, 2, 4),
+        admission_max_backlog_ticks=8,
+    )
+    base.update(over)
+    return _params(**base)
+
+
+class TestServiceServingSeam:
+    def test_offer_drain_matches_plain_backlog(self):
+        s = 2
+        streams_frames = _streams_frames(s)
+        ticks = _mk_ticks(
+            streams_frames, np.random.default_rng(11), idle_prob=0.0
+        )
+        # the bound must not bite here: this test is drain parity, the
+        # shed policy has its own tests above
+        p = _svc_params(admission_max_backlog_ticks=64)
+        ref = ShardedFilterService(
+            p, s, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        ref_outs = ref.submit_bytes_backlog(ticks)
+
+        svc = ShardedFilterService(
+            p, s, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        svc.attach_scheduler()
+        svc.fleet_ingest.precompile([DENSE] * s)
+        # deliver the whole backlog as one burst offer, drain once
+        svc.offer_bytes([[t[i] for t in ticks if t[i]] for i in range(s)])
+        outs = svc.drain_scheduled()
+        assert len(outs) == s
+        for i in range(s):
+            assert len(outs[i]) == len(ref_outs[i])
+            for a, b in zip(outs[i], ref_outs[i]):
+                assert np.array_equal(
+                    np.asarray(a.ranges), np.asarray(b.ranges)
+                )
+        # the burst drained above rung 1
+        assert any(
+            n for r, n in svc.fleet_ingest.rung_dispatches.items()
+            if r > 1
+        )
+        st = svc.scheduler_status()
+        assert st["backlog"] == [0] * s
+        assert st["rung_dispatches"] == dict(
+            svc.fleet_ingest.rung_dispatches
+        )
+
+    def test_host_backend_refuses_scheduler_and_rung(self):
+        svc = ShardedFilterService(
+            _params(fleet_ingest_backend="host"), 2, beams=BEAMS
+        )
+        with pytest.raises(ValueError, match="fused"):
+            svc.attach_scheduler()
+        with pytest.raises(ValueError, match="rung"):
+            svc.submit_bytes_backlog([[None, None]], rung=2)
+
+    def test_offer_requires_attach(self):
+        svc = ShardedFilterService(
+            _svc_params(), 2, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        with pytest.raises(RuntimeError, match="attach_scheduler"):
+            svc.offer_bytes([None, None])
+
+
+# ---------------------------------------------------------------------------
+# /diagnostics scheduler value group (pinned like shard_topology)
+# ---------------------------------------------------------------------------
+
+
+class TestSchedulerDiagnostics:
+    def test_rendering_pinned(self):
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        payload = {
+            "rungs": [4, 1],
+            "backlog": [3, 0, 1],
+            "admission_drops": [2, 0, 0],
+            "shed_total": 2,
+            "byte_rates": [512.5, 0.0, 33.1],
+            "rung_dispatches": {1: 7, 4: 2},
+            "weights": [2.0, 1.0, 1.25],
+        }
+        status = DiagnosticsUpdater("rig", CollectingPublisher()).update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="pod", rpm=0, device_info="",
+            scheduler=payload,
+        )
+        assert status.values["Sched Rung"] == "4,1"
+        assert status.values["Sched Backlog"] == "3,0,1"
+        assert status.values["Admission Drops"] == "2,0,0"
+        assert status.values["Admission Shed Total"] == "2"
+        assert status.values["Rung Dispatches"] == "T1:7 T4:2"
+        assert status.values["Placement Weights"] == "2.00,1.00,1.25"
+
+    def test_live_payload_feeds_the_renderer(self):
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        svc = ShardedFilterService(
+            _svc_params(), 2, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        svc.attach_scheduler()
+        status = DiagnosticsUpdater("rig", CollectingPublisher()).update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="svc", rpm=0, device_info="",
+            scheduler=svc.scheduler_status(),
+        )
+        assert status.values["Sched Backlog"] == "0,0"
+        assert "Rung Dispatches" in status.values
+
+
+# The zero-recompile / zero-implicit-transfer pin for mid-run rung
+# switches lives with the other engine steady-state sentinels in
+# tests/test_guards.py (TestAdaptiveRungSteadyState).
