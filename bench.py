@@ -203,6 +203,7 @@ GRADED = {
     17: ("loop_close", POINTS, dict(window=WINDOW)),  # SLAM back-end loop-closure A/B
     18: ("fused_mapping", POINTS, dict(window=WINDOW)),  # one-dispatch stack A/B
     19: ("elastic_serving", POINTS, dict(window=WINDOW)),  # traffic-shaped serving A/B
+    20: ("async_serving", POINTS, dict(window=WINDOW)),  # link-latency-hiding A/B
 }
 
 
@@ -3857,6 +3858,458 @@ def bench_elastic_serving(smoke: bool = False) -> dict:
     }
 
 
+def bench_async_serving(smoke: bool = False) -> dict:
+    """Config 20 — the link-latency-hiding A/B (ROADMAP item 3): two
+    identical multi-shard pods serve the SAME arrival trace
+    tick-paired; the ASYNC arm runs the full PR 16 stack — double-
+    buffered H2D staging (the ``device_put`` of drain t+1 overlaps the
+    compute of drain t, snapshot pulls ride the idle half), the
+    measured per-(rung, bucket) latency model seeded from precompile
+    warmup timings, and the occupancy-driven padding-bucket ladder —
+    while the PR14 arm keeps the synchronous static staging plane
+    (``staging_double_buffer`` off, no ``bucket_rungs``).  Both arms
+    share the SAME rung ladder: the A/B prices the staging overlap and
+    the bucket collapse, not the rung adaptivity config 19 already
+    measured.
+
+    The trace is the config-19 reconnect-storm generator (per-stream
+    chaos stalls + one fleet-wide outage overflowing the admission
+    bound) followed by an OCCUPANCY-COLLAPSE phase — all but the first
+    quarter of the fleet go idle for a stretch, so whole shards stage
+    dead rows — and a recovery tail where every stream resumes.
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      * per-(rung, bucket) dispatch accounting: every engine's
+        ``rung_bucket_dispatches`` sums to its ``dispatch_count`` AND
+        its per-rung marginals reproduce ``rung_dispatches`` exactly;
+      * the bucket ladder moved BOTH ways with zero recompiles: the
+        async arm applied >= 2 mid-run bucket switches (the collapse
+        drop and the recovery step-up), the PR14 arm none;
+      * the double buffer engaged: the async arm overlapped staging
+        with in-flight compute (``staging_overlap_hits`` > 0), the
+        PR14 arm never did;
+      * the latency model is fully seeded: after the first drain the
+        table prices every warmed (rung, bucket) program — the first
+        real drain is never blind;
+      * bounded backlog + shed parity with the shadow admission
+        simulation (identical across arms — admission is upstream of
+        staging policy);
+      * byte-equal trajectories: the arms' per-stream outputs are
+        byte-identical across the WHOLE run — staging overlap, bucket
+        switches, snapshot pulls included — and byte-identical to N
+        independent host decoder+assembler+chain golden paths over the
+        admitted tick sequences (no kill in this config, so the golden
+        covers the full run);
+      * zero recompiles / zero implicit transfers across the whole
+        serving cycle under utils/guards.steady_state (the double
+        buffer is EXPLICIT ``device_put``s; every (rung, bucket)
+        program is pre-warmed at precompile);
+      * p99 drain latency: the async arm beats the synchronous-staging
+        baseline on the paired per-wall-tick drain p99, asserted with
+        a timer-floor clamp on BOTH arms.
+
+    The artifact carries the clamped ``async_serving_ab`` decision key
+    (scripts/decide_backends.py: TPU records only — on this linkless
+    CPU rig ``device_put`` is a memcpy, so there is no link latency TO
+    hide; the win bar applies on-chip).  ``smoke`` shrinks geometry to
+    a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+    from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, grid = 4, 256, 32
+        points_per_rev, capacity = 800, 1024
+        streams, shards, run = 4, 2, 8
+        rungs, cap = (1, 2, 4), 6
+        stall_period, stall_frames, storm_len = 7, 4, 8
+        ticks_a, collapse_len, recovery_len = 14, 8, 10
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, capacity = POINTS, CAPACITY
+        streams, shards, run = 8, 4, 16
+        rungs, cap = (1, 2, 4, 8), 8
+        stall_period, stall_frames, storm_len = 9, 6, 10
+        ticks_a, collapse_len, recovery_len = 20, 10, 12
+    buckets = (4, run)
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    # phase-B survivors: the first quarter of the fleet keeps arriving
+    # while the rest go idle — entire shards stage nothing but dead
+    # rows, the occupancy collapse the bucket ladder exists for
+    live = max(1, streams // 4)
+    need = [
+        ticks_a + (collapse_len if s < live else 0) + recovery_len
+        for s in range(streams)
+    ]
+    data = [
+        _stream_data_ticks(
+            _denseboost_wire_frames(max(need) + 4, points_per_rev),
+            run, ans, 1000.0 + 7.0 * s,
+        )
+        for s in range(streams)
+    ]
+    if any(len(d) < n for d, n in zip(data, need)):
+        raise RuntimeError("scene too short for the three-phase trace")
+    # phase A: the config-19 storm trace over the first ticks_a data
+    # ticks of every stream (uniform rates — the weighted-placement
+    # spread is config 19's claim, not this one's)
+    wall = _storm_wall_schedule(
+        [d[:ticks_a] for d in data], [1] * streams,
+        stall_period=stall_period, stall_frames=stall_frames, phase=3,
+        storm_at=ticks_a // 3, storm_len=storm_len,
+    )
+    rem = [list(d[ticks_a:ticks_a + need[s] - ticks_a])
+           for s, d in enumerate(data)]
+    # phase B (collapse): only the survivors deliver
+    for _ in range(collapse_len):
+        wall.append([
+            [rem[s].pop(0)] if s < live else None
+            for s in range(streams)
+        ])
+    # phase C (recovery): the whole fleet resumes per-tick arrivals
+    for _ in range(recovery_len):
+        wall.append([[rem[s].pop(0)] for s in range(streams)])
+    warm = 2
+
+    def build(async_arm: bool):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused",
+            sched_rungs=rungs, admission_max_backlog_ticks=cap,
+            shard_count=shards, failover_snapshot_ticks=4,
+            staging_double_buffer=async_arm,
+            bucket_rungs=buckets if async_arm else (),
+            # the storm and the collapse phase are TRAFFIC, not device
+            # deaths: a fully idled shard sees collapse_len consecutive
+            # empty drains, which the FSM would read as starvation at
+            # deployment defaults — no loss is scheduled in this config
+            shard_starvation_ticks=2 * (
+                storm_len + stall_frames + collapse_len
+            ),
+        )
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=beams,
+            capacity=capacity, fleet_ingest_buckets=buckets,
+        )
+        pod.attach_scheduler()
+        pod.precompile([ans])
+        return pod
+
+    pods = {"pr14": build(False), "async": build(True)}
+    outs = {name: [[] for _ in range(streams)] for name in pods}
+    admitted: list = [[] for _ in range(streams)]
+    shadow: list = [[] for _ in range(streams)]
+    shadow_drops = [0] * streams
+    max_depth_seen = 0
+    times: dict = {"pr14": [], "async": []}
+
+    def advance(name, items):
+        nonlocal max_depth_seen
+        pod = pods[name]
+        pod.offer_bytes(items)
+        max_depth_seen = max(
+            max_depth_seen,
+            max(len(q) for q in pod.scheduler.queues),
+        )
+        t0 = time.perf_counter()
+        got = pod.drain_scheduled()
+        dt = time.perf_counter() - t0
+        for i, g in enumerate(got):
+            outs[name][i].extend(g)
+        return dt
+
+    def shadow_admit(items):
+        for s, item in enumerate(items):
+            if not item:
+                continue
+            for tick in item:
+                shadow[s].append(tick)
+                if len(shadow[s]) > cap:
+                    shadow[s].pop(0)
+                    shadow_drops[s] += 1
+
+    def run_tick(t, items, timed):
+        order = (
+            ("pr14", "async") if t % 2 == 0 else ("async", "pr14")
+        )
+        tick_times = {}
+        for name in order:
+            tick_times[name] = advance(name, items)
+        shadow_admit(items)
+        for s in range(streams):
+            admitted[s].extend(shadow[s])
+            shadow[s].clear()
+        if timed:
+            for name in pods:
+                times[name].append(tick_times[name])
+
+    for t, items in enumerate(wall[:warm]):
+        run_tick(t, items, False)
+    n_after_warm = [len(o) for o in outs["async"]]
+    with guards.steady_state(tag="async-serving A/B pair"):
+        for t, items in enumerate(wall[warm:]):
+            run_tick(warm + t, items, True)
+
+    # -- structural claims: violations are bugs, not weather --
+    tables: dict = {}
+    for name, pod in pods.items():
+        rb: dict = {}
+        switches = 0
+        overlap = 0
+        top_rung_hits = 0
+        for sh in pod.shards:
+            eng = sh.fleet_ingest
+            if sum(eng.rung_bucket_dispatches.values()) != eng.dispatch_count:
+                raise RuntimeError(
+                    f"{name}: per-(rung,bucket) counters do not sum to "
+                    "the engine dispatch count — the accounting leaks"
+                )
+            marginal: dict = {}
+            for (r, _b), n in eng.rung_bucket_dispatches.items():
+                marginal[r] = marginal.get(r, 0) + n
+            # rung_dispatches pre-registers every warmed rung at 0;
+            # the (rung, bucket) table only grows keys on dispatch
+            if any(
+                marginal.get(r, 0) != n
+                for r, n in eng.rung_dispatches.items()
+            ) or any(r not in eng.rung_dispatches for r in marginal):
+                raise RuntimeError(
+                    f"{name}: per-(rung,bucket) marginals "
+                    f"{marginal} != per-rung counters "
+                    f"{dict(eng.rung_dispatches)}"
+                )
+            if eng.revs_dropped:
+                raise RuntimeError(
+                    f"{name}: {eng.revs_dropped} revolutions dropped "
+                    "(max_revs overflow) — the golden replay would "
+                    "diverge"
+                )
+            for key, n in eng.rung_bucket_dispatches.items():
+                rb[key] = rb.get(key, 0) + n
+            switches += eng.bucket_switches
+            overlap += eng.staging_overlap_hits
+            top_rung_hits += eng.rung_dispatches.get(max(rungs), 0)
+        tables[name] = {
+            "rung_bucket": rb,
+            "bucket_switches": switches,
+            "overlap_hits": overlap,
+            "top_rung_hits": top_rung_hits,
+        }
+    for name in pods:
+        if not tables[name]["top_rung_hits"]:
+            raise RuntimeError(
+                f"{name}: the storm never reached the top rung "
+                f"T={max(rungs)} — the trace did not exercise the "
+                "ladder"
+            )
+    if tables["async"]["bucket_switches"] < 2:
+        raise RuntimeError(
+            "the occupancy collapse+recovery applied "
+            f"{tables['async']['bucket_switches']} < 2 mid-run bucket "
+            "switches — the ladder never moved both ways"
+        )
+    if tables["pr14"]["bucket_switches"]:
+        raise RuntimeError(
+            "the PR14 arm switched buckets — its ladder should be "
+            "disabled"
+        )
+    if not tables["async"]["overlap_hits"]:
+        raise RuntimeError(
+            "the async arm never overlapped staging with in-flight "
+            "compute — the double buffer did not engage"
+        )
+    if tables["pr14"]["overlap_hits"]:
+        raise RuntimeError(
+            "the PR14 arm recorded staging overlaps — its staging "
+            "should be synchronous"
+        )
+    model_keys = set(pods["async"].scheduler.model.table_ms())
+    want_keys = {f"T{r}xM{b}" for r in rungs for b in buckets}
+    if not want_keys <= model_keys:
+        raise RuntimeError(
+            f"latency model is missing warmed programs: "
+            f"{sorted(want_keys - model_keys)} — the first drain "
+            "would be blind"
+        )
+    if max_depth_seen > cap:
+        raise RuntimeError(
+            f"observed backlog depth {max_depth_seen} exceeds the "
+            f"admission bound {cap} — the queue is not bounded"
+        )
+    for name, pod in pods.items():
+        if list(pod.scheduler.admission_drops) != shadow_drops:
+            raise RuntimeError(
+                f"{name}: admission-shed counters "
+                f"{pod.scheduler.admission_drops} != shadow policy "
+                f"{shadow_drops}"
+            )
+    if sum(shadow_drops) == 0:
+        raise RuntimeError(
+            "the fleet-wide outage never forced a shed — the bound was "
+            "not exercised"
+        )
+    # byte-equal trajectories: arm vs arm, whole run
+    for i in range(streams):
+        a, b = outs["async"][i], outs["pr14"][i]
+        if len(a) != len(b) or not all(
+            np.array_equal(np.asarray(x.ranges), np.asarray(y.ranges))
+            and np.array_equal(np.asarray(x.voxel), np.asarray(y.voxel))
+            for x, y in zip(a, b)
+        ):
+            raise RuntimeError(
+                f"stream {i}: outputs diverged between the async and "
+                "PR14 arms — staging policy changed WHAT, not when"
+            )
+    # host golden over the full run (no kill in this config)
+    for i in range(streams):
+        completed: list = []
+        asm = ScanAssembler(
+            max_nodes=capacity,
+            on_complete=lambda sc, c=completed: c.append(dict(sc)),
+        )
+        dec = BatchScanDecoder(asm)
+        for ans_t, frames in admitted[i]:
+            dec.on_measurement_batch(int(ans_t), list(frames))
+        chain = ScanFilterChain(
+            pods["async"].params, beams=beams, warmup=False
+        )
+        golden = [
+            chain.process_raw(
+                sc["angle_q14"], sc["dist_q2"], sc["quality"], sc["flag"]
+            )
+            for sc in completed
+        ]
+        got = outs["async"][i]
+        if len(golden) != len(got) or not all(
+            np.array_equal(np.asarray(g.ranges), np.asarray(o.ranges))
+            and np.array_equal(np.asarray(g.voxel), np.asarray(o.voxel))
+            for g, o in zip(golden, got)
+        ):
+            raise RuntimeError(
+                f"stream {i}: outputs diverged from the host golden "
+                "replay of the admitted tick sequence"
+            )
+
+    # -- the latency claim --
+    p99_pr14 = float(np.percentile(times["pr14"], 99))
+    p99_async = float(np.percentile(times["async"], 99))
+    p99_speedup = p99_pr14 / max(p99_async, 1e-9)
+    clamped = min(
+        float(np.percentile(times["pr14"], 50)),
+        float(np.percentile(times["async"], 50)),
+    ) < 50e-6
+    # smoke is a parity SANITY floor: on a linkless CPU device_put is
+    # a memcpy, so there is no H2D latency TO hide and the ping/pong
+    # bookkeeping costs a few percent of Python — weather, not
+    # structure.  The WIN bar applies to full runs on-chip, where each
+    # synchronous stage is a link round trip the overlap removes.
+    bar = 0.85 if smoke else 1.05
+    if not clamped and p99_speedup < bar:
+        raise RuntimeError(
+            f"async arm p99 {p99_async * 1e3:.3f} ms did not beat the "
+            f"synchronous baseline {p99_pr14 * 1e3:.3f} ms (ratio "
+            f"{p99_speedup:.3f} < {bar})"
+        )
+    scans = sum(len(o) for o in outs["async"]) - sum(n_after_warm)
+    dt = float(np.sum(times["async"]))
+    value = scans / max(dt, 1e-9)
+    return {
+        "metric": metric_name(20),
+        "value": round(value, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "shards": shards,
+        "rungs": list(rungs),
+        "buckets": list(buckets),
+        "wall_ticks": len(wall),
+        "timed_ticks": len(times["async"]),
+        "scans": scans,
+        "p99_pr14_ms": round(p99_pr14 * 1e3, 3),
+        "p99_async_ms": round(p99_async * 1e3, 3),
+        "p50_pr14_ms": round(
+            float(np.percentile(times["pr14"], 50)) * 1e3, 3
+        ),
+        "p50_async_ms": round(
+            float(np.percentile(times["async"], 50)) * 1e3, 3
+        ),
+        "rung_bucket_dispatches": {
+            name: {
+                f"T{r}xM{b}": n
+                for (r, b), n in sorted(t["rung_bucket"].items())
+            }
+            for name, t in tables.items()
+        },
+        "bucket_switches": {
+            name: t["bucket_switches"] for name, t in tables.items()
+        },
+        "staging_overlap_hits": {
+            name: t["overlap_hits"] for name, t in tables.items()
+        },
+        "latency_model_ms": pods["async"].scheduler.model.table_ms(),
+        "admission": {
+            "bound_ticks": cap,
+            "max_depth_seen": max_depth_seen,
+            "sheds_per_stream": shadow_drops,
+            "sheds_total": sum(shadow_drops),
+        },
+        "structural": {
+            "per_rung_bucket_accounting": True,   # asserted above
+            "reached_top_rung": True,             # asserted above
+            "bucket_ladder_moved_both_ways": True,  # asserted above
+            "pr14_arm_static": True,              # asserted above
+            "async_overlap_engaged": True,        # asserted above
+            "latency_model_fully_seeded": True,   # asserted above
+            "bounded_backlog": True,              # asserted above
+            "shed_policy_matches_shadow": True,   # asserted above
+            "byte_equal_arms": True,              # asserted above
+            "byte_equal_host_golden": True,       # asserted above
+            "zero_recompiles": True,              # steady_state guard
+            "zero_implicit_transfers": True,      # steady_state guard
+        },
+        # the decide_backends decision key for the staging default:
+        # TPU records only, the clamp honored — the overlap and the
+        # bucket collapse are structural everywhere, but only on-chip
+        # wall time can price hiding a link this rig does not have
+        "async_serving_ab": {
+            "p99_speedup": round(p99_speedup, 4),
+            "buckets": list(buckets),
+            "rungs": list(rungs),
+            "overlap_hits": tables["async"]["overlap_hits"],
+            "bucket_switches": tables["async"]["bucket_switches"],
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the overlap is structural: every drain's H2D stage for "
+            "group k+1 is issued while group k's compute is still in "
+            "flight, and snapshot pulls ride the idle half — asserted "
+            "by overlap counters and byte-equal trajectories, not "
+            "inferred from wall time.  On this linkless CPU rig "
+            "device_put is a memcpy into host RAM, so the measured "
+            "ratio prices ping/pong bookkeeping, not the per-stage "
+            "link round trip the double buffer hides; the occupancy "
+            "collapse's cheaper-executable win is likewise sub-"
+            "microsecond here.  The on-chip capture queued in "
+            "scripts/rig_recapture.sh is where the latency claim "
+            "lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 class _DriftingFrontEnd:
     """Scripted SLAM front-end for the config-17 back-end A/B: maps are
     rasterized at CALLER-SUPPLIED (drift-injected) poses with no
@@ -4235,6 +4688,7 @@ def metric_name(config: int) -> str:
         17: "loop_close_corrected_scans_per_sec",
         18: "fused_mapping_stack_updates_per_sec",
         19: "elastic_serving_adaptive_scans_per_sec",
+        20: "async_serving_overlapped_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -4266,6 +4720,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_fused_mapping()
     if kind == "elastic_serving":
         return bench_elastic_serving()
+    if kind == "async_serving":
+        return bench_async_serving()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -4693,6 +5149,18 @@ if __name__ == "__main__":
         "a shard kill — the tier-1 regression gate for the scheduler",
     )
     ap.add_argument(
+        "--smoke-async-serving",
+        action="store_true",
+        help="seconds-scale CPU run of the config-20 link-latency-"
+        "hiding A/B (small geometry, forced CPU backend, no tunnel "
+        "probe): asserts per-(rung,bucket) dispatch accounting, the "
+        "double buffer's staging/compute overlap, mid-run bucket-"
+        "ladder collapse + recovery, the fully seeded latency model, "
+        "byte-equal trajectories across arms + the host golden and "
+        "zero recompiles/implicit transfers across rung AND bucket "
+        "switches — the tier-1 regression gate for async staging",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -4802,6 +5270,14 @@ if __name__ == "__main__":
         # anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_elastic_serving(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_async_serving:
+        # same CPU-only discipline: the staging-overlap structural
+        # gate (per-(rung,bucket) accounting, bucket-ladder moves,
+        # byte equality) must run anywhere, device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_async_serving(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -374,6 +374,31 @@ class DriverParams:
     # shed (counted per stream, surfaced on /diagnostics), never
     # unbounded growth.  The SLO-aware admission policy's hard edge.
     admission_max_backlog_ticks: int = 32
+    # -- link-latency hiding (PR 16) --
+    # double-buffered async H2D staging: within a multi-group drain the
+    # NEXT group's staging planes are filled and device_put while the
+    # previous group's compute is in flight, so the host->device link
+    # transfer of drain t+1 hides under the compute of drain t (d2h
+    # already overlaps via async dispatch).  Staging order is
+    # unchanged — byte-equal trajectories by construction; off
+    # reproduces the serialized stage->compute order exactly (the
+    # bench --config 20 A/B arm).
+    staging_double_buffer: bool = True
+    # adaptive padding-bucket LADDER for the frame-run bucket M: every
+    # listed bucket is pre-warmed per rung at precompile (one compiled
+    # program per (rung, bucket)), and the scheduler's live-lane
+    # occupancy EWMA picks the ACTIVE slicing cap with hysteresis —
+    # occupancy collapse (many idle/quarantined lanes) drops dispatches
+    # to a cheaper executable with zero recompiles; recovery steps back
+    # up.  Must be strictly ascending when set; empty disables the
+    # ladder (the static largest-bucket cap — pre-PR 16 behavior).
+    # Inert until a TrafficShaper is attached.
+    bucket_rungs: tuple = ()
+    # EWMA weight of the live-lane occupancy estimate feeding the
+    # bucket ladder (deliberately separate from sched_byte_rate_alpha:
+    # retuning placement responsiveness must not silently retune the
+    # bucket choice, or vice versa)
+    occupancy_alpha: float = 0.2
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -673,6 +698,28 @@ class DriverParams:
                 "stream backlog is BOUNDED by contract — unbounded "
                 "growth is the failure mode this knob exists to forbid)"
             )
+        if not isinstance(self.staging_double_buffer, bool):
+            raise ValueError(
+                "staging_double_buffer must be a bool (the ping/pong "
+                "staging pair is on or off — there is no depth knob; "
+                "two halves fully overlap one in-flight drain)"
+            )
+        buckets = tuple(self.bucket_rungs)
+        if any(
+            not isinstance(b, int) or isinstance(b, bool) for b in buckets
+        ):
+            raise ValueError("bucket_rungs must be a tuple of ints")
+        if buckets:
+            if min(buckets) < 1:
+                raise ValueError("bucket_rungs buckets must be >= 1")
+            if any(b <= a for a, b in zip(buckets, buckets[1:])):
+                raise ValueError(
+                    "bucket_rungs must be strictly ascending (the "
+                    "bucket ladder steps between pre-warmed padding "
+                    "buckets)"
+                )
+        if not (0.0 < self.occupancy_alpha <= 1.0):
+            raise ValueError("occupancy_alpha must be within (0, 1]")
         if not (1 <= self.pose_graph_max_constraints <= 256):
             raise ValueError(
                 "pose_graph_max_constraints must be within [1, 256]"
@@ -689,6 +736,8 @@ class DriverParams:
             p.filter_chain = tuple(p.filter_chain)
         if isinstance(p.sched_rungs, list):
             p.sched_rungs = tuple(p.sched_rungs)
+        if isinstance(p.bucket_rungs, list):
+            p.bucket_rungs = tuple(p.bucket_rungs)
         p.validate()
         return p
 

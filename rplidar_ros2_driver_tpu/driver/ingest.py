@@ -611,6 +611,39 @@ class FleetFusedIngest:
         # the GC.  Steady state (pipelined depth ~2) holds two pairs per
         # bucket and allocates nothing per tick.
         self._staging_free: dict = {}
+        # double-buffered async H2D staging: within a multi-group drain
+        # the NEXT group's staging planes are filled and device_put
+        # while the previous group's compute is still in flight — the
+        # free list then holds a ping/pong PAIR of planes per staging
+        # key (the in-flight dispatch owns one half, the overlap stage
+        # fills the other), recycled at result fetch as before.  Off
+        # reproduces the PR 14 serialized stage->compute order exactly
+        # (same ticks, same contents — the A/B arm of bench --config 20)
+        self.double_buffer = bool(
+            getattr(params, "staging_double_buffer", True)
+        )
+        # dispatches whose H2D staging overlapped an in-flight compute
+        # (the /diagnostics staging-overlap hit counter)
+        self.staging_overlap_hits = 0
+        # adaptive padding-bucket ladder seam: when set (a warmed
+        # bucket), _tick_slices caps frame runs at THIS bucket instead
+        # of the largest — the scheduler's BucketLadder drops it on
+        # occupancy collapse so dispatches ride a cheaper executable.
+        # The cap only re-slices future ticks: contents and order never
+        # change, so any cap sequence is byte-equal by construction
+        # (same argument as the rung ladder) and per-stream snapshots
+        # round-trip across a switch untouched.
+        self.active_bucket: Optional[int] = None
+        self.bucket_switches = 0
+        # per-(rung, bucket) dispatch accounting (sums to
+        # dispatch_count; marginal over buckets reproduces
+        # rung_dispatches — bench --config 20 asserts both identities)
+        self.rung_bucket_dispatches: dict = {}
+        # precompile's timed re-runs of each warmed (rung, bucket)
+        # program (compile excluded): the LatencyModel seeds
+        # (parallel/scheduler.py) so the first live drain is priced
+        # before any traffic
+        self.warmup_costs: dict = {}
         # per-stream host trackers (everything else lives on device)
         self._stream_fmt: list = [None] * streams   # active ans type
         self._bases: list = [None] * streams        # f64 timestamp base
@@ -826,6 +859,21 @@ class FleetFusedIngest:
         if icfg is None:
             return
         self._rungs_warmed = True
+
+        def timed_seed(rung, bucket, run, st):
+            # warm (pays the compile), then time a SECOND run of the
+            # now-cached executable end to end — the LatencyModel seed
+            # for this (rung, bucket) program.  Compile time must stay
+            # out of the seed or the deadline cap would price every
+            # rung at its one-off warmup cost and pin the ladder to the
+            # floor for the first hundreds of drains.  The state arg is
+            # donated, so the timed re-run threads the returned carry.
+            out = run(st)
+            self._jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            self._jax.block_until_ready(run(out[0]))
+            self.warmup_costs[(rung, bucket)] = time.perf_counter() - t0
+
         for b in buckets or self._buckets:
             st = self._place(create_fleet_ingest_state(icfg, self.streams))
             aux = np.zeros((self.streams, fleet_aux_len(b)), np.float32)
@@ -834,7 +882,17 @@ class FleetFusedIngest:
                 np.zeros((self.streams, b, icfg.frame_bytes), np.uint8),
                 aux,
             )
-            fleet_fused_ingest_step(st, dbuf, daux, cfg=icfg)
+            timed_seed(
+                1, b,
+                # graftlint: disable=GL003 — timed_seed threads the
+                # RETURNED carry into its second call; the donated
+                # handle is never re-read (each invocation gets a
+                # fresh state, see the docstring above)
+                lambda s, u=dbuf, a=daux: fleet_fused_ingest_step(
+                    s, u, a, cfg=icfg
+                ),
+                st,
+            )
             for T in self.rungs:
                 if T <= 1:
                     continue  # the per-tick program above IS rung 1
@@ -856,7 +914,16 @@ class FleetFusedIngest:
                     saux,
                     super_step=True,
                 )
-                super_fleet_ingest_step(st, dbuf, daux, cfg=icfg)
+                timed_seed(
+                    T, b,
+                    # graftlint: disable=GL003 — timed_seed threads
+                    # the RETURNED carry into its second call; the
+                    # donated handle is never re-read
+                    lambda s, u=dbuf, a=daux: super_fleet_ingest_step(
+                        s, u, a, cfg=icfg
+                    ),
+                    st,
+                )
 
     # -- producer side -----------------------------------------------------
 
@@ -865,6 +932,36 @@ class FleetFusedIngest:
             if n <= b:
                 return b
         return self._buckets[-1]
+
+    def set_active_bucket(self, bucket: int) -> None:
+        """Move the frame-run slicing cap to ``bucket`` (the scheduler's
+        BucketLadder pick — occupancy collapse drops it, recovery
+        raises it).  The bucket must be warmed: every listed bucket got
+        its own compiled program per rung at precompile, so a mid-run
+        switch is a compile-cache hit by construction.  The cap only
+        re-slices FUTURE ticks — contents and order never change, so
+        any cap sequence lands byte-equal trajectories and per-stream
+        snapshots round-trip across the switch untouched (the PR 9
+        migration-relabel argument; tests/test_guards.py pins the
+        zero-recompile half, bench --config 20 the byte-equality)."""
+        b = int(bucket)
+        if b not in self._buckets:
+            raise ValueError(
+                f"bucket {b} is not a warmed padding bucket "
+                f"{self._buckets} — list it in bucket_rungs (every "
+                "ladder bucket is compiled per rung at precompile)"
+            )
+        prev = self.active_bucket or self._buckets[-1]
+        self.active_bucket = b
+        if b != prev:
+            self.bucket_switches += 1
+
+    @property
+    def slicing_bucket(self) -> int:
+        """The active slicing-cap bucket: the bucket ladder's pick, or
+        the top warmed bucket when no ladder has spoken (the pre-PR-16
+        static behaviour)."""
+        return self.active_bucket or self._buckets[-1]
 
     def _normalize_tick(self, items) -> list:
         """Validate one tick's per-stream byte runs: payload-size filter
@@ -929,7 +1026,10 @@ class FleetFusedIngest:
         resets = self._reset_next
         self._reset_next = [False] * self.streams
         no_reset = [False] * self.streams
-        cap = self._buckets[-1]
+        # the bucket ladder's slicing cap: a collapsed fleet slices at
+        # a small pre-warmed bucket (cheap executable, a couple more
+        # dispatches), a full fleet at the largest (one padded plane)
+        cap = self.active_bucket or self._buckets[-1]
         slices = []
         off = 0
         while True:
@@ -957,7 +1057,17 @@ class FleetFusedIngest:
         default depth is ``super_tick_max``; a scheduler picks a
         different WARMED rung per drain — an unwarmed depth is refused
         loudly, because it would pay its compile inside the serving
-        loop."""
+        loop.
+
+        With ``staging_double_buffer`` on and more than one group
+        queued, staging runs one group AHEAD of compute: group t's
+        dispatch is issued (async), THEN group t+1's planes are filled
+        and ``device_put`` while t computes — the H2D link transfer of
+        drain t+1 hides under the compute of drain t.  Staging order is
+        unchanged (groups stage strictly in tick order, so the
+        timestamp-base walk and the pending queue see the exact PR 14
+        sequence), only the interleaving with compute dispatch moves —
+        byte-equal trajectories by construction."""
         if depth is None:
             depth = self.super_tick_max
         elif depth not in self.rungs:
@@ -980,17 +1090,29 @@ class FleetFusedIngest:
                 "— this dispatch compiles in-line", depth,
             )
         if depth <= 1:
-            for sl in slices:
-                self._dispatch_slice(sl)
-            return
-        off = 0
-        while off < len(slices):
-            group = slices[off : off + depth]
+            groups = [[sl] for sl in slices]
+        else:
+            groups = [
+                slices[off : off + depth]
+                for off in range(0, len(slices), depth)
+            ]
+
+        def stage(group):
             if len(group) == 1:
-                self._dispatch_slice(group[0])
-            else:
-                self._dispatch_super(group, depth)
-            off += len(group)
+                return self._stage_tick(group[0])
+            return self._stage_super(group, depth)
+
+        if not self.double_buffer or len(groups) < 2:
+            # PR 14 order: stage -> compute, serialized per group
+            for group in groups:
+                self._launch(stage(group))
+            return
+        staged = stage(groups[0])
+        for group in groups[1:]:
+            self._launch(staged)   # async dispatch: drain t computes
+            staged = stage(group)  # drain t+1's H2D overlaps drain t
+            self.staging_overlap_hits += 1
+        self._launch(staged)
 
     def _staging_buffers(self, skey: tuple) -> tuple:
         """A (frames, aux) staging pair for one staging key —
@@ -1078,9 +1200,14 @@ class FleetFusedIngest:
             self.wires_dropped += 1
 
     # graftlint: hot-loop
-    def _dispatch_slice(self, sl) -> None:
-        from rplidar_ros2_driver_tpu.ops.ingest import fleet_fused_ingest_step
-
+    def _stage_tick(self, sl) -> tuple:
+        """Fill and ``device_put`` ONE per-tick dispatch's staging
+        planes — 2 DECLARED host->device transfers per fleet tick
+        slice, independent of fleet size; the runtime transfer sentinel
+        forbids the implicit numpy->jit alternative.  Returns the
+        staged descriptor :meth:`_launch` consumes (the stage/compute
+        split is what lets the double buffer issue drain t+1's H2D
+        while drain t computes)."""
         icfg = self._icfg
         mb = self._bucket(max(
             (len(c[1]) for c in sl[0] if c), default=1
@@ -1089,34 +1216,23 @@ class FleetFusedIngest:
         pair = self._staging_buffers(skey)
         buf, aux = pair
         self._stage_slice(sl, mb, buf, aux)
-        # explicit device_put staging (_put_staging) — 2 DECLARED
-        # host->device transfers per fleet tick slice, independent of
-        # fleet size; the runtime transfer sentinel forbids the implicit
-        # numpy->jit alternative
         dbuf, daux = self._put_staging(buf, aux)
-        self._state, *res = fleet_fused_ingest_step(
-            self._state, dbuf, daux, cfg=icfg
-        )
-        self.dispatch_count += 1
-        self.rung_dispatches[1] = self.rung_dispatches.get(1, 0) + 1
         self.h2d_transfers += 2
-        self._append_pending(
-            res, ("tick", tuple(res), icfg, list(self._bases), skey, pair)
+        return (
+            "tick", 1, 1, icfg, list(self._bases), skey, pair, dbuf, daux
         )
 
     # graftlint: hot-loop
-    def _dispatch_super(self, group, T: int) -> None:
-        """Stage up to ``T`` tick slices (a warmed rung depth) as one
-        (T, streams, M, frame_bytes) plane and drain them in ONE
-        compiled super-step dispatch (ops/ingest.super_fleet_ingest_step).
-        The group is padded to the full T with all-idle tick planes —
-        zeroed staging rows are exactly the idle-lane encoding (m=0,
-        base_shift=0, no reset), which pass every carry through — so each
-        (rung, bucket) pair compiles once, whatever the backlog length,
-        and any rung SEQUENCE lands byte-identical state (the pad ticks
-        are no-ops by construction)."""
-        from rplidar_ros2_driver_tpu.ops.ingest import super_fleet_ingest_step
-
+    def _stage_super(self, group, T: int) -> tuple:
+        """Fill and ``device_put`` one super-step dispatch's staging
+        planes: up to ``T`` tick slices (a warmed rung depth) as one
+        (T, streams, M, frame_bytes) plane.  The group is padded to the
+        full T with all-idle tick planes — zeroed staging rows are
+        exactly the idle-lane encoding (m=0, base_shift=0, no reset),
+        which pass every carry through — so each (rung, bucket) pair
+        compiles once, whatever the backlog length, and any rung
+        SEQUENCE lands byte-identical state (the pad ticks are no-ops
+        by construction)."""
         icfg = self._icfg
         mb = self._bucket(max(
             (len(c[1]) for sl in group for c in sl[0] if c), default=1
@@ -1132,17 +1248,50 @@ class FleetFusedIngest:
         # rows come back all-zero and the parse skips them.  Staging is
         # an explicit device_put, like the per-tick path.
         dbuf, daux = self._put_staging(buf, aux, super_step=True)
-        self._state, *res = super_fleet_ingest_step(
-            self._state, dbuf, daux, cfg=icfg
-        )
-        self.dispatch_count += 1
-        self.super_dispatches += 1
-        self.ticks_super_fused += len(group)
-        self.rung_dispatches[T] = self.rung_dispatches.get(T, 0) + 1
         self.h2d_transfers += 2
-        self._append_pending(
-            res, ("super", tuple(res), icfg, bases_per_tick, skey, pair)
+        return (
+            "super", T, len(group), icfg, bases_per_tick, skey, pair,
+            dbuf, daux,
         )
+
+    # graftlint: hot-loop
+    def _launch(self, staged) -> None:
+        """Issue the compiled dispatch for one staged descriptor and
+        append its pending entry — the compute half of the
+        stage/compute split (dispatch is async: this returns as soon as
+        the program is enqueued, which is what the double buffer's
+        overlap stage hides behind)."""
+        from rplidar_ros2_driver_tpu.ops.ingest import (
+            fleet_fused_ingest_step,
+            super_fleet_ingest_step,
+        )
+
+        kind, T, n, icfg, bases, skey, pair, dbuf, daux = staged
+        if kind == "super":
+            self._state, *res = super_fleet_ingest_step(
+                self._state, dbuf, daux, cfg=icfg
+            )
+            self.super_dispatches += 1
+            self.ticks_super_fused += n
+        else:
+            self._state, *res = fleet_fused_ingest_step(
+                self._state, dbuf, daux, cfg=icfg
+            )
+        self.dispatch_count += 1
+        self.rung_dispatches[T] = self.rung_dispatches.get(T, 0) + 1
+        rb = (T, skey[-1])
+        self.rung_bucket_dispatches[rb] = (
+            self.rung_bucket_dispatches.get(rb, 0) + 1
+        )
+        self._append_pending(
+            res, (kind, tuple(res), icfg, bases, skey, pair)
+        )
+
+    def _dispatch_slice(self, sl) -> None:
+        self._launch(self._stage_tick(sl))
+
+    def _dispatch_super(self, group, T: int) -> None:
+        self._launch(self._stage_super(group, T))
 
     # -- consumer side -----------------------------------------------------
 
@@ -1243,7 +1392,13 @@ class FleetFusedIngest:
             self._pending.clear()
             return self._parse_entries(entries)
 
-    def submit_backlog(self, ticks, *, rung: Optional[int] = None) -> list:
+    def submit_backlog(
+        self,
+        ticks,
+        *,
+        rung: Optional[int] = None,
+        overlap_work=None,
+    ) -> list:
         """Drain a BACKLOG of queued fleet ticks — frames that piled up
         behind a link stall or a slow consumer — in
         ``ceil(len(ticks)/T)`` compiled dispatches instead of one per
@@ -1258,12 +1413,24 @@ class FleetFusedIngest:
         planes.  Returns every pending revolution as per-stream
         ``(FilterOutput, ts0, duration)`` lists, in tick order —
         bit-exact against submitting the same ticks one by one, for ANY
-        rung sequence (the scheduler chooses when, never what)."""
+        rung sequence (the scheduler chooses when, never what).
+
+        ``overlap_work`` (optional zero-arg callable) runs AFTER every
+        dispatch is issued and BEFORE their results are fetched — the
+        idle half of the double buffer: work queued there (the elastic
+        pod's failover snapshot pulls and quarantine checkpoints — row
+        gathers the device executes after the in-flight drain, in
+        order) hides its latency under the drain's compute instead of
+        extending the critical path.  It runs OUTSIDE the engine lock,
+        so it may call the snapshot surface (snapshot_stream)."""
         with self._lock:
             slices = []
             for items in ticks:
                 slices.extend(self._tick_slices(items))
             self._dispatch_slices(slices, depth=rung)
+        if overlap_work is not None:
+            overlap_work()
+        with self._lock:
             entries = list(self._pending)
             self._pending.clear()
             return self._parse_entries(entries)

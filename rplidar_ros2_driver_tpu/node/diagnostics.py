@@ -200,6 +200,28 @@ class DiagnosticsUpdater:
                 values["Placement Weights"] = ",".join(
                     f"{w:.2f}" for w in weights
                 )
+            # link-latency hiding (PR 16): the measured per-(rung,
+            # bucket) cost table steering the deadline cap, the bucket
+            # ladder's picks, and the double buffer's overlap hit
+            # count — only rendered once the model has keys / the
+            # ladder is configured (a plain rung-only shaper keeps the
+            # PR 14 group unchanged)
+            model = scheduler.get("latency_model")
+            if model:
+                values["Latency Model ms"] = " ".join(
+                    f"{k}:{model[k]}" for k in sorted(model)
+                )
+            buckets = scheduler.get("active_buckets")
+            if buckets is not None:
+                values["Active Bucket"] = ",".join(
+                    str(b) for b in buckets
+                )
+                values["Bucket Switches"] = str(
+                    scheduler.get("bucket_switches", 0)
+                )
+            hits = scheduler.get("staging_overlap_hits")
+            if hits is not None:
+                values["Staging Overlap Hits"] = str(hits)
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

@@ -23,8 +23,25 @@ constraint):
     ``admission_max_backlog_ticks`` the OLDEST queued tick is shed
     (counted per stream, surfaced on /diagnostics), never unbounded
     growth; and a per-shard deadline budget (``sched_deadline_ms``)
-    caps the rung so the PREDICTED drain wall time (EWMA per-tick
-    drain cost x depth) stays inside the publish SLO.
+    caps the rung so the PREDICTED drain wall time stays inside the
+    publish SLO.
+  * **measured (rung, bucket) latency model** — a :class:`LatencyModel`
+    cost table fit online from timed drains and SEEDED from the
+    precompile warmup timings (``FleetFusedIngest.warmup_costs``), so
+    the deadline cap prices each rung with ITS OWN measured executable
+    cost instead of extrapolating one scalar EWMA across depths — the
+    first real drain is never blind, and a rung whose program is
+    cheaper than linear (the super-step amortizes dispatch overhead)
+    is not spuriously capped.
+  * **adaptive padding-bucket ladder** — the frame-run bucket M gets
+    the same pre-warmed-ladder + hysteresis treatment T has
+    (:class:`BucketLadder`): every ``bucket_rungs`` bucket is warmed
+    per rung at precompile, and a live-lane occupancy EWMA
+    (``occupancy_alpha``) picks the ACTIVE bucket with hysteresis —
+    occupancy collapse (many idle/quarantined lanes) drops the slicing
+    cap to a cheaper executable with zero recompiles, and a mid-run
+    bucket switch never touches stream state (per-stream snapshots
+    round-trip across it exactly like a PR 9 migration relabel).
   * **byte-rate estimation** — a per-stream EWMA of offered bytes per
     tick (``sched_byte_rate_alpha``) feeding byte-rate-weighted
     placement (parallel/sharding.FleetTopology.set_weight): evacuation
@@ -56,6 +73,8 @@ class SchedulerConfig:
     deadline_ms: float = 0.0
     byte_rate_alpha: float = 0.2
     max_backlog_ticks: int = 32
+    bucket_rungs: tuple = ()
+    occupancy_alpha: float = 0.2
 
     def __post_init__(self) -> None:
         rungs = tuple(int(r) for r in self.rungs)
@@ -84,6 +103,19 @@ class SchedulerConfig:
                 "max_backlog_ticks must be >= 1 (the backlog is "
                 "bounded by contract)"
             )
+        buckets = tuple(int(b) for b in self.bucket_rungs)
+        object.__setattr__(self, "bucket_rungs", buckets)
+        if buckets:
+            if min(buckets) < 1:
+                raise ValueError("bucket_rungs must be >= 1")
+            if any(b <= a for a, b in zip(buckets, buckets[1:])):
+                raise ValueError(
+                    "bucket_rungs must be strictly ascending (the "
+                    "bucket ladder steps between pre-warmed padding "
+                    "buckets)"
+                )
+        if not (0.0 < self.occupancy_alpha <= 1.0):
+            raise ValueError("occupancy_alpha must be within (0, 1]")
 
     @classmethod
     def from_params(cls, params) -> "SchedulerConfig":
@@ -98,6 +130,10 @@ class SchedulerConfig:
             ),
             max_backlog_ticks=int(
                 getattr(params, "admission_max_backlog_ticks", 32)
+            ),
+            bucket_rungs=tuple(getattr(params, "bucket_rungs", ()) or ()),
+            occupancy_alpha=float(
+                getattr(params, "occupancy_alpha", 0.2)
             ),
         )
 
@@ -125,6 +161,154 @@ class ByteRateEwma:
         return [0.0 if r is None else r for r in self._rate]
 
 
+class LatencyModel:
+    """Per-(rung, bucket) measured cost table — the deadline predictor.
+
+    One entry per (drain rung T, active padding bucket M): the EWMA of
+    the measured wall seconds ONE compiled dispatch of that executable
+    costs.  Seeded from the precompile warmup timings
+    (``FleetFusedIngest.warmup_costs`` — a timed re-run of each warmed
+    program, compile excluded) so the first live drain is priced before
+    any traffic; live drains then refit each entry online via
+    :meth:`note`.  The scalar drain-time EWMA this replaces extrapolated
+    one per-tick cost linearly across depths, which mis-prices the
+    super-step's amortization (a rung-8 dispatch does NOT cost 8x a
+    rung-1 dispatch — that gap is the whole point of the ladder)."""
+
+    # deliberately NOT byte_rate_alpha — see RungLadder.DRAIN_COST_ALPHA
+    ALPHA = 0.2
+
+    def __init__(self) -> None:
+        self._cost: dict = {}     # (rung, bucket) -> EWMA seconds/dispatch
+        self._seeded: set = set()  # keys still holding only their seed
+
+    def seed(self, rung: int, bucket: int, seconds: float) -> None:
+        """Install a warmup-timed prior for one (rung, bucket) program.
+        A live measurement always outranks a seed; re-seeding an
+        already-measured entry is a no-op."""
+        key = (int(rung), int(bucket))
+        if seconds <= 0 or key in self._cost:
+            return
+        self._cost[key] = float(seconds)
+        self._seeded.add(key)
+
+    def seed_many(self, costs: dict) -> None:
+        for (rung, bucket), seconds in costs.items():
+            self.seed(rung, bucket, seconds)
+
+    def note(self, rung: int, bucket: int, seconds: float) -> None:
+        """Fold one measured dispatch cost into the table (EWMA); the
+        first live measurement REPLACES the warmup seed outright — the
+        seed exists to price the first drain, not to bias the fit."""
+        key = (int(rung), int(bucket))
+        if seconds < 0:
+            return
+        if key not in self._cost or key in self._seeded:
+            self._cost[key] = float(seconds)
+            self._seeded.discard(key)
+            return
+        a = self.ALPHA
+        self._cost[key] = (1.0 - a) * self._cost[key] + a * float(seconds)
+
+    def cost(self, rung: int, bucket: Optional[int]) -> Optional[float]:
+        """Fitted seconds for one dispatch of the (rung, bucket)
+        program; with no bucket identity, the worst fitted cost across
+        buckets at that rung (a safe deadline bound); None when the
+        table holds nothing for the rung."""
+        if bucket is not None:
+            return self._cost.get((int(rung), int(bucket)))
+        costs = [
+            c for (r, _b), c in self._cost.items() if r == int(rung)
+        ]
+        return max(costs) if costs else None
+
+    def table_ms(self) -> dict:
+        """The /diagnostics rendering payload: ``"T{rung}xM{bucket}"``
+        -> fitted cost in ms, sorted for a stable display."""
+        return {
+            f"T{r}xM{b}": round(c * 1e3, 3)
+            for (r, b), c in sorted(self._cost.items())
+        }
+
+
+class BucketLadder:
+    """One shard's frame-run padding-bucket state: the occupancy EWMA
+    plus hysteresis that picks the ACTIVE bucket from ``bucket_rungs``.
+
+    Occupancy is the fraction of the shard's hosted lanes that carried
+    data in a drain — idle and quarantined/masked lanes both stage m=0
+    rows, so both pull the estimate down.  A collapsed fleet pads most
+    of the (streams, M) plane with dead rows; dropping the slicing cap
+    to a SMALLER pre-warmed bucket trades a couple more dispatches for
+    a much cheaper executable each.  Stepping DOWN (collapse) is
+    immediate — the waste is being paid NOW; stepping back UP waits out
+    ``hysteresis_ticks`` consecutive high-occupancy drains so a
+    flapping lane cannot thrash the cap.  Every bucket is pre-warmed
+    per rung at precompile, so a switch is a compile-cache hit by
+    construction — and it never touches stream state (the cap only
+    re-slices FUTURE ticks), so per-stream snapshots round-trip across
+    a switch exactly like a PR 9 migration relabel."""
+
+    def __init__(
+        self, buckets: tuple, hysteresis_ticks: int, alpha: float
+    ) -> None:
+        if not buckets:
+            raise ValueError("bucket ladder needs at least one bucket")
+        self.buckets = tuple(int(b) for b in buckets)
+        self.hysteresis_ticks = int(hysteresis_ticks)
+        self.alpha = float(alpha)
+        self._idx = len(self.buckets) - 1  # start at the full-size cap
+        self._high_streak = 0
+        self.occupancy_ema: Optional[float] = None
+        self.switches = 0
+
+    def note_occupancy(self, live: int, total: int) -> None:
+        if total <= 0:
+            return
+        occ = min(max(live / total, 0.0), 1.0)
+        self.occupancy_ema = (
+            occ if self.occupancy_ema is None
+            else (1.0 - self.alpha) * self.occupancy_ema + self.alpha * occ
+        )
+
+    def _target_idx(self) -> int:
+        """Evenly spaced occupancy thresholds: bucket index i needs the
+        EWMA strictly above i/len — a half-quarantined fleet (EWMA at
+        0.5) sits at the floor of a two-bucket ladder."""
+        if self.occupancy_ema is None:
+            return len(self.buckets) - 1
+        n = len(self.buckets)
+        return sum(
+            1 for k in range(1, n) if self.occupancy_ema > k / n
+        )
+
+    def pick(self) -> int:
+        """The active bucket for the NEXT drain (called once per
+        drain, after :meth:`note_occupancy`)."""
+        t = self._target_idx()
+        if t < self._idx:
+            # collapse: the padding waste is being paid on every
+            # dispatch — drop to the cheaper executable NOW
+            self._idx = t
+            self._high_streak = 0
+            self.switches += 1
+        elif t > self._idx:
+            self._high_streak += 1
+            if self._high_streak >= self.hysteresis_ticks:
+                # recovered for long enough: step UP one bucket (not
+                # to the target — a re-collapse drops back in one pick)
+                self._idx += 1
+                self._high_streak = 0
+                self.switches += 1
+        else:
+            self._high_streak = 0
+        return self.buckets[self._idx]
+
+    @property
+    def bucket(self) -> int:
+        return self.buckets[self._idx]
+
+
 class RungLadder:
     """One shard's rung state: hysteresis + the deadline budget.
 
@@ -133,13 +317,21 @@ class RungLadder:
     immediate, moving DOWN one rung needs ``hysteresis_ticks``
     consecutive drains whose target sat below the current rung.  The
     deadline budget then CAPS (never raises) the picked rung so the
-    predicted drain wall time — EWMA per-tick drain cost x depth,
-    measured via ``note_drain`` — fits ``deadline_ms``; the cap leaves
+    predicted drain wall time fits ``deadline_ms``; the cap leaves
     the hysteresis state untouched, so demand memory survives a
-    temporarily tight budget."""
+    temporarily tight budget.
 
-    def __init__(self, cfg: SchedulerConfig) -> None:
+    The predictor prefers the attached :class:`LatencyModel`'s
+    per-(rung, bucket) MEASURED dispatch cost (pass the active bucket
+    to ``pick``); the scalar per-tick EWMA (``tick_cost_ema``, the
+    pre-model predictor) remains the fallback for rungs the table has
+    never priced."""
+
+    def __init__(
+        self, cfg: SchedulerConfig, model: Optional[LatencyModel] = None
+    ) -> None:
         self.cfg = cfg
+        self.model = model
         self._idx = 0
         self._low_streak = 0
         self.tick_cost_ema: Optional[float] = None  # seconds/tick
@@ -150,7 +342,19 @@ class RungLadder:
                 return j
         return len(self.cfg.rungs) - 1
 
-    def pick(self, backlog: int) -> int:
+    def _predicted_cost(self, rung: int, bucket: Optional[int]):
+        """Predicted wall seconds for ONE dispatch at ``rung``: the
+        latency model's measured (rung, bucket) entry when it has one,
+        else the scalar extrapolation (per-tick EWMA x depth)."""
+        if self.model is not None:
+            c = self.model.cost(rung, bucket)
+            if c is not None:
+                return c
+        if self.tick_cost_ema:
+            return rung * self.tick_cost_ema
+        return None
+
+    def pick(self, backlog: int, bucket: Optional[int] = None) -> int:
         t = self._target_idx(max(int(backlog), 1))
         if t > self._idx:
             # a burst: swallow it in one deep dispatch NOW
@@ -166,11 +370,12 @@ class RungLadder:
         else:
             self._low_streak = 0
         idx = self._idx
-        if self.cfg.deadline_ms > 0 and self.tick_cost_ema:
+        if self.cfg.deadline_ms > 0:
             budget_s = self.cfg.deadline_ms / 1e3
-            while idx > 0 and (
-                self.cfg.rungs[idx] * self.tick_cost_ema > budget_s
-            ):
+            while idx > 0:
+                cost = self._predicted_cost(self.cfg.rungs[idx], bucket)
+                if cost is None or cost <= budget_s:
+                    break
                 idx -= 1
         return self.cfg.rungs[idx]
 
@@ -180,9 +385,19 @@ class RungLadder:
     # the SLO predictor jittery (or vice versa)
     DRAIN_COST_ALPHA = 0.2
 
-    def note_drain(self, n_ticks: int, seconds: float) -> None:
-        """Record a drain's measured cost (the deadline predictor's
-        input): EWMA of seconds per drained tick."""
+    def note_drain(
+        self,
+        n_ticks: int,
+        seconds: float,
+        *,
+        rung: Optional[int] = None,
+        bucket: Optional[int] = None,
+    ) -> None:
+        """Record a drain's measured cost: the scalar per-tick EWMA
+        (the model-less fallback predictor) always updates; with the
+        drain's (rung, bucket) identity and an attached model, the
+        per-dispatch cost — ``seconds / ceil(n_ticks / rung)`` — also
+        refits that executable's table entry."""
         if n_ticks <= 0 or seconds < 0:
             return
         per = seconds / n_ticks
@@ -191,6 +406,12 @@ class RungLadder:
             per if self.tick_cost_ema is None
             else (1.0 - a) * self.tick_cost_ema + a * per
         )
+        if self.model is not None and rung is not None and rung >= 1:
+            n_dispatches = -(-n_ticks // int(rung))  # ceil
+            if bucket is not None:
+                self.model.note(
+                    rung, bucket, seconds / n_dispatches
+                )
 
     @property
     def rung(self) -> int:
@@ -228,8 +449,28 @@ class TrafficShaper:
         self.shed_total = 0
         self.admitted_ticks = 0
         self.rates = ByteRateEwma(streams, cfg.byte_rate_alpha)
-        self.ladders = [RungLadder(cfg) for _ in range(shards)]
+        # one measured (rung, bucket) cost table for the pod: every
+        # shard runs the same compiled programs over the same shapes,
+        # so their timings price the same executables — sharing the
+        # table means one shard's drains warm the predictor for all
+        self.model = LatencyModel()
+        self.ladders = [
+            RungLadder(cfg, model=self.model) for _ in range(shards)
+        ]
         self.last_rungs = [cfg.rungs[0]] * shards
+        # the padding-bucket ladder (None when bucket_rungs is empty —
+        # the pre-PR 16 static-bucket behavior), one per shard like the
+        # rung ladders: each shard's occupancy tracks its own lanes
+        self.bucket_ladders = (
+            [
+                BucketLadder(
+                    cfg.bucket_rungs, cfg.hysteresis_ticks,
+                    cfg.occupancy_alpha,
+                )
+                for _ in range(shards)
+            ]
+            if cfg.bucket_rungs else None
+        )
 
     # -- admission ---------------------------------------------------------
 
@@ -278,10 +519,21 @@ class TrafficShaper:
         into GLOBAL per-tick item lists (non-listed streams idle), and
         pick the shard's rung for the dispatch grouping.  Returns
         ``(ticks, rung)`` — ``([], rung)`` when nothing is queued (the
-        ladder still observes the empty drain, so it can step down)."""
+        ladder still observes the empty drain, so it can step down).
+        The shard's live-lane occupancy is observed here (lanes whose
+        queues held data vs all hosted lanes) and the bucket ladder
+        picked BEFORE the rung, so the deadline cap prices rungs with
+        the bucket the drain will actually dispatch on."""
         ids = [i for i in stream_ids if i is not None]
         depth = max((len(self.queues[i]) for i in ids), default=0)
-        rung = self.ladders[shard].pick(depth)
+        bucket = None
+        if self.bucket_ladders is not None and ids:
+            bl = self.bucket_ladders[shard]
+            bl.note_occupancy(
+                sum(1 for i in ids if self.queues[i]), len(ids)
+            )
+            bucket = bl.pick()
+        rung = self.ladders[shard].pick(depth, bucket=bucket)
         self.last_rungs[shard] = rung
         if depth == 0:
             return [], rung
@@ -294,18 +546,44 @@ class TrafficShaper:
             ticks.append(tick)
         return ticks, rung
 
-    def note_drain(self, shard: int, n_ticks: int, seconds: float) -> None:
-        self.ladders[shard].note_drain(n_ticks, seconds)
+    def bucket_plan(self, shard: int) -> Optional[int]:
+        """The shard's active padding bucket (None: ladder disabled —
+        the engine keeps its static largest-bucket slicing cap)."""
+        if self.bucket_ladders is None:
+            return None
+        return self.bucket_ladders[shard].bucket
+
+    def note_drain(
+        self,
+        shard: int,
+        n_ticks: int,
+        seconds: float,
+        *,
+        rung: Optional[int] = None,
+        bucket: Optional[int] = None,
+    ) -> None:
+        self.ladders[shard].note_drain(
+            n_ticks, seconds, rung=rung, bucket=bucket
+        )
 
     # -- observability -----------------------------------------------------
 
     def status(self) -> dict:
         """The /diagnostics scheduler value group's payload
         (node/diagnostics.py renders it; tests pin the rendering)."""
-        return {
+        status = {
             "rungs": list(self.last_rungs),
             "backlog": self.backlog_depths(),
             "admission_drops": list(self.admission_drops),
             "shed_total": self.shed_total,
             "byte_rates": [round(r, 1) for r in self.rates.rates()],
+            "latency_model": self.model.table_ms(),
         }
+        if self.bucket_ladders is not None:
+            status["active_buckets"] = [
+                bl.bucket for bl in self.bucket_ladders
+            ]
+            status["bucket_switches"] = sum(
+                bl.switches for bl in self.bucket_ladders
+            )
+        return status

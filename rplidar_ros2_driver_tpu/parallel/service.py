@@ -166,6 +166,11 @@ class ShardedFilterService:
         self.stream_checkpoints: dict = {}
         self.quarantines = 0
         self.rejoins = 0
+        # when a double-buffered scheduled drain is in flight this is a
+        # list collecting quarantine checkpoint pulls so they ride the
+        # IDLE half of the staging buffer instead of the critical path
+        # (drain_scheduled sets/flushes it; None = checkpoint inline)
+        self._defer_checkpoints: Optional[list] = None
         # traffic-shaping seam (parallel/scheduler.TrafficShaper):
         # when attached, offer_bytes/drain_scheduled run the serving
         # plane — bounded per-stream admission queues, byte-rate EWMA,
@@ -469,6 +474,14 @@ class ShardedFilterService:
         backend fleets have no per-stream device rows to freeze (the
         lockstep window advances all-masked); masking alone degrades
         them."""
+        if self._defer_checkpoints is not None:
+            # a double-buffered scheduled drain is dispatching: the
+            # checkpoint pull rides the idle half of the staging buffer
+            # (drain_scheduled's overlap hook).  The lane was MASKED
+            # for this drain — an idle row is a carry no-op — so the
+            # deferred snapshot is byte-identical to an inline pull
+            self._defer_checkpoints.append(i)
+            return
         snap: dict = {}
         if self.fleet_ingest is not None:
             snap["ingest"] = self.fleet_ingest.snapshot_stream(i)
@@ -560,12 +573,23 @@ class ShardedFilterService:
     def drain_scheduled(self) -> list[list[FilterOutput]]:
         """Drain the whole admitted backlog at the rung the shaper
         picks from its depth — ``ceil(depth/rung)`` compiled dispatches
-        — and feed the ladder's deadline predictor the measured wall
-        time.  Returns the :meth:`submit_bytes_backlog` per-stream
-        lists (all-empty when nothing was queued; the ladder still
-        observes the empty drain so it can step down)."""
+        — and feed the measured wall time back into the per-(rung,
+        bucket) latency model steering the ladder's deadline cap.  The
+        bucket ladder's pick (when ``bucket_rungs`` is configured) is
+        applied to the engine's slicing cap before the drain, and
+        quarantine checkpoints triggered by masking ride the idle half
+        of the double buffer.  Returns the :meth:`submit_bytes_backlog`
+        per-stream lists (all-empty when nothing was queued; the ladder
+        still observes the empty drain so it can step down)."""
         if self.scheduler is None:
             raise RuntimeError("attach_scheduler() first")
+        eng = self.fleet_ingest
+        if eng is not None and eng.warmup_costs:
+            # blind-start priors: precompile's timed warmup seeds the
+            # per-(rung, bucket) cost table ONCE; the first live
+            # measurement of each key replaces its seed outright
+            self.scheduler.model.seed_many(eng.warmup_costs)
+            eng.warmup_costs = {}
         ticks, rung = self.scheduler.drain_plan(0, range(self.streams))
         if not ticks:
             # nothing queued: no poses are current this tick (the
@@ -574,10 +598,39 @@ class ShardedFilterService:
             # drain's estimates)
             self.last_poses = [None] * self.streams
             return [[] for _ in range(self.streams)]
+        bucket = self.scheduler.bucket_plan(0)
+        if bucket is not None:
+            eng.set_active_bucket(bucket)
+        deferred: Optional[list] = None
+        if eng is not None and eng.double_buffer and self.health is not None:
+            deferred = []
+            self._defer_checkpoints = deferred
+
+        def _overlap(deferred=deferred) -> None:
+            # the idle half of the double buffer: quarantine
+            # checkpoints pulled while the drain's compute is still in
+            # flight (see _quarantine_stream's deferral gate for the
+            # byte-equality argument)
+            self._defer_checkpoints = None
+            while deferred:
+                self._quarantine_stream(deferred.pop(0))
+
         t0 = time.perf_counter()
-        outs = self.submit_bytes_backlog(ticks, rung=rung)
+        try:
+            outs = self.submit_bytes_backlog(
+                ticks, rung=rung,
+                overlap_work=_overlap if deferred is not None else None,
+            )
+        finally:
+            self._defer_checkpoints = None
+            while deferred:
+                # the dispatch path never reached the overlap hook
+                # (raised drain): flush synchronously — a deferred
+                # checkpoint must never be dropped
+                self._quarantine_stream(deferred.pop(0))
         self.scheduler.note_drain(
-            0, len(ticks), time.perf_counter() - t0
+            0, len(ticks), time.perf_counter() - t0,
+            rung=rung, bucket=eng.slicing_bucket,
         )
         return outs
 
@@ -591,6 +644,13 @@ class ShardedFilterService:
             {} if self.fleet_ingest is None
             else dict(self.fleet_ingest.rung_dispatches)
         )
+        if self.fleet_ingest is not None:
+            eng = self.fleet_ingest
+            status["rung_bucket_dispatches"] = {
+                f"T{r}xM{b}": n
+                for (r, b), n in sorted(eng.rung_bucket_dispatches.items())
+            }
+            status["staging_overlap_hits"] = eng.staging_overlap_hits
         return status
 
     # -- raw-bytes ingest seam ----------------------------------------------
@@ -744,7 +804,8 @@ class ShardedFilterService:
         return self.submit_bytes(items, pipelined=True)
 
     def submit_bytes_backlog(
-        self, ticks, *, rung: Optional[int] = None
+        self, ticks, *, rung: Optional[int] = None,
+        overlap_work=None,
     ) -> list[list[FilterOutput]]:
         """The catch-up seam: drain a BACKLOG of queued fleet byte ticks
         (frames that piled up behind a link stall or a slow consumer) in
@@ -770,7 +831,11 @@ class ShardedFilterService:
         ``rung`` overrides the drain's super-tick depth with another
         warmed ladder rung (fused backend only — the scheduler's
         backlog-adaptive depth pick; the host path has no compiled
-        drain program to pick between)."""
+        drain program to pick between).  ``overlap_work`` (fused only)
+        is a callback the engine runs on the idle half of the double
+        buffer — after this drain's dispatches are issued, before
+        their results are fetched — for off-critical-path host work
+        like snapshot pulls."""
         self._ensure_byte_ingest()
         if rung is not None and self.fleet_ingest_backend != "fused":
             raise ValueError(
@@ -778,13 +843,21 @@ class ShardedFilterService:
                 "backend (the host path dispatches per tick — there is "
                 "no super-step depth to pick)"
             )
+        if overlap_work is not None and self.fleet_ingest_backend != "fused":
+            raise ValueError(
+                "overlap_work needs the fused fleet ingest backend "
+                "(the host path has no async dispatch window for the "
+                "work to overlap with)"
+            )
         if self.health is not None:
             # masking only: a catch-up drain is one event, not
             # len(ticks) of steady-state evidence — the health FSMs
             # advance on live ticks (driver/health.FleetHealth.mask)
             ticks = [self.health.mask(t) for t in ticks]
         if self.fleet_ingest_backend == "fused":
-            outs = self.fleet_ingest.submit_backlog(ticks, rung=rung)
+            outs = self.fleet_ingest.submit_backlog(
+                ticks, rung=rung, overlap_work=overlap_work
+            )
             results = [[o for (o, _ts0, _dur) in s] for s in outs]
             if self.fleet_ingest._mapping is not None:
                 # FUSED mapping route: every drained tick's map update
@@ -1847,9 +1920,20 @@ class ElasticFleetService:
         t0 = time.perf_counter()
         self._tick_faults()
         outs: list = [[] for _ in range(self.streams)]
+        snap_due = (
+            self.snapshot_ticks > 0
+            and (t + 1) % self.snapshot_ticks == 0
+        )
         for s, hs in enumerate(self.shard_health):
             if not hs.hosting:
                 continue
+            eng = self.shards[s].fleet_ingest
+            if eng is not None and eng.warmup_costs:
+                # one shared pod model (every shard runs the same
+                # compiled programs): each engine's precompile warmup
+                # timings seed only the keys still absent
+                self.scheduler.model.seed_many(eng.warmup_costs)
+                eng.warmup_costs = {}
             lane_streams = self.topology.lane_streams(s)
             ticks, rung = self.scheduler.drain_plan(s, lane_streams)
             if not ticks:
@@ -1868,14 +1952,32 @@ class ElasticFleetService:
                 if tr is not None and tr[1] is ShardState.LOST:
                     self._on_lost(s, hs.last_reason)
                 continue
+            bucket = self.scheduler.bucket_plan(s)
+            if bucket is not None:
+                eng.set_active_bucket(bucket)
             lane_ticks = [
                 self.topology.lane_items(s, tick) for tick in ticks
             ]
             offered = any(any(it for it in lt) for lt in lane_ticks)
+            overlap = None
+            if snap_due and eng is not None and eng.double_buffer:
+                from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
+
+                if self.shards[s].mapper is None or is_carried(
+                    self.shards[s].mapper
+                ):
+                    # due failover snapshot pulls ride the idle half of
+                    # this shard's staging buffer (non-carried mappers
+                    # update AFTER the engine drain returns, so their
+                    # rows aren't final yet — those shards keep the
+                    # epilogue pull)
+                    def overlap(t=t, s=s):
+                        self._overlap_snapshots(t, s)
+
             x0 = time.perf_counter()
             try:
                 shard_outs = self.shards[s].submit_bytes_backlog(
-                    lane_ticks, rung=rung
+                    lane_ticks, rung=rung, overlap_work=overlap
                 )
             except Exception as e:  # noqa: BLE001 - heartbeat boundary
                 logger.exception("shard %d drain failed", s)
@@ -1890,7 +1992,9 @@ class ElasticFleetService:
                         self._excluded[stream].add(t)
                 continue
             self.scheduler.note_drain(
-                s, len(ticks), time.perf_counter() - x0
+                s, len(ticks), time.perf_counter() - x0,
+                rung=rung,
+                bucket=None if eng is None else eng.slicing_bucket,
             )
             self.rung_log.append((t, s, rung, len(ticks)))
             completed = 0
@@ -1939,6 +2043,18 @@ class ElasticFleetService:
             for r, n in sh.fleet_ingest.rung_dispatches.items():
                 rung_d[r] = rung_d.get(r, 0) + n
         status["rung_dispatches"] = rung_d
+        rb: dict = {}
+        overlap_hits = 0
+        for sh in self.shards:
+            if sh.fleet_ingest is None:
+                continue
+            for key, n in sh.fleet_ingest.rung_bucket_dispatches.items():
+                rb[key] = rb.get(key, 0) + n
+            overlap_hits += sh.fleet_ingest.staging_overlap_hits
+        status["rung_bucket_dispatches"] = {
+            f"T{r}xM{b}": n for (r, b), n in sorted(rb.items())
+        }
+        status["staging_overlap_hits"] = overlap_hits
         status["weights"] = [
             round(self.topology.weight_of(i), 3)
             for i in range(self.streams)
@@ -1966,6 +2082,26 @@ class ElasticFleetService:
             snap["map"] = sh.mapper.snapshot_stream(lane)
         return snap
 
+    def _overlap_snapshots(self, t: int, s: int) -> None:
+        """Failover snapshot pulls on the idle half of shard ``s``'s
+        double buffer: the drain's compute is still in flight when
+        these run, but the engine's state handle is already the
+        post-drain carry (async dispatch returns it immediately), so
+        the gathered rows are byte-identical to an epilogue pull —
+        the D2H row fetches just leave the critical path.  Streams
+        refreshed here are recognized by :meth:`_refresh_snapshots`
+        (same stored tick) and only get their bookkeeping cleared."""
+        from rplidar_ros2_driver_tpu.driver.health import ShardState
+
+        if self.shard_health[s].state is not ShardState.UP:
+            return
+        for stream in self.topology.lane_streams(s):
+            if stream is None:
+                continue
+            snap = self._stream_snapshot(stream)
+            if snap is not None:
+                self._snap[stream] = (t, snap)
+
     def _refresh_snapshots(self, t: int) -> None:
         """Refresh the host-side snapshot store for every hosted stream
         on an UP shard; the stored tick marks how much history the
@@ -1984,6 +2120,12 @@ class ElasticFleetService:
             if got is None or (
                 self.shard_health[got[0]].state is not ShardState.UP
             ):
+                continue
+            if self._snap.get(stream, (None, None))[0] == t:
+                # already pulled on the idle half of this drain's
+                # staging buffer (_overlap_snapshots saw the post-drain
+                # carry) — only the bookkeeping is still due
+                self._since_snap[stream] = []
                 continue
             snap = self._stream_snapshot(stream)
             if snap is not None:

@@ -33,6 +33,7 @@ def test_metric_names_stable():
     assert bench.metric_name(17) == "loop_close_corrected_scans_per_sec"
     assert bench.metric_name(18) == "fused_mapping_stack_updates_per_sec"
     assert bench.metric_name(19) == "elastic_serving_adaptive_scans_per_sec"
+    assert bench.metric_name(20) == "async_serving_overlapped_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -41,7 +42,7 @@ def test_graded_table_well_formed():
             "passthrough", "chain", "e2e", "fused", "fleet", "ingest",
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
-            "fused_mapping", "elastic_serving",
+            "fused_mapping", "elastic_serving", "async_serving",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1426,6 +1427,131 @@ def test_decide_backends_elastic_serving_key():
     # outweighs a later above-parity noise record
     got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
     assert got["recommendations"]["sched_rungs.tpu"]["flip"] is False
+
+
+def test_bench_smoke_async_serving():
+    """`bench.py --smoke-async-serving` — the tier-1 gate for the
+    link-latency-hiding serving plane (config-20 A/B at seconds-scale
+    CPU geometry).  The structural claims are what matters: per-(rung,
+    bucket) dispatch accounting, the double buffer's staging/compute
+    overlap engaging on the async arm ONLY, the bucket ladder
+    collapsing AND recovering mid-run with zero recompiles, a fully
+    warmup-seeded latency model, bounded shadow-checked admission, and
+    byte-equal trajectories across the async/PR14 arms AND the host
+    golden (the bench itself raises on violation; this gate pins that
+    the asserted artifact lands).  The p99 ratio is 1.5-core-CI
+    weather at smoke geometry and floor-checked only; the asserted WIN
+    bar applies to full runs."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-async-serving"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(20)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    for claim in (
+        "per_rung_bucket_accounting", "reached_top_rung",
+        "bucket_ladder_moved_both_ways", "pr14_arm_static",
+        "async_overlap_engaged", "latency_model_fully_seeded",
+        "bounded_backlog", "shed_policy_matches_shadow",
+        "byte_equal_arms", "byte_equal_host_golden",
+        "zero_recompiles", "zero_implicit_transfers",
+    ):
+        assert s[claim] is True, claim
+    # the overlap and the ladder are async-arm effects ONLY: the PR14
+    # arm must show the static pre-PR-16 behavior on the same trace
+    assert out["staging_overlap_hits"]["async"] > 0
+    assert out["staging_overlap_hits"]["pr14"] == 0
+    assert out["bucket_switches"]["async"] >= 2  # collapse AND recovery
+    assert out["bucket_switches"]["pr14"] == 0
+    # every warmed (rung, bucket) executable is priced
+    want = {
+        f"T{r_}xM{b}" for r_ in out["rungs"] for b in out["buckets"]
+    }
+    assert set(out["latency_model_ms"]) >= want
+    # per-(rung, bucket) accounting landed for both arms
+    for arm in ("pr14", "async"):
+        assert out["rung_bucket_dispatches"][arm]
+        assert all(
+            n >= 0 for n in out["rung_bucket_dispatches"][arm].values()
+        )
+    # the admission bound held and was exercised
+    adm = out["admission"]
+    assert adm["max_depth_seen"] <= adm["bound_ticks"]
+    assert adm["sheds_total"] > 0
+    assert out["scans"] > 0 and out["value"] > 0
+    # the decision key rides with its clamp flag
+    ab = out["async_serving_ab"]
+    assert "p99_speedup" in ab
+    assert isinstance(ab["ratio_clamped"], bool)
+    assert ab["overlap_hits"] > 0 and ab["bucket_switches"] >= 2
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_async_serving_key():
+    """The staging_double_buffer recommendation flips from config-20
+    evidence alone: an unclamped TPU record with p99_speedup above the
+    noise margin recommends the double-buffered staging path (with its
+    measured bucket ladder); CPU records and clamped ratios never
+    flip, and the floor-asymmetric strength merge keeps an
+    above-parity noise record from displacing committed degradation
+    evidence (the elastic_serving_ab discipline)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, speedup, clamped=False):
+        return {
+            "device": dev,
+            "async_serving_ab": {
+                "p99_speedup": speedup,
+                "buckets": [4, 16],
+                "rungs": [1, 2, 4, 8],
+                "overlap_hits": 40,
+                "bucket_switches": 4,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    got = db.analyze([rec("tpu", 1.2)])
+    r = got["recommendations"]["staging_double_buffer.tpu"]
+    assert r["flip"] is True
+    assert r["recommended"] == "double-buffered, bucket_rungs=4,16"
+    assert r["measured"] == 1.2
+    # CPU record: reported, never flips (a linkless rig has no H2D
+    # latency to hide — its ratio prices bookkeeping)
+    got = db.analyze([rec("cpu", 1.5)])
+    assert "staging_double_buffer.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 1.5, clamped=True)])
+    assert "staging_double_buffer.tpu" not in got["recommendations"]
+    assert got["evidence"]["async_serving_ab"]
+    # below the margin: keep the synchronous PR14 staging
+    got = db.analyze([rec("tpu", 1.01)])
+    r = got["recommendations"]["staging_double_buffer.tpu"]
+    assert r["flip"] is False
+    assert "synchronous" in r["recommended"]
+    # floor-asymmetric strength merge: a committed degradation record
+    # outweighs a later above-parity noise record
+    got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
+    assert (
+        got["recommendations"]["staging_double_buffer.tpu"]["flip"]
+        is False
+    )
 
 
 def test_decide_backends_deskew_key():

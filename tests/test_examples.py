@@ -29,4 +29,18 @@ def test_example_runs(script, extra):
         timeout=360,
         cwd=_ROOT,
     )
+    if out.returncode != 0 and (
+        "Multiprocess computations aren't implemented on the CPU backend"
+        in out.stdout + out.stderr
+    ):
+        # capability probe, same contract as test_multiprocess: this
+        # jaxlib's CPU backend has no cross-process collective runtime,
+        # so the multihost example CANNOT run here — only this exact
+        # signature downgrades to a skip; any other failure stays loud
+        pytest.skip(
+            "CPU backend lacks multiprocess collectives "
+            "(\"Multiprocess computations aren't implemented on the "
+            "CPU backend\") — the multihost example needs a device "
+            "runtime with cross-process support"
+        )
     assert out.returncode == 0, (out.stdout, out.stderr)

@@ -219,6 +219,73 @@ class TestAdaptiveRungSteadyState:
         assert sum(eng.rung_dispatches.values()) == eng.dispatch_count
 
 
+class TestBucketLadderSteadyState:
+    def test_bucket_switches_and_offpath_snapshots_stay_steady(self):
+        """PR 16's structural preconditions (driver/ingest.py): every
+        (rung, bucket) pair is warmed at precompile, so mid-run bucket
+        switches — the occupancy-collapse DROP and the recovery
+        STEP-UP — are compile-cache hits; and a snapshot pull on the
+        idle half of the double buffer (submit_backlog's overlap_work
+        hook) adds no recompiles or implicit transfers.  The drains
+        are double-buffered multi-group dispatches, so both halves of
+        the ping/pong staging pair are exercised (the overlap counter
+        proves staging ran while compute was in flight)."""
+        s = 2
+        eng = FleetFusedIngest(
+            _params(), s, beams=BEAMS, buckets=(4, 8), max_revs=6,
+            rungs=(1, 2),
+        )
+        assert eng.double_buffer
+        eng.precompile([DENSE] * s)
+        streams = [
+            (DENSE, _make_stream(DENSE, 96, np.random.default_rng(40 + i),
+                                 syncs=(0, 17, 34, 51, 68, 85)))
+            for i in range(s)
+        ]
+        ticks = _mk_ticks(streams, np.random.default_rng(9), idle_prob=0.0)
+        cut = max(4, len(ticks) // 3)
+        eng.submit_backlog(ticks[:cut], rung=2)  # live-path warmup
+        eng.snapshot_stream(0)  # warm the row-gather programs
+        before = dict(eng.rung_bucket_dispatches)
+        hits_before = eng.staging_overlap_hits
+        snaps: list = []
+        total = 0
+        with guards.steady_state(tag="bucket switches + off-path snaps"):
+            pos = cut
+            # collapse to the small bucket, recover to the big one,
+            # collapse again — every drain pulls a snapshot on the
+            # idle half of the buffer
+            for bucket, rung in ((4, 1), (4, 2), (8, 1), (8, 2), (4, 1)):
+                if pos + 2 > len(ticks):
+                    break
+                eng.set_active_bucket(bucket)
+                step = max(2 * rung, 2)
+                outs = eng.submit_backlog(
+                    ticks[pos : pos + step], rung=rung,
+                    overlap_work=lambda: snaps.append(
+                        eng.snapshot_stream(0)
+                    ),
+                )
+                pos += step
+                total += sum(len(o) for o in outs)
+        assert eng.bucket_switches >= 2  # down AND back up applied
+        assert eng.staging_overlap_hits > hits_before
+        assert len(snaps) >= 3 and all(s_ is not None for s_ in snaps)
+        assert total >= 1
+        # the collapsed cap dispatched at the small bucket (the big cap
+        # may legitimately also land there — _bucket() picks the
+        # smallest covering bucket per slice), and the per-(rung,bucket)
+        # accounting identity holds
+        moved = {
+            b for (r, b), n in eng.rung_bucket_dispatches.items()
+            if n > before.get((r, b), 0)
+        }
+        assert 4 in moved
+        assert (
+            sum(eng.rung_bucket_dispatches.values()) == eng.dispatch_count
+        )
+
+
 class TestFleetMapperSteadyState:
     @pytest.mark.parametrize("match_backend", ["xla", "pallas"])
     def test_zero_recompiles_zero_implicit_transfers(self, match_backend):

@@ -30,7 +30,9 @@ import pytest
 
 from rplidar_ros2_driver_tpu.driver.ingest import FleetFusedIngest
 from rplidar_ros2_driver_tpu.parallel.scheduler import (
+    BucketLadder,
     ByteRateEwma,
+    LatencyModel,
     RungLadder,
     SchedulerConfig,
     TrafficShaper,
@@ -61,6 +63,7 @@ class TestSchedulerConfig:
             sched_rungs=(1, 3, 9), sched_hysteresis_ticks=5,
             sched_deadline_ms=7.5, sched_byte_rate_alpha=0.5,
             admission_max_backlog_ticks=11,
+            bucket_rungs=(4, 16), occupancy_alpha=0.4,
         )
         cfg = SchedulerConfig.from_params(p)
         assert cfg.rungs == (1, 3, 9)
@@ -68,6 +71,8 @@ class TestSchedulerConfig:
         assert cfg.deadline_ms == 7.5
         assert cfg.byte_rate_alpha == 0.5
         assert cfg.max_backlog_ticks == 11
+        assert cfg.bucket_rungs == (4, 16)
+        assert cfg.occupancy_alpha == 0.4
 
     @pytest.mark.parametrize("bad", [
         dict(rungs=()),
@@ -79,6 +84,10 @@ class TestSchedulerConfig:
         dict(byte_rate_alpha=1.5),
         dict(max_backlog_ticks=0),   # the backlog is bounded by contract
         dict(rungs=(1, 128)),        # compile-cost cap (one program/bucket)
+        dict(bucket_rungs=(0, 4)),   # buckets must be >= 1
+        dict(bucket_rungs=(8, 4)),   # buckets must ascend
+        dict(occupancy_alpha=0.0),
+        dict(occupancy_alpha=1.5),
     ])
     def test_rejects_invalid(self, bad):
         with pytest.raises(ValueError):
@@ -140,6 +149,128 @@ class TestRungLadder:
         lad.note_drain(1, 10.0)  # 10 s/tick: nothing fits the budget
         assert lad.pick(4) == 1
 
+    def test_model_cost_outranks_the_scalar_extrapolation(self):
+        """The measured (rung, bucket) entry prices the deadline cap;
+        the scalar EWMA — which extrapolates linearly and so mis-prices
+        the super-step's amortization — only prices rungs the table
+        has never seen."""
+        model = LatencyModel()
+        lad = RungLadder(SchedulerConfig(
+            rungs=(1, 2, 4, 8), hysteresis_ticks=1, deadline_ms=10.0,
+        ), model=model)
+        # scalar says 3 ms/tick -> rung 8 extrapolates to 24 ms (over
+        # budget), but the MEASURED rung-8 dispatch amortizes to 8 ms
+        lad.note_drain(4, 0.012)
+        model.note(8, 4, 0.008)
+        assert lad.pick(8, bucket=4) == 8
+        # a different bucket has no entry: the scalar fallback caps
+        assert lad.pick(8, bucket=16) == 2
+
+    def test_note_drain_refits_the_model_per_dispatch(self):
+        model = LatencyModel()
+        lad = RungLadder(SchedulerConfig(rungs=(1, 2, 4)), model=model)
+        # 7 ticks at rung 4 = ceil(7/4) = 2 dispatches of 6 ms each
+        lad.note_drain(7, 0.012, rung=4, bucket=8)
+        assert model.cost(4, 8) == pytest.approx(0.006)
+        # no bucket identity: the table is untouched, the scalar still
+        # updates (the model-less fallback predictor)
+        lad.note_drain(2, 0.004, rung=2)
+        assert model.cost(2, None) is None
+        assert lad.tick_cost_ema is not None
+
+
+class TestLatencyModel:
+    def test_seed_prices_before_traffic_and_live_replaces(self):
+        m = LatencyModel()
+        m.seed(4, 8, 0.010)
+        assert m.cost(4, 8) == pytest.approx(0.010)
+        m.seed(4, 8, 0.999)        # re-seeding an existing key: no-op
+        assert m.cost(4, 8) == pytest.approx(0.010)
+        m.note(4, 8, 0.002)        # first live measurement REPLACES
+        assert m.cost(4, 8) == pytest.approx(0.002)
+        m.note(4, 8, 0.004)        # then the EWMA folds (ALPHA=0.2)
+        assert m.cost(4, 8) == pytest.approx(0.8 * 0.002 + 0.2 * 0.004)
+
+    def test_seed_many_and_invalid_seeds_ignored(self):
+        m = LatencyModel()
+        m.seed_many({(1, 4): 0.001, (2, 4): 0.0015})
+        assert m.cost(1, 4) == pytest.approx(0.001)
+        m.seed(1, 8, 0.0)          # non-positive: ignored
+        assert m.cost(1, 8) is None
+
+    def test_no_bucket_returns_the_worst_cost_at_the_rung(self):
+        """With no bucket identity the deadline must use a SAFE bound:
+        the most expensive fitted executable at that rung."""
+        m = LatencyModel()
+        m.note(4, 4, 0.002)
+        m.note(4, 16, 0.005)
+        assert m.cost(4, None) == pytest.approx(0.005)
+        assert m.cost(2, None) is None
+
+    def test_table_ms_rendering_keys(self):
+        m = LatencyModel()
+        m.note(2, 16, 0.0015)
+        m.note(1, 4, 0.0005)
+        assert m.table_ms() == {"T1xM4": 0.5, "T2xM16": 1.5}
+
+
+class TestBucketLadder:
+    def test_starts_at_the_top_bucket(self):
+        lad = BucketLadder((4, 8, 16), hysteresis_ticks=2, alpha=1.0)
+        assert lad.bucket == 16
+        assert lad.pick() == 16    # no occupancy observed yet: hold
+
+    def test_collapse_immediate_recovery_hysteretic(self):
+        # alpha=1.0: the EWMA is the raw observation, so the threshold
+        # arithmetic is exact
+        lad = BucketLadder((4, 8), hysteresis_ticks=2, alpha=1.0)
+        lad.note_occupancy(0, 4)       # fleet collapsed
+        assert lad.pick() == 4         # DOWN is immediate
+        assert lad.switches == 1
+        lad.note_occupancy(4, 4)       # recovered
+        assert lad.pick() == 4         # high streak 1 of 2: hold
+        lad.note_occupancy(4, 4)
+        assert lad.pick() == 8         # streak complete: ONE step up
+        assert lad.switches == 2
+
+    def test_recovery_streak_resets_on_a_dip(self):
+        lad = BucketLadder((4, 8), hysteresis_ticks=2, alpha=1.0)
+        lad.note_occupancy(0, 4)
+        lad.pick()
+        lad.note_occupancy(4, 4)
+        lad.pick()                     # streak 1
+        lad.note_occupancy(0, 4)
+        assert lad.pick() == 4         # dip: target == idx, streak reset
+        lad.note_occupancy(4, 4)
+        assert lad.pick() == 4         # streak must rebuild from 1
+        lad.note_occupancy(4, 4)
+        assert lad.pick() == 8
+
+    def test_evenly_spaced_thresholds(self):
+        """Bucket index i needs the EWMA strictly above i/n — a
+        half-quarantined fleet sits at the floor of a 2-bucket
+        ladder."""
+        lad = BucketLadder((4, 8), hysteresis_ticks=1, alpha=1.0)
+        lad.note_occupancy(2, 4)       # exactly 0.5: NOT above 1/2
+        assert lad.pick() == 4
+        lad.note_occupancy(3, 4)       # 0.75 > 0.5
+        assert lad.pick() == 8
+
+    def test_occupancy_ewma_smooths_a_flap(self):
+        # alpha=0.2 from 1.0: one idle drain only drags the EWMA to
+        # 0.8 — a single flapping tick cannot collapse the cap
+        lad = BucketLadder((4, 8), hysteresis_ticks=2, alpha=0.2)
+        lad.note_occupancy(4, 4)
+        lad.pick()
+        lad.note_occupancy(0, 4)
+        assert lad.occupancy_ema == pytest.approx(0.8)
+        assert lad.pick() == 8
+        assert lad.switches == 0
+
+    def test_needs_at_least_one_bucket(self):
+        with pytest.raises(ValueError, match="at least one bucket"):
+            BucketLadder((), hysteresis_ticks=1, alpha=0.5)
+
 
 class TestTrafficShaperAdmission:
     def _tick(self, n=1):
@@ -186,6 +317,77 @@ class TestTrafficShaperAdmission:
         assert st["admission_drops"] == [0, 0]
         assert st["shed_total"] == 0
         assert len(st["byte_rates"]) == 2 and st["byte_rates"][0] > 0
+        # the latency model is always in the payload (empty before any
+        # seed/drain); the bucket-ladder keys only with bucket_rungs
+        assert st["latency_model"] == {}
+        assert "active_buckets" not in st
+        assert "bucket_switches" not in st
+
+
+class TestTrafficShaperBucketLadder:
+    def _tick(self, n=1):
+        return (DENSE, [(b"\xa5" * 84, 1.0 + 0.001 * k) for k in range(n)])
+
+    def _shaper(self, streams=4, **over):
+        cfg = dict(
+            rungs=(1, 2), hysteresis_ticks=2,
+            bucket_rungs=(4, 8), occupancy_alpha=1.0,
+        )
+        cfg.update(over)
+        return TrafficShaper(streams, SchedulerConfig(**cfg))
+
+    def test_disabled_without_bucket_rungs(self):
+        sh = TrafficShaper(2, SchedulerConfig())
+        assert sh.bucket_ladders is None
+        assert sh.bucket_plan(0) is None
+
+    def test_drain_plan_observes_occupancy_and_collapses(self):
+        sh = self._shaper()
+        assert sh.bucket_plan(0) == 8  # starts at the full-size cap
+        # one live lane of four: occupancy 0.25 -> immediate collapse
+        sh.offer_tick([self._tick(1), None, None, None])
+        ticks, _rung = sh.drain_plan(0, [0, 1, 2, 3])
+        assert len(ticks) == 1
+        assert sh.bucket_plan(0) == 4
+        assert sh.bucket_ladders[0].switches == 1
+
+    def test_empty_drain_still_walks_the_bucket_ladder(self):
+        """An all-idle drain observes occupancy 0 — the ladder must see
+        the collapse even when nothing dispatches, exactly like the
+        rung ladder's empty-drain step-down."""
+        sh = self._shaper()
+        _ticks, _rung = sh.drain_plan(0, [0, 1, 2, 3])
+        assert _ticks == []
+        assert sh.bucket_plan(0) == 4
+
+    def test_recovery_is_hysteretic(self):
+        sh = self._shaper()
+        sh.drain_plan(0, [0, 1, 2, 3])          # collapse to 4
+        for pick in (4, 8):                     # 2-drain streak, then up
+            sh.offer_tick([self._tick(1)] * 4)
+            sh.drain_plan(0, [0, 1, 2, 3])
+            assert sh.bucket_plan(0) == pick
+
+    def test_per_shard_ladders_are_independent(self):
+        sh = TrafficShaper(4, SchedulerConfig(
+            rungs=(1, 2), hysteresis_ticks=2,
+            bucket_rungs=(4, 8), occupancy_alpha=1.0,
+        ), shards=2)
+        # shard 0's lanes idle, shard 1's lanes live
+        sh.offer_tick([None, None, self._tick(1), self._tick(1)])
+        sh.drain_plan(0, [0, 1])
+        sh.drain_plan(1, [2, 3])
+        assert sh.bucket_plan(0) == 4
+        assert sh.bucket_plan(1) == 8
+
+    def test_status_carries_the_ladder_and_model(self):
+        sh = self._shaper()
+        sh.model.note(1, 4, 0.002)
+        sh.drain_plan(0, [0, 1, 2, 3])          # collapse
+        st = sh.status()
+        assert st["active_buckets"] == [4]
+        assert st["bucket_switches"] == 1
+        assert st["latency_model"] == {"T1xM4": 2.0}
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +613,56 @@ class TestServiceServingSeam:
             svc.fleet_ingest.rung_dispatches
         )
 
+    def test_warmup_seeds_the_latency_model(self):
+        """Precompile's timed warmup re-runs land in
+        ``FleetFusedIngest.warmup_costs``; the first scheduled drain
+        folds them into the shared LatencyModel (and clears the engine
+        stash), so EVERY warmed (rung, bucket) executable is priced
+        before any live traffic reaches the deadline cap."""
+        svc = ShardedFilterService(
+            _svc_params(), 2, beams=BEAMS, fleet_ingest_buckets=(4, 8)
+        )
+        svc.attach_scheduler()
+        svc.fleet_ingest.precompile([DENSE] * 2)
+        warmed = set(svc.fleet_ingest.warmup_costs)
+        assert warmed == {
+            (r, b) for r in (1, 2, 4) for b in (4, 8)
+        }
+        svc.drain_scheduled()   # even an empty drain consumes the seeds
+        assert svc.fleet_ingest.warmup_costs == {}
+        assert set(svc.scheduler.model.table_ms()) == {
+            f"T{r}xM{b}" for r in (1, 2, 4) for b in (4, 8)
+        }
+
+    def test_scheduler_status_carries_the_staging_counters(self):
+        svc = ShardedFilterService(
+            _svc_params(), 2, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        svc.attach_scheduler()
+        st = svc.scheduler_status()
+        assert st["rung_bucket_dispatches"] == {}
+        assert st["staging_overlap_hits"] == 0
+        assert st["latency_model"] == {}
+
+    def test_quarantine_checkpoint_deferral_gate(self):
+        """With ``_defer_checkpoints`` armed (a double-buffered drain
+        in flight), a quarantine hook queues the stream instead of
+        pulling the checkpoint inline; disarmed, the same call freezes
+        state immediately — the overlap hook replays the queue through
+        this exact path."""
+        svc = ShardedFilterService(
+            _svc_params(), 2, beams=BEAMS, fleet_ingest_buckets=(4,)
+        )
+        svc._ensure_byte_ingest()
+        svc.fleet_ingest.precompile([DENSE] * 2)
+        svc._defer_checkpoints = []
+        svc._quarantine_stream(0)
+        assert svc._defer_checkpoints == [0]
+        assert not svc.stream_checkpoints and svc.quarantines == 0
+        svc._defer_checkpoints = None
+        svc._quarantine_stream(0)
+        assert 0 in svc.stream_checkpoints and svc.quarantines == 1
+
     def test_host_backend_refuses_scheduler_and_rung(self):
         svc = ShardedFilterService(
             _params(fleet_ingest_backend="host"), 2, beams=BEAMS
@@ -419,6 +671,10 @@ class TestServiceServingSeam:
             svc.attach_scheduler()
         with pytest.raises(ValueError, match="rung"):
             svc.submit_bytes_backlog([[None, None]], rung=2)
+        with pytest.raises(ValueError, match="fused"):
+            svc.submit_bytes_backlog(
+                [[None, None]], overlap_work=lambda: None
+            )
 
     def test_offer_requires_attach(self):
         svc = ShardedFilterService(
@@ -463,6 +719,45 @@ class TestSchedulerDiagnostics:
         assert status.values["Admission Shed Total"] == "2"
         assert status.values["Rung Dispatches"] == "T1:7 T4:2"
         assert status.values["Placement Weights"] == "2.00,1.00,1.25"
+        # the link-latency-hiding keys are absent from a pre-PR-16
+        # payload, so their value rows must be too
+        for key in ("Latency Model ms", "Active Bucket",
+                    "Bucket Switches", "Staging Overlap Hits"):
+            assert key not in status.values
+
+    def test_rendering_pinned_latency_model_group(self):
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        payload = {
+            "rungs": [2],
+            "backlog": [0, 1],
+            "admission_drops": [0, 0],
+            "shed_total": 0,
+            "byte_rates": [10.0, 0.0],
+            "rung_dispatches": {1: 3},
+            "latency_model": {"T1xM4": 0.5, "T1xM8": 0.9, "T2xM4": 0.8},
+            "active_buckets": [4, 8],
+            "bucket_switches": 3,
+            "staging_overlap_hits": 17,
+        }
+        status = DiagnosticsUpdater("rig", CollectingPublisher()).update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="pod", rpm=0, device_info="",
+            scheduler=payload,
+        )
+        # keys sort lexicographically: T1xM4 < T1xM8 < T2xM4
+        assert status.values["Latency Model ms"] == (
+            "T1xM4:0.5 T1xM8:0.9 T2xM4:0.8"
+        )
+        assert status.values["Active Bucket"] == "4,8"
+        assert status.values["Bucket Switches"] == "3"
+        assert status.values["Staging Overlap Hits"] == "17"
 
     def test_live_payload_feeds_the_renderer(self):
         from rplidar_ros2_driver_tpu.node.diagnostics import (
