@@ -204,6 +204,7 @@ GRADED = {
     18: ("fused_mapping", POINTS, dict(window=WINDOW)),  # one-dispatch stack A/B
     19: ("elastic_serving", POINTS, dict(window=WINDOW)),  # traffic-shaped serving A/B
     20: ("async_serving", POINTS, dict(window=WINDOW)),  # link-latency-hiding A/B
+    21: ("pod_scaleout", POINTS, dict(window=WINDOW)),  # steal+autoscale pod A/B
 }
 
 
@@ -4310,6 +4311,431 @@ def bench_async_serving(smoke: bool = False) -> dict:
     }
 
 
+def bench_pod_scaleout(smoke: bool = False) -> dict:
+    """Config 21 — the pod-of-pods A/B (ROADMAP item 2's remaining
+    depth): two identical multi-shard pods serve the SAME skewed
+    arrival trace tick-paired; the POD arm runs cross-shard work
+    stealing (``steal_threshold_ticks``) plus the byte-rate
+    ``PodAutoscaler``, while the STATIC arm keeps the PR 16 pod
+    (both policies off).  Both arms share the rung ladder, admission
+    bound and placement — the A/B prices WHERE backlog drains and
+    whether idle shards stay powered, never what is computed.
+
+    The trace has three phases: a SKEW phase where two streams
+    co-hosted on one shard burst ``burst`` data ticks per wall tick
+    while every sibling trickles one (the deep-shard/idle-sibling
+    imbalance stealing exists for), an IDLE stretch (the whole fleet
+    goes quiet, so the autoscaler's occupancy EWMA sinks below the
+    low watermark and parks a shard), and a full-fleet RESUME (the
+    pressure rises back through the high watermark and the parked
+    shard is re-admitted via ``rebalance_into``).
+
+    The claims, asserted rather than inferred (a violation raises):
+
+      * stealing moved backlog: the pod arm planned > 0 steals, every
+        one moved a WHOLE queue off the deep shard onto a sibling
+        (``steal_log`` sources pin the donor), at least one carried a
+        full burst, and none were dropped at staging;
+      * the steal accounting identity: ``steal_ticks`` equals the sum
+        of per-steal queued-tick counts in ``steal_log``;
+      * a FULL autoscale cycle ran: >= 1 scale-down and >= 1
+        scale-up in ``scale_events``, and no shard is still parked
+        after the resume phase;
+      * the static arm stayed inert: zero steals, zero scale events;
+      * bounded backlog + shed parity with the shadow admission
+        simulation (identical across arms — admission is upstream of
+        steal and scale policy);
+      * byte-equal trajectories: the arms' per-stream outputs are
+        byte-identical across the WHOLE run — steals, the park and
+        the re-admission included — and byte-identical to N
+        independent host decoder+assembler+chain golden paths over
+        the admitted tick sequences (every stream publishes through
+        the end);
+      * zero recompiles / zero implicit transfers across steals AND
+        the full scale cycle under utils/guards.steady_state (a steal
+        is a row snapshot/restore onto an already-warmed lane; a park
+        is the evacuate path plus an engine release; an unpark re-
+        enters programs the survivors kept warm);
+      * p99 pod drain latency: per wall tick the pod's cost is its
+        SLOWEST shard drain (shards drain concurrently on a real
+        pod; this CPU rig serializes them, so the max is the honest
+        stand-in), and the pod arm's paired p99 must not regress
+        past the floor.
+
+    The artifact carries the clamped ``pod_scaleout_ab`` decision key
+    (scripts/decide_backends.py: TPU records only — stealing converts
+    a sibling's idle lanes into wall-clock only where shards really
+    drain in parallel).  ``smoke`` shrinks geometry to a seconds-scale
+    CPU run — the tier-1 gate (tests/test_bench_meta.py), same code
+    path, same metric name, ``"smoke": true``."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.driver.assembly import ScanAssembler
+    from rplidar_ros2_driver_tpu.driver.decode import BatchScanDecoder
+    from rplidar_ros2_driver_tpu.filters.chain import ScanFilterChain
+    from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, grid = 4, 256, 32
+        points_per_rev, capacity = 800, 1024
+        streams, shards, hosts, run = 6, 3, 1, 8
+        rungs, cap = (1, 2, 4), 8
+        burst, skew_len, idle_len, resume_len = 4, 6, 8, 14
+    else:
+        window, beams, grid = WINDOW, BEAMS, GRID
+        points_per_rev, capacity = POINTS, CAPACITY
+        streams, shards, hosts, run = 8, 4, 2, 16
+        rungs, cap = (1, 2, 4), 10
+        burst, skew_len, idle_len, resume_len = 4, 10, 12, 18
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    # every stream gets a deep-stream-sized source; the cursors below
+    # consume only what each phase delivers
+    need = skew_len * burst + resume_len + 2
+    revs = -(-(need * run * 40) // points_per_rev) + 2
+    data = [
+        _stream_data_ticks(
+            _denseboost_wire_frames(revs, points_per_rev),
+            run, ans, 1000.0 + 7.0 * s,
+        )
+        for s in range(streams)
+    ]
+    if any(len(d) < need for d in data):
+        raise RuntimeError("scene too short for the three-phase trace")
+
+    def build(pod_arm: bool):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=grid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused",
+            sched_rungs=rungs, admission_max_backlog_ticks=cap,
+            shard_count=shards, pod_hosts=hosts,
+            failover_snapshot_ticks=4,
+            steal_threshold_ticks=2 if pod_arm else 0,
+            autoscale_enable=pod_arm,
+            autoscale_low_watermark=0.3,
+            autoscale_high_watermark=0.75,
+            autoscale_hysteresis_ticks=3,
+            # the idle stretch is TRAFFIC, not device death: a parked
+            # or quiet shard sees idle_len consecutive empty drains,
+            # which the FSM would read as starvation at deployment
+            # defaults — no loss is scheduled in this config
+            shard_starvation_ticks=4 * (skew_len + idle_len + resume_len),
+        )
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=beams,
+            capacity=capacity, fleet_ingest_buckets=(run,),
+        )
+        pod.attach_scheduler()
+        pod.precompile([ans])
+        return pod
+
+    pods = {"static": build(False), "pod": build(True)}
+    # the deep shard's tenants: both arms place identically, so the
+    # skew lands on the same co-hosted pair in each
+    deep = [s for s in pods["pod"].topology.lane_streams(0)
+            if s is not None][:2]
+    if len(deep) < 2:
+        raise RuntimeError("shard 0 hosts fewer than two streams")
+    cursor = [0] * streams
+
+    def take(s: int, n: int):
+        got = data[s][cursor[s]:cursor[s] + n]
+        cursor[s] += len(got)
+        return list(got) or None
+
+    wall: list = []
+    for _ in range(skew_len):
+        wall.append(
+            [take(s, burst if s in deep else 1) for s in range(streams)]
+        )
+    for _ in range(idle_len):
+        wall.append([None] * streams)
+    for _ in range(resume_len):
+        wall.append([take(s, 1) for s in range(streams)])
+    warm = 2
+
+    outs = {name: [[] for _ in range(streams)] for name in pods}
+    admitted: list = [[] for _ in range(streams)]
+    shadow: list = [[] for _ in range(streams)]
+    shadow_drops = [0] * streams
+    max_depth_seen = 0
+    times: dict = {"static": [], "pod": []}
+
+    def advance(name, items):
+        nonlocal max_depth_seen
+        pod = pods[name]
+        pod.offer_bytes(items)
+        max_depth_seen = max(
+            max_depth_seen,
+            max(len(q) for q in pod.scheduler.queues),
+        )
+        mark = len(pod.drain_log)
+        got = pod.drain_scheduled()
+        for i, g in enumerate(got):
+            outs[name][i].extend(g)
+        # per-wall-tick POD latency: shards drain concurrently on a
+        # real pod, so the tick costs its SLOWEST shard drain — the
+        # drain_log rows this tick appended, reduced by max
+        return max((e[4] for e in pod.drain_log[mark:]), default=0.0)
+
+    def shadow_admit(items):
+        for s, item in enumerate(items):
+            if not item:
+                continue
+            for tick in item:
+                shadow[s].append(tick)
+                if len(shadow[s]) > cap:
+                    shadow[s].pop(0)
+                    shadow_drops[s] += 1
+
+    def run_tick(t, items, timed):
+        order = (
+            ("static", "pod") if t % 2 == 0 else ("pod", "static")
+        )
+        tick_times = {}
+        for name in order:
+            tick_times[name] = advance(name, items)
+        shadow_admit(items)
+        for s in range(streams):
+            admitted[s].extend(shadow[s])
+            shadow[s].clear()
+        # idle ticks drain nothing in EITHER arm — pairing them at
+        # 0.0/0.0 would only dilute the percentiles
+        if timed and max(tick_times.values()) > 0.0:
+            for name in pods:
+                times[name].append(tick_times[name])
+
+    for t, items in enumerate(wall[:warm]):
+        run_tick(t, items, False)
+    n_after_warm = [len(o) for o in outs["pod"]]
+    with guards.steady_state(tag="pod-scaleout A/B pair"):
+        for t, items in enumerate(wall[warm:]):
+            run_tick(warm + t, items, True)
+
+    # -- structural claims: violations are bugs, not weather --
+    pp, ps = pods["pod"], pods["static"]
+    for name, pod in pods.items():
+        for sh in pod.shards:
+            if sh.fleet_ingest is None:
+                continue  # a parked shard released its engine
+            if sh.fleet_ingest.revs_dropped:
+                raise RuntimeError(
+                    f"{name}: revolutions dropped (max_revs overflow) "
+                    "— the golden replay would diverge"
+                )
+    if ps.scheduler.steals or ps.scale_events:
+        raise RuntimeError(
+            "the static arm stole or scaled — its policies should be "
+            "off"
+        )
+    if not pp.scheduler.steals:
+        raise RuntimeError(
+            "the skewed phase never triggered a steal — the trace did "
+            "not exercise the policy"
+        )
+    if pp.scheduler.steal_ticks != sum(
+        e[3] for e in pp.scheduler.steal_log
+    ):
+        raise RuntimeError(
+            f"steal accounting identity broken: steal_ticks "
+            f"{pp.scheduler.steal_ticks} != steal_log sum "
+            f"{sum(e[3] for e in pp.scheduler.steal_log)}"
+        )
+    if pp.steal_drops:
+        raise RuntimeError(
+            f"{pp.steal_drops} planned steals were dropped at staging "
+            "— the plan and the lane state disagreed"
+        )
+    if any(
+        src != 0 or stream not in deep or dst == 0
+        for dst, src, stream, _n in pp.scheduler.steal_log
+    ):
+        raise RuntimeError(
+            "a steal moved a queue that was not the deep shard's — "
+            f"the policy picked the wrong donor: "
+            f"{pp.scheduler.steal_log}"
+        )
+    if max(e[3] for e in pp.scheduler.steal_log) < burst:
+        raise RuntimeError(
+            "no steal carried a whole burst-deep queue — the taker "
+            "never drained the backlog stealing exists for"
+        )
+    downs = [e for e in pp.scale_events if e[1] == "down"]
+    ups = [e for e in pp.scale_events if e[1] == "up"]
+    if not downs or not ups:
+        raise RuntimeError(
+            f"no full autoscale cycle: scale_events={pp.scale_events}"
+        )
+    if pp.pod_status()["parked"]:
+        raise RuntimeError(
+            "a shard is still parked after the resume phase — the "
+            "scale-up never completed"
+        )
+    if max_depth_seen > cap:
+        raise RuntimeError(
+            f"observed backlog depth {max_depth_seen} exceeds the "
+            f"admission bound {cap} — the queue is not bounded"
+        )
+    for name, pod in pods.items():
+        if list(pod.scheduler.admission_drops) != shadow_drops:
+            raise RuntimeError(
+                f"{name}: admission-shed counters "
+                f"{pod.scheduler.admission_drops} != shadow policy "
+                f"{shadow_drops}"
+            )
+    # byte-equal trajectories: arm vs arm, whole run
+    for i in range(streams):
+        a, b = outs["pod"][i], outs["static"][i]
+        if len(a) != len(b) or not all(
+            np.array_equal(np.asarray(x.ranges), np.asarray(y.ranges))
+            and np.array_equal(np.asarray(x.voxel), np.asarray(y.voxel))
+            for x, y in zip(a, b)
+        ):
+            raise RuntimeError(
+                f"stream {i}: outputs diverged between the pod and "
+                "static arms — steal/scale policy changed WHAT, not "
+                "where"
+            )
+    # host golden over the full run (no loss in this config)
+    for i in range(streams):
+        completed: list = []
+        asm = ScanAssembler(
+            max_nodes=capacity,
+            on_complete=lambda sc, c=completed: c.append(dict(sc)),
+        )
+        dec = BatchScanDecoder(asm)
+        for ans_t, frames in admitted[i]:
+            dec.on_measurement_batch(int(ans_t), list(frames))
+        chain = ScanFilterChain(
+            pods["pod"].params, beams=beams, warmup=False
+        )
+        golden = [
+            chain.process_raw(
+                sc["angle_q14"], sc["dist_q2"], sc["quality"], sc["flag"]
+            )
+            for sc in completed
+        ]
+        got = outs["pod"][i]
+        if len(golden) != len(got) or not all(
+            np.array_equal(np.asarray(g.ranges), np.asarray(o.ranges))
+            and np.array_equal(np.asarray(g.voxel), np.asarray(o.voxel))
+            for g, o in zip(golden, got)
+        ):
+            raise RuntimeError(
+                f"stream {i}: outputs diverged from the host golden "
+                "replay of the admitted tick sequence"
+            )
+
+    # -- the latency claim --
+    p99_static = float(np.percentile(times["static"], 99))
+    p99_pod = float(np.percentile(times["pod"], 99))
+    p99_speedup = p99_static / max(p99_pod, 1e-9)
+    clamped = min(
+        float(np.percentile(times["static"], 50)),
+        float(np.percentile(times["pod"], 50)),
+    ) < 50e-6
+    # a whole queue drains wherever it lands, so the per-tick MAX is
+    # steal-NEUTRAL by construction, and on the smoke's ~18 paired
+    # samples the p99 IS the max — single-tick CPU jitter swings it
+    # ±30% run to run.  The smoke floor is therefore a CATASTROPHE
+    # floor, not a win bar: a recompile or a host copy landing inside
+    # the dispatch window is an order-of-magnitude regression, never
+    # a jitter.  The WIN bar applies to full on-chip runs, where a
+    # parked shard's released engine and the taker's deadline
+    # headroom are real wall-clock the static pod spends.
+    bar = 0.5 if smoke else 1.05
+    if not clamped and p99_speedup < bar:
+        raise RuntimeError(
+            f"pod arm p99 {p99_pod * 1e3:.3f} ms regressed past the "
+            f"static baseline {p99_static * 1e3:.3f} ms (ratio "
+            f"{p99_speedup:.3f} < {bar})"
+        )
+    scans = sum(len(o) for o in outs["pod"]) - sum(n_after_warm)
+    dt = float(np.sum(times["pod"]))
+    value = scans / max(dt, 1e-9)
+    return {
+        "metric": metric_name(21),
+        "value": round(value, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "shards": shards,
+        "hosts": hosts,
+        "rungs": list(rungs),
+        "wall_ticks": len(wall),
+        "timed_ticks": len(times["pod"]),
+        "scans": scans,
+        "p99_static_ms": round(p99_static * 1e3, 3),
+        "p99_pod_ms": round(p99_pod * 1e3, 3),
+        "p50_static_ms": round(
+            float(np.percentile(times["static"], 50)) * 1e3, 3
+        ),
+        "p50_pod_ms": round(
+            float(np.percentile(times["pod"], 50)) * 1e3, 3
+        ),
+        "steals": pp.scheduler.steals,
+        "steal_ticks": pp.scheduler.steal_ticks,
+        "steal_log": [list(e) for e in pp.scheduler.steal_log],
+        "steal_drops": pp.steal_drops,
+        "scale_events": [list(e) for e in pp.scale_events],
+        "admission": {
+            "bound_ticks": cap,
+            "max_depth_seen": max_depth_seen,
+            "sheds_per_stream": shadow_drops,
+            "sheds_total": sum(shadow_drops),
+        },
+        "structural": {
+            "steals_moved_whole_deep_queues": True,  # asserted above
+            "steal_accounting_identity": True,       # asserted above
+            "no_steal_drops": True,                  # asserted above
+            "static_arm_inert": True,                # asserted above
+            "full_scale_cycle": True,                # asserted above
+            "all_shards_unparked_at_end": True,      # asserted above
+            "bounded_backlog": True,                 # asserted above
+            "shed_policy_matches_shadow": True,      # asserted above
+            "byte_equal_arms": True,                 # asserted above
+            "byte_equal_host_golden": True,          # asserted above
+            "zero_recompiles": True,            # steady_state guard
+            "zero_implicit_transfers": True,    # steady_state guard
+        },
+        # the decide_backends decision key for the steal/scale
+        # default: TPU records only, the clamp honored — the moves
+        # are structural everywhere, but only a rig whose shards
+        # drain in parallel can price the idle lanes they reclaim
+        "pod_scaleout_ab": {
+            "p99_speedup": round(p99_speedup, 4),
+            "steals": pp.scheduler.steals,
+            "steal_ticks": pp.scheduler.steal_ticks,
+            "scale_downs": len(downs),
+            "scale_ups": len(ups),
+            "hosts": hosts,
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the moves are structural: every steal is a whole queued "
+            "backlog draining on a sibling's already-warmed lane in "
+            "the same wall tick, and the scale cycle parks and re-"
+            "admits a shard with zero recompiles — asserted by steal "
+            "accounting and byte-equal trajectories, not inferred "
+            "from wall time.  On this one-process CPU rig the shard "
+            "drains SERIALIZE, so the per-tick max-over-shards is a "
+            "stand-in and a steal merely relocates the deep drain; "
+            "on a pod whose shards drain concurrently the donor's "
+            "and taker's dispatches overlap, and a parked shard's "
+            "engine is real memory and scheduling slack returned to "
+            "the fleet.  The on-chip capture queued in scripts/"
+            "rig_recapture.sh is where the latency claim lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "grid": grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 class _DriftingFrontEnd:
     """Scripted SLAM front-end for the config-17 back-end A/B: maps are
     rasterized at CALLER-SUPPLIED (drift-injected) poses with no
@@ -4689,6 +5115,7 @@ def metric_name(config: int) -> str:
         18: "fused_mapping_stack_updates_per_sec",
         19: "elastic_serving_adaptive_scans_per_sec",
         20: "async_serving_overlapped_scans_per_sec",
+        21: "pod_scaleout_balanced_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -4722,6 +5149,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_elastic_serving()
     if kind == "async_serving":
         return bench_async_serving()
+    if kind == "pod_scaleout":
+        return bench_pod_scaleout()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -5161,6 +5590,18 @@ if __name__ == "__main__":
         "switches — the tier-1 regression gate for async staging",
     )
     ap.add_argument(
+        "--smoke-pod-scaleout",
+        action="store_true",
+        help="seconds-scale CPU run of the config-21 pod-of-pods A/B "
+        "(small geometry, forced CPU backend, no tunnel probe): "
+        "asserts cross-shard stealing moved whole deep queues with "
+        "the accounting identity, a full autoscale park/re-admit "
+        "cycle, byte-equal trajectories across arms + the host "
+        "golden and zero recompiles/implicit transfers across steals "
+        "AND the scale cycle — the tier-1 regression gate for the "
+        "pod-of-pods serving plane",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -5278,6 +5719,15 @@ if __name__ == "__main__":
         # byte equality) must run anywhere, device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_async_serving(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_pod_scaleout:
+        # same CPU-only discipline: the steal/scale structural gate
+        # (whole-queue moves, the accounting identity, the full park/
+        # re-admit cycle, byte equality) must run anywhere, device
+        # link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_pod_scaleout(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -399,6 +399,51 @@ class DriverParams:
     # retuning placement responsiveness must not silently retune the
     # bucket choice, or vice versa)
     occupancy_alpha: float = 0.2
+    # -- pod of pods (PR 17): two-level placement, stealing, autoscale --
+    # number of HOSTS the pod's shards split across (two-level
+    # (host, shard, lane) coordinates): shards partition into
+    # contiguous equal blocks, one host-local StagingPool per block,
+    # and placement/evacuation/rebalance prefer same-host moves.
+    # Must divide shard_count; 1 = the single-level pod (byte-
+    # identical placement to pre-PR-17).
+    pod_hosts: int = 1
+    # cross-shard work stealing: when a shard's queued backlog depth
+    # exceeds this many ticks and a sibling has idle lanes plus
+    # deadline headroom, the sibling drains whole per-stream QUEUES
+    # borrowed for that drain only (row snapshot -> restore onto the
+    # taker's idle lane, decode carries intact, copied home after —
+    # placement never moves).  Byte-equal to the no-steal schedule by
+    # construction: admission and tick order are untouched, the policy
+    # picks WHERE, never what.  0 disables stealing.
+    steal_threshold_ticks: int = 0
+    # reserve (ms) subtracted from sched_deadline_ms when pricing a
+    # prospective taker's post-steal drain with the measured latency
+    # model — the taker must finish the borrowed depth inside
+    # (deadline - headroom).  With sched_deadline_ms=0 this is the
+    # absolute budget; 0 disables the time gate (idle lanes alone
+    # gate the steal).  Must stay below sched_deadline_ms when both
+    # are set.
+    steal_headroom_ms: float = 0.0
+    # byte-rate autoscale seam: sustained fleet-wide thin occupancy
+    # (live streams per active lane below the low watermark for
+    # autoscale_hysteresis_ticks straight) gracefully drains one shard
+    # out of the pod (live row moves, engine released); sustained
+    # pressure above the high watermark re-admits one.  Hysteresis
+    # mirrors the rung/bucket ladders: the watermark gap is the dead
+    # zone a sawtooth cannot thrash across, and every scale event is
+    # recompile-free (surviving shards' (rung, bucket) programs are
+    # already warmed).  Scheduled seam only.
+    autoscale_enable: bool = False
+    autoscale_low_watermark: float = 0.25
+    autoscale_high_watermark: float = 0.75
+    autoscale_hysteresis_ticks: int = 8
+    # the pod never scales below this many active shards
+    autoscale_min_shards: int = 1
+    # byte-rate EWMA floor (bytes/tick) above which a stream counts as
+    # LIVE for occupancy: the EWMA decays toward zero but never
+    # reaches it, so a zero floor would count every stream ever seen
+    # as live forever
+    autoscale_rate_floor: float = 256.0
     # pipelined publish seam: publish revolution N-1's chain output while
     # revolution N computes on the device (one revolution of bounded
     # staleness; the publish never waits on device compute).  Off by
@@ -720,6 +765,51 @@ class DriverParams:
                 )
         if not (0.0 < self.occupancy_alpha <= 1.0):
             raise ValueError("occupancy_alpha must be within (0, 1]")
+        if self.pod_hosts < 1:
+            raise ValueError("pod_hosts must be >= 1")
+        if self.shard_count % self.pod_hosts != 0:
+            raise ValueError(
+                f"pod_hosts must divide shard_count ({self.shard_count} "
+                f"shards cannot split evenly across {self.pod_hosts} "
+                "hosts — the two-level topology uses contiguous equal "
+                "blocks)"
+            )
+        if self.steal_threshold_ticks < 0:
+            raise ValueError(
+                "steal_threshold_ticks must be >= 0 (0 disables "
+                "work stealing)"
+            )
+        if self.steal_headroom_ms < 0:
+            raise ValueError("steal_headroom_ms must be >= 0")
+        if (
+            self.sched_deadline_ms > 0
+            and self.steal_headroom_ms >= self.sched_deadline_ms
+        ):
+            raise ValueError(
+                "steal_headroom_ms must leave part of sched_deadline_ms "
+                "as the taker's drain budget"
+            )
+        if not isinstance(self.autoscale_enable, bool):
+            raise ValueError("autoscale_enable must be a bool")
+        if not (
+            0.0 < self.autoscale_low_watermark
+            < self.autoscale_high_watermark <= 1.0
+        ):
+            raise ValueError(
+                "autoscale watermarks must satisfy 0 < low < high <= 1 "
+                "(the gap between them is the hysteresis dead zone)"
+            )
+        if self.autoscale_hysteresis_ticks < 1:
+            raise ValueError("autoscale_hysteresis_ticks must be >= 1")
+        if self.autoscale_min_shards < 1:
+            raise ValueError("autoscale_min_shards must be >= 1")
+        if self.autoscale_rate_floor <= 0:
+            raise ValueError(
+                "autoscale_rate_floor must be > 0 (the byte-rate EWMA "
+                "decays toward zero but never reaches it, so a zero "
+                "floor would count every stream ever seen as live "
+                "forever)"
+            )
         if not (1 <= self.pose_graph_max_constraints <= 256):
             raise ValueError(
                 "pose_graph_max_constraints must be within [1, 256]"

@@ -69,6 +69,67 @@ _FUSED_BUCKETS = (4, 64)
 INGEST_STREAM_SNAPSHOT_VERSION = 3
 
 
+class StagingPool:
+    """Recycled host-side (frames, aux) staging pairs, keyed by staging
+    key — the free list the PR 16 double buffer drew from, split out of
+    the engines so it can be owned PER HOST rather than per shard.
+
+    The ownership split is the pod-of-pods enabler: staging planes are
+    host-local state (pinned numpy feeding the H2D link of whichever
+    process runs the shard), while everything else an engine carries is
+    device state plus per-lane scalars that travel in the per-stream
+    snapshot.  With the pool outside the engine, re-homing a shard to
+    another process moves only device rows — the destination host's own
+    pool supplies staging — and sibling shards on one host share a
+    single allocation pool instead of each holding private ping/pong
+    pairs per (rung, bucket).
+
+    Reuse safety is the caller's completion-barrier contract, unchanged
+    from the in-engine free lists: a pair is ``give``-n back only after
+    its dispatch's RESULTS were fetched, proving the device consumed
+    the staged inputs, so reuse can never race an in-flight dispatch
+    even on a PJRT client with zero-copy host-buffer semantics.  Pairs
+    dropped unfetched (queue overflow, reset) just release to the GC.
+    Thread-safe: shards on one host stage concurrently.
+    """
+
+    def __init__(self) -> None:
+        self._free: dict = {}
+        self._lock = threading.Lock()
+
+    def take(self, key: tuple, shape_b: tuple, shape_a: tuple) -> tuple:
+        """A zeroed (frames, aux) pair for ``key`` — recycled when a
+        pooled pair matches the requested shapes (shapes go stale when
+        the active format set's payload width moves; stale pairs under
+        the key are simply dropped), freshly allocated otherwise.  The
+        zero fill happens OUTSIDE the lock: it is the dominant cost at
+        big buckets and must not serialize sibling shards' staging."""
+        entry = None
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            while free:
+                cand = free.pop()
+                if cand[0].shape == shape_b and cand[1].shape == shape_a:
+                    entry = cand
+                    break
+        if entry is not None:
+            entry[0].fill(0)
+            entry[1].fill(0)
+            return entry
+        return (np.zeros(shape_b, np.uint8), np.zeros(shape_a, np.float32))
+
+    def give(self, key: tuple, pair: tuple) -> None:
+        """Return a pair whose dispatch results were fetched (the
+        completion barrier) to the free list."""
+        with self._lock:
+            self._free.setdefault(key, []).append(pair)
+
+    def pooled(self) -> int:
+        """Pairs currently pooled (diagnostics)."""
+        with self._lock:
+            return sum(len(v) for v in self._free.values())
+
+
 class FusedIngest:
     """Producer/consumer engine around ops/ingest.fused_ingest_step."""
 
@@ -144,15 +205,13 @@ class FusedIngest:
         # drifts to ~ms f32 ulp after hours of streaming)
         self._base: Optional[float] = None
         # recycled staging pairs per (bucket, frame_bytes): each dispatch
-        # takes a (frames, aux) numpy pair from this free list (zeroed —
-        # the fused program's contract is zero-padding past the live
-        # count) and the pair rides its pending entry until that
-        # dispatch's results are fetched: the fetch is the completion
-        # barrier proving the device consumed the inputs, so reuse can
-        # never race an in-flight dispatch even on a PJRT client with
-        # zero-copy host-buffer semantics (FleetFusedIngest discipline).
-        # Entries dropped unfetched just release their pair to the GC.
-        self._staging_free: dict = {}
+        # takes a (frames, aux) numpy pair from the pool (zeroed — the
+        # fused program's contract is zero-padding past the live count)
+        # and the pair rides its pending entry until that dispatch's
+        # results are fetched (StagingPool's completion-barrier
+        # contract).  Private pool: the single-stream engine has no
+        # host-sharing story.
+        self.staging = StagingPool()
         # pipelined collect seam: dispatched-but-unfetched wires
         self._pending: deque = deque()
         self._max_queue = max_queue
@@ -262,17 +321,10 @@ class FusedIngest:
     def _staging_buffers(self, mb: int, expect: int) -> tuple:
         """A recycled (frames, aux) staging pair, zeroed for reuse;
         freshly allocated on first contact with a (bucket, payload
-        width).  Unlike the fleet engine's free list, the key pins BOTH
+        width).  Unlike the fleet engine's keys, this one pins BOTH
         dimensions, so any pooled pair already has the right shape."""
-        free = self._staging_free.setdefault((mb, expect), [])
-        if free:
-            entry = free.pop()
-            entry[0].fill(0)
-            entry[1].fill(0)
-            return entry
-        return (
-            np.zeros((mb, expect), np.uint8),
-            np.zeros((2 * mb + 2,), np.float32),
+        return self.staging.take(
+            (mb, expect), (mb, expect), (2 * mb + 2,)
         )
 
     # graftlint: hot-loop
@@ -360,7 +412,7 @@ class FusedIngest:
         res = unpack_ingest_result(arrays, icfg)
         # the unpack fetched this dispatch's results, proving its staged
         # inputs consumed: the staging pair is safe to recycle
-        self._staging_free.setdefault(skey, []).append(pair)
+        self.staging.give(skey, pair)
         if res.recon_pushed:
             self.last_recon = (res.recon_plane, res.recon_pts)
             if self.recon_log:
@@ -488,6 +540,7 @@ class FleetFusedIngest:
         slot_impl: str = "fori",
         super_tick_max: Optional[int] = None,
         rungs: Optional[tuple] = None,
+        staging_pool: Optional[StagingPool] = None,
     ) -> None:
         import jax
 
@@ -600,17 +653,19 @@ class FleetFusedIngest:
         self.timing = timingmod.TimingDesc()
         self.recorder = None
         self._lock = threading.Lock()
-        # recycled staging planes per (kind, bucket): each dispatch takes
-        # a (frames, aux) numpy pair from this free list instead of
-        # allocating fresh, and the pair rides its pending entry until
-        # that dispatch's RESULTS have been fetched — the fetch is the
-        # completion barrier proving the device consumed the inputs, so
-        # reuse can never race an in-flight dispatch even on a PJRT
-        # client with zero-copy host-buffer semantics.  Entries dropped
-        # unfetched (queue overflow, reset) just release their pair to
-        # the GC.  Steady state (pipelined depth ~2) holds two pairs per
-        # bucket and allocates nothing per tick.
-        self._staging_free: dict = {}
+        # recycled staging planes per (kind, bucket): each dispatch
+        # takes a (frames, aux) numpy pair from the StagingPool instead
+        # of allocating fresh, and the pair rides its pending entry
+        # until that dispatch's RESULTS have been fetched (the pool's
+        # completion-barrier contract).  Steady state (pipelined depth
+        # ~2) holds two pairs per bucket and allocates nothing per
+        # tick.  The pool is INJECTED by the elastic pod (one per host,
+        # shared across its shards) so this engine carries only device
+        # state and per-lane scalars — the re-homing unit; standalone
+        # engines own a private pool.
+        self.staging = staging_pool if staging_pool is not None else (
+            StagingPool()
+        )
         # double-buffered async H2D staging: within a multi-group drain
         # the NEXT group's staging planes are filled and device_put
         # while the previous group's compute is still in flight — the
@@ -1135,20 +1190,19 @@ class FleetFusedIngest:
         else:
             shape_b = (self.streams, mb, fb)
             shape_a = (self.streams, al)
-        free = self._staging_free.setdefault(skey, [])
-        while free:
-            entry = free.pop()
-            if entry[0].shape == shape_b:
-                entry[0].fill(0)
-                entry[1].fill(0)
-                return entry
-        return (np.zeros(shape_b, np.uint8), np.zeros(shape_a, np.float32))
+        return self.staging.take(skey, shape_b, shape_a)
+
+    @property
+    def _staging_free(self) -> dict:
+        """The pool's raw free-list dict (test/diagnostic seam kept
+        from the in-engine free-list era)."""
+        return self.staging._free
 
     def _recycle_staging(self, skey: tuple, pair) -> None:
-        """Return a fetched entry's staging pair to the free list (its
+        """Return a fetched entry's staging pair to the pool (its
         dispatch's results are host-side, so the inputs are provably
         consumed)."""
-        self._staging_free.setdefault(skey, []).append(pair)
+        self.staging.give(skey, pair)
 
     # graftlint: hot-loop
     def _stage_slice(self, sl, mb: int, buf, aux) -> None:

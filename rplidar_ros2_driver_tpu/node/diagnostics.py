@@ -65,6 +65,7 @@ class DiagnosticsUpdater:
         stream_health: Optional[list] = None,
         shard_topology: Optional[dict] = None,
         scheduler: Optional[dict] = None,
+        pod: Optional[dict] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -222,6 +223,31 @@ class DiagnosticsUpdater:
             hits = scheduler.get("staging_overlap_hits")
             if hits is not None:
                 values["Staging Overlap Hits"] = str(hits)
+        # pod-of-pods group (parallel/service.ElasticFleetService via
+        # service.pod_status()): per-host shard states (PARKED marks a
+        # shard the autoscaler spun down — engine released, membership
+        # intact), the steal counters, the scale counters, and the
+        # autoscaler's hysteresis state — mirroring the scheduler and
+        # shard-topology groups (tests/test_scheduler.py pins the
+        # rendering)
+        if pod:
+            for h in pod.get("per_host", []):
+                states = " ".join(
+                    f"{sh['shard']}:{sh['state']}[{sh['streams']}]"
+                    for sh in h.get("shards", [])
+                )
+                values[f"Pod Host {h.get('host', '?')}"] = states or "n/a"
+            values["Steals"] = str(pod.get("steals", 0))
+            values["Steal Ticks"] = str(pod.get("steal_ticks", 0))
+            values["Scale-Downs"] = str(pod.get("scale_downs", 0))
+            values["Scale-Ups"] = str(pod.get("scale_ups", 0))
+            auto = pod.get("autoscaler")
+            if auto:
+                occ = auto.get("occupancy")
+                occ_s = "n/a" if occ is None else f"{occ:.3f}"
+                values["Autoscaler"] = (
+                    f"{auto.get('state', '?')} (occ {occ_s})"
+                )
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

@@ -47,6 +47,22 @@ constraint):
     placement (parallel/sharding.FleetTopology.set_weight): evacuation
     and re-admission land hot streams on cold shards instead of
     counting streams.
+  * **cross-shard work stealing** — a steal phase ahead of the drain
+    (:meth:`TrafficShaper.plan_steals`): when a shard's backlog depth
+    exceeds ``steal_threshold_ticks`` and a sibling's predicted drain
+    (priced by the pod-shared :class:`LatencyModel`) leaves headroom,
+    the sibling drains whole per-stream QUEUES borrowed from the deep
+    shard for this drain only.  Admission and per-stream tick order
+    are untouched — the policy picks WHERE a queue drains, never what
+    — so the stolen schedule is byte-equal to the no-steal schedule by
+    the same argument as the rung ladder.
+  * **byte-rate autoscale seam** — a :class:`PodAutoscaler` over the
+    same byte-rate EWMAs: sustained thin fleet-wide occupancy spins a
+    shard down (graceful evacuation, engine released), sustained
+    pressure re-admits it, with watermark+streak hysteresis mirroring
+    the rung/bucket ladders so a sawtooth load cannot thrash scale
+    events.  Scale events are recompile-free because every (rung,
+    bucket) program on the surviving shards is already warmed.
 
 The policy chooses *when* work dispatches, never *what* it computes:
 any rung sequence over the same admitted ticks lands byte-identical
@@ -75,6 +91,24 @@ class SchedulerConfig:
     max_backlog_ticks: int = 32
     bucket_rungs: tuple = ()
     occupancy_alpha: float = 0.2
+    # cross-shard work stealing: a shard whose backlog depth exceeds
+    # the threshold donates whole stream queues to a sibling with
+    # predicted headroom for this drain only (0 disables the phase).
+    # ``steal_headroom_ms`` is the reserve subtracted from
+    # ``deadline_ms`` when a deadline is configured, else the absolute
+    # predicted-drain budget a taker must stay within (0 = no time
+    # gate: idleness + lane capacity alone qualify a taker).
+    steal_threshold_ticks: int = 0
+    steal_headroom_ms: float = 0.0
+    # byte-rate autoscale seam (PodAutoscaler): occupancy watermarks
+    # over the live-stream fraction, streak hysteresis, the scale-down
+    # floor, and the EWMA bytes/tick at which a stream counts as live
+    autoscale_enable: bool = False
+    autoscale_low_watermark: float = 0.25
+    autoscale_high_watermark: float = 0.75
+    autoscale_hysteresis_ticks: int = 8
+    autoscale_min_shards: int = 1
+    autoscale_rate_floor: float = 256.0
 
     def __post_init__(self) -> None:
         rungs = tuple(int(r) for r in self.rungs)
@@ -116,6 +150,43 @@ class SchedulerConfig:
                 )
         if not (0.0 < self.occupancy_alpha <= 1.0):
             raise ValueError("occupancy_alpha must be within (0, 1]")
+        if self.steal_threshold_ticks < 0:
+            raise ValueError(
+                "steal_threshold_ticks must be >= 0 (0 disables "
+                "cross-shard work stealing)"
+            )
+        if self.steal_headroom_ms < 0:
+            raise ValueError("steal_headroom_ms must be >= 0")
+        if (
+            self.deadline_ms > 0
+            and self.steal_headroom_ms >= self.deadline_ms
+        ):
+            raise ValueError(
+                "steal_headroom_ms must leave part of sched_deadline_ms "
+                "as the taker's budget (reserve >= deadline means no "
+                "steal can ever qualify — say so instead of silently "
+                "disabling the phase)"
+            )
+        if not (
+            0.0
+            < self.autoscale_low_watermark
+            < self.autoscale_high_watermark
+            <= 1.0
+        ):
+            raise ValueError(
+                "autoscale watermarks must satisfy 0 < low < high <= 1 "
+                "(the gap between them is the hysteresis dead zone)"
+            )
+        if self.autoscale_hysteresis_ticks < 1:
+            raise ValueError("autoscale_hysteresis_ticks must be >= 1")
+        if self.autoscale_min_shards < 1:
+            raise ValueError("autoscale_min_shards must be >= 1")
+        if self.autoscale_rate_floor <= 0:
+            raise ValueError(
+                "autoscale_rate_floor must be > 0 (a zero floor would "
+                "count every never-seen stream as live forever — the "
+                "byte-rate EWMA decays toward zero but never reaches it)"
+            )
 
     @classmethod
     def from_params(cls, params) -> "SchedulerConfig":
@@ -134,6 +205,30 @@ class SchedulerConfig:
             bucket_rungs=tuple(getattr(params, "bucket_rungs", ()) or ()),
             occupancy_alpha=float(
                 getattr(params, "occupancy_alpha", 0.2)
+            ),
+            steal_threshold_ticks=int(
+                getattr(params, "steal_threshold_ticks", 0)
+            ),
+            steal_headroom_ms=float(
+                getattr(params, "steal_headroom_ms", 0.0)
+            ),
+            autoscale_enable=bool(
+                getattr(params, "autoscale_enable", False)
+            ),
+            autoscale_low_watermark=float(
+                getattr(params, "autoscale_low_watermark", 0.25)
+            ),
+            autoscale_high_watermark=float(
+                getattr(params, "autoscale_high_watermark", 0.75)
+            ),
+            autoscale_hysteresis_ticks=int(
+                getattr(params, "autoscale_hysteresis_ticks", 8)
+            ),
+            autoscale_min_shards=int(
+                getattr(params, "autoscale_min_shards", 1)
+            ),
+            autoscale_rate_floor=float(
+                getattr(params, "autoscale_rate_floor", 256.0)
             ),
         )
 
@@ -471,6 +566,13 @@ class TrafficShaper:
             ]
             if cfg.bucket_rungs else None
         )
+        # cross-shard steal accounting: borrowed stream queues, the
+        # queued ticks they carried, and the per-steal log —
+        # ``steal_ticks == sum(n for *_ , n in steal_log)`` is the
+        # accounting identity bench --config 21 asserts
+        self.steals = 0
+        self.steal_ticks = 0
+        self.steal_log: list = []  # (dst_shard, src_shard, stream, n)
 
     # -- admission ---------------------------------------------------------
 
@@ -510,10 +612,123 @@ class TrafficShaper:
     def backlog_depths(self) -> list:
         return [len(q) for q in self.queues]
 
+    # -- steal planning ----------------------------------------------------
+
+    def predict_drain_s(self, shard: int, depth: int) -> Optional[float]:
+        """Model-priced wall seconds for ``shard`` to drain ``depth``
+        queued ticks — the steal planner's headroom predictor.  The
+        rung is the deeper of the ladder's current demand rung and the
+        depth's target (``pick`` steps UP immediately, never below the
+        hysteretic hold), priced per dispatch by the pod-shared latency
+        model (scalar EWMA fallback).  None = unpriced: the planner
+        treats an unpriced shard as having no headroom EVIDENCE, and
+        vetoes the steal rather than gambling the deadline on it.
+        Non-mutating — planning must not disturb ladder hysteresis."""
+        if depth <= 0:
+            return 0.0
+        lad = self.ladders[shard]
+        rung = max(lad.rung, self.cfg.rungs[lad._target_idx(depth)])
+        bucket = (
+            self.bucket_ladders[shard].bucket
+            if self.bucket_ladders is not None else None
+        )
+        per = lad._predicted_cost(rung, bucket)
+        if per is None:
+            return None
+        return -(-depth // rung) * per  # ceil(depth / rung) dispatches
+
+    def plan_steals(self, hosted: dict, free_lanes: dict) -> dict:
+        """The steal phase, run once per wall tick BEFORE any shard's
+        :meth:`drain_plan` (drains pop queues, so the WHERE decision
+        must precede every pop).  ``hosted`` maps each draining shard
+        to its hosted stream ids, ``free_lanes`` to its idle-lane
+        count.  Returns ``{taker_shard: [(stream, donor_shard), ...]}``
+        — the caller moves each stream's row onto a taker lane, passes
+        the ids as ``drain_plan``'s ``extra_streams``, and moves the
+        row back after the drain (placement untouched: a steal is
+        reversible by construction and cheaper than a migration).
+
+        Policy: a DONOR's backlog depth exceeds
+        ``steal_threshold_ticks``; a TAKER sits at or below it with an
+        idle lane (the borrowed stream needs a real lane to stage on);
+        with a time budget configured (``deadline_ms`` minus
+        ``steal_headroom_ms``, or the headroom alone when no deadline)
+        the taker's PREDICTED drain including the borrow must fit it —
+        an unpriced model vetoes.  Deepest donors first, each donating
+        its deepest queues to the shallowest qualifying taker, until
+        the donor's depth sinks to the threshold.  Byte-equality is
+        untouched: admission already happened, and each stolen queue
+        drains front-aligned in its own per-stream order wherever it
+        lands."""
+        thr = self.cfg.steal_threshold_ticks
+        if thr <= 0 or len(hosted) < 2:
+            return {}
+        budget_s = None
+        if self.cfg.deadline_ms > 0:
+            budget_s = (
+                self.cfg.deadline_ms - self.cfg.steal_headroom_ms
+            ) / 1e3
+        elif self.cfg.steal_headroom_ms > 0:
+            budget_s = self.cfg.steal_headroom_ms / 1e3
+        depths = {
+            s: max((len(self.queues[i]) for i in ids), default=0)
+            for s, ids in hosted.items()
+        }
+        cap = {s: int(free_lanes.get(s, 0)) for s in hosted}
+        # per-taker planned borrow depth: drain depth is a MAX over
+        # queues, so a borrow only deepens a taker past its own depth
+        extra = {s: 0 for s in hosted}
+        taken: set = set()
+        plan: dict = {}
+        for src in sorted(hosted, key=lambda s: (-depths[s], s)):
+            if depths[src] <= thr:
+                break  # sorted: nobody after this donor is deep either
+            for i in sorted(
+                hosted[src], key=lambda j: (-len(self.queues[j]), j)
+            ):
+                if depths[src] <= thr:
+                    break  # donor no longer deep
+                n = len(self.queues[i])
+                if n == 0 or i in taken:
+                    continue
+                best = None
+                for dst in sorted(hosted):
+                    if dst == src or depths[dst] > thr or cap[dst] <= 0:
+                        continue
+                    if budget_s is not None:
+                        proj = max(depths[dst], extra[dst], n)
+                        pred = self.predict_drain_s(dst, proj)
+                        if pred is None or pred > budget_s:
+                            continue
+                    key = (max(depths[dst], extra[dst]), dst)
+                    if best is None or key < best[0]:
+                        best = (key, dst)
+                if best is None:
+                    continue  # a shallower queue may still fit a taker
+                dst = best[1]
+                plan.setdefault(dst, []).append((i, src))
+                taken.add(i)
+                cap[dst] -= 1
+                extra[dst] = max(extra[dst], n)
+                self.steals += 1
+                self.steal_ticks += n
+                self.steal_log.append((dst, src, i, n))
+                depths[src] = max(
+                    (
+                        len(self.queues[j])
+                        for j in hosted[src] if j not in taken
+                    ),
+                    default=0,
+                )
+        return plan
+
     # -- drain planning ----------------------------------------------------
 
     def drain_plan(
-        self, shard: int, stream_ids: Sequence[int]
+        self,
+        shard: int,
+        stream_ids: Sequence[int],
+        extra_streams: Sequence[int] = (),
     ) -> tuple:
         """Pop the given streams' whole queued backlog, front-aligned
         into GLOBAL per-tick item lists (non-listed streams idle), and
@@ -523,8 +738,15 @@ class TrafficShaper:
         The shard's live-lane occupancy is observed here (lanes whose
         queues held data vs all hosted lanes) and the bucket ladder
         picked BEFORE the rung, so the deadline cap prices rungs with
-        the bucket the drain will actually dispatch on."""
+        the bucket the drain will actually dispatch on.
+
+        ``extra_streams`` are queues BORROWED for this drain (the
+        :meth:`plan_steals` output): they join the pop set, the depth,
+        and the occupancy count — a borrowed stream stages on a real
+        lane of this shard — while the donor passes the same ids as
+        None in ITS ``stream_ids`` so no queue pops twice."""
         ids = [i for i in stream_ids if i is not None]
+        ids += [i for i in extra_streams if i is not None]
         depth = max((len(self.queues[i]) for i in ids), default=0)
         bucket = None
         if self.bucket_ladders is not None and ids:
@@ -578,6 +800,8 @@ class TrafficShaper:
             "shed_total": self.shed_total,
             "byte_rates": [round(r, 1) for r in self.rates.rates()],
             "latency_model": self.model.table_ms(),
+            "steals": self.steals,
+            "steal_ticks": self.steal_ticks,
         }
         if self.bucket_ladders is not None:
             status["active_buckets"] = [
@@ -587,3 +811,95 @@ class TrafficShaper:
                 bl.switches for bl in self.bucket_ladders
             )
         return status
+
+
+class PodAutoscaler:
+    """The byte-rate autoscale policy: watermark + streak hysteresis
+    over the fleet's live-stream occupancy, deciding when the pod spins
+    a shard down (sustained thin traffic) or re-admits one (sustained
+    pressure).  Pure policy, like the ladders: the service executes the
+    decision (graceful evacuation via the PR 9 relabel machinery for
+    DOWN, ``rebalance_into`` for UP), this class only says when.
+
+    The signal is the scheduler's per-stream byte-rate EWMA: a stream
+    is LIVE while its EWMA sits at or above ``autoscale_rate_floor``
+    bytes/tick (the EWMA decays while a stream is quiet, so liveness
+    expires on its own), and occupancy is live streams over the ACTIVE
+    fleet's lane capacity.  Hysteresis mirrors the rung/bucket ladders
+    twice over: the watermark gap is a dead zone no decision fires in
+    (occupancy between low and high resets both streaks), and either
+    decision needs ``autoscale_hysteresis_ticks`` CONSECUTIVE ticks on
+    its side of the gap — a sawtooth that recrosses the band restarts
+    the count, so it can never thrash scale events the way it would a
+    threshold comparator."""
+
+    def __init__(self, cfg: SchedulerConfig, lanes: int) -> None:
+        if lanes < 1:
+            raise ValueError("need at least one lane per shard")
+        self.cfg = cfg
+        self.lanes = int(lanes)
+        self.occupancy: Optional[float] = None
+        self.scale_downs = 0
+        self.scale_ups = 0
+        self._thin_streak = 0
+        self._pressure_streak = 0
+        self.state = "steady"
+
+    def live_streams(self, rates: Sequence[float]) -> int:
+        """Streams whose byte-rate EWMA clears the liveness floor."""
+        floor = self.cfg.autoscale_rate_floor
+        return sum(1 for r in rates if r >= floor)
+
+    def note_tick(
+        self,
+        rates: Sequence[float],
+        active_shards: int,
+        *,
+        can_down: bool = True,
+        can_up: bool = True,
+    ) -> Optional[str]:
+        """Observe one wall tick; returns ``"down"``, ``"up"``, or
+        None.  ``can_down``/``can_up`` gate what the fleet can execute
+        (capacity invariant, ``autoscale_min_shards``, parked shards
+        available) — a gated side ticks its streak without firing, so
+        the decision lands the moment the gate opens instead of
+        restarting the wait."""
+        live = self.live_streams(rates)
+        cap = max(int(active_shards) * self.lanes, 1)
+        occ = min(live / cap, 1.0)
+        self.occupancy = occ
+        n = self.cfg.autoscale_hysteresis_ticks
+        decision = None
+        if occ < self.cfg.autoscale_low_watermark:
+            self._thin_streak += 1
+            self._pressure_streak = 0
+            self.state = f"thin {min(self._thin_streak, n)}/{n}"
+            if self._thin_streak >= n and can_down:
+                decision = "down"
+                self._thin_streak = 0
+                self.scale_downs += 1
+        elif occ > self.cfg.autoscale_high_watermark:
+            self._pressure_streak += 1
+            self._thin_streak = 0
+            self.state = f"pressure {min(self._pressure_streak, n)}/{n}"
+            if self._pressure_streak >= n and can_up:
+                decision = "up"
+                self._pressure_streak = 0
+                self.scale_ups += 1
+        else:
+            self._thin_streak = 0
+            self._pressure_streak = 0
+            self.state = "steady"
+        return decision
+
+    def status(self) -> dict:
+        """The /diagnostics Pod value group's autoscaler payload."""
+        return {
+            "state": self.state,
+            "occupancy": (
+                None if self.occupancy is None
+                else round(self.occupancy, 3)
+            ),
+            "scale_downs": self.scale_downs,
+            "scale_ups": self.scale_ups,
+        }

@@ -58,6 +58,7 @@ class ShardedFilterService:
         beams: int = DEFAULT_BEAMS,
         capacity: int = MAX_SCAN_NODES,
         fleet_ingest_buckets: Optional[tuple] = None,
+        staging_pool=None,
     ) -> None:
         from rplidar_ros2_driver_tpu.utils.backend import (
             maybe_enable_compilation_cache,
@@ -139,6 +140,11 @@ class ShardedFilterService:
         )
         self.fleet_ingest = None        # FleetFusedIngest (fused backend)
         self._fleet_ingest_buckets = fleet_ingest_buckets
+        # host-local staging planes (driver/ingest.StagingPool): the
+        # elastic pod injects one pool per HOST so sibling shards share
+        # it and an engine carries only device state (re-homable);
+        # None = the engine owns a private pool
+        self._staging_pool = staging_pool
         self._host_ingest = None        # per-stream (decoder, latest-slot)
         self.host_scans_dropped = 0     # newest-wins drops on the host path
         # SLAM front-end seam (mapping/mapper.FleetMapper): when
@@ -669,7 +675,8 @@ class ShardedFilterService:
                 )
                 self.fleet_ingest = FleetFusedIngest(
                     self.params, self.streams, mesh=self.mesh,
-                    beams=self.cfg.beams, capacity=self.capacity, **kw,
+                    beams=self.cfg.beams, capacity=self.capacity,
+                    staging_pool=self._staging_pool, **kw,
                 )
             return
         if getattr(self.params, "deskew_enable", False):
@@ -1524,6 +1531,7 @@ class ElasticFleetService:
         *,
         shards: Optional[int] = None,
         lanes: Optional[int] = None,
+        hosts: Optional[int] = None,
         mesh=None,
         beams: int = DEFAULT_BEAMS,
         capacity: int = MAX_SCAN_NODES,
@@ -1535,6 +1543,7 @@ class ElasticFleetService:
             ShardHealth,
             ShardHealthConfig,
         )
+        from rplidar_ros2_driver_tpu.driver.ingest import StagingPool
         from rplidar_ros2_driver_tpu.parallel.sharding import (
             FleetTopology,
             make_mesh,
@@ -1544,6 +1553,8 @@ class ElasticFleetService:
             shards = int(getattr(params, "shard_count", 1))
         if lanes is None:
             lanes = int(getattr(params, "shard_lanes", 0))
+        if hosts is None:
+            hosts = int(getattr(params, "pod_hosts", 1))
         if lanes == 0:
             # smallest lane count that survives one full shard loss
             # ((shards-1)*lanes >= streams); single-shard pods get no
@@ -1554,8 +1565,13 @@ class ElasticFleetService:
             )
         self.params = params
         self.streams = streams
-        self.topology = FleetTopology(streams, shards, lanes)
+        self.topology = FleetTopology(streams, shards, lanes, hosts=hosts)
         self.clock = clock or time.monotonic
+        # one staging plane per HOST, owned by the pod: every shard on
+        # a host shares its pool, so a shard's engine carries only
+        # device state and a re-home (steal, scale event, real
+        # multi-process split) never copies host buffers
+        self.staging_pools = [StagingPool() for _ in range(hosts)]
         if mesh is None:
             # one shard = one mesh SLICE: the available devices split
             # into contiguous per-shard groups (fewer devices than
@@ -1589,6 +1605,9 @@ class ElasticFleetService:
                 params, lanes, mesh=meshes[s], beams=beams,
                 capacity=capacity,
                 fleet_ingest_buckets=fleet_ingest_buckets,
+                staging_pool=self.staging_pools[
+                    self.topology.host_of(s)
+                ],
             )
             for s in range(shards)
         ]
@@ -1638,6 +1657,18 @@ class ElasticFleetService:
         # per-drain (tick, shard, rung, depth) log
         self.scheduler = None
         self.rung_log: list = []
+        # per-drain (tick, shard, rung, depth, seconds) — the pod p99
+        # metric takes max-over-shards per wall tick (shards drain
+        # concurrently on real hardware; the rig serializes them)
+        self.drain_log: list = []
+        # pod-of-pods seams: autoscaler (attach_scheduler builds one
+        # when autoscale_enable), parked shards (engine released,
+        # membership intact), steal bookkeeping for the current tick
+        self.autoscaler = None
+        self._parked: set = set()
+        self.scale_events: list = []
+        self.steal_drops = 0
+        self._stolen_this_tick: set = set()
 
     # -- warmup ------------------------------------------------------------
 
@@ -1721,6 +1752,12 @@ class ElasticFleetService:
         Periodic per-stream snapshots refresh after the dispatches so
         a snapshot never includes a half-applied tick.
         """
+        if self._parked:
+            raise RuntimeError(
+                "pod is autoscaled down (parked shards: "
+                f"{sorted(self._parked)}) — the per-tick seam has no "
+                "scale-up path; use offer_bytes/drain_scheduled"
+            )
         if len(items) != self.streams:
             raise ValueError(
                 f"expected {self.streams} per-stream items, got {len(items)}"
@@ -1863,11 +1900,31 @@ class ElasticFleetService:
                 f"{len(shaper.ladders)} ladders) does not match the pod "
                 f"({self.streams} streams, {len(self.shards)} shards)"
             )
+        if (
+            shaper.cfg.steal_threshold_ticks > 0
+            or shaper.cfg.autoscale_enable
+        ) and getattr(self.params, "loop_enable", False):
+            # steal/scale moves carry ingest+map rows (the failover
+            # row-ops); loop-closure rows don't migrate, so a borrowed
+            # lane would run the back-end over a stranger's history
+            raise ValueError(
+                "work stealing / autoscale do not support the "
+                "loop-closure back-end (loop rows do not migrate)"
+            )
         for sh in self.shards:
             sh._ensure_byte_ingest()
             sh.fleet_ingest.ensure_rungs(shaper.cfg.rungs)
         self.scheduler = shaper
         self.rung_log: list = []
+        self.drain_log: list = []
+        if shaper.cfg.autoscale_enable:
+            from rplidar_ros2_driver_tpu.parallel.scheduler import (
+                PodAutoscaler,
+            )
+
+            self.autoscaler = PodAutoscaler(
+                shaper.cfg, self.topology.lanes
+            )
         return shaper
 
     def _refresh_weights(self) -> None:
@@ -1911,7 +1968,16 @@ class ElasticFleetService:
         dispatch (the per-tick seam's exclusion contract), but the
         victims' QUEUES survive — their next backlog drains on the
         survivor.  Returns per-GLOBAL-stream lists of FilterOutputs in
-        tick order (empty for idle/unhosted streams)."""
+        tick order (empty for idle/unhosted streams).
+
+        Pod-of-pods extensions at this boundary, in order: the
+        autoscaler ticks (park/unpark on sustained occupancy), then
+        the steal phase plans whole-queue borrows (deep shard ->
+        sibling with deadline headroom).  A borrowed stream's row is
+        copied LIVE onto the taker's idle lane right before the
+        taker's drain and copied back right after — placement never
+        moves, so a steal is reversible by construction and the donor
+        simply sees the lane idle (a carry no-op) this tick."""
         if self.scheduler is None:
             raise RuntimeError("attach_scheduler() first")
         from rplidar_ros2_driver_tpu.driver.health import ShardState
@@ -1919,13 +1985,19 @@ class ElasticFleetService:
         t = self.tick_no
         t0 = time.perf_counter()
         self._tick_faults()
+        self._tick_autoscale()
         outs: list = [[] for _ in range(self.streams)]
         snap_due = (
             self.snapshot_ticks > 0
             and (t + 1) % self.snapshot_ticks == 0
         )
+        steals = self._plan_steals()
+        stolen_away = {
+            stream for plans in steals.values() for stream, _src in plans
+        }
+        self._stolen_this_tick = stolen_away
         for s, hs in enumerate(self.shard_health):
-            if not hs.hosting:
+            if not hs.hosting or s in self._parked:
                 continue
             eng = self.shards[s].fleet_ingest
             if eng is not None and eng.warmup_costs:
@@ -1935,14 +2007,25 @@ class ElasticFleetService:
                 self.scheduler.model.seed_many(eng.warmup_costs)
                 eng.warmup_costs = {}
             lane_streams = self.topology.lane_streams(s)
-            ticks, rung = self.scheduler.drain_plan(s, lane_streams)
+            # a donor's stolen streams are masked out of its own plan:
+            # their queues pop on the taker, the donor's lanes idle
+            # through this drain (a carry no-op preserves the rows)
+            plan_ids = (
+                [None if st in stolen_away else st for st in lane_streams]
+                if stolen_away else lane_streams
+            )
+            borrows = self._stage_borrows(s, steals.get(s, []))
+            ticks, rung = self.scheduler.drain_plan(
+                s, plan_ids, extra_streams=[b[0] for b in borrows]
+            )
             if not ticks:
                 # nothing queued: no poses are current this tick — the
                 # stale-pose discipline (PR 10/13) extended to the
                 # scheduled seam, which must not republish the previous
-                # drain's estimates
+                # drain's estimates.  Stolen streams are the taker's to
+                # publish (it may already have, earlier this tick).
                 for stream in lane_streams:
-                    if stream is not None:
+                    if stream is not None and stream not in stolen_away:
                         self.last_poses[stream] = None
                 # the FSM still observes the empty drain (the per-tick
                 # seam's idle observe): probation completes through
@@ -1955,8 +2038,15 @@ class ElasticFleetService:
             bucket = self.scheduler.bucket_plan(s)
             if bucket is not None:
                 eng.set_active_bucket(bucket)
+            # effective lane table: this shard's own lanes plus any
+            # borrowed rows staged onto its idle lanes for this drain
+            eff = list(lane_streams)
+            for stream, _src, _sl, lane in borrows:
+                eff[lane] = stream
+            borrow_lanes = {lane for *_x, lane in borrows}
             lane_ticks = [
-                self.topology.lane_items(s, tick) for tick in ticks
+                [None if st is None else tick[st] for st in eff]
+                for tick in ticks
             ]
             offered = any(any(it for it in lt) for lt in lane_ticks)
             overlap = None
@@ -1986,20 +2076,33 @@ class ElasticFleetService:
                 )
                 # the popped ticks died with the dispatch: excluded via
                 # the PRE-loss lane table (_lose_shard just evacuated
-                # every victim, so streams_on(s) is empty by now)
+                # every victim, so streams_on(s) is empty by now).  A
+                # stream stolen AWAY from this shard is the taker's:
+                # its fate rides the taker's dispatch, not this one.
                 for stream in lane_streams:
-                    if stream is not None:
+                    if stream is not None and stream not in stolen_away:
                         self._excluded[stream].add(t)
+                for stream, _src, _sl, _bl in borrows:
+                    # borrowed pops died with this dispatch; the return
+                    # never ran, so the donor still holds the pre-drain
+                    # row and only the popped wall tick is lost
+                    self._excluded[stream].add(t)
                 continue
+            dt = time.perf_counter() - x0
             self.scheduler.note_drain(
-                s, len(ticks), time.perf_counter() - x0,
+                s, len(ticks), dt,
                 rung=rung,
                 bucket=None if eng is None else eng.slicing_bucket,
             )
             self.rung_log.append((t, s, rung, len(ticks)))
+            self.drain_log.append((t, s, rung, len(ticks), dt))
             completed = 0
-            for lane, stream in enumerate(lane_streams):
+            for lane, stream in enumerate(eff):
                 if stream is None:
+                    continue
+                if stream in stolen_away and lane not in borrow_lanes:
+                    # this shard's own stream, drained by the taker
+                    # this tick — outputs/poses are collected there
                     continue
                 outs[stream].extend(shard_outs[lane])
                 self.last_poses[stream] = self.shards[s].last_poses[lane]
@@ -2009,9 +2112,11 @@ class ElasticFleetService:
                     # deep the drained backlog (the per-tick seam's
                     # single append)
                     self._since_snap[stream].append(t)
+            self._return_borrows(s, borrows)
             tr = hs.observe(offered, completed)
             if tr is not None and tr[1] is ShardState.LOST:
                 self._on_lost(s, hs.last_reason)
+        self._stolen_this_tick = set()
         # unhosted streams' queues keep building toward the admission
         # bound (shed beyond it — bounded by contract); nothing to
         # exclude here, the data is still queued, not lost
@@ -2026,6 +2131,267 @@ class ElasticFleetService:
             self._first_tick_pending = False
         self.tick_no += 1
         return outs
+
+    # -- pod-of-pods: work stealing + autoscale ----------------------------
+
+    def _plan_steals(self) -> dict:
+        """One wall tick's steal plan ({taker: [(stream, donor), ...]})
+        from the shaper's policy, fed the live membership: hosting
+        non-parked shards only, free-lane counts from the topology."""
+        sched = self.scheduler
+        if sched is None or sched.cfg.steal_threshold_ticks <= 0:
+            return {}
+        hosted: dict = {}
+        free: dict = {}
+        for s, hs in enumerate(self.shard_health):
+            if not hs.hosting or s in self._parked:
+                continue
+            tbl = self.topology.lane_streams(s)
+            hosted[s] = [st for st in tbl if st is not None]
+            free[s] = sum(1 for st in tbl if st is None)
+        if len(hosted) < 2:
+            return {}
+        return sched.plan_steals(hosted, free)
+
+    def _stage_borrows(self, s: int, plans: list) -> list:
+        """Copy each planned donor row LIVE onto one of taker ``s``'s
+        idle lanes (the PR 9 row-ops with decode carries intact) right
+        before the taker's drain.  Best-effort: a donor that died
+        mid-tick, a stream relabeled since planning, or an idle-lane
+        shortage (a mid-tick evacuation claimed the lane) drops the
+        borrow — nothing popped the stream's queue yet, so it simply
+        drains on its own shard next tick.  Returns
+        ``[(stream, donor, donor_lane, borrow_lane), ...]``."""
+        if not plans:
+            return []
+        t = self.tick_no
+        lane_tbl = self.topology.lane_streams(s)
+        idle = [lane for lane, st in enumerate(lane_tbl) if st is None]
+        out = []
+        for stream, src in plans:
+            got = self.topology.placement(stream)
+            if (
+                not idle
+                or src in self._parked
+                or not self.shard_health[src].hosting
+                or got is None
+                or got[0] != src
+            ):
+                self.steal_drops += 1
+                self._stolen_this_tick.discard(stream)
+                continue
+            lane = idle.pop(0)
+            self._move_row_live(stream, src, got[1], s, lane)
+            out.append((stream, src, got[1], lane))
+            self.events.append((t, "stolen", stream, src, s, lane))
+        return out
+
+    def _return_borrows(self, s: int, borrows: list) -> None:
+        """Copy each borrowed row home after the taker's drain — the
+        reverse of :meth:`_stage_borrows`.  Placement never moved, so
+        the steal is over the moment the row lands."""
+        for stream, src, src_lane, lane in borrows:
+            self._move_row_live(stream, s, lane, src, src_lane)
+
+    def _move_row_live(
+        self, stream: int, src: int, src_lane: int, dst: int, dst_lane: int
+    ) -> None:
+        """Live row move between two HEALTHY engines with decode
+        carries intact (``restore_decode=True`` — the same-stream
+        resume discipline): unlike the failover restore
+        (:meth:`_restore_into`) nothing is reset and nothing lands in
+        the replay plan, so steals and graceful scale migrations are
+        byte-invisible to the output trajectory."""
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            carried_map_row,
+            is_carried,
+        )
+
+        snap = self.shards[src].fleet_ingest.snapshot_stream(src_lane)
+        sh = self.shards[dst]
+        if not sh.fleet_ingest.restore_stream(
+            dst_lane, snap, restore_decode=True
+        ):
+            raise RuntimeError(
+                f"stream {stream}: live row rejected by shard {dst} "
+                f"lane {dst_lane} (schema/geometry drift)"
+            )
+        if sh.mapper is not None:
+            if is_carried(sh.mapper):
+                ok = sh.mapper.restore_stream(
+                    dst_lane, carried_map_row(snap)
+                )
+            else:
+                ok = sh.mapper.restore_stream(
+                    dst_lane,
+                    self.shards[src].mapper.snapshot_stream(src_lane),
+                )
+            if not ok:
+                raise RuntimeError(
+                    f"stream {stream}: live map row rejected by shard "
+                    f"{dst} lane {dst_lane} (schema/geometry drift)"
+                )
+
+    def _tick_autoscale(self) -> None:
+        """One autoscaler observation at the tick boundary; a fired
+        decision parks (scale down) or unparks (scale up) one shard.
+        Scale-down legality is the failover capacity invariant — the
+        survivors' idle lanes must cover every stream — plus the
+        configured shard floor; scale-up needs a parked shard."""
+        if self.autoscaler is None:
+            return
+        active = [
+            s for s, hs in enumerate(self.shard_health)
+            if hs.hosting and s not in self._parked
+        ]
+        if not active:
+            return
+        cfg = self.autoscaler.cfg
+        can_down = (
+            len(active) > cfg.autoscale_min_shards
+            and (len(active) - 1) * self.topology.lanes >= self.streams
+        )
+        can_up = bool(self._parked)
+        d = self.autoscaler.note_tick(
+            self.scheduler.rates.rates(), len(active),
+            can_down=can_down, can_up=can_up,
+        )
+        if d == "down":
+            victim = min(
+                active, key=lambda s: (self.topology.shard_load(s), s)
+            )
+            self._park_shard(victim)
+        elif d == "up":
+            self._unpark_shard(min(self._parked))
+
+    def _park_shard(self, s: int) -> None:
+        """Autoscale DOWN: gracefully drain shard ``s`` out of the
+        pod.  Every hosted stream's row moves LIVE (decode carries
+        intact — the engine is healthy, unlike a loss) onto siblings'
+        idle lanes, so nothing resets and nothing lands in the replay
+        plan; then the engine is wiped (released).  The placement move
+        is the PR 9 evacuate relabel, so the survivors' already-warm
+        programs absorb the migrants with zero recompiles."""
+        t = self.tick_no
+        lane_of = {
+            stream: self.topology.placement(stream)[1]
+            for stream in self.topology.streams_on(s)
+        }
+        avoid = sorted(
+            {
+                x for x, hs in enumerate(self.shard_health)
+                if not hs.hosting and x != s
+            }
+            | (self._parked - {s})
+        )
+        plan = self.topology.evacuate(s, avoid=avoid)
+        if len(plan) != len(lane_of):
+            raise RuntimeError(
+                f"scale-down of shard {s} would strand "
+                f"{len(lane_of) - len(plan)} streams (capacity guard "
+                "out of sync with the topology)"
+            )
+        for stream, dst, lane in plan:
+            self._move_row_live(stream, s, lane_of[stream], dst, lane)
+            self.migrations += 1
+            self.shard_migrations_in[dst] += 1
+            self.shard_last_migration_tick[dst] = t
+            self.last_migration_tick = t
+            self.events.append(
+                (t, "scale_down_migrated", stream, s, dst, lane)
+            )
+        self._parked.add(s)
+        self.scale_events.append((t, "down", s))
+        self.events.append((t, "scale_down", s))
+        sh = self.shards[s]
+        if sh.fleet_ingest is not None:
+            sh.fleet_ingest.cold_reset()
+        if sh.mapper is not None:
+            sh.mapper.reset()
+        logger.info(
+            "shard %d parked (autoscale down), %d streams moved live",
+            s, len(plan),
+        )
+
+    def _unpark_shard(self, s: int) -> None:
+        """Autoscale UP: re-admit parked shard ``s``.  Its engine was
+        wiped at park time and every (rung, bucket) program is still
+        warm from precompile, so the rebalance migrations are
+        recompile-free; movers travel LIVE (decode carries intact) —
+        the graceful mirror of :meth:`_readmit_shard`'s loss path.
+        A stream stranded unhosted while scaled down (a loss beyond
+        the shrunken capacity) restores from its stored snapshot with
+        the full PR 9 reset/replay bookkeeping."""
+        t = self.tick_no
+        self._parked.discard(s)
+        self.scale_events.append((t, "up", s))
+        self.events.append((t, "scale_up", s))
+        moves = self.topology.rebalance_into(s)
+        for stream, src, src_lane, dst, lane in moves:
+            if src < 0:
+                entry = self._snap.get(stream)
+                self._restore_into(
+                    stream, dst, lane, entry[1] if entry else None
+                )
+                self._resets[stream].add(t)
+            else:
+                self._move_row_live(stream, src, src_lane, dst, lane)
+            self.migrations += 1
+            self.shard_migrations_in[dst] += 1
+            self.shard_last_migration_tick[dst] = t
+            self.last_migration_tick = t
+            self.events.append(
+                (t, "scale_up_migrated", stream, src, dst, lane)
+            )
+        self.streams_lost_unhosted = len(self.topology.unhosted())
+        logger.info(
+            "shard %d unparked (autoscale up), %d streams moved",
+            s, len(moves),
+        )
+
+    def pod_status(self) -> dict:
+        """The /diagnostics "Pod" value group payload: per-host shard
+        states (parked shards report PARKED — the health FSM still
+        says UP, but the engine is released), steal and scale
+        counters, and the autoscaler's hysteresis state."""
+        per_host = []
+        for h in range(self.topology.hosts):
+            states = []
+            for s in self.topology.shards_on_host(h):
+                states.append({
+                    "shard": s,
+                    "state": (
+                        "PARKED" if s in self._parked
+                        else self.shard_health[s].state.name
+                    ),
+                    "streams": len(self.topology.streams_on(s)),
+                })
+            per_host.append({"host": h, "shards": states})
+        return {
+            "hosts": self.topology.hosts,
+            "per_host": per_host,
+            "parked": sorted(self._parked),
+            "steals": (
+                0 if self.scheduler is None else self.scheduler.steals
+            ),
+            "steal_ticks": (
+                0 if self.scheduler is None
+                else self.scheduler.steal_ticks
+            ),
+            "steal_drops": self.steal_drops,
+            "scale_downs": (
+                0 if self.autoscaler is None
+                else self.autoscaler.scale_downs
+            ),
+            "scale_ups": (
+                0 if self.autoscaler is None
+                else self.autoscaler.scale_ups
+            ),
+            "autoscaler": (
+                None if self.autoscaler is None
+                else self.autoscaler.status()
+            ),
+        }
 
     def scheduler_status(self) -> Optional[dict]:
         """The /diagnostics scheduler value group's payload (None when
@@ -2096,7 +2462,11 @@ class ElasticFleetService:
         if self.shard_health[s].state is not ShardState.UP:
             return
         for stream in self.topology.lane_streams(s):
-            if stream is None:
+            if stream is None or stream in self._stolen_this_tick:
+                # a stolen stream's home row is (or will be) behind its
+                # borrowed copy this tick — a mid-drain pull would store
+                # a snapshot claiming history it doesn't hold; the
+                # epilogue refresh catches it after the row returns
                 continue
             snap = self._stream_snapshot(stream)
             if snap is not None:
@@ -2209,10 +2579,11 @@ class ElasticFleetService:
         t0 = time.perf_counter()
         # victims must land on shards that can actually host them: a
         # double loss must not evacuate onto an earlier casualty's
-        # empty (wiped) lanes
+        # empty (wiped) lanes, and a PARKED shard's engine is released
+        # (its lanes are cold and the drain loop skips it)
         dead = [
             x for x, hs in enumerate(self.shard_health)
-            if not hs.hosting and x != s
+            if (not hs.hosting or x in self._parked) and x != s
         ]
         victims = self.topology.streams_on(s)
         plan = self.topology.evacuate(s, avoid=dead)
@@ -2338,6 +2709,8 @@ class ElasticFleetService:
         out = []
         for s, hs in enumerate(self.shard_health):
             d = hs.status()
+            d["host"] = self.topology.host_of(s)
+            d["parked"] = s in self._parked
             d["streams"] = self.topology.streams_on(s)
             d["evacuations"] = self.shard_evacuations[s]
             d["migrations_in"] = self.shard_migrations_in[s]

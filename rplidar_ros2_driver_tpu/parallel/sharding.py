@@ -484,9 +484,25 @@ class FleetTopology:
     finds idle lanes (the ``shard_lanes`` auto default in
     core/config.py picks the smallest such L).  Host-side bookkeeping
     only: no jax, no device work.
+
+    Two-level coordinates (pod of pods): ``hosts`` partitions the
+    shards into contiguous equal blocks — shard s lives on host
+    ``s // (shards // hosts)`` — and every stream gains a
+    ``(host, shard, lane)`` coordinate (:meth:`coordinate`).  The
+    relabeling discipline is unchanged (a host is only a grouping of
+    lane tables), but placement becomes per-host-first: ``assign``
+    picks the least-loaded HOST before the least-loaded shard within
+    it, ``evacuate`` prefers same-host destinations (an intra-host
+    move is a device-to-device row copy; a cross-host move must ship
+    the row between processes), and ``rebalance_into`` pulls from
+    same-host sources before crossing a host boundary.  With
+    ``hosts=1`` every preference key is constant and the planner is
+    byte-identical to the single-level rules above.
     """
 
-    def __init__(self, streams: int, shards: int, lanes: int) -> None:
+    def __init__(
+        self, streams: int, shards: int, lanes: int, hosts: int = 1,
+    ) -> None:
         if streams < 1:
             raise ValueError("need at least one stream")
         if shards < 1:
@@ -504,9 +520,22 @@ class FleetTopology:
                 f"shard loss with {streams} streams (need "
                 f"(shards-1)*lanes >= streams)"
             )
+        if hosts < 1:
+            raise ValueError("need at least one host")
+        if shards % hosts != 0:
+            # contiguous equal blocks keep host_of O(1) and match the
+            # contiguous device-group mesh slicing in the service — a
+            # ragged split would leave one host's pod under-provisioned
+            # relative to its device slice
+            raise ValueError(
+                f"{shards} shards cannot split evenly across "
+                f"{hosts} hosts"
+            )
         self.streams = streams
         self.shards = shards
         self.lanes = lanes
+        self.hosts = hosts
+        self.shards_per_host = shards // hosts
         # per-stream placement weights (byte-rate-weighted placement,
         # ROADMAP item 4): load is the SUM of hosted weights, so
         # ``assign``/``evacuate``/``rebalance_into`` land hot streams
@@ -549,6 +578,37 @@ class FleetTopology:
         return [
             i for i in range(self.streams) if i not in self._placement
         ]
+
+    # -- two-level (host) queries ------------------------------------------
+
+    def host_of(self, shard: int) -> int:
+        """The host owning ``shard`` (contiguous equal blocks)."""
+        if not (0 <= shard < self.shards):
+            raise IndexError(
+                f"shard {shard} out of range [0, {self.shards})"
+            )
+        return shard // self.shards_per_host
+
+    def shards_on_host(self, host: int) -> list[int]:
+        """``host``'s shard ids, ascending."""
+        if not (0 <= host < self.hosts):
+            raise IndexError(
+                f"host {host} out of range [0, {self.hosts})"
+            )
+        base = host * self.shards_per_host
+        return list(range(base, base + self.shards_per_host))
+
+    def coordinate(self, stream: int) -> Optional[tuple[int, int, int]]:
+        """``(host, shard, lane)`` hosting ``stream``, or None."""
+        got = self._placement.get(stream)
+        if got is None:
+            return None
+        shard, lane = got
+        return (self.host_of(shard), shard, lane)
+
+    def host_load(self, host: int) -> float:
+        """``host``'s weighted load: the sum over its shards."""
+        return sum(self.shard_load(s) for s in self.shards_on_host(host))
 
     # -- weights -----------------------------------------------------------
 
@@ -609,22 +669,43 @@ class FleetTopology:
             self._lane_map[shard][lane] = None
 
     def assign(
-        self, stream: int, avoid: Sequence[int] = (),
+        self,
+        stream: int,
+        avoid: Sequence[int] = (),
+        prefer_host: Optional[int] = None,
     ) -> Optional[tuple[int, int]]:
-        """Place an unhosted ``stream`` on the least-loaded shard not in
-        ``avoid`` — load is the WEIGHTED sum (:meth:`shard_load`), so a
-        shard hosting one hot stream counts as fuller than one hosting
-        two cold ones; returns the new (shard, lane) or None when no
-        shard has an idle lane."""
+        """Place an unhosted ``stream`` per host first, cross-host
+        second: among hosts with a candidate shard (idle lane, not in
+        ``avoid``) the least WEIGHTED-loaded host wins, then the
+        least-loaded candidate shard within it — load is the weighted
+        sum (:meth:`shard_load`), so a shard hosting one hot stream
+        counts as fuller than one hosting two cold ones.
+        ``prefer_host`` (the evacuation path) pins the host choice to
+        the named host whenever it still has a candidate — an
+        intra-host move is a row copy between device slices; crossing
+        a host boundary ships the row between processes.  With one
+        host both keys are constant and this is exactly the original
+        least-loaded-shard rule.  Returns the new (shard, lane) or
+        None when no candidate shard remains."""
         if stream in self._placement:
             raise ValueError(f"stream {stream} is already hosted")
-        best, best_load = None, None
+        # candidate shards per host, then a two-level pick: host key
+        # (preference, weighted host load, index) before shard key
+        # (weighted shard load, index)
+        best, best_key = None, None
         for shard in range(self.shards):
             if shard in avoid or self._free_lane(shard) is None:
                 continue
-            load = self.shard_load(shard)
-            if best_load is None or load < best_load:
-                best, best_load = shard, load
+            host = self.host_of(shard)
+            key = (
+                0 if host == prefer_host else 1,
+                self.host_load(host),
+                host,
+                self.shard_load(shard),
+                shard,
+            )
+            if best_key is None or key < best_key:
+                best, best_key = shard, key
         if best is None:
             return None
         return self._place(stream, best)
@@ -648,12 +729,16 @@ class FleetTopology:
         # heaviest victims place first (stable on ties, so equal-weight
         # fleets keep the original lane order): each assign updates the
         # weighted loads the next one compares, so the hot streams take
-        # the coldest shards before the cold ones fill the gaps
+        # the coldest shards before the cold ones fill the gaps.  The
+        # lost shard's own host is preferred per victim — same-host
+        # siblings take the refugees before any cross the host boundary
         for stream in sorted(
             victims, key=lambda s: -self.weight_of(s)
         ):
             self.release(stream)
-            got = self.assign(stream, avoid=skip)
+            got = self.assign(
+                stream, avoid=skip, prefer_host=self.host_of(shard)
+            )
             if got is not None:
                 plan.append((stream, got[0], got[1]))
         return plan
@@ -681,24 +766,28 @@ class FleetTopology:
                 break
             _, lane = self._place(stream, shard)
             moves.append((stream, -1, -1, shard, lane))
+        dst_host = self.host_of(shard)
         while self._free_lane(shard) is not None:
             dst_load = self.shard_load(shard)
             # the best improving move across EVERY source shard — not
             # just the most-loaded one, whose sole tenant may be too
             # heavy to move while a lighter sibling still has improving
-            # candidates.  Preference order (heaviest stream, then
-            # most-loaded source, then highest shard index, then last
-            # lane) reproduces the original count rule exactly at
-            # equal weights.
-            best = None  # ((w, src_load, src, lane_pos), stream, src)
+            # candidates.  Preference order (same-host source, then
+            # heaviest stream, then most-loaded source, then highest
+            # shard index, then last lane) reproduces the original
+            # count rule exactly at equal weights on one host; across
+            # hosts it drains same-host siblings before shipping rows
+            # over a host boundary.
+            best = None  # ((same_host, w, src_load, src, pos), stream, src)
             for s in range(self.shards):
                 if s == shard:
                     continue
                 sl = self.shard_load(s)
+                same = 1 if self.host_of(s) == dst_host else 0
                 for pos, stream in enumerate(self.streams_on(s)):
                     w = self.weight_of(stream)
                     if sl - dst_load > w:
-                        key = (w, sl, s, pos)
+                        key = (same, w, sl, s, pos)
                         if best is None or key > best[0]:
                             best = (key, stream, s)
             if best is None:
@@ -721,6 +810,7 @@ class FleetTopology:
         a scheduler feeds measured byte rates)."""
         return [
             {
+                "host": self.host_of(s),
                 "streams": self.streams_on(s),
                 "lanes": self.lanes,
                 "load": round(self.shard_load(s), 3),

@@ -48,7 +48,7 @@ _DECISION_KEYS = (
     "median_ab", "deep_window_ab", "derived", "fleet_ingest_ab",
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
     "deskew_ab", "loop_close_ab", "fused_mapping_ab",
-    "elastic_serving_ab", "async_serving_ab",
+    "elastic_serving_ab", "async_serving_ab", "pod_scaleout_ab",
 )
 
 
@@ -529,6 +529,49 @@ def analyze(records: list[dict]) -> dict:
                     "p99_speedup", "buckets", "rungs", "overlap_hits",
                     "bucket_switches", "ratio_clamped",
                 ) if k in asb
+            })
+
+        # config 21: the pod-of-pods A/B (steal_threshold_ticks +
+        # autoscale_enable default).  The whole-queue steals, the
+        # accounting identity, the full park/re-admit cycle and byte-
+        # equality are structural (asserted in the bench), so the flip
+        # question is only whether draining a deep shard's backlog on
+        # a sibling's idle lanes beats the static pod on p99 drain
+        # latency where shards really drain in parallel: >= 1.05 (the
+        # standing noise bar) turns stealing + the autoscaler on.  The
+        # clamp records evidence but must never flip, and the floor-
+        # asymmetric strength merge keeps an above-parity noise record
+        # from displacing committed degradation evidence (the
+        # failover_ab discipline).  CPU/interpret records carry no
+        # weight — a one-process rig serializes the shard drains, so
+        # its per-tick max prices relocation, not the reclaimed idle
+        # lanes (device rule).
+        psb = rec.get("pod_scaleout_ab")
+        if isinstance(psb, dict):
+            v = psb.get("p99_speedup")
+            if isinstance(v, (int, float)) and not psb.get(
+                "ratio_clamped"
+            ):
+                flip = v >= MARGIN
+                recommend("pod_scaleout.tpu", {
+                    "current": "static pod (steal + autoscale off)",
+                    "recommended": (
+                        "steal + autoscale on" if flip
+                        else "static pod (steal + autoscale off)"
+                    ),
+                    "flip": flip,
+                    "key": "config21 p99_speedup",
+                    "value": 1.0 if flip else float(min(v, 1.0)),
+                    "measured": float(v),
+                    "margin": MARGIN,
+                    "source": "pod_scaleout_ab",
+                })
+            out["evidence"].setdefault("pod_scaleout_ab", []).append({
+                k: psb[k] for k in (
+                    "p99_speedup", "steals", "steal_ticks",
+                    "scale_downs", "scale_ups", "hosts",
+                    "ratio_clamped",
+                ) if k in psb
             })
 
         # ablation: resample + voxel kernels

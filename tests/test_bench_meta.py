@@ -34,6 +34,7 @@ def test_metric_names_stable():
     assert bench.metric_name(18) == "fused_mapping_stack_updates_per_sec"
     assert bench.metric_name(19) == "elastic_serving_adaptive_scans_per_sec"
     assert bench.metric_name(20) == "async_serving_overlapped_scans_per_sec"
+    assert bench.metric_name(21) == "pod_scaleout_balanced_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -43,6 +44,7 @@ def test_graded_table_well_formed():
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
             "fused_mapping", "elastic_serving", "async_serving",
+            "pod_scaleout",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1551,6 +1553,128 @@ def test_decide_backends_async_serving_key():
     assert (
         got["recommendations"]["staging_double_buffer.tpu"]["flip"]
         is False
+    )
+
+
+def test_bench_smoke_pod_scaleout():
+    """`bench.py --smoke-pod-scaleout` — the tier-1 gate for the
+    pod-of-pods serving plane (config-21 A/B at seconds-scale CPU
+    geometry).  The structural claims are what matters: cross-shard
+    stealing moving WHOLE deep queues onto sibling lanes with the
+    accounting identity and zero staging drops, a full autoscale
+    park/re-admit cycle with nothing left parked, an inert static
+    arm, bounded shadow-checked admission, and byte-equal
+    trajectories across the pod/static arms AND the host golden (the
+    bench itself raises on violation; this gate pins that the
+    asserted artifact lands).  The p99 ratio is steal-neutral by
+    construction on a serializing CPU rig and catastrophe-floored
+    only; the asserted WIN bar applies to full on-chip runs."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-pod-scaleout"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(21)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    for claim in (
+        "steals_moved_whole_deep_queues", "steal_accounting_identity",
+        "no_steal_drops", "static_arm_inert", "full_scale_cycle",
+        "all_shards_unparked_at_end", "bounded_backlog",
+        "shed_policy_matches_shadow", "byte_equal_arms",
+        "byte_equal_host_golden", "zero_recompiles",
+        "zero_implicit_transfers",
+    ):
+        assert s[claim] is True, claim
+    # the steal counters carry the accounting identity the bench
+    # asserted: every steal_log row is (dst, src, stream, n) with the
+    # deep shard as the ONE donor
+    assert out["steals"] > 0
+    assert out["steal_ticks"] == sum(e[3] for e in out["steal_log"])
+    assert len(out["steal_log"]) == out["steals"]
+    assert all(e[1] == 0 and e[0] != 0 for e in out["steal_log"])
+    assert out["steal_drops"] == 0
+    # the full scale cycle: the park precedes the re-admission
+    downs = [e for e in out["scale_events"] if e[1] == "down"]
+    ups = [e for e in out["scale_events"] if e[1] == "up"]
+    assert downs and ups and downs[0][0] < ups[0][0]
+    # the admission bound held (no shed is scheduled in this config —
+    # the skew is a burst, not an outage)
+    adm = out["admission"]
+    assert adm["max_depth_seen"] <= adm["bound_ticks"]
+    assert out["scans"] > 0 and out["value"] > 0
+    # the decision key rides with its clamp flag
+    ab = out["pod_scaleout_ab"]
+    assert "p99_speedup" in ab
+    assert isinstance(ab["ratio_clamped"], bool)
+    assert ab["steals"] > 0 and ab["scale_downs"] >= 1
+    assert ab["scale_ups"] >= 1
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_pod_scaleout_key():
+    """The pod_scaleout recommendation flips from config-21 evidence
+    alone: an unclamped TPU record with p99_speedup above the noise
+    margin recommends turning stealing + the autoscaler on; CPU
+    records and clamped ratios never flip, and the floor-asymmetric
+    strength merge keeps an above-parity noise record from displacing
+    committed degradation evidence (the async_serving_ab
+    discipline)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, speedup, clamped=False):
+        return {
+            "device": dev,
+            "pod_scaleout_ab": {
+                "p99_speedup": speedup,
+                "steals": 12,
+                "steal_ticks": 48,
+                "scale_downs": 1,
+                "scale_ups": 1,
+                "hosts": 2,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    got = db.analyze([rec("tpu", 1.2)])
+    r = got["recommendations"]["pod_scaleout.tpu"]
+    assert r["flip"] is True
+    assert r["recommended"] == "steal + autoscale on"
+    assert r["measured"] == 1.2
+    # CPU record: reported, never flips (a one-process rig serializes
+    # the shard drains — its per-tick max prices relocation, not the
+    # reclaimed idle lanes)
+    got = db.analyze([rec("cpu", 1.5)])
+    assert "pod_scaleout.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 1.5, clamped=True)])
+    assert "pod_scaleout.tpu" not in got["recommendations"]
+    assert got["evidence"]["pod_scaleout_ab"]
+    # below the margin: keep the static pod
+    got = db.analyze([rec("tpu", 1.01)])
+    r = got["recommendations"]["pod_scaleout.tpu"]
+    assert r["flip"] is False
+    assert "static pod" in r["recommended"]
+    # floor-asymmetric strength merge: a committed degradation record
+    # outweighs a later above-parity noise record
+    got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
+    assert (
+        got["recommendations"]["pod_scaleout.tpu"]["flip"] is False
     )
 
 

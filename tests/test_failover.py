@@ -626,9 +626,89 @@ class TestFleetTopology:
         topo = FleetTopology(4, 2, 4)
         st = topo.status()
         assert st == [
-            {"streams": [0, 2], "lanes": 4, "load": 2.0},
-            {"streams": [1, 3], "lanes": 4, "load": 2.0},
+            {"host": 0, "streams": [0, 2], "lanes": 4, "load": 2.0},
+            {"host": 0, "streams": [1, 3], "lanes": 4, "load": 2.0},
         ]
+
+
+class TestPodTopology:
+    """The two-level (host, shard, lane) coordinates — ISSUE 17's
+    placement layer.  Hosts are contiguous equal shard blocks; every
+    preference key degrades to the single-level rules at hosts=1."""
+
+    def test_host_partition_validated(self):
+        with pytest.raises(ValueError):
+            FleetTopology(6, 4, 3, hosts=0)
+        with pytest.raises(ValueError):
+            FleetTopology(6, 4, 3, hosts=3)  # 4 shards % 3 hosts
+        FleetTopology(6, 4, 3, hosts=2)
+        FleetTopology(6, 4, 3, hosts=4)
+
+    def test_host_queries(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        assert [topo.host_of(s) for s in range(4)] == [0, 0, 1, 1]
+        assert topo.shards_on_host(0) == [0, 1]
+        assert topo.shards_on_host(1) == [2, 3]
+        with pytest.raises(IndexError):
+            topo.host_of(4)
+        with pytest.raises(IndexError):
+            topo.shards_on_host(2)
+
+    def test_coordinate_is_the_placement_plus_host(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        # round-robin: stream 4 landed on shard 0's second lane
+        assert topo.coordinate(4) == (0, 0, 1)
+        assert topo.coordinate(2) == (1, 2, 0)
+        topo.release(4)
+        assert topo.coordinate(4) is None
+
+    def test_host_load_sums_the_weighted_shards(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        assert topo.host_load(0) == 4.0  # shards 0,1: streams 0,4,1,5
+        assert topo.host_load(1) == 2.0
+        topo.set_weight(2, 5.0)
+        assert topo.host_load(1) == 6.0
+
+    def test_assign_picks_the_cold_host_first(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        topo.release(5)
+        # host 0 carries 3 streams, host 1 two: the cold HOST wins
+        # before any shard compare, then its lowest-index cold shard
+        assert topo.assign(5) == (2, 1)
+
+    def test_assign_prefer_host_pins_the_choice(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        topo.release(5)
+        # host 0 is the HOTTER host; the preference still pins it and
+        # the least-loaded shard within it takes the stream
+        assert topo.assign(5, prefer_host=0) == (1, 1)
+
+    def test_evacuate_prefers_same_host_siblings(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        plan = topo.evacuate(0)
+        # victim 0 fits shard 0's host-0 sibling; victim 4 overflows
+        # host 0 (shard 1 is full at 3 lanes) and only then crosses
+        assert plan == [(0, 1, 2), (4, 2, 1)]
+        assert topo.host_of(plan[0][1]) == 0
+        assert topo.host_of(plan[1][1]) == 1
+
+    def test_rebalance_pulls_same_host_sources_first(self):
+        topo = FleetTopology(6, 4, 3, hosts=2)
+        topo.evacuate(2)          # stream 2 takes refuge on shard 3
+        plan = topo.rebalance_into(2)
+        # the refugee returns from the SAME-HOST sibling even though
+        # host 0's shards are just as loaded
+        assert plan == [(2, 3, 1, 2, 0)]
+        assert topo.coordinate(2) == (1, 2, 0)
+
+    def test_single_host_is_byte_identical_to_flat(self):
+        flat = FleetTopology(8, 4, 3)
+        one = FleetTopology(8, 4, 3, hosts=1)
+        assert flat.evacuate(1) == one.evacuate(1)
+        assert flat.rebalance_into(1) == one.rebalance_into(1)
+        for i in range(8):
+            assert flat.placement(i) == one.placement(i)
+            assert one.coordinate(i)[0] == 0
 
 
 # ---------------------------------------------------------------------------

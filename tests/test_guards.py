@@ -318,3 +318,116 @@ class TestFleetMapperSteadyState:
         assert m.dispatch_count == 4
         assert all(e is not None for e in est)
         del rng
+
+
+# ---------------------------------------------------------------------------
+# pod-of-pods: steals + a full autoscale cycle stay steady
+# ---------------------------------------------------------------------------
+
+
+class TestPodScaleoutSteadyState:
+    def test_steal_and_scale_cycle_stay_in_the_compile_cache(self):
+        """The pod-of-pods structural contract (ISSUE 17 acceptance):
+        cross-shard steals are live row moves between ALREADY-COMPILED
+        engines, a scale-down is a relabeling plus an engine release,
+        and a scale-up re-admits the parked shard's warm executables —
+        so a skew -> idle -> resume trace that forces steals AND a full
+        down/up autoscale cycle runs with ZERO recompiles and ZERO
+        implicit transfers after warmup, while every stream keeps
+        publishing byte-identically to a static pod fed the same
+        schedule (the steal/scale policies choose WHERE and WITH WHAT
+        CAPACITY a queue drains, never what)."""
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ElasticFleetService,
+        )
+
+        from test_chaos import _fleet_ticks, _map_params
+
+        streams, shards = 6, 3
+        ticks = _fleet_ticks(streams, 24)
+
+        def build(pod_arm):
+            params = _map_params(
+                fleet_ingest_backend="fused", map_backend="fused",
+                shard_count=shards, failover_snapshot_ticks=4,
+                shard_starvation_ticks=500,
+                sched_rungs=(1, 2, 4),
+                admission_max_backlog_ticks=16,
+                steal_threshold_ticks=2 if pod_arm else 0,
+                autoscale_enable=pod_arm,
+                autoscale_low_watermark=0.3,
+                autoscale_high_watermark=0.75,
+                autoscale_hysteresis_ticks=3,
+            )
+            pod = ElasticFleetService(
+                params, streams, shards=shards, beams=BEAMS,
+                fleet_ingest_buckets=(8,),
+            )
+            pod.attach_scheduler()
+            pod.precompile([DENSE])
+            return pod
+
+        pods = {"static": build(False), "pod": build(True)}
+        deep = [
+            s for s in pods["pod"].topology.lane_streams(0)
+            if s is not None
+        ][:2]
+        cursor = [0] * streams
+
+        def take(i, n):
+            got = [
+                ticks[t][i]
+                for t in range(cursor[i], min(cursor[i] + n, len(ticks)))
+            ]
+            cursor[i] += len(got)
+            return [g for g in got if g] or None
+
+        wall = []
+        for _ in range(6):    # skewed bursts -> steals
+            wall.append([
+                take(i, 4 if i in deep else 1) for i in range(streams)
+            ])
+        for _ in range(8):    # idle -> scale down (hysteresis 3)
+            wall.append([None] * streams)
+        for _ in range(14):   # full resume -> scale up + re-publish
+            wall.append([take(i, 1) for i in range(streams)])
+
+        outs = {n: [[] for _ in range(streams)] for n in pods}
+
+        def run_tick(t, items):
+            for name in (
+                ("static", "pod") if t % 2 == 0 else ("pod", "static")
+            ):
+                pods[name].offer_bytes(items)
+                for i, g in enumerate(pods[name].drain_scheduled()):
+                    outs[name][i].extend(g)
+
+        warm = 2
+        for t in range(warm):
+            run_tick(t, wall[t])
+        with guards.steady_state(tag="pod steal + autoscale cycle"):
+            for t in range(warm, len(wall)):
+                run_tick(t, wall[t])
+
+        pp = pods["pod"]
+        assert pp.scheduler.steals > 0
+        assert pp.steal_drops == 0
+        assert pp.scheduler.steal_ticks == sum(
+            n for *_, n in pp.scheduler.steal_log
+        )
+        downs = [e for e in pp.scale_events if e[1] == "down"]
+        ups = [e for e in pp.scale_events if e[1] == "up"]
+        assert downs and ups, f"no full scale cycle: {pp.scale_events}"
+        assert pp.pod_status()["parked"] == []
+        assert pods["static"].scheduler.steals == 0
+        assert pods["static"].scale_events == []
+        for i in range(streams):
+            a, b = outs["pod"][i], outs["static"][i]
+            assert len(a) == len(b) and len(a) > 0
+            for x, y in zip(a, b):
+                assert np.array_equal(
+                    np.asarray(x.ranges), np.asarray(y.ranges)
+                )
+                assert np.array_equal(
+                    np.asarray(x.voxel), np.asarray(y.voxel)
+                )

@@ -157,16 +157,86 @@ _WORKER = textwrap.dedent(
 )
 
 
+# First-class CPU CI arm (PR 17): the same two-process jax.distributed
+# launch, but with NO cross-process collective execution — coordinator
+# join, GLOBAL mesh construction, the local-stream ownership split, and
+# a two-host FleetTopology relabel cycle are all capability-independent
+# host/compiler-metadata work, so this arm must PASS wherever the
+# coordination service runs (the collective-backed replay above keeps
+# its capability probe).
+_TOPOLOGY_WORKER = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_NUM_PROCESSES"] = "2"
+    os.environ["JAX_PROCESS_ID"] = str(pid)
+
+    from rplidar_ros2_driver_tpu.parallel import multihost
+    assert multihost.is_configured()
+    assert multihost.initialize()
+    assert jax.process_count() == 2 and jax.device_count() == 4
+
+    # global mesh spans both processes; no program is dispatched over
+    # it here — construction + axis bookkeeping only
+    mesh = multihost.make_global_mesh(stream=1)
+    assert dict(mesh.shape) == {"stream": 1, "beam": 4}
+    mesh2 = multihost.make_global_mesh(stream=2)
+    assert dict(mesh2.shape) == {"stream": 2, "beam": 2}
+    assert multihost.local_stream_slice(4) == (
+        slice(0, 2) if pid == 0 else slice(2, 4)
+    )
+    print(f"proc {pid}: global mesh spans both processes", flush=True)
+
+    # two-host pod relabel cycle: each jax process models one HOST of
+    # a 4-shard pod.  Every move below is a live-lane relabel in the
+    # shared topology — both processes compute the identical placement
+    # (SPMD control plane), which is what lets a real pod-of-pods keep
+    # one placement view without a coordinator round trip.
+    from rplidar_ros2_driver_tpu.parallel.sharding import FleetTopology
+
+    topo = FleetTopology(6, 4, 3, hosts=2)
+    assert topo.hosts == 2 and topo.shards_per_host == 2
+    assert [topo.host_of(s) for s in range(4)] == [0, 0, 1, 1]
+    assert topo.shards_on_host(pid) == ([0, 1] if pid == 0 else [2, 3])
+    before = {i: topo.coordinate(i) for i in range(6)}
+    assert all(c is not None for c in before.values())
+
+    # lose host 0's shard 0: victims must land on the same-host
+    # sibling (shard 1) first — cross-host moves only on overflow
+    victims = topo.streams_on(0)
+    plan = topo.evacuate(0)
+    assert {p[0] for p in plan} == set(victims)
+    # the same-host sibling fills before any victim crosses hosts
+    if any(topo.host_of(dst) != 0 for _v, dst, _l in plan):
+        assert len(topo.streams_on(1)) == 3
+    assert any(topo.host_of(dst) == 0 for _v, dst, _l in plan)
+    # re-admit: movers rebalance back, no stream left unhosted
+    moves = topo.rebalance_into(0)
+    assert topo.unhosted() == []
+    assert len(topo.streams_on(0)) > 0
+    loads = [len(topo.streams_on(s)) for s in range(4)]
+    assert max(loads) - min(loads) <= 1
+    print(f"proc {pid}: two-host relabel cycle consistent", flush=True)
+    """
+)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def _launch_once(port: int):
+def _launch_once(port: int, worker: str = _WORKER):
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(port), str(i)],
+            [sys.executable, "-c", worker, str(port), str(i)],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -219,3 +289,20 @@ def test_two_process_distributed_fleet_replay():
         assert "fleet replay bit-exact" in out, out[-1000:]
         assert "service ticks bit-exact" in out, out[-1000:]
         assert "pipelined local ticks bit-exact one tick late" in out, out[-1000:]
+
+
+def test_two_process_global_mesh_and_pod_topology():
+    """First-class CPU CI arm: a real two-process jax.distributed
+    launch (coordinator on localhost) that joins the process group,
+    builds the GLOBAL (stream, beam) mesh spanning both processes, and
+    runs the two-host FleetTopology relabel cycle — no cross-process
+    collective is dispatched, so this must pass on any backend whose
+    coordination service runs; there is no rig-weather skip here."""
+    for attempt in range(2):
+        procs, outs = _launch_once(_free_port(), worker=_TOPOLOGY_WORKER)
+        if all(p.returncode == 0 for p in procs) or attempt == 1:
+            break
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert "global mesh spans both processes" in out, out[-1000:]
+        assert "two-host relabel cycle consistent" in out, out[-1000:]

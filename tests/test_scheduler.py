@@ -88,10 +88,38 @@ class TestSchedulerConfig:
         dict(bucket_rungs=(8, 4)),   # buckets must ascend
         dict(occupancy_alpha=0.0),
         dict(occupancy_alpha=1.5),
+        dict(steal_threshold_ticks=-1),
+        dict(steal_headroom_ms=-0.5),
+        # the reserve must leave part of the deadline as drain budget
+        dict(deadline_ms=5.0, steal_headroom_ms=5.0),
+        dict(autoscale_low_watermark=0.0),
+        dict(autoscale_low_watermark=0.8,
+             autoscale_high_watermark=0.5),   # low < high
+        dict(autoscale_high_watermark=1.5),
+        dict(autoscale_hysteresis_ticks=0),
+        dict(autoscale_min_shards=0),
+        dict(autoscale_rate_floor=0.0),       # liveness needs a floor
     ])
     def test_rejects_invalid(self, bad):
         with pytest.raises(ValueError):
             SchedulerConfig(**bad)
+
+    def test_from_params_reads_the_steal_autoscale_surface(self):
+        p = _params(
+            steal_threshold_ticks=3, steal_headroom_ms=1.5,
+            autoscale_enable=True, autoscale_low_watermark=0.2,
+            autoscale_high_watermark=0.8, autoscale_hysteresis_ticks=5,
+            autoscale_min_shards=2, autoscale_rate_floor=128.0,
+        )
+        cfg = SchedulerConfig.from_params(p)
+        assert cfg.steal_threshold_ticks == 3
+        assert cfg.steal_headroom_ms == 1.5
+        assert cfg.autoscale_enable is True
+        assert cfg.autoscale_low_watermark == 0.2
+        assert cfg.autoscale_high_watermark == 0.8
+        assert cfg.autoscale_hysteresis_ticks == 5
+        assert cfg.autoscale_min_shards == 2
+        assert cfg.autoscale_rate_floor == 128.0
 
 
 class TestByteRateEwma:
@@ -781,6 +809,372 @@ class TestSchedulerDiagnostics:
         assert "Rung Dispatches" in status.values
 
 
+# ---------------------------------------------------------------------------
+# pod-of-pods: steal planning, the autoscaler, the byte-equal pin
+# ---------------------------------------------------------------------------
+
+
+class TestStealPlanning:
+    def _tick(self, n=1):
+        return (DENSE, [(b"\xa5" * 84, 1.0 + 0.001 * k) for k in range(n)])
+
+    def _shaper(self, streams, shards, **over):
+        over.setdefault("steal_threshold_ticks", 2)
+        cfg = SchedulerConfig(rungs=(1, 2, 4), **over)
+        return TrafficShaper(streams, cfg, shards=shards)
+
+    def test_predict_drain_s_prices_with_the_model(self):
+        sh = self._shaper(2, 2)
+        assert sh.predict_drain_s(0, 0) == 0.0
+        # an unpriced shard has no headroom EVIDENCE
+        assert sh.predict_drain_s(0, 3) is None
+        sh.model.seed(4, 8, 0.002)
+        # depth 3 targets the 4-rung: one dispatch
+        assert sh.predict_drain_s(0, 3) == pytest.approx(0.002)
+        # depth 9 at the top rung: ceil(9/4) = 3 dispatches
+        assert sh.predict_drain_s(0, 9) == pytest.approx(0.006)
+
+    def test_threshold_gates_the_phase(self):
+        sh = self._shaper(2, 2, steal_threshold_ticks=0)
+        sh.offer_tick([[self._tick()] * 6, None])
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+        sh = self._shaper(2, 2)
+        sh.offer_tick([[self._tick()] * 2, None])   # == thr, not past it
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+        assert sh.steals == 0 and sh.steal_log == []
+
+    def test_deep_donor_steals_to_the_idle_sibling(self):
+        sh = self._shaper(4, 2)
+        sh.offer_tick([[self._tick()] * 4, None, self._tick(), None])
+        plan = sh.plan_steals({0: [0, 1], 1: [2, 3]}, {0: 1, 1: 1})
+        assert plan == {1: [(0, 0)]}
+        # the accounting identity the bench asserts, from tick one
+        assert sh.steals == 1 and sh.steal_ticks == 4
+        assert sh.steal_log == [(1, 0, 0, 4)]
+        assert sh.steal_ticks == sum(n for *_, n in sh.steal_log)
+
+    def test_taker_needs_an_idle_lane(self):
+        sh = self._shaper(2, 2)
+        sh.offer_tick([[self._tick()] * 4, None])
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 0, 1: 0}) == {}
+
+    def test_deep_takers_are_disqualified(self):
+        sh = self._shaper(2, 2)
+        sh.offer_tick([[self._tick()] * 5, [self._tick()] * 4])
+        # both shards past the threshold: donors, never takers
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+
+    def test_shallowest_qualifying_taker_wins(self):
+        sh = self._shaper(3, 3)
+        sh.offer_tick([[self._tick()] * 5, self._tick(), None])
+        plan = sh.plan_steals(
+            {0: [0], 1: [1], 2: [2]}, {0: 1, 1: 1, 2: 1}
+        )
+        assert plan == {2: [(0, 0)]}
+
+    def test_donor_donates_deepest_until_the_threshold(self):
+        sh = self._shaper(5, 3)
+        sh.offer_tick([
+            [self._tick()] * 5, [self._tick()] * 4, [self._tick()] * 3,
+            None, None,
+        ])
+        plan = sh.plan_steals(
+            {0: [0, 1, 2], 1: [3], 2: [4]}, {0: 0, 1: 2, 2: 2}
+        )
+        moved = [s for takes in plan.values() for s, _src in takes]
+        assert sorted(moved) == [0, 1, 2]   # depth sank to the thr
+        assert sh.steal_ticks == 12
+        # a borrow deepens a taker: the planner spreads, deepest first
+        assert plan == {1: [(0, 0)], 2: [(1, 0), (2, 0)]}
+
+    def test_headroom_budget_vetoes_unpriced_takers(self):
+        sh = self._shaper(2, 2, steal_headroom_ms=5.0)
+        sh.offer_tick([[self._tick()] * 4, None])
+        # no model entry: the planner refuses to gamble the deadline
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+        sh.model.seed(4, 8, 0.001)   # 1 ms/dispatch fits the 5 ms budget
+        plan = sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1})
+        assert plan == {1: [(0, 0)]}
+
+    def test_headroom_budget_vetoes_overpriced_takers(self):
+        sh = self._shaper(2, 2, steal_headroom_ms=5.0)
+        sh.model.seed(4, 8, 0.010)   # 10 ms/dispatch blows the budget
+        sh.offer_tick([[self._tick()] * 4, None])
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+
+    def test_deadline_reserve_is_the_budget(self):
+        # with a deadline, the budget is deadline - headroom: 2 ms
+        sh = self._shaper(
+            2, 2, deadline_ms=3.0, steal_headroom_ms=1.0
+        )
+        sh.model.seed(4, 8, 0.0025)
+        sh.offer_tick([[self._tick()] * 4, None])
+        assert sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {}
+        sh2 = self._shaper(
+            2, 2, deadline_ms=3.0, steal_headroom_ms=1.0
+        )
+        sh2.model.seed(4, 8, 0.0015)
+        sh2.offer_tick([[self._tick()] * 4, None])
+        assert sh2.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1}) == {
+            1: [(0, 0)]
+        }
+
+    def test_status_carries_the_steal_counters(self):
+        sh = self._shaper(2, 2)
+        st = sh.status()
+        assert st["steals"] == 0 and st["steal_ticks"] == 0
+        sh.offer_tick([[self._tick()] * 4, None])
+        sh.plan_steals({0: [0], 1: [1]}, {0: 1, 1: 1})
+        st = sh.status()
+        assert st["steals"] == 1 and st["steal_ticks"] == 4
+
+
+class TestPodAutoscalerPolicy:
+    def _auto(self, **over):
+        from rplidar_ros2_driver_tpu.parallel.scheduler import (
+            PodAutoscaler,
+        )
+
+        cfg = SchedulerConfig(
+            autoscale_enable=True, autoscale_low_watermark=0.25,
+            autoscale_high_watermark=0.75,
+            autoscale_hysteresis_ticks=3, autoscale_rate_floor=256.0,
+            **over,
+        )
+        return PodAutoscaler(cfg, lanes=2)
+
+    def test_liveness_floor(self):
+        auto = self._auto()
+        assert auto.live_streams([0.0, 100.0, 256.0, 1024.0]) == 2
+
+    def test_thin_streak_fires_down_after_hysteresis(self):
+        auto = self._auto()
+        quiet = [0.0, 0.0, 0.0, 0.0]
+        assert auto.note_tick(quiet, 2) is None
+        assert auto.state == "thin 1/3"
+        assert auto.note_tick(quiet, 2) is None
+        assert auto.note_tick(quiet, 2) == "down"
+        assert auto.scale_downs == 1
+        # the streak reset: the next decision needs a fresh streak
+        assert auto.note_tick(quiet, 2) is None
+
+    def test_pressure_streak_fires_up(self):
+        auto = self._auto()
+        hot = [1000.0] * 4
+        for _ in range(2):
+            assert auto.note_tick(hot, 2) is None
+        assert auto.note_tick(hot, 2) == "up"
+        assert auto.scale_ups == 1
+
+    def test_dead_zone_resets_both_streaks(self):
+        auto = self._auto()
+        quiet, mid = [0.0] * 4, [1000.0, 1000.0, 0.0, 0.0]
+        auto.note_tick(quiet, 2)
+        auto.note_tick(quiet, 2)
+        # occupancy 2/4 sits in the watermark gap: a sawtooth that
+        # recrosses the band restarts the count
+        assert auto.note_tick(mid, 2) is None
+        assert auto.state == "steady"
+        assert auto.note_tick(quiet, 2) is None
+        assert auto.note_tick(quiet, 2) is None
+        assert auto.note_tick(quiet, 2) == "down"
+
+    def test_gated_side_still_ticks_its_streak(self):
+        auto = self._auto()
+        quiet = [0.0] * 4
+        for _ in range(4):
+            assert auto.note_tick(quiet, 2, can_down=False) is None
+        assert auto.scale_downs == 0
+        # the decision lands the moment the gate opens
+        assert auto.note_tick(quiet, 2, can_down=True) == "down"
+
+    def test_occupancy_uses_the_active_fleet_capacity(self):
+        auto = self._auto()
+        hot = [1000.0, 1000.0, 1000.0, 0.0]
+        # 3 live / (2 shards * 2 lanes) = 0.75: the dead zone
+        assert auto.note_tick(hot, 2) is None
+        assert auto.state == "steady"
+        # a parked fleet halves the capacity: 3/2 caps at 1.0 > high
+        auto.note_tick(hot, 1)
+        assert auto.state == "pressure 1/3"
+        assert auto.occupancy == 1.0
+
+    def test_status_payload(self):
+        auto = self._auto()
+        st = auto.status()
+        assert st == {
+            "state": "steady", "occupancy": None,
+            "scale_downs": 0, "scale_ups": 0,
+        }
+        auto.note_tick([0.0] * 4, 2)
+        st = auto.status()
+        assert st["state"] == "thin 1/3" and st["occupancy"] == 0.0
+
+
+class TestPodStealByteEqual:
+    def test_steal_schedule_is_byte_equal_to_no_steal(self):
+        """The acceptance pin: a skewed trace forces cross-shard
+        steals, and the stolen schedule's per-stream outputs are
+        byte-identical to the static pod's — the steal policy picks
+        WHERE a queue drains, never what (the bench asserts the same
+        at config-21 scale; this is the tier-1 unit)."""
+        from test_chaos import _fleet_ticks, _map_params
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ElasticFleetService,
+        )
+
+        streams, shards = 4, 2
+        ticks = _fleet_ticks(streams, 24)
+
+        def build(steal):
+            params = _map_params(
+                fleet_ingest_backend="fused", map_backend="fused",
+                shard_count=shards, failover_snapshot_ticks=4,
+                shard_starvation_ticks=500,
+                sched_rungs=(1, 2, 4),
+                steal_threshold_ticks=2 if steal else 0,
+            )
+            pod = ElasticFleetService(
+                params, streams, shards=shards, beams=BEAMS,
+                fleet_ingest_buckets=(8,),
+            )
+            pod.attach_scheduler()
+            pod.precompile([DENSE])
+            return pod
+
+        pods = {"static": build(False), "pod": build(True)}
+        deep = [
+            s for s in pods["pod"].topology.lane_streams(0)
+            if s is not None
+        ][:2]
+        cursor = [0] * streams
+
+        def take(i, n):
+            got = [
+                ticks[t][i]
+                for t in range(cursor[i], min(cursor[i] + n, len(ticks)))
+            ]
+            cursor[i] += len(got)
+            return [g for g in got if g] or None
+
+        outs = {n: [[] for _ in range(streams)] for n in pods}
+        for t in range(5):
+            items = [
+                take(i, 4 if i in deep else 1) for i in range(streams)
+            ]
+            for name in (
+                ("static", "pod") if t % 2 == 0 else ("pod", "static")
+            ):
+                pods[name].offer_bytes(items)
+                for i, g in enumerate(pods[name].drain_scheduled()):
+                    outs[name][i].extend(g)
+        pp = pods["pod"]
+        assert pp.scheduler.steals > 0
+        assert pp.steal_drops == 0
+        assert pp.scheduler.steal_ticks == sum(
+            n for *_, n in pp.scheduler.steal_log
+        )
+        assert pods["static"].scheduler.steals == 0
+        for i in range(streams):
+            a, b = outs["pod"][i], outs["static"][i]
+            assert len(a) == len(b) and len(a) > 0
+            for x, y in zip(a, b):
+                assert np.array_equal(
+                    np.asarray(x.ranges), np.asarray(y.ranges)
+                )
+                assert np.array_equal(
+                    np.asarray(x.voxel), np.asarray(y.voxel)
+                )
+
+
+class TestPodDiagnostics:
+    def _update(self, pod_payload):
+        from rplidar_ros2_driver_tpu.node.diagnostics import (
+            DiagnosticsUpdater,
+        )
+        from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+        from rplidar_ros2_driver_tpu.node.publisher import (
+            CollectingPublisher,
+        )
+
+        return DiagnosticsUpdater("rig", CollectingPublisher()).update(
+            lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+            port="pod", rpm=0, device_info="",
+            pod=pod_payload,
+        )
+
+    def test_rendering_pinned(self):
+        status = self._update({
+            "hosts": 2,
+            "per_host": [
+                {"host": 0, "shards": [
+                    {"shard": 0, "state": "UP", "streams": 3},
+                    {"shard": 1, "state": "PARKED", "streams": 0},
+                ]},
+                {"host": 1, "shards": [
+                    {"shard": 2, "state": "UP", "streams": 3},
+                ]},
+            ],
+            "parked": [1],
+            "steals": 12,
+            "steal_ticks": 48,
+            "steal_drops": 0,
+            "scale_downs": 1,
+            "scale_ups": 1,
+            "autoscaler": {
+                "state": "thin 2/3", "occupancy": 0.167,
+                "scale_downs": 1, "scale_ups": 0,
+            },
+        })
+        assert status.values["Pod Host 0"] == "0:UP[3] 1:PARKED[0]"
+        assert status.values["Pod Host 1"] == "2:UP[3]"
+        assert status.values["Steals"] == "12"
+        assert status.values["Steal Ticks"] == "48"
+        assert status.values["Scale-Downs"] == "1"
+        assert status.values["Scale-Ups"] == "1"
+        assert status.values["Autoscaler"] == "thin 2/3 (occ 0.167)"
+
+    def test_group_absent_without_payload(self):
+        status = self._update(None)
+        for key in ("Pod Host 0", "Steals", "Steal Ticks",
+                    "Scale-Downs", "Scale-Ups", "Autoscaler"):
+            assert key not in status.values
+
+    def test_no_autoscaler_row_without_the_policy(self):
+        status = self._update({
+            "hosts": 1,
+            "per_host": [{"host": 0, "shards": []}],
+            "steals": 0, "steal_ticks": 0,
+            "scale_downs": 0, "scale_ups": 0,
+            "autoscaler": None,
+        })
+        assert status.values["Pod Host 0"] == "n/a"
+        assert "Autoscaler" not in status.values
+
+    def test_live_payload_feeds_the_renderer(self):
+        from test_chaos import _map_params
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ElasticFleetService,
+        )
+
+        params = _map_params(
+            fleet_ingest_backend="fused", map_backend="fused",
+            shard_count=2, steal_threshold_ticks=2,
+            autoscale_enable=True,
+        )
+        pod = ElasticFleetService(
+            params, 4, shards=2, beams=BEAMS,
+            fleet_ingest_buckets=(4,),
+        )
+        pod.attach_scheduler()
+        status = self._update(pod.pod_status())
+        assert "Pod Host 0" in status.values
+        assert status.values["Steals"] == "0"
+        assert status.values["Autoscaler"].startswith("steady")
+
+
 # The zero-recompile / zero-implicit-transfer pin for mid-run rung
 # switches lives with the other engine steady-state sentinels in
-# tests/test_guards.py (TestAdaptiveRungSteadyState).
+# tests/test_guards.py (TestAdaptiveRungSteadyState); the pod-of-pods
+# analogs (steals + the autoscale cycle) are TestPodScaleoutSteadyState
+# there.

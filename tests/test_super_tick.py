@@ -443,3 +443,78 @@ class TestServiceBacklogSeam:
         )
         with pytest.raises(ValueError):
             svc.submit_bytes_backlog([[None]])  # 1 run for a 2-stream fleet
+
+
+class TestStagingPool:
+    def test_take_give_round_trip_recycles_zeroed(self):
+        from rplidar_ros2_driver_tpu.driver.ingest import StagingPool
+
+        pool = StagingPool()
+        key = ("tick", 4)
+        buf, aux = pool.take(key, (2, 4, 84), (2, 12))
+        assert buf.shape == (2, 4, 84) and aux.shape == (2, 12)
+        assert pool.pooled() == 0
+        buf[:] = 7
+        aux[:] = 3.5
+        pool.give(key, (buf, aux))
+        assert pool.pooled() == 1
+        buf2, aux2 = pool.take(key, (2, 4, 84), (2, 12))
+        # recycled, not reallocated — and scrubbed back to zero
+        assert buf2 is buf and aux2 is aux
+        assert not buf2.any() and not aux2.any()
+        assert pool.pooled() == 0
+
+    def test_stale_shapes_are_dropped_not_served(self):
+        from rplidar_ros2_driver_tpu.driver.ingest import StagingPool
+
+        pool = StagingPool()
+        key = ("tick", 2)
+        pool.give(key, pool.take(key, (1, 4, 84), (1, 12)))
+        # the payload width moved: the pooled pair cannot serve this
+        # request and must not survive under the key either
+        buf, aux = pool.take(key, (1, 4, 132), (1, 12))
+        assert buf.shape == (1, 4, 132)
+        assert pool.pooled() == 0
+
+    def test_keys_are_independent(self):
+        from rplidar_ros2_driver_tpu.driver.ingest import StagingPool
+
+        pool = StagingPool()
+        a = pool.take(("tick", 1), (1, 4, 84), (1, 12))
+        pool.give(("tick", 1), a)
+        b, _aux = pool.take(("tick", 2), (1, 4, 84), (1, 12))
+        assert b is not a[0]
+        assert pool.pooled() == 1  # ("tick", 1)'s pair is untouched
+
+    def test_engine_staging_free_is_the_pool_view(self):
+        eng = FleetFusedIngest(
+            _params(), 1, beams=BEAMS, max_revs=6, buckets=(4,),
+        )
+        assert eng._staging_free is eng.staging._free
+
+    def test_elastic_pod_shares_one_pool_per_host(self):
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ElasticFleetService,
+        )
+
+        pod = ElasticFleetService(
+            _params(fleet_ingest_backend="fused"), 4, shards=2,
+            hosts=2, beams=BEAMS, fleet_ingest_buckets=(4,),
+        )
+        assert len(pod.staging_pools) == 2
+        for s, sh in enumerate(pod.shards):
+            sh._ensure_byte_ingest()
+            host = pod.topology.host_of(s)
+            assert sh.fleet_ingest.staging is pod.staging_pools[host]
+        # single-host pod: every shard shares the ONE pool
+        pod1 = ElasticFleetService(
+            _params(fleet_ingest_backend="fused"), 4, shards=2,
+            beams=BEAMS, fleet_ingest_buckets=(4,),
+        )
+        assert len(pod1.staging_pools) == 1
+        for sh in pod1.shards:
+            sh._ensure_byte_ingest()
+        assert (
+            pod1.shards[0].fleet_ingest.staging
+            is pod1.shards[1].fleet_ingest.staging
+        )
