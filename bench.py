@@ -205,6 +205,7 @@ GRADED = {
     19: ("elastic_serving", POINTS, dict(window=WINDOW)),  # traffic-shaped serving A/B
     20: ("async_serving", POINTS, dict(window=WINDOW)),  # link-latency-hiding A/B
     21: ("pod_scaleout", POINTS, dict(window=WINDOW)),  # steal+autoscale pod A/B
+    22: ("map_serving", POINTS, dict(window=WINDOW)),  # merged-world tile serving A/B
 }
 
 
@@ -4736,6 +4737,401 @@ def bench_pod_scaleout(smoke: bool = False) -> dict:
     }
 
 
+def bench_map_serving(smoke: bool = False) -> dict:
+    """Config 22 — map-as-a-service A/B: the device-resident
+    cross-stream world merge + quantized tile snapshot serving
+    (mapping/worldmap + mapping/tiles, ISSUE 18) against the
+    per-stream full-grid pull baseline.
+
+    Two pods run the SAME tick-paired traffic (alternating order, like
+    every paired config):
+
+      * ``tiles`` — the world map attached: finalized submaps align
+        once on the host, fuse into ONE device int32 accumulation
+        (associative — merge order cannot matter), and versioned
+        quantized tile snapshots publish on the drain's idle staging
+        half (the PR-16 ``overlap_work`` hook).  A map READ
+        reconstructs the serving grid from the held snapshot — pure
+        host work over immutable arrays, ZERO dispatches, zero
+        stalls.
+      * ``pull`` — no world: a map read must fetch every live
+        stream's full (G, G) int32 plane off the device and fuse on
+        the host — the per-read link+fuse cost the tile plane
+        amortizes into its publish cadence.
+
+    Structural claims (violations raise — bugs, not weather):
+
+      * byte-equal SCAN outputs across arms, whole run — serving is
+        read-side only and never changes what the drain publishes;
+      * dispatch-count identity: every shard's per-rung compiled
+        dispatch counters are IDENTICAL across arms, and the read
+        loop moves no counter — merging rides the drain it joined,
+        serving adds zero dispatches (the acceptance pin);
+      * merge order-independence at bench scale: the device
+        accumulation is byte-equal to the numpy oracle's plain sum of
+        the member planes, under shuffled orders AND split partial
+        sums (the cross-shard case);
+      * bounded residency: membership stayed at the cap, evictions
+        fired, and resident bytes never exceeded the closed-form
+        bound;
+      * quantization honesty: the served grid sits within the
+        backend's published error bound of the clamped accumulation,
+        level-0 cells exactly zero;
+      * compression: the published payload beats the dense int32 grid
+        by >= 3x (the capacity headline);
+      * zero recompiles / zero implicit transfers across merge,
+        publish, eviction AND the read loop under
+        utils/guards.steady_state (the accumulation fetch and the
+        baseline pulls are EXPLICIT device_get — allowed; anything
+        implicit raises).
+
+    The artifact carries the clamped ``map_serving_ab`` decision key
+    (scripts/decide_backends.py: TPU records only — on this CPU rig
+    the "link" the tile plane hides is a host memcpy).  ``smoke``
+    shrinks geometry to a seconds-scale CPU run — the tier-1 gate
+    (tests/test_bench_meta.py), same code path, same metric name,
+    ``"smoke": true``."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.mapping.tiles import snapshot_grid
+    from rplidar_ros2_driver_tpu.ops.tile_quant import fuse_planes_np
+    from rplidar_ros2_driver_tpu.parallel.service import ElasticFleetService
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    if smoke:
+        window, beams, vgrid = 4, 256, 32
+        points_per_rev, capacity = 800, 1024
+        map_grid, map_cell = 64, 0.1
+        streams, shards, run = 4, 2, 4
+        world_cap, merge_revs, publish_ticks = 4, 1, 2
+        wall_len = 22
+    else:
+        window, beams, vgrid = WINDOW, BEAMS, GRID
+        points_per_rev, capacity = POINTS, CAPACITY
+        map_grid, map_cell = 256, 0.05
+        streams, shards, run = 6, 3, 8
+        world_cap, merge_revs, publish_ticks = 8, 1, 4
+        wall_len = 40
+    ans = int(Ans.MEASUREMENT_DENSE_CAPSULED)
+    revs = -(-(wall_len * run * 40) // points_per_rev) + 2
+    data = [
+        _stream_data_ticks(
+            _denseboost_wire_frames(revs, points_per_rev),
+            run, ans, 1000.0 + 7.0 * s,
+        )
+        for s in range(streams)
+    ]
+    if any(len(d) < wall_len for d in data):
+        raise RuntimeError("scene too short for the serving trace")
+
+    def build(world_arm: bool):
+        params = DriverParams(
+            filter_chain=("clip", "median", "voxel"), filter_window=window,
+            voxel_grid_size=vgrid, voxel_cell_m=0.25,
+            fleet_ingest_backend="fused",
+            map_enable=True, map_backend="fused",
+            map_grid=map_grid, map_cell_m=map_cell,
+            shard_count=shards, failover_snapshot_ticks=4,
+            shard_starvation_ticks=4 * wall_len,
+            world_map_enable=world_arm,
+            map_tile_backend="auto",
+            world_tile_cells=8, world_max_submaps=world_cap,
+            world_merge_revs=merge_revs,
+            world_publish_ticks=publish_ticks,
+        )
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=beams,
+            capacity=capacity, fleet_ingest_buckets=(run,),
+        )
+        pod.attach_scheduler()
+        pod.precompile([ans])
+        if world_arm:
+            pod.attach_world_map()
+        return pod
+
+    pods = {"tiles": build(True), "pull": build(False)}
+    world = pods["tiles"].world
+    tcfg = world.cfg.tile
+    cursor = [0] * streams
+
+    def take(s: int):
+        got = data[s][cursor[s]:cursor[s] + 1]
+        cursor[s] += len(got)
+        return list(got) or None
+
+    outs = {name: [[] for _ in range(streams)] for name in pods}
+
+    def advance(name, items):
+        pod = pods[name]
+        pod.offer_bytes(items)
+        for i, g in enumerate(pod.drain_scheduled()):
+            outs[name][i].extend(g)
+
+    def dispatch_counts(name):
+        return [
+            dict(sh.fleet_ingest.rung_dispatches)
+            for sh in pods[name].shards
+            if sh.fleet_ingest is not None
+        ]
+
+    def read_tiles():
+        """The served read: reconstruct the full world grid from the
+        HELD snapshot — host-only, dispatch-free by construction."""
+        return snapshot_grid(world.snapshot())
+
+    def read_pull(name="pull"):
+        """The baseline read: pull every live stream's full int32
+        plane off the device (explicit fetch) and fuse on the host —
+        per-read link traffic the tile plane amortizes away."""
+        pod = pods[name]
+        acc = np.zeros((map_grid, map_grid), np.int64)
+        for s in range(shards):
+            sh = pod.shards[s]
+            if sh.mapper is None:
+                continue
+            for lane, stream in enumerate(pod.topology.lane_streams(s)):
+                if stream is None:
+                    continue
+                acc += np.asarray(
+                    sh.mapper.snapshot_stream(lane)["log_odds"], np.int64
+                )
+        return acc
+
+    read_times: dict = {"tiles": [], "pull": []}
+    max_resident = 0
+    resident_bound = (
+        map_grid * map_grid * 4 * (world_cap + 1) + map_grid * map_grid * 4
+    )
+    warm = 4
+
+    def run_tick(t, timed):
+        nonlocal max_resident
+        items = [take(s) for s in range(streams)]
+        for name in (
+            ("pull", "tiles") if t % 2 == 0 else ("tiles", "pull")
+        ):
+            advance(name, items)
+        if len(world._members) > world.cfg.max_submaps:
+            raise RuntimeError("world membership exceeded the cap")
+        max_resident = max(max_resident, world.resident_bytes)
+        if world.snapshot() is None:
+            return
+        # the paired read: both arms answer the same "give me the
+        # world" query this tick; reads must move NO dispatch counter
+        before = dispatch_counts("tiles")
+        x0 = time.perf_counter()
+        grid_a = read_tiles()
+        t_tiles = time.perf_counter() - x0
+        x0 = time.perf_counter()
+        grid_b = read_pull()
+        t_pull = time.perf_counter() - x0
+        if dispatch_counts("tiles") != before:
+            raise RuntimeError(
+                "a map read moved a dispatch counter — serving is "
+                "supposed to be dispatch-free"
+            )
+        if grid_a.shape != (map_grid, map_grid) or grid_b.shape != (
+            map_grid, map_grid,
+        ):
+            raise RuntimeError("read grids came back misshapen")
+        if timed:
+            read_times["tiles"].append(t_tiles)
+            read_times["pull"].append(t_pull)
+
+    for t in range(warm):
+        run_tick(t, False)
+    with guards.steady_state(tag="map-serving A/B pair"):
+        for t in range(warm, wall_len):
+            run_tick(t, True)
+
+    # -- structural claims --
+    if world.merges < world_cap + 1:
+        raise RuntimeError(
+            f"only {world.merges} merges — the trace never filled the "
+            "world membership"
+        )
+    if world.evictions < 1:
+        raise RuntimeError(
+            "no eviction fired — the bounded-residency claim was "
+            "never exercised"
+        )
+    if max_resident > resident_bound:
+        raise RuntimeError(
+            f"resident bytes {max_resident} exceeded the closed-form "
+            f"bound {resident_bound}"
+        )
+    if world.serving_version < 1 or world.snapshot() is None:
+        raise RuntimeError("no tile snapshot was ever published")
+    if not read_times["tiles"]:
+        raise RuntimeError("no paired reads were timed")
+    # dispatch identity: serving adds ZERO dispatches to the drain
+    if dispatch_counts("tiles") != dispatch_counts("pull"):
+        raise RuntimeError(
+            f"per-rung dispatch counters diverged between arms: "
+            f"{dispatch_counts('tiles')} != {dispatch_counts('pull')} "
+            "— the world merge/publish added dispatches to the drain"
+        )
+    # byte-equal scan outputs: serving is read-side only
+    for i in range(streams):
+        a, b = outs["tiles"][i], outs["pull"][i]
+        if len(a) != len(b) or not all(
+            np.array_equal(np.asarray(x.ranges), np.asarray(y.ranges))
+            and np.array_equal(np.asarray(x.voxel), np.asarray(y.voxel))
+            for x, y in zip(a, b)
+        ):
+            raise RuntimeError(
+                f"stream {i}: scan outputs diverged between the tiles "
+                "and pull arms — the world plane leaked into the drain"
+            )
+    # merge order-independence at bench scale: device accumulation ==
+    # numpy oracle under in-order, shuffled, and split partial sums
+    state = world.save_state()
+    member_planes = [m["plane"] for m in state["members"]]
+    acc = state["acc"]
+    oracle = fuse_planes_np(member_planes)
+    rng = np.random.default_rng(22)
+    shuffled = list(member_planes)
+    rng.shuffle(shuffled)
+    half = len(member_planes) // 2
+    partial = (
+        fuse_planes_np(member_planes[:half])
+        + fuse_planes_np(member_planes[half:])
+    )
+    if not (
+        np.array_equal(acc, oracle)
+        and np.array_equal(fuse_planes_np(shuffled), oracle)
+        and np.array_equal(partial, oracle)
+    ):
+        raise RuntimeError(
+            "merge order-independence broken: the device accumulation, "
+            "the shuffled-order fold and the split partial sums are "
+            "not byte-identical"
+        )
+    if len(member_planes) != min(world.merges, world.cfg.max_submaps):
+        raise RuntimeError("membership count disagrees with the ledger")
+    # quantization honesty: the served grid within the published bound
+    snap = world.snapshot()
+    served = snapshot_grid(snap)
+    clipped = np.clip(acc, 0, tcfg.clamp_q)
+    shift = tcfg.quant_shift
+    occ = (clipped >> shift) > 0 if shift else clipped > 0
+    if occ.any() and int(
+        np.abs(served[occ] - clipped[occ]).max()
+    ) > tcfg.error_bound:
+        raise RuntimeError(
+            "served grid exceeded the quantization error bound on "
+            "occupied cells"
+        )
+    if shift and not (served[~occ] == 0).all():
+        raise RuntimeError(
+            "level-0 cells reconstructed non-zero — unknown space "
+            "acquired phantom occupancy"
+        )
+    ratio = snap.compression_ratio
+    if ratio < 3.0:
+        raise RuntimeError(
+            f"compression ratio {ratio:.2f}x is below the 3x bar "
+            "against the dense int32 grid"
+        )
+
+    # -- the latency claim --
+    p50_tiles = float(np.percentile(read_times["tiles"], 50))
+    p50_pull = float(np.percentile(read_times["pull"], 50))
+    p99_tiles = float(np.percentile(read_times["tiles"], 99))
+    p99_pull = float(np.percentile(read_times["pull"], 99))
+    read_speedup = p99_pull / max(p99_tiles, 1e-9)
+    clamped = min(p50_tiles, p50_pull) < 50e-6
+    # the floor is a catastrophe bar, not a win bar (config-21
+    # precedent): on this CPU rig the "link" a pull crosses is a host
+    # memcpy, so the arms can sit within jitter of each other — but a
+    # tile read that DISPATCHES or recompiles is an order-of-magnitude
+    # regression the floor still catches
+    bar = 0.5 if smoke else 1.0
+    if not clamped and read_speedup < bar:
+        raise RuntimeError(
+            f"tile read p99 {p99_tiles * 1e3:.3f} ms regressed past "
+            f"the pull baseline {p99_pull * 1e3:.3f} ms (ratio "
+            f"{read_speedup:.3f} < {bar})"
+        )
+    reads = len(read_times["tiles"])
+    dt = float(np.sum(read_times["tiles"]))
+    value = reads / max(dt, 1e-9)
+    return {
+        "metric": metric_name(22),
+        "value": round(value, 2),
+        "unit": "reads/s",
+        "vs_baseline": round(value / BASELINE_SCANS_PER_SEC, 3),
+        "streams": streams,
+        "shards": shards,
+        "wall_ticks": wall_len,
+        "paired_reads": reads,
+        "tile_backend": tcfg.backend,
+        "tile_cells": tcfg.tile_cells,
+        "quant_shift": shift,
+        "error_bound_q": tcfg.error_bound,
+        "merges": world.merges,
+        "evictions": world.evictions,
+        "serving_version": world.serving_version,
+        "resident_bytes_max": max_resident,
+        "resident_bytes_bound": resident_bound,
+        "payload_bytes": snap.payload_bytes,
+        "raw_bytes": snap.raw_bytes,
+        "compression_ratio": round(ratio, 2),
+        "p50_tiles_ms": round(p50_tiles * 1e3, 4),
+        "p50_pull_ms": round(p50_pull * 1e3, 4),
+        "p99_tiles_ms": round(p99_tiles * 1e3, 4),
+        "p99_pull_ms": round(p99_pull * 1e3, 4),
+        "structural": {
+            "byte_equal_arms": True,                 # asserted above
+            "dispatch_count_identity": True,         # asserted above
+            "reads_moved_no_dispatch": True,         # asserted above
+            "merge_order_independent": True,         # asserted above
+            "cross_shard_partial_sums_equal": True,  # asserted above
+            "bounded_residency_with_evictions": True,  # asserted above
+            "quant_error_within_bound": True,        # asserted above
+            "compression_over_3x": True,             # asserted above
+            "zero_recompiles": True,            # steady_state guard
+            "zero_implicit_transfers": True,    # steady_state guard
+        },
+        # the decide_backends decision key: TPU records only, the
+        # clamp honored — the structure (zero dispatches, bounded
+        # bytes, exact merges) holds everywhere, but only a rig with
+        # a real device link can price the pulls the tile plane
+        # replaces
+        "map_serving_ab": {
+            "read_speedup": round(read_speedup, 4),
+            "compression_ratio": round(ratio, 2),
+            "merges": world.merges,
+            "evictions": world.evictions,
+            "ratio_clamped": clamped,
+        },
+        "ceiling_analysis": (
+            "the wins are structural: a served read touches only an "
+            "immutable host snapshot (zero dispatches, asserted by "
+            "counter identity), the merge is associative int32 "
+            "addition (byte-equal under shuffled orders and split "
+            "partial sums, asserted), and the published payload is "
+            f"{ratio:.1f}x smaller than the dense int32 grid it "
+            "replaces — bounded below by the level entropy of the "
+            "occupancy field, so the ratio GROWS with grid sparsity. "
+            "On this one-process CPU rig the baseline pull crosses a "
+            "host memcpy, not a device link, so the read-latency "
+            "ratio is a floor, not the claim: on a remote-attach or "
+            "on-chip rig every pull pays the real link round-trip "
+            "per stream per read, while the tile arm pays it once "
+            "per publish cadence.  The on-chip capture queued in "
+            "scripts/rig_recapture.sh is where the latency headline "
+            "lands."
+        ),
+        "points_per_rev": points_per_rev,
+        "window": window,
+        "beams": beams,
+        "map_grid": map_grid,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 class _DriftingFrontEnd:
     """Scripted SLAM front-end for the config-17 back-end A/B: maps are
     rasterized at CALLER-SUPPLIED (drift-injected) poses with no
@@ -5116,6 +5512,7 @@ def metric_name(config: int) -> str:
         19: "elastic_serving_adaptive_scans_per_sec",
         20: "async_serving_overlapped_scans_per_sec",
         21: "pod_scaleout_balanced_scans_per_sec",
+        22: "map_serving_tile_reads_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -5151,6 +5548,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_async_serving()
     if kind == "pod_scaleout":
         return bench_pod_scaleout()
+    if kind == "map_serving":
+        return bench_map_serving()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -5602,6 +6001,20 @@ if __name__ == "__main__":
         "pod-of-pods serving plane",
     )
     ap.add_argument(
+        "--smoke-map-serving",
+        action="store_true",
+        help="seconds-scale CPU run of the config-22 map-as-a-service "
+        "A/B (small geometry, forced CPU backend, no tunnel probe): "
+        "asserts a served tile read moves zero dispatch counters, the "
+        "device merge is byte-equal to the numpy oracle under "
+        "shuffled orders and split partial sums, eviction keeps "
+        "resident bytes under the closed-form bound, the served grid "
+        "sits within the quantization error bound, the published "
+        "payload beats the dense int32 grid by >= 3x, and the drain's "
+        "scan outputs are byte-equal with serving on — the tier-1 "
+        "regression gate for the shared-world mapping plane",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -5728,6 +6141,16 @@ if __name__ == "__main__":
         # link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_pod_scaleout(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_map_serving:
+        # same CPU-only discipline: the world-serving structural gate
+        # (dispatch-count identity, merge order-independence, bounded
+        # residency with evictions, quantization error bounds, the 3x
+        # compression bar, byte-equal scan outputs) must run anywhere,
+        # device link or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_map_serving(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

@@ -254,6 +254,29 @@ class DriverParams:
     # loop-constraint plane capacity (dense padded; the solver plane is
     # loop_max_submaps odometry rows + this many loop rows)
     pose_graph_max_constraints: int = 16
+    # -- shared-world mapping plane (mapping/worldmap.WorldMap +
+    # mapping/tiles.py + ops/tile_quant.py) --
+    # attach the fleet-wide world map: finalized per-stream submaps are
+    # aligned against a fixed reference (the matcher's bit-exact host
+    # twin, loop-closure search radii), fused into ONE device-resident
+    # int32 accumulation (associative addition — any merge order is
+    # byte-identical; eviction subtracts exactly), and served as
+    # versioned quantized run-length tile snapshots published on the
+    # idle staging half (a map read adds zero dispatches to a drain).
+    # Requires map_enable (the world is made of the mapper's submaps).
+    world_map_enable: bool = False
+    # tile serving backend seam: "raw" = dense int32 tiles (lossless —
+    # the A/B baseline arm); "int8"/"int4" = SR-LIO++-style quantized
+    # levels + run-length coding (mapping/tiles.resolve_map_tile_backend
+    # — bounded band-midpoint error, tests pin it); "auto" = int8
+    # (capacity feature with a validated error bound, so auto does not
+    # wait on on-chip evidence; the `map_serving_ab` decide_backends
+    # key governs only the serving-latency claim, TPU records only).
+    map_tile_backend: str = "auto"
+    world_tile_cells: int = 8         # tile edge (cells; must divide map_grid)
+    world_max_submaps: int = 16       # world membership cap (= graph nodes)
+    world_merge_revs: int = 4         # revolutions between cross-stream merges
+    world_publish_ticks: int = 8      # drain ticks between tile publications
     # -- de-skew + sweep reconstruction (ops/deskew.py, fused ingest) --
     # per-revolution range-only de-skew + caching-aware sweep
     # reconstruction INSIDE the fused ingest core
@@ -709,6 +732,33 @@ class DriverParams:
             )
         if self.pose_graph_iters < 1:
             raise ValueError("pose_graph_iters must be >= 1")
+        if self.map_tile_backend not in ("auto", "raw", "int8", "int4"):
+            raise ValueError(
+                "map_tile_backend must be 'auto', 'raw', 'int8' or "
+                "'int4'"
+            )
+        if self.world_map_enable and not self.map_enable:
+            raise ValueError(
+                "world_map_enable requires map_enable (the shared "
+                "world is fused from the mapper's finalized submaps)"
+            )
+        if self.world_tile_cells < 1:
+            raise ValueError("world_tile_cells must be >= 1")
+        if self.map_grid % self.world_tile_cells != 0:
+            raise ValueError(
+                "world_tile_cells must divide map_grid (partial edge "
+                "tiles would give one cell two serving addresses)"
+            )
+        if not (2 <= self.world_max_submaps <= 64):
+            raise ValueError(
+                "world_max_submaps must be within [2, 64] (a reference "
+                "plus at least one member; the cap sizes the "
+                "inter-stream pose graph)"
+            )
+        if self.world_merge_revs < 1:
+            raise ValueError("world_merge_revs must be >= 1")
+        if self.world_publish_ticks < 1:
+            raise ValueError("world_publish_ticks must be >= 1")
         rungs = tuple(self.sched_rungs)
         if not rungs or any(
             not isinstance(r, int) or isinstance(r, bool) for r in rungs

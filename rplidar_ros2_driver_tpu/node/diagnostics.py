@@ -66,6 +66,7 @@ class DiagnosticsUpdater:
         shard_topology: Optional[dict] = None,
         scheduler: Optional[dict] = None,
         pod: Optional[dict] = None,
+        world_map: Optional[dict] = None,
     ) -> DiagnosticStatus:
         level, message = summarize(lifecycle, fsm_state)
         values = {
@@ -248,6 +249,22 @@ class DiagnosticsUpdater:
                 values["Autoscaler"] = (
                     f"{auto.get('state', '?')} (occ {occ_s})"
                 )
+        if world_map:
+            # the shared-world serving plane (mapping/worldmap.status())
+            values["World Map"] = (
+                f"{world_map.get('backend', '?')} "
+                f"v{world_map.get('serving_version', 0)}"
+            )
+            values["World Tiles"] = str(world_map.get("tiles", 0))
+            values["World Resident Bytes"] = str(
+                world_map.get("resident_bytes", 0)
+            )
+            ratio = world_map.get("compression_ratio", 0.0)
+            values["World Compression"] = f"{ratio:.2f}x"
+            values["World Merges"] = str(world_map.get("merges", 0))
+            values["World Evictions"] = str(
+                world_map.get("evictions", 0)
+            )
         status = DiagnosticStatus(
             level=level,
             name="rplidar_node: Device Status",

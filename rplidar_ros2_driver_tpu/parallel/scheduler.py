@@ -593,6 +593,22 @@ class TrafficShaper:
             self.shed_total += 1
         return nbytes
 
+    def shed_stream(self, i: int) -> int:
+        """Shed a stream's ENTIRE backlog through the oldest-tick-shed
+        counters — the autoscaler's park pre-shed.  A scale-down that
+        would strand queued ticks on a parked shard sheds them here
+        first, so the shed shows up in the same ``admission_drops`` /
+        ``shed_total`` ledger operators already watch (a stranded
+        queue silently dying is the failure mode this replaces).
+        Returns the number of ticks shed."""
+        q = self.queues[i]
+        n = len(q)
+        if n:
+            q.clear()
+            self.admission_drops[i] += n
+            self.shed_total += n
+        return n
+
     def offer_tick(self, items: Sequence) -> None:
         """Admit one wall tick of arrivals: ``items[i]`` is None (idle),
         one ``(ans_type, [(payload, ts), ...])`` data tick, or a list

@@ -162,6 +162,11 @@ class ShardedFilterService:
         self.loop = None
         self.last_loop: list = [None] * streams
         self.last_corrected_poses: list = [None] * streams
+        # shared-world mapping seam (mapping/worldmap.WorldMap): when
+        # attached, finalized submaps fuse into the fleet-wide
+        # device-resident accumulation and versioned tile snapshots
+        # publish on the idle staging half (see attach_world_map)
+        self.world = None
         # fleet fault-tolerance seam (driver/health.py FleetHealth):
         # when attached, every live byte tick runs the per-stream health
         # FSMs — quarantined streams are masked onto the existing idle
@@ -296,6 +301,10 @@ class ShardedFilterService:
         # a guarded steady-state loop must never pay an XLA compile
         engine.precompile()
         self.loop = engine
+        if self.world is not None:
+            # the world consumes the engine's finalization product from
+            # here on (one quantize path; the cadence pull retires)
+            engine.on_install = self._world_install
         return engine
 
     def _loop_tick(self) -> None:
@@ -318,6 +327,75 @@ class ShardedFilterService:
         when no engine is attached)."""
         return None if self.loop is None else self.loop.status()
 
+    def attach_world_map(self, world=None) -> "object":
+        """Attach the shared-world mapping plane (built here from this
+        service's params when not given): finalized per-stream submaps
+        are aligned against the world reference and fused into ONE
+        device-resident int32 accumulation, with versioned quantized
+        tile snapshots published on the idle half of the staging
+        double buffer (:meth:`drain_scheduled` chains the publication
+        onto the ``overlap_work`` hook — a map read never adds a
+        dispatch).  Requires an attached mapper; with a loop engine
+        attached the world consumes the engine's OWN finalization
+        product through its ``on_install`` tap (one quantize path, no
+        second pull), otherwise the world pulls row snapshots at its
+        ``world_merge_revs`` cadence.  Returns the attached world."""
+        if self.mapper is None:
+            self.attach_mapper()
+        if world is None:
+            from rplidar_ros2_driver_tpu.mapping.worldmap import (
+                WorldMap,
+                world_config_from_params,
+            )
+
+            world = WorldMap(
+                world_config_from_params(self.params, self.mapper.cfg)
+            )
+        # warm both fusion executables NOW (the mapper precompile
+        # discipline): a merge inside a guarded steady-state loop must
+        # never pay an XLA compile
+        world.precompile()
+        self.world = world
+        if self.loop is not None:
+            self.loop.on_install = self._world_install
+        return world
+
+    def _world_install(self, i: int, plane, anchor) -> None:
+        """The loop engine's finalization tap: the exact quantized
+        plane the submap library stored fuses into the world."""
+        if self.world is not None:
+            self.world.ingest_submap(i, plane, anchor)
+
+    def _world_tick(self) -> None:
+        """Feed the attached world map (no-op without one).  With a
+        loop engine the merges already arrived through its
+        ``on_install`` tap; without one, streams whose revolution count
+        crossed the ``world_merge_revs`` cadence contribute a row
+        snapshot quantized through the ONE finalization path
+        (mapping/submap.quantize_submap_plane)."""
+        if self.world is None or self.loop is not None:
+            return
+        from rplidar_ros2_driver_tpu.mapping.submap import (
+            quantize_submap_plane,
+        )
+
+        for i, est in enumerate(self.last_poses):
+            if est is None:
+                continue
+            rev = int(est.revision)
+            if self.world.merge_due(i, rev):
+                snap = self.mapper.snapshot_stream(i)
+                plane = quantize_submap_plane(
+                    snap["log_odds"], self.mapper.cfg
+                )
+                self.world.ingest_submap(i, plane, snap["pose"])
+                self.world.note_merged(i, rev)
+
+    def world_status(self) -> Optional[dict]:
+        """The /diagnostics "World Map" value group's payload (None
+        when no world is attached)."""
+        return None if self.world is None else self.world.status()
+
     def _map_tick(self, outs: list) -> list:
         """Feed one materialized tick to the attached mapper (no-op
         without one); stashes and returns the per-stream estimates."""
@@ -325,6 +403,7 @@ class ShardedFilterService:
             return outs
         self.last_poses = self.mapper.submit(outs)
         self._loop_tick()
+        self._world_tick()
         return outs
 
     def _map_tick_recon(self) -> None:
@@ -355,6 +434,7 @@ class ShardedFilterService:
         )
         self.last_poses = self.mapper.submit_points(points, masks, live)
         self._loop_tick()
+        self._world_tick()
 
     def _map_tick_fused(self) -> None:
         """The FUSED mapping seam (fused_mapping_backend='fused'): the
@@ -373,6 +453,7 @@ class ShardedFilterService:
         self.last_poses = self.mapper.absorb_wires(wires, recons)
         if any(p is not None for p in self.last_poses):
             self._loop_tick()
+            self._world_tick()
 
     # -- fault tolerance seam -----------------------------------------------
 
@@ -611,21 +692,37 @@ class ShardedFilterService:
         if eng is not None and eng.double_buffer and self.health is not None:
             deferred = []
             self._defer_checkpoints = deferred
+        # due world-map tile publications ride the same idle half: the
+        # hook is pure host work from one explicit accumulation fetch,
+        # so serving adds ZERO dispatches to this drain (the config-22
+        # dispatch-count identity)
+        overlapped_world = (
+            self.world is not None
+            and eng is not None
+            and eng.double_buffer
+        )
+        world_pub = self.world.overlap_hook() if overlapped_world else None
 
-        def _overlap(deferred=deferred) -> None:
+        def _overlap(deferred=deferred, world_pub=world_pub) -> None:
             # the idle half of the double buffer: quarantine
             # checkpoints pulled while the drain's compute is still in
             # flight (see _quarantine_stream's deferral gate for the
-            # byte-equality argument)
+            # byte-equality argument), then the due tile publication
             self._defer_checkpoints = None
             while deferred:
                 self._quarantine_stream(deferred.pop(0))
+            if world_pub is not None:
+                world_pub()
 
         t0 = time.perf_counter()
         try:
             outs = self.submit_bytes_backlog(
                 ticks, rung=rung,
-                overlap_work=_overlap if deferred is not None else None,
+                overlap_work=(
+                    _overlap
+                    if deferred is not None or world_pub is not None
+                    else None
+                ),
             )
         finally:
             self._defer_checkpoints = None
@@ -638,6 +735,11 @@ class ShardedFilterService:
             0, len(ticks), time.perf_counter() - t0,
             rung=rung, bucket=eng.slicing_bucket,
         )
+        if self.world is not None and not overlapped_world:
+            # no idle half to ride (single-buffered engine): publish in
+            # the epilogue — still dispatch-free, just not overlapped
+            if self.world.tick():
+                self.world.publish()
         return outs
 
     def scheduler_status(self) -> Optional[dict]:
@@ -1669,6 +1771,17 @@ class ElasticFleetService:
         self.scale_events: list = []
         self.steal_drops = 0
         self._stolen_this_tick: set = set()
+        # autoscale-aware admission: queued ticks a park decision would
+        # strand are pre-shed through the shaper's oldest-tick-shed
+        # counters (_park_shard) instead of dying silently on the
+        # parked shard
+        self.park_sheds = 0
+        # pod-level shared-world mapping plane (attach_world_map): ONE
+        # WorldMap fused from every shard's finalized submaps — the
+        # cross-shard merge the associative accumulation makes
+        # order-free — publishing on the first drained shard's idle
+        # staging half each cadence edge
+        self.world = None
 
     # -- warmup ------------------------------------------------------------
 
@@ -1714,6 +1827,92 @@ class ElasticFleetService:
                 self._fresh_snap["loop"] = (
                     self.shards[0].loop.snapshot_stream(0)
                 )
+
+    # -- shared-world mapping seam -----------------------------------------
+
+    def attach_world_map(self, world=None) -> "object":
+        """Attach ONE pod-level shared world (built from params when
+        not given): every shard's finalized submaps fuse into the same
+        device-resident accumulation — the CROSS-SHARD merge, order-
+        free because the fusion is associative int32 addition — and a
+        due tile publication rides the first drained shard's idle
+        staging half each pod drain (:meth:`drain_scheduled`).  Shards
+        with a loop engine feed through its ``on_install`` tap (the
+        lane resolves to its global stream at install time); shards
+        without one contribute row snapshots at the
+        ``world_merge_revs`` cadence after their drain.  Requires
+        :meth:`precompile` (the shard mappers must exist)."""
+        if self.shards[0].mapper is None:
+            raise RuntimeError(
+                "attach_world_map needs the shard mappers: run "
+                "precompile(formats) with map_enable first"
+            )
+        if world is None:
+            from rplidar_ros2_driver_tpu.mapping.worldmap import (
+                WorldMap,
+                world_config_from_params,
+            )
+
+            world = WorldMap(
+                world_config_from_params(
+                    self.params, self.shards[0].mapper.cfg
+                )
+            )
+        world.precompile()
+        self.world = world
+        for s, sh in enumerate(self.shards):
+            if sh.loop is not None:
+                sh.loop.on_install = self._make_world_tap(s)
+        return world
+
+    def _make_world_tap(self, s: int):
+        """A shard-bound loop-engine ``on_install`` tap: resolves the
+        installing LANE to its current global stream at call time (the
+        placement moves under steals and scale events) and fuses the
+        library's exact finalization product into the pod world."""
+
+        def tap(lane: int, plane, anchor) -> None:
+            if self.world is None:
+                return
+            tbl = self.topology.lane_streams(s)
+            stream = tbl[lane] if lane < len(tbl) else None
+            self.world.ingest_submap(
+                lane if stream is None else stream, plane, anchor
+            )
+
+        return tap
+
+    def _world_merge_shard(self, s: int, eff: list) -> None:
+        """The no-loop-engine merge path for shard ``s`` after its
+        drain: streams whose revolution count crossed the merge
+        cadence contribute a row snapshot quantized through the ONE
+        finalization path (mapping/submap.quantize_submap_plane)."""
+        sh = self.shards[s]
+        if self.world is None or sh.loop is not None or sh.mapper is None:
+            return
+        from rplidar_ros2_driver_tpu.mapping.submap import (
+            quantize_submap_plane,
+        )
+
+        for lane, stream in enumerate(eff):
+            if stream is None:
+                continue
+            est = sh.last_poses[lane]
+            if est is None:
+                continue
+            rev = int(est.revision)
+            if self.world.merge_due(stream, rev):
+                snap = sh.mapper.snapshot_stream(lane)
+                plane = quantize_submap_plane(
+                    snap["log_odds"], sh.mapper.cfg
+                )
+                self.world.ingest_submap(stream, plane, snap["pose"])
+                self.world.note_merged(stream, rev)
+
+    def world_status(self) -> Optional[dict]:
+        """The /diagnostics "World Map" value group's payload (None
+        when no world is attached)."""
+        return None if self.world is None else self.world.status()
 
     # -- chaos seam --------------------------------------------------------
 
@@ -1996,6 +2195,16 @@ class ElasticFleetService:
             stream for plans in steals.values() for stream, _src in plans
         }
         self._stolen_this_tick = stolen_away
+        # ONE due world-tile publication per pod drain: the first
+        # double-buffered shard's overlap hook claims it (idle-half
+        # host work — zero extra dispatches), the epilogue runs it if
+        # no shard could
+        world_box = {
+            "pub": (
+                self.world.overlap_hook()
+                if self.world is not None else None
+            )
+        }
         for s, hs in enumerate(self.shard_health):
             if not hs.hosting or s in self._parked:
                 continue
@@ -2050,19 +2259,28 @@ class ElasticFleetService:
             ]
             offered = any(any(it for it in lt) for lt in lane_ticks)
             overlap = None
-            if snap_due and eng is not None and eng.double_buffer:
+            if eng is not None and eng.double_buffer:
                 from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
 
-                if self.shards[s].mapper is None or is_carried(
-                    self.shards[s].mapper
-                ):
-                    # due failover snapshot pulls ride the idle half of
-                    # this shard's staging buffer (non-carried mappers
-                    # update AFTER the engine drain returns, so their
-                    # rows aren't final yet — those shards keep the
-                    # epilogue pull)
-                    def overlap(t=t, s=s):
-                        self._overlap_snapshots(t, s)
+                # due failover snapshot pulls ride the idle half of
+                # this shard's staging buffer (non-carried mappers
+                # update AFTER the engine drain returns, so their
+                # rows aren't final yet — those shards keep the
+                # epilogue pull)
+                do_snap = snap_due and (
+                    self.shards[s].mapper is None
+                    or is_carried(self.shards[s].mapper)
+                )
+                world_pub = world_box["pub"]
+                if do_snap or world_pub is not None:
+                    # this shard's overlap claims the due publication
+                    world_box["pub"] = None
+
+                    def overlap(t=t, s=s, do_snap=do_snap, wp=world_pub):
+                        if do_snap:
+                            self._overlap_snapshots(t, s)
+                        if wp is not None:
+                            wp()
 
             x0 = time.perf_counter()
             try:
@@ -2112,11 +2330,16 @@ class ElasticFleetService:
                     # deep the drained backlog (the per-tick seam's
                     # single append)
                     self._since_snap[stream].append(t)
+            self._world_merge_shard(s, eff)
             self._return_borrows(s, borrows)
             tr = hs.observe(offered, completed)
             if tr is not None and tr[1] is ShardState.LOST:
                 self._on_lost(s, hs.last_reason)
         self._stolen_this_tick = set()
+        if self.world is not None and world_box["pub"] is not None:
+            # no double-buffered shard claimed the due publication:
+            # publish in the epilogue (still dispatch-free)
+            world_box["pub"]()
         # unhosted streams' queues keep building toward the admission
         # bound (shed beyond it — bounded by contract); nothing to
         # exclude here, the data is still queued, not lost
@@ -2247,13 +2470,20 @@ class ElasticFleetService:
         if not active:
             return
         cfg = self.autoscaler.cfg
+        rates = self.scheduler.rates.rates()
+        # scale-down legality covers the LIVE streams (byte-rate EWMA
+        # over the floor), not the nominal fleet: a mostly-idle fleet
+        # may shrink below full-coverage capacity, because a stream a
+        # park would strand is pre-shed + snapshotted by _park_shard
+        # and restored by the scale-up rebalance — never silently lost
+        live = self.autoscaler.live_streams(rates)
         can_down = (
             len(active) > cfg.autoscale_min_shards
-            and (len(active) - 1) * self.topology.lanes >= self.streams
+            and (len(active) - 1) * self.topology.lanes >= live
         )
         can_up = bool(self._parked)
         d = self.autoscaler.note_tick(
-            self.scheduler.rates.rates(), len(active),
+            rates, len(active),
             can_down=can_down, can_up=can_up,
         )
         if d == "down":
@@ -2286,11 +2516,37 @@ class ElasticFleetService:
         )
         plan = self.topology.evacuate(s, avoid=avoid)
         if len(plan) != len(lane_of):
-            raise RuntimeError(
-                f"scale-down of shard {s} would strand "
-                f"{len(lane_of) - len(plan)} streams (capacity guard "
-                "out of sync with the topology)"
-            )
+            # survivors can't host every evacuee (the live-stream
+            # capacity relaxation): each stranded stream's queued
+            # backlog is PRE-SHED through the shaper's oldest-tick
+            # counters — the same admission_drops/shed_total ledger
+            # operators already watch, instead of ticks silently dying
+            # on the parked engine — and its final live row snapshots
+            # so the scale-up rebalance restores it (the src < 0 path
+            # of _unpark_shard)
+            from rplidar_ros2_driver_tpu.mapping.mapper import is_carried
+
+            moved = {stream for stream, _dst, _lane in plan}
+            sh = self.shards[s]
+            for stream in sorted(lane_of):
+                if stream in moved:
+                    continue
+                snap = {
+                    "ingest": sh.fleet_ingest.snapshot_stream(
+                        lane_of[stream]
+                    )
+                }
+                if sh.mapper is not None and not is_carried(sh.mapper):
+                    snap["map"] = sh.mapper.snapshot_stream(
+                        lane_of[stream]
+                    )
+                self._snap[stream] = (t, snap)
+                shed = (
+                    0 if self.scheduler is None
+                    else self.scheduler.shed_stream(stream)
+                )
+                self.park_sheds += shed
+                self.events.append((t, "park_shed", stream, s, shed))
         for stream, dst, lane in plan:
             self._move_row_live(stream, s, lane_of[stream], dst, lane)
             self.migrations += 1
@@ -2301,6 +2557,7 @@ class ElasticFleetService:
                 (t, "scale_down_migrated", stream, s, dst, lane)
             )
         self._parked.add(s)
+        self.streams_lost_unhosted = len(self.topology.unhosted())
         self.scale_events.append((t, "down", s))
         self.events.append((t, "scale_down", s))
         sh = self.shards[s]
@@ -2379,6 +2636,7 @@ class ElasticFleetService:
                 else self.scheduler.steal_ticks
             ),
             "steal_drops": self.steal_drops,
+            "park_sheds": self.park_sheds,
             "scale_downs": (
                 0 if self.autoscaler is None
                 else self.autoscaler.scale_downs

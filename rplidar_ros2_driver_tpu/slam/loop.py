@@ -47,7 +47,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 
@@ -190,6 +190,11 @@ class LoopClosureEngine:
         self._ncons = np.zeros((s,), np.int32)    # host ncons mirror
         self._last_final_rev = np.zeros((s,), np.int64)
         self._last_check_rev = np.zeros((s,), np.int64)
+        # world-map tap: called as on_install(stream, plane, anchor)
+        # after every submap finalization, with the exact quantized
+        # plane the library stored — the shared-world merge consumes
+        # the SAME finalization product (one path, no second pull)
+        self.on_install: Optional[Callable] = None
         self.reset_counters()
         self._install_state(self._fresh_states())
 
@@ -343,6 +348,8 @@ class LoopClosureEngine:
             self._valid[i, c] = 1
             self._count[i] = c + 1
             self.installs += 1
+            if self.on_install is not None:
+                self.on_install(i, plane, anchor)
 
     # -- hot path -----------------------------------------------------------
 

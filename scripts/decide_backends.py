@@ -49,6 +49,7 @@ _DECISION_KEYS = (
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
     "deskew_ab", "loop_close_ab", "fused_mapping_ab",
     "elastic_serving_ab", "async_serving_ab", "pod_scaleout_ab",
+    "map_serving_ab",
 )
 
 
@@ -572,6 +573,41 @@ def analyze(records: list[dict]) -> dict:
                     "scale_downs", "scale_ups", "hosts",
                     "ratio_clamped",
                 ) if k in psb
+            })
+
+        # config 22: merged-world tile serving vs per-stream full-grid
+        # pulls.  The read_speedup prices the link round-trips a
+        # served snapshot read avoids: >= 1.05 keeps the world map +
+        # tile plane on for map consumers.  The structure (zero added
+        # dispatches, byte-exact merges, bounded residency) holds on
+        # any rig, but only a real device link prices the pulls —
+        # CPU/interpret records carry no weight (device rule), and
+        # the timer-floor clamp records evidence without flipping.
+        msb = rec.get("map_serving_ab")
+        if isinstance(msb, dict):
+            v = msb.get("read_speedup")
+            if isinstance(v, (int, float)) and not msb.get(
+                "ratio_clamped"
+            ):
+                flip = v >= MARGIN
+                recommend("map_serving.tpu", {
+                    "current": "per-stream full-grid pulls",
+                    "recommended": (
+                        "world map + tile snapshot serving" if flip
+                        else "per-stream full-grid pulls"
+                    ),
+                    "flip": flip,
+                    "key": "config22 read_speedup",
+                    "value": 1.0 if flip else float(min(v, 1.0)),
+                    "measured": float(v),
+                    "margin": MARGIN,
+                    "source": "map_serving_ab",
+                })
+            out["evidence"].setdefault("map_serving_ab", []).append({
+                k: msb[k] for k in (
+                    "read_speedup", "compression_ratio", "merges",
+                    "evictions", "ratio_clamped",
+                ) if k in msb
             })
 
         # ablation: resample + voxel kernels

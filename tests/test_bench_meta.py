@@ -35,6 +35,7 @@ def test_metric_names_stable():
     assert bench.metric_name(19) == "elastic_serving_adaptive_scans_per_sec"
     assert bench.metric_name(20) == "async_serving_overlapped_scans_per_sec"
     assert bench.metric_name(21) == "pod_scaleout_balanced_scans_per_sec"
+    assert bench.metric_name(22) == "map_serving_tile_reads_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -44,7 +45,7 @@ def test_graded_table_well_formed():
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
             "fused_mapping", "elastic_serving", "async_serving",
-            "pod_scaleout",
+            "pod_scaleout", "map_serving",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -1675,6 +1676,121 @@ def test_decide_backends_pod_scaleout_key():
     got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
     assert (
         got["recommendations"]["pod_scaleout.tpu"]["flip"] is False
+    )
+
+
+def test_bench_smoke_map_serving():
+    """`bench.py --smoke-map-serving` — the tier-1 gate for the
+    shared-world mapping plane (config-22 A/B at seconds-scale CPU
+    geometry).  The structural claims are what matters: a served tile
+    read moves ZERO dispatch counters, the device merge is byte-equal
+    to the numpy oracle under shuffled orders and split partial sums
+    (the cross-shard case), eviction keeps resident bytes under the
+    closed-form bound, the served grid sits within the quantization
+    error bound with level-0 cells exactly zero, the published
+    payload beats the dense int32 grid by >= 3x, and the drain's scan
+    outputs are byte-equal across the tiles/pull arms (the bench
+    itself raises on violation; this gate pins that the asserted
+    artifact lands).  The read-latency ratio is a catastrophe floor
+    on a one-process CPU rig; the latency headline belongs to
+    on-chip captures."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-map-serving"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(22)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    for claim in (
+        "byte_equal_arms", "dispatch_count_identity",
+        "reads_moved_no_dispatch", "merge_order_independent",
+        "cross_shard_partial_sums_equal",
+        "bounded_residency_with_evictions", "quant_error_within_bound",
+        "compression_over_3x", "zero_recompiles",
+        "zero_implicit_transfers",
+    ):
+        assert s[claim] is True, claim
+    # the world ledger: membership filled past the cap (evictions
+    # fired), snapshots published, residency stayed under the
+    # closed-form bound
+    assert out["merges"] > out["evictions"] > 0
+    assert out["serving_version"] >= 1
+    assert out["resident_bytes_max"] <= out["resident_bytes_bound"]
+    # the capacity headline: RLE-over-quantized beats the dense int32
+    # grid it replaces
+    assert out["compression_ratio"] >= 3.0
+    assert 0 < out["payload_bytes"] < out["raw_bytes"]
+    assert out["paired_reads"] > 0 and out["value"] > 0
+    # the decision key rides with its clamp flag
+    ab = out["map_serving_ab"]
+    assert "read_speedup" in ab
+    assert isinstance(ab["ratio_clamped"], bool)
+    assert ab["compression_ratio"] >= 3.0
+    assert ab["merges"] > 0 and ab["evictions"] > 0
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_map_serving_key():
+    """The map_serving recommendation flips from config-22 evidence
+    alone: an unclamped TPU record with read_speedup above the noise
+    margin recommends the world map + tile snapshot serving for map
+    consumers; CPU records and clamped ratios never flip, and the
+    floor-asymmetric strength merge keeps an above-parity noise
+    record from displacing committed degradation evidence (the
+    pod_scaleout_ab discipline)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    def rec(dev, speedup, clamped=False):
+        return {
+            "device": dev,
+            "map_serving_ab": {
+                "read_speedup": speedup,
+                "compression_ratio": 12.5,
+                "merges": 18,
+                "evictions": 10,
+                "ratio_clamped": clamped,
+            },
+        }
+
+    got = db.analyze([rec("tpu", 4.0)])
+    r = got["recommendations"]["map_serving.tpu"]
+    assert r["flip"] is True
+    assert r["recommended"] == "world map + tile snapshot serving"
+    assert r["measured"] == 4.0
+    # CPU record: reported, never flips (the pull baseline crosses a
+    # host memcpy on a one-process rig, not a device link)
+    got = db.analyze([rec("cpu", 7.0)])
+    assert "map_serving.tpu" not in got["recommendations"]
+    assert got["non_tpu_ignored"]
+    # clamped ratio: evidence only
+    got = db.analyze([rec("tpu", 7.0, clamped=True)])
+    assert "map_serving.tpu" not in got["recommendations"]
+    assert got["evidence"]["map_serving_ab"]
+    # below the margin: keep the pulls
+    got = db.analyze([rec("tpu", 1.01)])
+    r = got["recommendations"]["map_serving.tpu"]
+    assert r["flip"] is False
+    assert "pulls" in r["recommended"]
+    # floor-asymmetric strength merge: a committed degradation record
+    # outweighs a later above-parity noise record
+    got = db.analyze([rec("tpu", 0.6), rec("tpu", 1.3)])
+    assert (
+        got["recommendations"]["map_serving.tpu"]["flip"] is False
     )
 
 

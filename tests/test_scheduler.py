@@ -316,6 +316,22 @@ class TestTrafficShaperAdmission:
         ticks, _ = sh.drain_plan(0, [0, 1])
         assert len(ticks) == 3
 
+    def test_shed_stream_clears_through_the_ledger(self):
+        """The autoscaler's park pre-shed: a whole queue sheds through
+        the SAME admission_drops/shed_total counters the oldest-tick
+        bound uses — operators watch one ledger."""
+        sh = TrafficShaper(2, SchedulerConfig(max_backlog_ticks=8))
+        sh.offer_tick([
+            [self._tick(1), self._tick(2), self._tick(3)],
+            self._tick(4),
+        ])
+        assert sh.shed_stream(0) == 3
+        assert sh.backlog_depths() == [0, 1]
+        assert sh.admission_drops == [3, 0] and sh.shed_total == 3
+        # an empty queue sheds nothing and leaves the ledger alone
+        assert sh.shed_stream(0) == 0
+        assert sh.admission_drops == [3, 0] and sh.shed_total == 3
+
     def test_drain_plan_front_aligns_unequal_queues(self):
         sh = TrafficShaper(3, SchedulerConfig(rungs=(1, 2, 4)))
         sh.offer_tick([[self._tick(1), self._tick(2)], self._tick(3), None])
@@ -1171,6 +1187,85 @@ class TestPodDiagnostics:
         assert "Pod Host 0" in status.values
         assert status.values["Steals"] == "0"
         assert status.values["Autoscaler"].startswith("steady")
+
+
+class TestAutoscaleParkShed:
+    def _tick(self, n=1):
+        return (DENSE, [(b"\xa5" * 84, 1.0 + 0.001 * k) for k in range(n)])
+
+    def test_park_pre_sheds_stranded_backlog_then_unpark_restores(self):
+        """The autoscale-aware admission cycle: a scale-down past
+        full-coverage capacity must not silently strand queued ticks on
+        the parked engine.  The FIRST park the survivors can absorb
+        moves every row live and leaves the ledger untouched; a SECOND
+        park (capacity now below coverage — the live-stream relaxation)
+        pre-sheds each stranded stream's backlog through the shaper's
+        admission ledger (``park_sheds`` mirrors the total pod-side)
+        and snapshots the live row, and the scale-up rebalance restores
+        the stream from that snapshot — park -> shed -> unpark, fully
+        accounted."""
+        from test_chaos import _map_params
+        from rplidar_ros2_driver_tpu.parallel.service import (
+            ElasticFleetService,
+        )
+
+        streams, shards = 6, 3
+        params = _map_params(
+            fleet_ingest_backend="fused", map_backend="fused",
+            shard_count=shards, failover_snapshot_ticks=4,
+            shard_starvation_ticks=500, sched_rungs=(1, 2),
+            autoscale_enable=True,
+        )
+        pod = ElasticFleetService(
+            params, streams, shards=shards, beams=BEAMS,
+            fleet_ingest_buckets=(8,),
+        )
+        pod.attach_scheduler()
+        pod.precompile([DENSE])
+        for _ in range(2):      # live rows everywhere
+            pod.offer_bytes([self._tick()] * streams)
+            pod.drain_scheduled()
+        # first park: the survivors' idle lanes absorb every evacuee
+        pod._park_shard(2)
+        assert pod.park_sheds == 0
+        assert pod.scheduler.shed_total == 0
+        assert pod.topology.unhosted() == []
+        assert not [e for e in pod.events if e[1] == "park_shed"]
+        # second park: the survivors are full — every hosted stream
+        # strands, with queued backlog the park must not silently drop
+        victim = 1
+        stranded = sorted(pod.topology.streams_on(victim))
+        assert stranded
+        pod.offer_bytes([self._tick()] * streams)
+        depth = {s: len(pod.scheduler.queues[s]) for s in stranded}
+        assert all(d > 0 for d in depth.values())
+        drops_before = list(pod.scheduler.admission_drops)
+        pod._park_shard(victim)
+        assert pod.park_sheds == sum(depth.values()) > 0
+        assert pod.pod_status()["park_sheds"] == pod.park_sheds
+        assert pod.scheduler.shed_total >= pod.park_sheds
+        for s in stranded:
+            assert len(pod.scheduler.queues[s]) == 0
+            assert (
+                pod.scheduler.admission_drops[s]
+                == drops_before[s] + depth[s]
+            )
+            assert s in pod._snap    # the live row snapshotted
+        shed_events = [e for e in pod.events if e[1] == "park_shed"]
+        assert {e[2] for e in shed_events} == set(stranded)
+        assert sorted(pod.topology.unhosted()) == stranded
+        assert pod.streams_lost_unhosted == len(stranded)
+        assert pod.pod_status()["parked"] == [1, 2]
+        # unpark: the rebalance re-homes the stranded streams from
+        # their snapshots (the src < 0 restore path)
+        pod._unpark_shard(victim)
+        assert pod.topology.unhosted() == []
+        assert pod.streams_lost_unhosted == 0
+        assert pod.pod_status()["parked"] == [2]
+        # the restored fleet keeps serving
+        pod.offer_bytes([self._tick()] * streams)
+        outs = pod.drain_scheduled()
+        assert len(outs) == streams
 
 
 # The zero-recompile / zero-implicit-transfer pin for mid-run rung

@@ -1,0 +1,729 @@
+"""Shared-world mapping plane suite (mapping/worldmap + mapping/tiles
++ ops/tile_quant) — ROADMAP item 1's map-as-a-service layer.
+
+The contracts under test:
+
+  * QUANTIZATION — int8/int4 level coding round-trips within the
+    published bound (band midpoint for occupied cells, EXACT zero for
+    level 0 — unknown space never acquires phantom occupancy), nibble
+    packing and run-length coding are lossless, long runs split at the
+    16-bit wire cap.
+  * FUSION GROUP — device fuse/retract match the numpy twin; merge
+    order (in-arrival, shuffled, cross-shard partial sums) lands a
+    byte-identical accumulation, and eviction subtracts a member's
+    exact fused plane back out (``fuse_planes_np`` is the oracle).
+  * ALIGNMENT — a whole-cell-translated copy of the reference aligns
+    back byte-exactly (the corner-anchored pseudo-scan's sharp
+    maximum), and the alignment doubles as the inter-stream pose-graph
+    constraint.
+  * SERVING — versioned immutable tile snapshots at the publish
+    cadence, resident bytes bounded under eviction, compression over
+    the dense grid, save/load byte-exact restore.
+  * WIRING — the 6 new params validate, /diagnostics renders the
+    "World Map" group (absent when off), and both services feed the
+    world through the loop-engine tap or the cadence pull.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from rplidar_ros2_driver_tpu.mapping.tiles import (
+    TileConfig,
+    publish_tiles,
+    resolve_map_tile_backend,
+    snapshot_grid,
+)
+from rplidar_ros2_driver_tpu.mapping.worldmap import (
+    WORLD_STATE_VERSION,
+    WorldConfig,
+    WorldMap,
+    shift_plane_np,
+    world_config_from_params,
+)
+from rplidar_ros2_driver_tpu.ops.loop_close import derive_match_config
+from rplidar_ros2_driver_tpu.ops.scan_match import SUB, MapConfig
+from rplidar_ros2_driver_tpu.ops.tile_quant import (
+    RUN_LEN_MAX,
+    dequantize_plane,
+    fuse_accumulate,
+    fuse_planes_np,
+    fuse_retract,
+    min_tile_shift,
+    pack_nibbles,
+    quant_error_bound,
+    quantize_plane,
+    rle_decode,
+    rle_encode,
+    rle_payload_bytes,
+    unpack_nibbles,
+)
+
+GRID = 64
+Z3 = np.zeros((3,), np.int32)
+
+
+def _map_cfg(**over) -> MapConfig:
+    base = dict(grid=GRID, cell_m=0.1, beams=256)
+    base.update(over)
+    return MapConfig(**base)
+
+
+def _world_cfg(backend: str = "int8", **over) -> WorldConfig:
+    mc = over.pop("base", None) or _map_cfg()
+    base = dict(
+        base=mc,
+        match=derive_match_config(mc, theta_window=4, window_cells=2),
+        tile=TileConfig(
+            grid=mc.grid, tile_cells=8, clamp_q=mc.clamp_q,
+            backend=backend,
+        ),
+        max_submaps=4,
+        merge_revs=2,
+        publish_ticks=2,
+    )
+    base.update(over)
+    return WorldConfig(**base)
+
+
+def _blob_plane(seed: int, grid: int = GRID, n: int = 60) -> np.ndarray:
+    """A sparse quantized submap plane: saturated occupied cells in
+    the interior (the stored-plane value ceiling clamp_q >> quant_shift
+    = 512 for the default geometry)."""
+    rng = np.random.default_rng(seed)
+    p = np.zeros((grid, grid), np.int32)
+    idx = rng.integers(14, grid - 14, size=(n, 2))
+    p[idx[:, 0], idx[:, 1]] = 512
+    return p
+
+
+# ---------------------------------------------------------------------------
+# quantization + coding units (ops/tile_quant)
+# ---------------------------------------------------------------------------
+
+
+class TestTileQuant:
+    def test_min_tile_shift(self):
+        assert min_tile_shift(8192, 8) == 6    # 8192 >> 6 = 128 <= 255
+        assert min_tile_shift(8192, 4) == 10   # 8192 >> 10 = 8 <= 15
+        assert min_tile_shift(255, 8) == 0
+        assert min_tile_shift(256, 8) == 1
+        with pytest.raises(ValueError):
+            min_tile_shift(0, 8)
+        with pytest.raises(ValueError):
+            min_tile_shift(8192, 0)
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_round_trip_error_bounds(self, bits):
+        clamp = 8192
+        shift = min_tile_shift(clamp, bits)
+        bound = quant_error_bound(shift)
+        assert bound == (1 << shift) >> 1
+        rng = np.random.default_rng(7)
+        plane = rng.integers(-clamp, clamp + 1, size=(64, 64)).astype(
+            np.int32
+        )
+        lv = quantize_plane(plane, clamp, shift)
+        assert lv.min() >= 0 and lv.max() <= (1 << bits) - 1
+        deq = dequantize_plane(lv, shift)
+        clipped = np.clip(plane, 0, clamp)
+        occ = lv > 0
+        # occupied cells land within the band-midpoint bound; level-0
+        # cells reconstruct to EXACTLY 0 within the band width
+        assert np.abs(deq[occ] - clipped[occ]).max() <= bound
+        assert (deq[~occ] == 0).all()
+        assert np.abs(deq[~occ] - clipped[~occ]).max() <= (1 << shift) - 1
+
+    def test_level_zero_is_exactly_zero(self):
+        deq = dequantize_plane(np.zeros((16,), np.int32), 6)
+        assert (deq == 0).all()
+
+    @pytest.mark.parametrize("n", [0, 1, 7, 8, 33])
+    def test_nibble_pack_round_trip(self, n):
+        rng = np.random.default_rng(n)
+        lv = rng.integers(0, 16, size=(n,)).astype(np.int32)
+        packed = pack_nibbles(lv)
+        assert packed.dtype == np.uint8 and packed.size == (n + 1) // 2
+        assert np.array_equal(unpack_nibbles(packed, n), lv)
+
+    def test_rle_round_trip(self):
+        rng = np.random.default_rng(11)
+        lv = np.repeat(
+            rng.integers(0, 256, size=(40,)),
+            rng.integers(1, 30, size=(40,)),
+        ).astype(np.int32)
+        v, r = rle_encode(lv)
+        assert v.size == r.size and (r >= 1).all()
+        assert np.array_equal(rle_decode(v, r), lv)
+        # empty stream round-trips empty
+        v0, r0 = rle_encode(np.zeros((0,), np.int32))
+        assert v0.size == 0 and rle_decode(v0, r0).size == 0
+
+    def test_rle_long_run_splits_at_the_wire_cap(self):
+        lv = np.full((RUN_LEN_MAX + 10,), 3, np.int32)
+        v, r = rle_encode(lv)
+        assert r.max() <= RUN_LEN_MAX
+        assert v.size == 2 and (v == 3).all()
+        assert int(r.sum()) == lv.size
+        assert np.array_equal(rle_decode(v, r), lv)
+
+    def test_rle_payload_accounting(self):
+        # int8: 1 value byte + 2 run bytes per run; int4 packs nibbles
+        assert rle_payload_bytes(10, 8) == 10 + 20
+        assert rle_payload_bytes(10, 4) == 5 + 20
+        assert rle_payload_bytes(11, 4) == 6 + 22
+        assert rle_payload_bytes(0, 8) == 0
+
+    def test_fuse_ops_match_the_numpy_twin(self):
+        a = _blob_plane(1)
+        b = _blob_plane(2)
+        import jax
+
+        acc = fuse_accumulate(jax.device_put(a.copy()), jax.device_put(b))
+        assert np.array_equal(np.asarray(acc), a + b)
+        back = fuse_retract(acc, jax.device_put(b))
+        assert np.array_equal(np.asarray(back), a)
+        # the shuffled-order oracle is the plain sum
+        planes = [_blob_plane(s) for s in range(4)]
+        ref = fuse_planes_np(planes)
+        assert np.array_equal(
+            fuse_planes_np([planes[2], planes[0], planes[3], planes[1]]),
+            ref,
+        )
+        with pytest.raises(ValueError):
+            fuse_planes_np([])
+
+
+# ---------------------------------------------------------------------------
+# tile plane (mapping/tiles)
+# ---------------------------------------------------------------------------
+
+
+class TestTilePlane:
+    def test_resolve_backend(self):
+        assert resolve_map_tile_backend("auto") == "int8"
+        assert resolve_map_tile_backend("auto", platform="tpu") == "int8"
+        for explicit in ("raw", "int8", "int4"):
+            assert resolve_map_tile_backend(explicit) == explicit
+        with pytest.raises(ValueError):
+            resolve_map_tile_backend("int2")
+
+    def test_tile_config_validation(self):
+        with pytest.raises(ValueError):
+            TileConfig(grid=64, tile_cells=12, clamp_q=8192)  # no divide
+        with pytest.raises(ValueError):
+            TileConfig(grid=64, tile_cells=0, clamp_q=8192)
+        with pytest.raises(ValueError):
+            TileConfig(grid=64, tile_cells=8, clamp_q=0)
+        with pytest.raises(ValueError):
+            TileConfig(grid=64, tile_cells=8, clamp_q=8192, backend="x")
+        cfg = TileConfig(grid=64, tile_cells=8, clamp_q=8192,
+                         backend="int4")
+        assert cfg.bits == 4 and cfg.tiles_per_side == 8
+        assert cfg.quant_shift == min_tile_shift(8192, 4)
+        assert cfg.error_bound == quant_error_bound(cfg.quant_shift)
+        raw = TileConfig(grid=64, tile_cells=8, clamp_q=8192,
+                         backend="raw")
+        assert raw.quant_shift == 0 and raw.error_bound == 0
+
+    def test_raw_backend_round_trips_exactly(self):
+        cfg = TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                         backend="raw")
+        plane = _blob_plane(3) * 7  # values past the stored ceiling
+        snap = publish_tiles(plane, cfg, version=1)
+        assert snap.version == 1 and snap.dense is not None
+        # empty tiles dropped outright
+        assert 0 < snap.tiles < cfg.tiles_per_side ** 2
+        assert snap.payload_bytes == snap.dense.size * 4
+        assert np.array_equal(
+            snapshot_grid(snap), np.clip(plane, 0, cfg.clamp_q)
+        )
+
+    @pytest.mark.parametrize("backend", ["int8", "int4"])
+    def test_quantized_round_trip_within_bound(self, backend):
+        cfg = TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                         backend=backend)
+        rng = np.random.default_rng(5)
+        plane = np.zeros((GRID, GRID), np.int32)
+        idx = rng.integers(0, GRID, size=(300, 2))
+        plane[idx[:, 0], idx[:, 1]] = rng.integers(1, 8193, size=300)
+        snap = publish_tiles(plane, cfg, version=9)
+        grid = snapshot_grid(snap)
+        clipped = np.clip(plane, 0, cfg.clamp_q)
+        occ = quantize_plane(plane, cfg.clamp_q, cfg.quant_shift) > 0
+        assert np.abs(grid[occ] - clipped[occ]).max() <= cfg.error_bound
+        assert (grid[~occ] == 0).all()
+
+    def test_sparse_compression_beats_dense_int32(self):
+        cfg = TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                         backend="int8")
+        snap = publish_tiles(_blob_plane(6), cfg, version=1)
+        assert snap.raw_bytes == GRID * GRID * 4
+        assert snap.compression_ratio > 3.0
+
+    def test_int4_payload_at_most_int8(self):
+        plane = _blob_plane(8)
+        p8 = publish_tiles(
+            plane,
+            TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                       backend="int8"),
+            version=1,
+        )
+        p4 = publish_tiles(
+            plane,
+            TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                       backend="int4"),
+            version=1,
+        )
+        assert p4.payload_bytes <= p8.payload_bytes
+
+    def test_empty_plane_publishes_zero_tiles(self):
+        cfg = TileConfig(grid=GRID, tile_cells=8, clamp_q=8192,
+                         backend="int8")
+        snap = publish_tiles(np.zeros((GRID, GRID), np.int32), cfg, 1)
+        assert snap.tiles == 0 and snap.payload_bytes == 0
+        assert (snapshot_grid(snap) == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# world merge: order independence, alignment, eviction
+# ---------------------------------------------------------------------------
+
+
+class TestWorldMerge:
+    def test_merge_order_is_byte_irrelevant(self):
+        """The tentpole contract: with the same frozen reference, ANY
+        ingest order of the remaining submaps — in-arrival, shuffled,
+        or interleaved across shards — lands a bit-identical
+        accumulation, equal to the numpy oracle's plain sum of the
+        aligned member planes."""
+        ref = _blob_plane(99)
+        planes = [_blob_plane(s) for s in range(5)]
+
+        def run(order):
+            w = WorldMap(_world_cfg(max_submaps=8))
+            w.ingest_submap(0, ref, Z3)
+            for k in order:
+                w.ingest_submap(k + 1, planes[k], Z3)
+            return w.save_state()
+
+        s0 = run([0, 1, 2, 3, 4])
+        for order in ([4, 2, 0, 3, 1], [3, 4, 1, 0, 2]):
+            assert np.array_equal(run(order)["acc"], s0["acc"])
+        member_planes = [m["plane"] for m in s0["members"]]
+        assert np.array_equal(s0["acc"], fuse_planes_np(member_planes))
+        # cross-shard partial sums: two half-fleet sums fused late are
+        # the same bytes (associativity at the partial-sum granularity)
+        half_a = fuse_planes_np(member_planes[:3])
+        half_b = fuse_planes_np(member_planes[3:])
+        assert np.array_equal(s0["acc"], half_a + half_b)
+
+    def test_alignment_recovers_a_whole_cell_shift_exactly(self):
+        """A translated copy of the reference aligns back byte-exactly
+        (the corner-anchored pseudo-scan puts full bilinear weight on
+        exactly one cell, so the true shift is a sharp maximum), the
+        rotation stays zero, and the constraint row is the shift in
+        subcells."""
+        ref = _blob_plane(0)
+        w = WorldMap(_world_cfg())
+        w.ingest_submap(0, ref, Z3)
+        for dx, dy in ((3, -2), (-5, 7)):
+            shifted = shift_plane_np(ref, dx, dy)
+            j = w.ingest_submap(1, shifted, Z3)
+            m = w._members[j]
+            assert m.weight == 1 and int(m.z[2]) == 0
+            assert int(m.z[0]) % SUB == 0 and int(m.z[1]) % SUB == 0
+            assert np.array_equal(m.plane, ref)
+        # accumulation = reference + two aligned copies = 3x reference
+        assert np.array_equal(w.save_state()["acc"], ref * 3)
+
+    def test_empty_submap_fuses_at_zero_weight(self):
+        w = WorldMap(_world_cfg())
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        before = w.save_state()["acc"]
+        j = w.ingest_submap(1, np.zeros((GRID, GRID), np.int32), Z3)
+        m = w._members[j]
+        assert m.weight == 0 and m.score == 0
+        assert np.array_equal(w.save_state()["acc"], before)
+
+    def test_eviction_is_exact_and_remaps_nodes(self):
+        """Past the cap the oldest NON-reference member retracts: the
+        accumulation returns byte-for-byte to the survivors' sum (the
+        int32 group inverse) and node indices follow list positions —
+        the pop IS the remap."""
+        w = WorldMap(_world_cfg(max_submaps=3))
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        w.ingest_submap(1, _blob_plane(1), Z3)
+        w.ingest_submap(2, _blob_plane(2), Z3)
+        assert len(w._members) == 3 and w.evictions == 0
+        w.ingest_submap(3, _blob_plane(3), Z3)
+        assert w.evictions == 1 and len(w._members) == 3
+        state = w.save_state()
+        assert [m["stream"] for m in state["members"]] == [0, 2, 3]
+        assert np.array_equal(
+            state["acc"],
+            fuse_planes_np([m["plane"] for m in state["members"]]),
+        )
+        assert w.world_nodes().shape == (3, 3)
+
+    def test_reference_never_evicts(self):
+        w = WorldMap(_world_cfg())
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        with pytest.raises(RuntimeError):
+            w.evict_oldest()
+
+    def test_align_without_reference_raises(self):
+        w = WorldMap(_world_cfg())
+        with pytest.raises(RuntimeError):
+            w.align_submap(_blob_plane(0))
+
+    def test_relaxed_nodes_hold_the_single_constraint(self):
+        """One constraint against the gauge anchor relaxes to the
+        measurement itself (zero residual at the seed) — the aligned
+        shift IS the member's world pose."""
+        ref = _blob_plane(0)
+        w = WorldMap(_world_cfg())
+        w.ingest_submap(0, ref, Z3)
+        j = w.ingest_submap(1, shift_plane_np(ref, 4, -3), Z3)
+        nodes = w.world_nodes()
+        assert np.array_equal(nodes[0], Z3)
+        assert np.array_equal(nodes[j], w._members[j].z)
+
+    def test_merge_due_cadence(self):
+        w = WorldMap(_world_cfg(merge_revs=4))
+        assert not w.merge_due(0, 0)
+        assert not w.merge_due(0, 3)
+        assert w.merge_due(0, 4)
+        w.note_merged(0, 4)
+        assert not w.merge_due(0, 4)   # deduplicated per stream
+        assert w.merge_due(1, 4)       # other streams independent
+        assert w.merge_due(0, 8)
+
+
+# ---------------------------------------------------------------------------
+# serving: versioned snapshots, cadence, bounded residency, state carry
+# ---------------------------------------------------------------------------
+
+
+class TestWorldServing:
+    def test_publish_cadence_and_versions(self):
+        w = WorldMap(_world_cfg(publish_ticks=3))
+        assert not w.tick()            # tick 1: nothing merged yet
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        assert w.tick()                # tick 2: first snapshot is eager
+        snap = w.publish()
+        assert snap.version == 1 and w.snapshot() is snap
+        assert not w.tick()            # tick 3: clean, nothing due
+        w.ingest_submap(1, _blob_plane(1), Z3)
+        assert not w.tick()            # tick 4: dirty, off the edge
+        assert not w.tick()            # tick 5: still off the edge
+        assert w.tick()                # tick 6: the cadence edge
+        assert w.publish().version == 2
+
+    def test_overlap_hook_is_the_due_publication(self):
+        w = WorldMap(_world_cfg(publish_ticks=1))
+        assert w.overlap_hook() is None
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        hook = w.overlap_hook()
+        assert callable(hook)
+        hook()
+        assert w.serving_version == 1 and w.snapshot() is not None
+        assert w.overlap_hook() is None   # published: nothing due
+
+    def test_snapshots_are_immutable_across_publishes(self):
+        w = WorldMap(_world_cfg(publish_ticks=1))
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        w.tick()
+        snap1 = w.publish()
+        grid1 = snapshot_grid(snap1).copy()
+        values1 = snap1.values.copy()
+        w.ingest_submap(1, _blob_plane(1), Z3)
+        w.tick()
+        snap2 = w.publish()
+        assert snap2.version == 2 and w.snapshot() is snap2
+        # the reader's held view never moved
+        assert snap1.version == 1
+        assert np.array_equal(snap1.values, values1)
+        assert np.array_equal(snapshot_grid(snap1), grid1)
+
+    def test_resident_bytes_bounded_under_eviction(self):
+        cap = 3
+        w = WorldMap(_world_cfg(max_submaps=cap, publish_ticks=1))
+        g = GRID * GRID * 4
+        bound = g * (cap + 1) + g   # acc + member planes + snapshot
+        for k in range(10):
+            w.ingest_submap(k, _blob_plane(k), Z3)
+            if w.tick():
+                w.publish()
+            assert len(w._members) <= cap
+            assert w.resident_bytes <= bound
+        assert w.evictions == 10 - cap
+        assert w.status()["evictions"] == w.evictions
+
+    def test_status_payload_shape(self):
+        w = WorldMap(_world_cfg(publish_ticks=1))
+        st = w.status()
+        assert st == {
+            "backend": "int8", "nodes": 0, "tiles": 0,
+            "resident_bytes": GRID * GRID * 4,
+            "compression_ratio": 0.0, "merges": 0,
+            "serving_version": 0, "evictions": 0,
+        }
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        w.tick()
+        w.publish()
+        st = w.status()
+        assert st["nodes"] == 1 and st["merges"] == 1
+        assert st["serving_version"] == 1 and st["tiles"] > 0
+        assert st["compression_ratio"] > 3.0
+
+    def test_save_load_round_trip_survives_eviction(self):
+        w = WorldMap(_world_cfg(max_submaps=3))
+        for k in range(4):
+            w.ingest_submap(k, _blob_plane(k), Z3)
+        state = w.save_state()
+        w2 = WorldMap(_world_cfg(max_submaps=3))
+        w2.load_state(state)
+        s1, s2 = w.save_state(), w2.save_state()
+        assert np.array_equal(s1["acc"], s2["acc"])
+        assert len(s1["members"]) == len(s2["members"])
+        for a, b in zip(s1["members"], s2["members"]):
+            assert a["stream"] == b["stream"]
+            assert np.array_equal(a["plane"], b["plane"])
+            assert np.array_equal(a["z"], b["z"])
+        assert s2["merges"] == s1["merges"]
+        assert s2["evictions"] == s1["evictions"]
+        # both sides keep evolving identically
+        w.evict_oldest()
+        w2.evict_oldest()
+        assert np.array_equal(
+            w.save_state()["acc"], w2.save_state()["acc"]
+        )
+
+    def test_load_rejects_version_and_geometry(self):
+        w = WorldMap(_world_cfg())
+        w.ingest_submap(0, _blob_plane(0), Z3)
+        state = w.save_state()
+        bad = dict(state)
+        bad["version"] = WORLD_STATE_VERSION + 1
+        with pytest.raises(ValueError):
+            WorldMap(_world_cfg()).load_state(bad)
+        small = _map_cfg(grid=32)
+        w32 = WorldMap(_world_cfg(base=small))
+        with pytest.raises(ValueError):
+            w32.load_state(state)
+
+
+# ---------------------------------------------------------------------------
+# config + params
+# ---------------------------------------------------------------------------
+
+
+class TestWorldConfig:
+    def test_world_config_validation(self):
+        with pytest.raises(ValueError):
+            _world_cfg(max_submaps=1)
+        with pytest.raises(ValueError):
+            _world_cfg(merge_revs=0)
+        with pytest.raises(ValueError):
+            _world_cfg(publish_ticks=0)
+        mc = _map_cfg()
+        with pytest.raises(ValueError):
+            WorldConfig(
+                base=mc,
+                match=derive_match_config(
+                    mc, theta_window=4, window_cells=2
+                ),
+                tile=TileConfig(grid=32, tile_cells=8, clamp_q=8192),
+            )
+        # the graph sizes with the membership cap
+        cfg = _world_cfg(max_submaps=6)
+        assert cfg.graph.max_nodes == 6
+        assert cfg.graph.max_constraints == 5
+
+    def test_world_config_from_params(self):
+        from test_loop_close import _params
+        from rplidar_ros2_driver_tpu.mapping.mapper import (
+            map_config_from_params,
+        )
+
+        params = _params(
+            world_map_enable=True, map_tile_backend="auto",
+            world_tile_cells=8, world_max_submaps=4,
+            world_merge_revs=3, world_publish_ticks=5,
+        )
+        mc = map_config_from_params(params, beams=256)
+        cfg = world_config_from_params(params, mc)
+        assert cfg.tile.backend == "int8"      # auto resolves
+        assert cfg.tile.grid == mc.grid
+        assert cfg.tile.tile_cells == 8
+        assert cfg.max_submaps == 4
+        assert cfg.merge_revs == 3 and cfg.publish_ticks == 5
+        # the match derivation scores STORED quantized planes
+        assert cfg.match.quant_shift == 0
+        assert cfg.match.clamp_q == mc.clamp_q >> mc.quant_shift
+
+    def test_param_validation(self):
+        from test_loop_close import _params
+
+        def validate(**kw):
+            _params(**kw).validate()
+
+        ok = _params(world_map_enable=True)
+        ok.validate()
+        assert ok.world_map_enable and ok.map_tile_backend == "auto"
+        with pytest.raises(ValueError, match="map_tile_backend"):
+            validate(map_tile_backend="int2")
+        with pytest.raises(ValueError, match="world_map_enable"):
+            validate(world_map_enable=True, map_enable=False,
+                     loop_enable=False)
+        with pytest.raises(ValueError, match="world_tile_cells"):
+            validate(world_tile_cells=0)
+        with pytest.raises(ValueError, match="world_tile_cells"):
+            validate(world_tile_cells=7)   # must divide map_grid=64
+        with pytest.raises(ValueError, match="world_max_submaps"):
+            validate(world_max_submaps=1)
+        with pytest.raises(ValueError, match="world_max_submaps"):
+            validate(world_max_submaps=65)
+        with pytest.raises(ValueError, match="world_merge_revs"):
+            validate(world_merge_revs=0)
+        with pytest.raises(ValueError, match="world_publish_ticks"):
+            validate(world_publish_ticks=0)
+
+
+# ---------------------------------------------------------------------------
+# wiring: diagnostics + the service seams
+# ---------------------------------------------------------------------------
+
+
+def test_diagnostics_world_group_rendering():
+    from rplidar_ros2_driver_tpu.node.diagnostics import DiagnosticsUpdater
+    from rplidar_ros2_driver_tpu.node.lifecycle import LifecycleState
+
+    class _Pub:
+        def publish_diagnostics(self, status):
+            self.last = status
+
+    upd = DiagnosticsUpdater("rplidar-test", _Pub())
+    status = upd.update(
+        lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+        port="/dev/x", rpm=600, device_info="sim",
+        world_map={
+            "backend": "int8", "nodes": 3, "tiles": 12,
+            "resident_bytes": 40960, "compression_ratio": 6.25,
+            "merges": 7, "serving_version": 3, "evictions": 2,
+        },
+    )
+    v = status.values
+    assert v["World Map"] == "int8 v3"
+    assert v["World Tiles"] == "12"
+    assert v["World Resident Bytes"] == "40960"
+    assert v["World Compression"] == "6.25x"
+    assert v["World Merges"] == "7"
+    assert v["World Evictions"] == "2"
+    # absent group renders nothing
+    status = upd.update(
+        lifecycle=LifecycleState.ACTIVE, fsm_state=None,
+        port="/dev/x", rpm=600, device_info="sim",
+    )
+    assert "World Map" not in status.values
+
+
+def test_service_attach_world_map_via_loop_tap():
+    """With a loop engine attached the world consumes the engine's OWN
+    finalization product through on_install — one quantize path, no
+    second pull."""
+    from test_loop_close import _params, _scan
+    from rplidar_ros2_driver_tpu.parallel.service import (
+        ShardedFilterService,
+    )
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    svc = ShardedFilterService(
+        _params(filter_window=2, voxel_grid_size=32, loop_submap_revs=2,
+                loop_check_revs=1, world_map_enable=True,
+                world_merge_revs=2, world_tile_cells=8,
+                world_max_submaps=4, world_publish_ticks=1),
+        streams=2, mesh=make_mesh(2), beams=128,
+    )
+    svc.attach_loop_closure()
+    world = svc.attach_world_map()
+    assert svc.world is world
+    for k in range(6):
+        svc.submit([_scan(2 * k), _scan(2 * k + 1)])
+    assert world.merges > 0            # finalizations fed the tap
+    st = svc.world_status()
+    assert st is not None and st["merges"] == world.merges
+    # the drain epilogue's publication seam
+    if world.tick():
+        world.publish()
+    assert world.serving_version >= 1 and world.snapshot() is not None
+
+
+def test_service_world_cadence_pull_without_loop():
+    """Without a loop engine the world pulls row snapshots at the
+    world_merge_revs cadence, quantized through the ONE finalization
+    path."""
+    from test_loop_close import _params, _scan
+    from rplidar_ros2_driver_tpu.parallel.service import (
+        ShardedFilterService,
+    )
+    from rplidar_ros2_driver_tpu.parallel.sharding import make_mesh
+
+    svc = ShardedFilterService(
+        _params(filter_window=2, voxel_grid_size=32, loop_enable=False,
+                world_map_enable=True, world_merge_revs=2,
+                world_tile_cells=8, world_max_submaps=4,
+                world_publish_ticks=1),
+        streams=2, mesh=make_mesh(2), beams=128,
+    )
+    world = svc.attach_world_map()    # attaches the mapper itself
+    assert svc.mapper is not None and svc.loop is None
+    for k in range(6):
+        svc.submit([_scan(2 * k), _scan(2 * k + 1)])
+    assert world.merges > 0
+    # the cadence dedup held: at most one merge per (stream, revision)
+    assert world.merges <= 2 * 3
+
+
+def test_pod_world_map_cross_shard_merge_and_publish():
+    """The pod seam: ONE world over every shard — merges arrive from
+    both shards' lanes (the cross-shard fusion the order-independence
+    contract makes safe) and a due tile publication lands during the
+    pod drain without any extra dispatch path."""
+    from test_chaos import _fleet_ticks, _map_params
+    from test_fused_ingest import BEAMS
+    from rplidar_ros2_driver_tpu.parallel.service import (
+        ElasticFleetService,
+    )
+    from rplidar_ros2_driver_tpu.protocol.constants import Ans
+
+    streams, shards = 4, 2
+    params = _map_params(
+        fleet_ingest_backend="fused", map_backend="fused",
+        shard_count=shards, failover_snapshot_ticks=4,
+        shard_starvation_ticks=500, sched_rungs=(1, 2),
+        world_map_enable=True, world_merge_revs=2,
+        world_tile_cells=8, world_max_submaps=4,
+        world_publish_ticks=1,
+    )
+    pod = ElasticFleetService(
+        params, streams, shards=shards, beams=BEAMS,
+        fleet_ingest_buckets=(8,),
+    )
+    pod.attach_scheduler()
+    pod.precompile([int(Ans.MEASUREMENT_DENSE_CAPSULED)])
+    world = pod.attach_world_map()
+    ticks = _fleet_ticks(streams, 10)
+    for t in range(len(ticks)):
+        pod.offer_bytes(list(ticks[t]))
+        pod.drain_scheduled()
+    assert world.merges > 0
+    assert world.evictions == max(0, world.merges - 4)  # bounded set
+    assert world.serving_version >= 1       # the drain published
+    assert world.snapshot() is not None
+    streams_seen = {m.stream for m in world._members}
+    assert len(streams_seen) > 1            # genuinely cross-shard
+    st = pod.world_status()
+    assert st is not None and st["merges"] == world.merges
