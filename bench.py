@@ -206,6 +206,7 @@ GRADED = {
     20: ("async_serving", POINTS, dict(window=WINDOW)),  # link-latency-hiding A/B
     21: ("pod_scaleout", POINTS, dict(window=WINDOW)),  # steal+autoscale pod A/B
     22: ("map_serving", POINTS, dict(window=WINDOW)),  # merged-world tile serving A/B
+    23: ("scenarios", POINTS, dict(window=WINDOW)),  # scene x chaos x fleet accuracy matrix
 }
 
 
@@ -5490,6 +5491,538 @@ def bench_loop_close(smoke: bool = False) -> dict:
     }
 
 
+# ----------------------------------------------------------------------
+# Config 23: the scenario regression matrix (procedural foundry worlds)
+# ----------------------------------------------------------------------
+
+_SCENARIO_SCENES = ("rooms", "corridor", "loop")
+_SCENARIO_CHAOS = ("clean", "faulty")
+_SCENARIO_SEED = 20260807  # the matrix is a pure function of this
+
+
+def _scenario_chaos_mask(chaos, rev, beams, salt):
+    """Deterministic per-revolution fault schedule for the ``faulty``
+    chaos column: every 7th revolution stalls outright (live=0), every
+    3rd loses a contiguous 30% beam sector whose start walks with
+    (rev, salt) — a seeded script, so every cell is exactly
+    reproducible (no RNG draws at stream time)."""
+    if chaos != "faulty":
+        return np.ones(beams, bool), 1
+    if rev % 7 == 5:
+        return np.zeros(beams, bool), 0
+    mask = np.ones(beams, bool)
+    if rev % 3 == 1:
+        width = (beams * 3) // 10
+        start = (rev * 7919 + salt * 104729) % beams
+        mask[(start + np.arange(width)) % beams] = False
+    return mask, 1
+
+
+def _scenario_determinism_check(base_seed):
+    """Structural claim: a foundry scene is a pure function of
+    (seed, rev, beam) — rebuilt scenes streamed under different
+    chunkings must emit byte-equal ranges (the contract that makes a
+    scenario cell a regression test rather than a weather report)."""
+    from rplidar_ros2_driver_tpu.scenarios.foundry import (
+        SCENE_KINDS,
+        SceneSpec,
+        build_scene,
+    )
+
+    th = 360.0 * np.arange(200) / 200
+    revs = np.repeat(np.arange(2, dtype=np.int64), 100)
+    for kind in SCENE_KINDS:
+        spec = SceneSpec(
+            kind=kind, seed=base_seed + 3, n_revs=8, dropout_rate=0.1
+        )
+        whole = build_scene(spec).dist_mm(th, revs)
+        b = build_scene(spec)
+        parts = np.concatenate(
+            [b.dist_mm(th[:63], revs[:63]), b.dist_mm(th[63:], revs[63:])]
+        )
+        if whole.tobytes() != parts.tobytes():
+            raise RuntimeError(
+                f"foundry determinism broke for {kind!r}: rebuilt scene "
+                "streamed under a different chunking emitted different "
+                "bytes"
+            )
+
+
+def _scenario_deskew_probe(base_seed):
+    """De-skew observability probe on foundry geometry: two profile
+    captures a known +x translation apart, through the PR 10 host
+    estimator.  The corridor must TIE TO IDENTITY (feature-starved
+    along-axis translation is unobservable and the estimator's
+    first-min-wins contract resolves the tie to zero); rooms and loop
+    must recover the translation within band.  A violation raises."""
+    from rplidar_ros2_driver_tpu.ops.deskew import DeskewConfig
+    from rplidar_ros2_driver_tpu.ops.deskew_ref import (
+        estimate_motion_np,
+        profile_from_nodes_np,
+    )
+    from rplidar_ros2_driver_tpu.scenarios.foundry import (
+        SceneSpec,
+        build_scene,
+    )
+
+    dcfg = DeskewConfig(recon_beams=256)
+    beams = 512
+    th = 360.0 * np.arange(beams) / beams
+    ang = np.round(th / 360.0 * 65536.0).astype(np.int64).astype(np.int32)
+    t_m = 0.05
+    truth_q2 = int(round(t_m * 4000.0))  # metres -> quarter-mm
+    out = {}
+    for kind in _SCENARIO_SCENES:
+        scene = build_scene(SceneSpec(kind=kind, seed=base_seed, n_revs=16))
+        x0 = float(scene.traj.x_m[0])
+        y0 = float(scene.traj.y_m[0])
+
+        def prof(x):
+            dq2 = np.round(scene.probe_dist_mm(x, y0, th) * 4.0)
+            dq2 = dq2.astype(np.int32)
+            return profile_from_nodes_np(ang, dq2, dq2 > 0, dcfg)
+
+        est = estimate_motion_np(prof(x0), prof(x0 + t_m), dcfg)
+        out[kind] = {
+            "est_dx_q2": int(est[0]), "est_dy_q2": int(est[1]),
+            "est_dth_u16": int(est[2]), "truth_dx_q2": truth_q2,
+        }
+    corr = out["corridor"]
+    if abs(corr["est_dx_q2"]) > 40 or abs(corr["est_dy_q2"]) > 40:
+        raise RuntimeError(
+            "corridor de-skew tie-to-identity broke: estimated "
+            f"({corr['est_dx_q2']}, {corr['est_dy_q2']}) q2 for an "
+            "along-axis translation that must be unobservable"
+        )
+    for kind in ("rooms", "loop"):
+        dx = out[kind]["est_dx_q2"]
+        if not (0.4 * truth_q2 <= dx <= 2.5 * truth_q2):
+            raise RuntimeError(
+                f"de-skew recovery failed on {kind!r}: estimated "
+                f"{dx} q2 for a {truth_q2} q2 translation (band "
+                "[0.4x, 2.5x])"
+            )
+    return out
+
+
+def _scenario_loop_probe(chaos, streams, n_revs, grid, cell, beams,
+                         base_seed):
+    """Loop-scene closure probe: foundry ``loop`` scans rasterized at
+    drift-injected poses through the scripted front-end + the PR 11
+    LoopClosureEngine (fused backend).  Claims, asserted: the
+    pose-graph-corrected end pose lands within bar while the baseline
+    carries the full injected drift, and at least one closure is
+    accepted.  Sector faults apply under ``faulty``; stalls don't (the
+    scripted front-end is odometry-clocked, a stalled rev is an
+    all-masked scan)."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.ops.scan_match import SUB
+    from rplidar_ros2_driver_tpu.scenarios import metrics as smet
+    from rplidar_ros2_driver_tpu.scenarios.foundry import (
+        SceneSpec,
+        build_scene,
+    )
+    from rplidar_ros2_driver_tpu.slam.loop import LoopClosureEngine
+    from rplidar_ros2_driver_tpu.utils import guards
+
+    p = DriverParams(
+        filter_chain=("clip", "median", "voxel"),
+        map_enable=True, map_backend="host",
+        map_grid=grid, map_cell_m=cell,
+        loop_enable=True, loop_backend="fused",
+        loop_submap_revs=4, loop_check_revs=2,
+        loop_max_submaps=16, loop_candidates=2, loop_weight=8,
+        pose_graph_max_constraints=32, pose_graph_iters=256,
+    )
+    # total injected drift ~8 cells: past the 4-cell degeneracy bar,
+    # inside the candidate-match search reach
+    drift_sub = max((8 * SUB) // n_revs, 1)
+    fe = _DriftingFrontEnd(p, streams, beams, 4)
+    eng = LoopClosureEngine(p, fe)
+    eng.precompile()
+    thetas = 360.0 * np.arange(beams) / beams
+    scenes, truths = [], []
+    for s in range(streams):
+        spec = SceneSpec(
+            kind="loop", seed=base_seed + 17 * s, n_revs=n_revs,
+            dropout_rate=0.08 if chaos == "faulty" else 0.0,
+        )
+        sc = build_scene(spec)
+        rel = sc.traj.relative_poses()
+        truths.append(np.stack([
+            smet.pose_to_lattice(rel[k, 0], rel[k, 1], rel[k, 2], fe.cfg)
+            for k in range(n_revs)
+        ]))
+        scenes.append(sc)
+    with guards.steady_state(tag=f"scenario loop probe {chaos}"):
+        for k in range(n_revs):
+            pts = np.zeros((streams, beams, 2), np.float32)
+            masks = np.ones((streams, beams), bool)
+            drifted = np.zeros((streams, 3), np.int32)
+            for s, sc in enumerate(scenes):
+                d = sc.dist_mm(thetas, np.full(beams, k, np.int64))
+                xy, m = smet.scan_points_xy(thetas, d)
+                cmask, _live = _scenario_chaos_mask(chaos, k, beams, s)
+                pts[s], masks[s] = xy, m & cmask
+                drifted[s] = truths[s][k]
+                drifted[s, 0] += drift_sub * (k + 1)
+            eng.observe(fe.submit(pts, masks, drifted))
+    base_err = corr_err = 0.0
+    for s in range(streams):
+        end, te = fe.pose[s], truths[s][n_revs - 1]
+        base_err = max(base_err, (
+            abs(int(end[0]) - int(te[0])) + abs(int(end[1]) - int(te[1]))
+        ) / SUB)
+        cor = eng.corrected_pose_q(s, end)
+        corr_err = max(corr_err, (
+            abs(int(cor[0]) - int(te[0])) + abs(int(cor[1]) - int(te[1]))
+        ) / SUB)
+    accepted = int(eng.closures_accepted.sum())
+    bar = 2.0 if chaos == "clean" else 2.5
+    if corr_err > bar:
+        raise RuntimeError(
+            f"loop scene failed to close under {chaos} chaos: corrected "
+            f"end-pose error {corr_err:.2f} cells > {bar}"
+        )
+    if base_err < 4.0:
+        raise RuntimeError(
+            f"loop drift scenario degenerate: baseline end error "
+            f"{base_err:.2f} cells < 4"
+        )
+    if accepted < 1:
+        raise RuntimeError("loop scene produced zero accepted closures")
+    return {
+        "chaos": chaos,
+        "baseline_end_err_cells": round(base_err, 3),
+        "corrected_end_err_cells": round(corr_err, 3),
+        "closures_accepted": accepted,
+        "drift_sub_per_rev": drift_sub,
+        "revs": n_revs, "streams": streams,
+    }
+
+
+def _scenario_decay_probe(grid, cell, beams, base_seed):
+    """Moved-obstacle decay probe: the ``decay`` scene maps a box up
+    close, walks out of its sensor-range bubble, THEN the box vanishes
+    — no later ray crosses the stale cells.  Claims, asserted: with
+    ``map_decay`` off the stale evidence persists untouched to the end
+    (byte-frozen from the vanish revolution on), with decay on it fades
+    to <= 0.  Both arms run the host mapper at ground-truth poses so
+    the claim isolates MAPPING semantics from matcher error."""
+    import math
+
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.mapping.mapper import (
+        map_config_from_params,
+    )
+    from rplidar_ros2_driver_tpu.ops.scan_match_ref import (
+        quantize_points_np,
+        update_map_np,
+    )
+    from rplidar_ros2_driver_tpu.scenarios import metrics as smet
+    from rplidar_ros2_driver_tpu.scenarios.foundry import (
+        SceneSpec,
+        build_scene,
+    )
+
+    # max_range 2.0 m: the whole point — the stale site leaves sensor
+    # range before the box moves
+    spec = SceneSpec(
+        kind="decay", seed=base_seed, n_revs=32, max_range_m=2.0
+    )
+    scene = build_scene(spec)
+    n = scene.traj.n_revs
+    thetas = 360.0 * np.arange(beams) / beams
+    rel = scene.traj.relative_poses()
+    box = scene.moving[0]
+    sx, sy = float(scene.traj.x_m[0]), float(scene.traj.y_m[0])
+    gx0 = grid // 2 + int(math.floor((box.x0 - box.half - sx) / cell))
+    gx1 = grid // 2 + int(math.ceil((box.x0 + box.half - sx) / cell))
+    gy0 = grid // 2 + int(math.floor((box.y0 - box.half - sy) / cell))
+    gy1 = grid // 2 + int(math.ceil((box.y0 + box.half - sy) / cell))
+    region = (slice(gx0, gx1 + 1), slice(gy0, gy1 + 1))
+
+    def run(map_decay):
+        p = DriverParams(
+            map_enable=True, map_backend="host",
+            map_grid=grid, map_cell_m=cell, map_decay=map_decay,
+        )
+        cfg = map_config_from_params(p, beams)
+        lo = np.zeros((grid, grid), np.int32)
+        at_vanish = 0
+        for k in range(n):
+            d = scene.dist_mm(thetas, np.full(beams, k, np.int64))
+            xy, m = smet.scan_points_xy(thetas, d)
+            pq, ok = quantize_points_np(xy, m, cfg)
+            pose = smet.pose_to_lattice(rel[k, 0], rel[k, 1], rel[k, 2], cfg)
+            lo = update_map_np(lo, pose, pq, ok, cfg)
+            if k == box.move_rev:
+                at_vanish = int(lo[region].max())
+        return int(lo[region].max()), at_vanish, cfg.decay_q
+
+    end_off, at_off, _ = run(0.0)
+    end_on, _at_on, decay_q = run(1.0)
+    if end_off <= 0:
+        raise RuntimeError(
+            "decay scenario degenerate: the moved obstacle left no "
+            "positive evidence with decay off"
+        )
+    if end_off != at_off:
+        raise RuntimeError(
+            "decay scenario degenerate: the stale region changed after "
+            "the obstacle moved — rays reached it, so the scene's "
+            "out-of-range guarantee broke"
+        )
+    if end_on > 0:
+        raise RuntimeError(
+            f"map_decay failed to fade the moved obstacle: stale region "
+            f"max {end_on} Q10 > 0 with decay_q={decay_q}"
+        )
+    return {
+        "stale_region_max_q_off": end_off,
+        "stale_region_max_q_on": end_on,
+        "decay_q_on": decay_q, "revs": n,
+    }
+
+
+def _scenario_cell(kind, chaos, fleet, base_seed, n_revs, grid, cell,
+                   beams):
+    """One matrix cell: ``fleet`` independent streams of a procedural
+    scene through the HOST matcher/mapper (``map_match_step_np``),
+    scored against the foundry's ground truth.  Accuracy is the
+    worst-stream end-pose error / map F1; perf is the mapper-pipeline
+    drain rate (scans pre-baked so the raycaster isn't timed)."""
+    from rplidar_ros2_driver_tpu.core.config import DriverParams
+    from rplidar_ros2_driver_tpu.mapping.mapper import (
+        map_config_from_params,
+    )
+    from rplidar_ros2_driver_tpu.ops import scan_match_ref as smr
+    from rplidar_ros2_driver_tpu.scenarios import metrics as smet
+    from rplidar_ros2_driver_tpu.scenarios.foundry import (
+        SceneSpec,
+        build_scene,
+    )
+
+    p = DriverParams(
+        map_enable=True, map_backend="host",
+        map_grid=grid, map_cell_m=cell,
+    )
+    cfg = map_config_from_params(p, beams)
+    thetas = 360.0 * np.arange(beams) / beams
+    worst_err, worst_f1, dt_total = 0.0, 1.0, 0.0
+    for s in range(fleet):
+        spec = SceneSpec(
+            kind=kind, seed=base_seed + 17 * s, n_revs=n_revs,
+            dropout_rate=0.08 if chaos == "faulty" else 0.0,
+        )
+        scene = build_scene(spec)
+        rel = scene.traj.relative_poses()
+        truth_q = np.stack([
+            smet.pose_to_lattice(rel[k, 0], rel[k, 1], rel[k, 2], cfg)
+            for k in range(n_revs)
+        ])
+        scans = []
+        for k in range(n_revs):
+            d = scene.dist_mm(thetas, np.full(beams, k, np.int64))
+            xy, m = smet.scan_points_xy(thetas, d)
+            cmask, live = _scenario_chaos_mask(chaos, k, beams, spec.seed)
+            scans.append((xy, m & cmask, live))
+        state = smr.create_map_state_np(cfg)
+        used = []
+        t0 = time.perf_counter()
+        for k, (xy, m, live) in enumerate(scans):
+            state, _wire = smr.map_match_step_np(state, xy, m, live, cfg)
+            if live:
+                used.append(k)
+        dt_total += time.perf_counter() - t0
+        # a trailing stalled rev leaves the pose parked one rev back by
+        # construction — score against the last LIVE rev's truth
+        err = smet.end_pose_error_cells(state["pose"], truth_q[used[-1]])
+        occ = smet.visible_truth_occupancy(
+            scene, thetas, used, truth_q[used], cfg
+        )
+        f1 = smet.map_f1(state["log_odds"], occ)
+        worst_err, worst_f1 = max(worst_err, err), min(worst_f1, f1)
+    return {
+        "scene": kind, "chaos": chaos, "fleet": fleet, "revs": n_revs,
+        "grid": grid, "cell_m": cell,
+        "end_pose_err_cells": round(worst_err, 3),
+        "map_f1": round(worst_f1, 3),
+        "scans_per_sec": round(fleet * n_revs / max(dt_total, 1e-9), 2),
+        "clamped": bool(dt_total < 0.05),
+        "_dt_s": dt_total,
+    }
+
+
+def bench_scenarios(smoke: bool = False) -> dict:
+    """Config 23 — the scenario regression matrix: procedural foundry
+    worlds (scenarios/foundry) swept over scene x chaos x fleet, each
+    cell recording ground-truth ACCURACY (end-pose error in cells, map
+    F1 against the visible-truth raster) alongside perf (host mapper
+    drain rate).  The structural claims, asserted rather than inferred
+    (a violation raises):
+
+      1. DETERMINISM — a scene is a pure function of (seed, rev, beam):
+         rebuilt scenes under different stream chunkings emit
+         byte-equal ranges.
+      2. DE-SKEW OBSERVABILITY — the feature-starved corridor ties the
+         PR 10 motion estimate to identity (the first-min-wins
+         contract) while feature-rich scenes recover a known
+         translation within band.
+      3. LOOP CLOSURE — the loop scene's genuine return-to-start
+         closes under the PR 11 engine: drift-injected baseline >= 4
+         cells, pose-graph-corrected end pose within bar, >= 1
+         accepted closure — under BOTH chaos columns.
+      4. DECAY — the moved-obstacle scene's stale evidence persists
+         byte-frozen with ``map_decay`` off (rays never reach it) and
+         fades to <= 0 with decay on.
+      5. ACCURACY FLOORS — feature-rich cells hold end-pose error and
+         F1 floors; the corridor cell DEGRADES (err >= 25% of along-
+         axis travel) — a matrix cell that stops degrading there means
+         the matcher started hallucinating corrections.
+
+    The artifact's ``scenario_matrix`` carries the per-cell records
+    (with per-cell ``deskew_ok``/``loop_ok``/``match_ok`` evidence
+    flags) that scripts/decide_backends.py requires as corroboration:
+    a backend flip needs its win supported by >= 2 unclamped scenario
+    cells.  ``smoke`` shrinks geometry to a seconds-scale CPU run —
+    the tier-1 gate (tests/test_bench_meta.py), same code path, same
+    metric name, ``"smoke": true``.
+    """
+    if smoke:
+        grid, cell, beams = 64, 0.1, 256
+        n_revs, fleets = 16, (1, 2)
+    else:
+        grid, cell, beams = 128, 0.05, 384
+        n_revs, fleets = 24, (2, 4)
+    # 128 revs around the 9.6 m ring = ~1.5 fine cells per rev, the
+    # measured robust-tracking regime across seeds AND the faulty
+    # schedule (at ~3 cells/rev some clutter layouts slip whole
+    # periods); the closure probe keeps 64 revs so its 16 submap
+    # epochs fit loop_max_submaps — the start submap must survive to
+    # the revisit or there is nothing to close against
+    loop_revs, probe_revs = 128, 64
+    # the loop ring needs the fine lattice in BOTH profiles: at 0.1 m
+    # cells its ~0.2 m/rev excursion sits at the matcher's granularity
+    # limit and slips whole clutter periods (measured), so loop cells
+    # pin grid 128 / 0.05 m — a matcher property worth regressing at
+    # exactly that margin, not a knob to loosen per profile
+    loop_grid, loop_cell = 128, 0.05
+    base_seed = _SCENARIO_SEED
+
+    _scenario_determinism_check(base_seed)
+    deskew = _scenario_deskew_probe(base_seed)
+    loop_probes = {
+        chaos: _scenario_loop_probe(
+            chaos, fleets[-1], probe_revs, loop_grid, loop_cell, beams,
+            base_seed,
+        )
+        for chaos in _SCENARIO_CHAOS
+    }
+    decay = _scenario_decay_probe(grid, cell, beams, base_seed)
+
+    cells = []
+    for kind in _SCENARIO_SCENES:
+        for chaos in _SCENARIO_CHAOS:
+            for fleet in fleets:
+                loop_kind = kind == "loop"
+                cells.append(_scenario_cell(
+                    kind, chaos, fleet, base_seed,
+                    loop_revs if loop_kind else n_revs,
+                    loop_grid if loop_kind else grid,
+                    loop_cell if loop_kind else cell,
+                    beams,
+                ))
+
+    # -- claim 5: accuracy floors (and the corridor's inverse floor) --
+    err_bars = {"rooms": {"clean": 4.0, "faulty": 6.0},
+                "loop": {"clean": 8.0, "faulty": 8.0}}
+    f1_bars = {"rooms": {"clean": 0.3, "faulty": 0.2},
+               "loop": {"clean": 0.15, "faulty": 0.15}}
+    for rec in cells:
+        kind, chaos = rec["scene"], rec["chaos"]
+        err, f1 = rec["end_pose_err_cells"], rec["map_f1"]
+        if kind == "corridor":
+            traveled = 0.12 * (rec["revs"] - 1) / rec["cell_m"]
+            if err < 0.25 * traveled:
+                raise RuntimeError(
+                    f"corridor degradation claim failed ({chaos}, fleet "
+                    f"{rec['fleet']}): err {err:.2f} cells over "
+                    f"{traveled:.1f} cells of unobservable travel — the "
+                    "matcher is hallucinating along-axis corrections"
+                )
+        else:
+            if err > err_bars[kind][chaos]:
+                raise RuntimeError(
+                    f"accuracy floor failed: {kind}/{chaos}/fleet "
+                    f"{rec['fleet']} end-pose error {err:.2f} cells > "
+                    f"{err_bars[kind][chaos]}"
+                )
+            if f1 < f1_bars[kind][chaos]:
+                raise RuntimeError(
+                    f"accuracy floor failed: {kind}/{chaos}/fleet "
+                    f"{rec['fleet']} map F1 {f1:.3f} < "
+                    f"{f1_bars[kind][chaos]}"
+                )
+        # per-cell evidence flags for decide_backends corroboration:
+        # the probes above RAISED unless they held, so a surviving
+        # artifact's flags state which mechanism each cell evidences
+        rec["deskew_ok"] = True          # claim 2 held for this kind
+        rec["loop_ok"] = kind == "loop"  # claim 3 held on loop cells
+        rec["match_ok"] = kind != "corridor"  # floors held (claim 5)
+
+    total_scans = sum(r["fleet"] * r["revs"] for r in cells)
+    total_dt = sum(r.pop("_dt_s") for r in cells)
+    sps = total_scans / max(total_dt, 1e-9)
+    worst_err = max(
+        r["end_pose_err_cells"] for r in cells if r["scene"] != "corridor"
+    )
+    worst_f1 = min(r["map_f1"] for r in cells if r["scene"] != "corridor")
+    return {
+        "metric": metric_name(23),
+        "value": round(sps, 2),
+        "unit": "scans/s",
+        "vs_baseline": round(sps / BASELINE_SCANS_PER_SEC, 3),
+        "matrix_cells": len(cells),
+        "scenes": list(_SCENARIO_SCENES),
+        "chaos": list(_SCENARIO_CHAOS),
+        "fleets": list(fleets),
+        "worst_end_pose_err_cells": round(worst_err, 3),
+        "worst_map_f1": round(worst_f1, 3),
+        "scenario_matrix": cells,
+        "deskew_probe": deskew,
+        "loop_probe": loop_probes,
+        "decay_probe": decay,
+        "structural": {
+            "scene_byte_determinism_holds": True,    # asserted above
+            "corridor_ties_deskew_to_identity": True,  # asserted above
+            "loop_closes_under_pr11": True,           # asserted above
+            "decay_fades_moved_obstacle": True,       # asserted above
+            "accuracy_floors_hold": True,             # asserted above
+        },
+        "ceiling_analysis": (
+            "the matrix's claims are structural and accuracy-shaped — "
+            "determinism, observability ties, loop closure, decay "
+            "semantics and floor margins are properties of the int32 "
+            "lattice pipeline, so they hold identically on-chip (the "
+            "mapper math is bit-exact between numpy and XLA by the "
+            "parity suites).  The scans/s headline is the HOST "
+            "reference mapper's drain rate on a 1.5-core CPU rig — "
+            "context, not the chip claim; the on-chip recapture queued "
+            "in scripts/rig_recapture.sh is where the perf column "
+            "lands.  Per-cell records feed decide_backends as the >= "
+            "2-cell corroboration evidence for backend flips."
+        ),
+        "grid": grid,
+        "cell_m": cell,
+        "loop_grid": loop_grid,
+        "loop_cell_m": loop_cell,
+        "beams": beams,
+        "smoke": smoke,
+        "device": str(jax.devices()[0].platform),
+    }
+
+
 def metric_name(config: int) -> str:
     """The one config -> metric-name mapping (success AND failure records
     of a config must share a name to land in the same series)."""
@@ -5513,6 +6046,7 @@ def metric_name(config: int) -> str:
         20: "async_serving_overlapped_scans_per_sec",
         21: "pod_scaleout_balanced_scans_per_sec",
         22: "map_serving_tile_reads_per_sec",
+        23: "scenario_matrix_scans_per_sec",
     }.get(config, f"graded_config{config}_scans_per_sec")
 
 
@@ -5550,6 +6084,8 @@ def main(config: int = 5, median: str = MEDIAN_BACKEND) -> dict:
         return bench_pod_scaleout()
     if kind == "map_serving":
         return bench_map_serving()
+    if kind == "scenarios":
+        return bench_scenarios()
     if kind in ("e2e", "fused", "fleet"):
         global MEDIAN_BACKEND
         MEDIAN_BACKEND = median
@@ -6015,6 +6551,20 @@ if __name__ == "__main__":
         "regression gate for the shared-world mapping plane",
     )
     ap.add_argument(
+        "--smoke-scenarios",
+        action="store_true",
+        help="seconds-scale CPU run of the config-23 scenario matrix "
+        "(small geometry, forced CPU backend, no tunnel probe): sweeps "
+        "procedural foundry scenes x chaos x fleet and asserts scene "
+        "byte-determinism across stream chunkings, the corridor's "
+        "de-skew tie-to-identity vs feature-rich recovery, loop-scene "
+        "closure under the PR 11 engine in both chaos columns, "
+        "moved-obstacle fade under map_decay (and byte-frozen "
+        "persistence without it), plus per-cell end-pose-error and "
+        "map-F1 floors — the tier-1 regression gate for the scenario "
+        "foundry",
+    )
+    ap.add_argument(
         "--xla-cache",
         nargs="?",
         const="artifacts/xla_cache",
@@ -6151,6 +6701,15 @@ if __name__ == "__main__":
         # device link or not
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(bench_map_serving(smoke=True)))
+        raise SystemExit(0)
+
+    if args.smoke_scenarios:
+        # same CPU-only discipline: the foundry's structural gate
+        # (byte-determinism, observability ties, loop closure, decay
+        # semantics, accuracy floors) must run anywhere, device link
+        # or not
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(bench_scenarios(smoke=True)))
         raise SystemExit(0)
 
     # Backend-init watchdog with retry (r3 VERDICT #1): a dead

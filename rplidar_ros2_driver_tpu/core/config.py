@@ -205,6 +205,11 @@ class DriverParams:
     map_log_odds_hit: float = 0.9     # increment per endpoint hit
     map_log_odds_miss: float = -0.4   # decrement per free-space pass
     map_log_odds_clamp: float = 8.0   # saturation bound (±)
+    # per-revolution decay of every cell toward zero (dynamic scenes:
+    # stale moving-obstacle evidence fades even when no ray revisits
+    # it).  0.0 disables — and the gate is static, so the default traces
+    # the byte-identical mapping program the pre-decay tree compiled
+    map_decay: float = 0.0
     # -- SLAM back-end: loop closure + pose graph (slam/loop.
     # LoopClosureEngine + ops/loop_close.py + ops/pose_graph.py) --
     # attach the loop-closure engine beside the mapper: every
@@ -690,6 +695,11 @@ class DriverParams:
             raise ValueError(
                 "map_log_odds_clamp must be >= map_log_odds_hit (a clamp "
                 "below one hit increment can never mark a cell occupied)"
+            )
+        if self.map_decay < 0 or self.map_decay > self.map_log_odds_clamp:
+            raise ValueError(
+                "map_decay must be within [0, map_log_odds_clamp] "
+                "(0 disables; decaying past the clamp is meaningless)"
             )
         if self.loop_backend not in ("auto", "host", "fused"):
             raise ValueError(

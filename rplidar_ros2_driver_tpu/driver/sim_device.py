@@ -109,6 +109,13 @@ class SimConfig:
     # scan start restarts the program at frame 0, so small
     # disconnect_frames indices model reconnect storms.  None = clean.
     chaos: object = None
+    # procedural world provider (scenarios/foundry.FoundryScene or any
+    # object with dist_mm(thetas_deg, revs) -> mm ndarray, 0 = no
+    # return): replaces the sinusoid ring for ALL six wire formats via
+    # the one _scene_dists seam.  None keeps the default ring on the
+    # exact per-beam scalar-math path — byte-identical frames to the
+    # pre-scene tree (pinned by tests/test_scenarios.py goldens).
+    scene: object = None
 
 
 class SimulatedDevice:
@@ -515,6 +522,35 @@ class SimulatedDevice:
             math.radians(theta_deg) + 0.1 * rev
         )
 
+    def _scene_dists(self, pts: np.ndarray) -> np.ndarray:
+        """Ranges (mm, float) for an array of GLOBAL point indices —
+        the ONE beam→(theta, rev) contract for every wire format:
+
+            theta = 360 · (p % points_per_rev) / points_per_rev
+            rev   = p // points_per_rev
+
+        Each beam is evaluated at its OWN revolution, even mid-frame —
+        a capsule frame that straddles a rev boundary mixes two revs,
+        which matters because the default ring's phase advances by
+        0.1 rad per rev (and a foundry scene's pose advances per rev).
+        Pinned by the golden test in tests/test_scenarios.py so scene
+        providers cannot silently disagree with the ring.
+
+        With no scene configured the default sinusoid ring keeps the
+        historical per-beam SCALAR math.sin path — vectorized libm can
+        differ from scalar libm in the last ulp, and the default wire
+        bytes are pinned byte-identical across trees."""
+        ppr = self.cfg.points_per_rev
+        thetas = 360.0 * (pts % ppr) / ppr
+        revs = pts // ppr
+        if self.cfg.scene is not None:
+            return np.asarray(
+                self.cfg.scene.dist_mm(thetas, revs), np.float64
+            )
+        return np.array(
+            [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
+        )
+
     # all six measurement wire formats, (frame bytes, points per frame)
     STREAMABLE = {
         Ans.MEASUREMENT: (NORMAL_NODE_BYTES, 1),
@@ -564,24 +600,16 @@ class SimulatedDevice:
             theta = 360.0 * pos / ppr
             start_q6 = int(theta * 64) & 0x7FFF
             if mode.ans_type == Ans.MEASUREMENT:
-                dist = self._scene_dist_mm(theta, rev)
+                dist = self._scene_dists(np.arange(1) + idx)[0]
                 frame = wire.encode_normal_node(
                     int(theta * 64), int(dist * 4), 0x2F, syncbit=(pos == 0)
                 )
             elif mode.ans_type == Ans.MEASUREMENT_DENSE_CAPSULED:
-                thetas = 360.0 * ((np.arange(40) + idx) % ppr) / ppr
-                revs = (np.arange(40) + idx) // ppr
-                dists = np.array(
-                    [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
-                )
+                dists = self._scene_dists(np.arange(40) + idx)
                 frame = wire.encode_dense_capsule(start_q6, first, dists.astype(int))
             elif mode.ans_type == Ans.MEASUREMENT_CAPSULED:
                 # express capsule: 16 cabins x 2 points
-                thetas = 360.0 * ((np.arange(32) + idx) % ppr) / ppr
-                revs = (np.arange(32) + idx) // ppr
-                dists = np.array(
-                    [self._scene_dist_mm(t, r) for t, r in zip(thetas, revs)]
-                )
+                dists = self._scene_dists(np.arange(32) + idx)
                 dist_q2 = (dists.astype(int) * 4) & ~0x3
                 frame = wire.encode_capsule(
                     start_q6, first, dist_q2.reshape(16, 2), np.zeros((16, 2), int)
@@ -595,11 +623,7 @@ class SimulatedDevice:
                 # are reserved invalid markers.  Encode quantization-aware
                 # against the decoded bases.
                 pts = np.arange(97) + idx  # + first point of the NEXT frame
-                thetas = 360.0 * (pts % ppr) / ppr
-                revs = pts // ppr
-                mm = np.array(
-                    [int(self._scene_dist_mm(t, r)) for t, r in zip(thetas, revs)]
-                )
+                mm = self._scene_dists(pts).astype(np.int64)
                 bases_mm = mm[0::3]  # 33 cabin bases (incl. next frame's)
                 majors = np.array(
                     [wire.varbitscale_encode(int(v)) for v in bases_mm]
@@ -617,24 +641,18 @@ class SimulatedDevice:
                 )
             elif mode.ans_type == Ans.MEASUREMENT_ULTRA_DENSE_CAPSULED:
                 # 32 cabins x 2 points, 20-bit piecewise-scaled samples
-                thetas = 360.0 * ((np.arange(64) + idx) % ppr) / ppr
-                revs = (np.arange(64) + idx) // ppr
+                dists = self._scene_dists(np.arange(64) + idx)
                 words = np.array(
                     [
-                        wire.ultra_dense_encode_sample(
-                            int(self._scene_dist_mm(t, r)), 0x2F
-                        )
-                        for t, r in zip(thetas, revs)
+                        wire.ultra_dense_encode_sample(int(d), 0x2F)
+                        for d in dists
                     ]
                 )
                 frame = wire.encode_ultra_dense_capsule(start_q6, first, words)
             else:  # HQ capsule: 96 pre-formatted nodes + CRC32
                 pts = np.arange(96) + idx
                 thetas = 360.0 * (pts % ppr) / ppr
-                revs = pts // ppr
-                dq2 = np.array(
-                    [int(self._scene_dist_mm(t, r)) * 4 for t, r in zip(thetas, revs)]
-                )
+                dq2 = self._scene_dists(pts).astype(np.int64) * 4
                 flags = np.where(pts % ppr == 0, 1, 2)  # bit0 sync else !sync
                 frame = wire.encode_hq_capsule(
                     (thetas * (65536.0 / 360.0)).astype(int),

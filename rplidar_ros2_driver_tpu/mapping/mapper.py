@@ -137,6 +137,7 @@ def map_config_from_params(
         hit_q=int(round(params.map_log_odds_hit * LO_SCALE)),
         miss_q=int(round(params.map_log_odds_miss * LO_SCALE)),
         clamp_q=clamp_q,
+        decay_q=int(round(getattr(params, "map_decay", 0.0) * LO_SCALE)),
         coarse=coarse,
         window_cells=max(
             1, int(math.ceil(params.map_match_window / (cell * coarse)))

@@ -133,6 +133,12 @@ class MapConfig:
     window_cells: int = 2      # coarse translation radius (coarse cells)
     fine_radius: int = 4       # fine translation radius (cells)
     free_samples: int = 4      # ray samples for the free-space miss pass
+    # Q10 per-revolution log-odds decay toward zero (dynamic scenes:
+    # stale moving-obstacle cells fade even when no ray revisits them).
+    # 0 disables — and the gate is STATIC Python, so a decay-off config
+    # traces the byte-identical program the pre-decay tree compiled
+    # (the deskew-plane discipline: an off feature costs nothing)
+    decay_q: int = 0
     quant_shift: int = 4       # match-map right shift (int32 score bound)
     voxel_backend: str = "scatter"  # endpoint histogram: scatter | matmul
     # score-volume + log-odds-update lowering: "xla" (the jnp arm below)
@@ -158,6 +164,11 @@ class MapConfig:
             )
         if self.clamp_q < self.hit_q:
             raise ValueError("log-odds clamp must be >= the hit increment")
+        if self.decay_q < 0 or self.decay_q > self.clamp_q:
+            raise ValueError(
+                "log-odds decay must satisfy 0 <= decay_q <= clamp_q "
+                "(0 disables; anything past the clamp is meaningless)"
+            )
         if self.theta_window >= self.theta_divisions // 2:
             raise ValueError("theta window exceeds half a turn")
         if self.match_backend not in ("xla", "pallas"):
@@ -535,9 +546,18 @@ def update_map(
     ``cfg.match_backend`` routes the whole update through the Pallas
     one-hot/matmul kernel (ops/pallas_scan_match.log_odds_update_pallas)
     or the jnp arm below; both are bit-identical to the NumPy reference
-    (integer counts, integer increments — nothing order-sensitive)."""
+    (integer counts, integer increments — nothing order-sensitive).
+
+    ``cfg.decay_q`` (when nonzero) first shrinks every cell toward zero
+    by that Q10 amount — stale dynamic-obstacle evidence fades even in
+    cells no ray revisits.  Applied BEFORE the backend branch so both
+    arms inherit it identically; the gate is static Python, so the
+    default decay_q=0 program is byte-identical to the pre-decay one."""
     g = cfg.grid
     center = (g // 2) * SUB
+    if cfg.decay_q:
+        mag = jnp.maximum(jnp.abs(log_odds) - cfg.decay_q, 0)
+        log_odds = jnp.sign(log_odds) * mag
     table = jnp.asarray(rotation_table(cfg.theta_divisions))
     cos_q = jnp.take(table[:, 0], pose[2])
     sin_q = jnp.take(table[:, 1], pose[2])

@@ -173,6 +173,11 @@ def match_scan_np(log_odds, pose, pq, ok, cfg: MapConfig):
 def update_map_np(log_odds, pose, pq, ok, cfg: MapConfig):
     g = cfg.grid
     center = (g // 2) * SUB
+    if cfg.decay_q:
+        # literal twin of the static-gated decay in ops/scan_match.py:
+        # shrink toward zero BEFORE the hit/miss pass
+        mag = np.maximum(np.abs(log_odds) - cfg.decay_q, 0)
+        log_odds = (np.sign(log_odds) * mag).astype(np.int32)
     table = rotation_table(cfg.theta_divisions)
     cos_q, sin_q = table[pose[2], 0], table[pose[2], 1]
     wx, wy = rotate_points_np(pq, cos_q, sin_q)

@@ -49,8 +49,24 @@ _DECISION_KEYS = (
     "super_tick_ab", "mapping_ab", "pallas_match_ab", "failover_ab",
     "deskew_ab", "loop_close_ab", "fused_mapping_ab",
     "elastic_serving_ab", "async_serving_ab", "pod_scaleout_ab",
-    "map_serving_ab",
+    "map_serving_ab", "scenario_matrix",
 )
+
+# config 23: which scenario-matrix cell flag corroborates which
+# mapping flip.  Speed ratios answer "is the backend faster"; the
+# scenario matrix answers "does the subsystem still land the accuracy
+# claim outside the synthetic ring".  A flip on any of these mappings
+# must be corroborated by >= 2 unclamped matrix cells whose flag holds
+# — one cell is one layout, and the loop-scene calibration history
+# shows single layouts lie (perceptual aliasing, layout-sensitive
+# slips).  Clamped cells (wall time under the timer floor) carry no
+# corroboration weight, same as every clamped ratio above.
+_SCENARIO_CORROBORATION = {
+    "deskew_enable.tpu": "deskew_ok",
+    "loop_enable.tpu": "loop_ok",
+    "loop_backend.tpu": "loop_ok",
+    "match_backend.tpu": "match_ok",
+}
 
 
 def _strength(value: float) -> float:
@@ -67,6 +83,7 @@ def analyze(records: list[dict]) -> dict:
     evidence per mapping (largest |log ratio|) — last-wins would let a
     degraded-link record mask a healthy one."""
     out: dict = {"recommendations": {}, "evidence": {}, "non_tpu_ignored": []}
+    scenario_cells: list[dict] = []
 
     def recommend(mapping: str, entry: dict) -> None:
         prev = out["recommendations"].get(mapping)
@@ -610,6 +627,21 @@ def analyze(records: list[dict]) -> dict:
                 ) if k in msb
             })
 
+        # config 23: scenario-matrix accuracy cells (corroboration
+        # evidence, not a ratio — consumed by the post-pass below)
+        sm = rec.get("scenario_matrix")
+        if isinstance(sm, list):
+            cells = [c for c in sm if isinstance(c, dict)]
+            scenario_cells.extend(cells)
+            out["evidence"].setdefault("scenario_matrix", []).append({
+                "cells": len(cells),
+                "clamped": sum(1 for c in cells if c.get("clamped")),
+                "worst_end_pose_err_cells": rec.get(
+                    "worst_end_pose_err_cells"
+                ),
+                "worst_map_f1": rec.get("worst_map_f1"),
+            })
+
         # ablation: resample + voxel kernels
         derived = rec.get("derived")
         if isinstance(derived, dict):
@@ -626,6 +658,33 @@ def analyze(records: list[dict]) -> dict:
                     "dense_vs_scatter_speedup", float(v), "ablation",
                 ))
             out["evidence"].setdefault("ablation_derived", []).append(derived)
+
+    # scenario-corroboration post-pass: with config-23 cells in the
+    # artifact set, an accuracy-coupled flip must show its subsystem
+    # winning in >= 2 unclamped scenario cells or it is downgraded to
+    # keep.  With NO scenario records the pass is inert — older
+    # artifact sets keep their standing semantics (the matrix adds a
+    # gate where it has evidence, it never invents one).
+    if scenario_cells:
+        for mapping, flag in _SCENARIO_CORROBORATION.items():
+            entry = out["recommendations"].get(mapping)
+            if entry is None:
+                continue
+            support = sum(
+                1 for c in scenario_cells
+                if c.get(flag) and not c.get("clamped")
+            )
+            entry["scenario_cells"] = support
+            if entry.get("flip") and support < 2:
+                entry["flip"] = False
+                entry["recommended"] = entry["current"]
+                entry["scenario_corroboration"] = (
+                    f"insufficient: {support} < 2 unclamped cells"
+                )
+            else:
+                entry["scenario_corroboration"] = (
+                    f"{support} unclamped cells"
+                )
 
     return out
 

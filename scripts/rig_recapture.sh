@@ -98,7 +98,8 @@ if [ $# -eq 0 ]; then
     "python bench.py --config 19" \
     "python bench.py --config 20" \
     "python bench.py --config 21" \
-    "python bench.py --config 22"
+    "python bench.py --config 22" \
+    "python bench.py --config 23"
 fi
 for cmd in "$@"; do
   # NOTE: commands are split on whitespace (plain sh expansion) — pass

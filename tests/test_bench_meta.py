@@ -36,6 +36,7 @@ def test_metric_names_stable():
     assert bench.metric_name(20) == "async_serving_overlapped_scans_per_sec"
     assert bench.metric_name(21) == "pod_scaleout_balanced_scans_per_sec"
     assert bench.metric_name(22) == "map_serving_tile_reads_per_sec"
+    assert bench.metric_name(23) == "scenario_matrix_scans_per_sec"
 
 
 def test_graded_table_well_formed():
@@ -45,7 +46,7 @@ def test_graded_table_well_formed():
             "fleet_ingest", "super_tick", "mapping", "chaos",
             "pallas_match", "failover", "deskew", "loop_close",
             "fused_mapping", "elastic_serving", "async_serving",
-            "pod_scaleout", "map_serving",
+            "pod_scaleout", "map_serving", "scenarios",
         )
         assert points > 0
         assert isinstance(over, dict)
@@ -2156,3 +2157,139 @@ def test_decide_backends_fleet_ingest_key():
     ])
     rec = keep["recommendations"]["fleet_ingest_backend.tpu"]
     assert rec["flip"] is False and rec["recommended"] == "host"
+
+
+def test_bench_smoke_scenarios():
+    """`bench.py --smoke-scenarios` — the tier-1 gate for the scenario
+    foundry (config-23 matrix at seconds-scale CPU geometry).  The
+    structural claims are what matters: scene byte-determinism across
+    chunkings, the corridor tying de-skew to identity (the first-min-
+    wins contract), the loop scene closing under the PR 11 machinery,
+    decay-on fading a moved obstacle while decay-off stays byte-frozen,
+    and the per-cell accuracy floors (the bench itself raises on
+    violation; this gate pins that the asserted artifact lands).  The
+    throughput headline is a catastrophe floor on CPU; the perf story
+    belongs to on-chip captures."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--smoke-scenarios"],
+        cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == bench.metric_name(23)
+    assert out["smoke"] is True and out["device"] == "cpu"
+    s = out["structural"]
+    for claim in (
+        "scene_byte_determinism_holds", "corridor_ties_deskew_to_identity",
+        "loop_closes_under_pr11", "decay_fades_moved_obstacle",
+        "accuracy_floors_hold",
+    ):
+        assert s[claim] is True, claim
+    # the matrix itself: every (scene, chaos, fleet) cell carries both
+    # accuracy numbers and a perf number, and the corroboration flags
+    # decide_backends consumes
+    cells = out["scenario_matrix"]
+    assert len(cells) == len(out["scenes"]) * len(out["chaos"]) * len(
+        out["fleets"]
+    )
+    for c in cells:
+        assert c["scene"] in out["scenes"] and c["chaos"] in out["chaos"]
+        assert c["end_pose_err_cells"] >= 0.0
+        assert 0.0 <= c["map_f1"] <= 1.0
+        assert c["scans_per_sec"] > 0
+        for flag in ("deskew_ok", "loop_ok", "match_ok", "clamped"):
+            assert isinstance(c[flag], bool), flag
+    # the probes ride along: loop closure corrected the injected drift
+    for chaos, probe in out["loop_probe"].items():
+        assert probe["corrected_end_err_cells"] < probe[
+            "baseline_end_err_cells"
+        ], chaos
+        assert probe["closures_accepted"] >= 1
+    # decay: off-arm stale evidence persisted byte-frozen, on-arm faded
+    dp = out["decay_probe"]
+    assert dp["stale_region_max_q_off"] > 0
+    assert dp["stale_region_max_q_on"] <= 0
+    assert out["value"] > 0
+    assert "ceiling_analysis" in out
+
+
+def test_decide_backends_scenario_corroboration():
+    """Config-23 cells gate accuracy-coupled flips: with scenario
+    records present, a deskew/loop/match flip needs >= 2 unclamped
+    supporting cells or it is downgraded to keep; clamped cells carry
+    no weight; with NO scenario records the pass is inert (older
+    artifact sets keep their standing semantics)."""
+    import importlib
+    import sys as _sys
+
+    _sys.path.insert(0, "scripts")
+    try:
+        db = importlib.import_module("decide_backends")
+    finally:
+        _sys.path.pop(0)
+
+    deskew = {
+        "device": "tpu",
+        "deskew_ab": {"update_multiplier": 2.5, "steady_tick_ratio": 0.97},
+    }
+    loop = {
+        "device": "tpu",
+        "loop_close_ab": {
+            "backend_speedup": 1.3,
+            "corrected_end_err_cells": 1.0,
+            "steady_tick_ratio": 0.95,
+        },
+    }
+
+    def cell(**flags):
+        return {"scene": "x", "chaos": "clean", "clamped": False, **flags}
+
+    # no scenario records: flips stand untouched (back-compat)
+    got = db.analyze([deskew, loop])
+    assert got["recommendations"]["deskew_enable.tpu"]["flip"] is True
+    assert "scenario_corroboration" not in got[
+        "recommendations"]["deskew_enable.tpu"]
+
+    # >= 2 unclamped supporting cells: the flip stands, annotated
+    sm2 = {"device": "tpu", "scenario_matrix": [
+        cell(deskew_ok=True, loop_ok=True, match_ok=True),
+        cell(deskew_ok=True, loop_ok=True, match_ok=True),
+    ]}
+    got = db.analyze([deskew, loop, sm2])
+    for mapping in ("deskew_enable.tpu", "loop_enable.tpu",
+                    "loop_backend.tpu"):
+        r = got["recommendations"][mapping]
+        assert r["flip"] is True and r["scenario_cells"] == 2, mapping
+
+    # one supporting cell (the other clamped): downgraded to keep
+    sm1 = {"device": "tpu", "scenario_matrix": [
+        cell(deskew_ok=True, loop_ok=True),
+        dict(cell(deskew_ok=True, loop_ok=True), clamped=True),
+    ]}
+    got = db.analyze([deskew, loop, sm1])
+    for mapping, current in (("deskew_enable.tpu", "false"),
+                             ("loop_enable.tpu", "false"),
+                             ("loop_backend.tpu", "host")):
+        r = got["recommendations"][mapping]
+        assert r["flip"] is False and r["recommended"] == current, mapping
+        assert "insufficient" in r["scenario_corroboration"], mapping
+
+    # CPU scenario records: reported, no corroboration weight either way
+    cpu_sm = dict(sm2, device="cpu")
+    got = db.analyze([deskew, cpu_sm])
+    assert got["recommendations"]["deskew_enable.tpu"]["flip"] is True
+    assert "scenario_corroboration" not in got[
+        "recommendations"]["deskew_enable.tpu"]
+    assert got["non_tpu_ignored"]
+
+    # scenario records WITHOUT the ratio records: cells land in
+    # evidence but invent no recommendation
+    got = db.analyze([sm2])
+    assert "deskew_enable.tpu" not in got["recommendations"]
+    assert got["evidence"]["scenario_matrix"][0]["cells"] == 2
