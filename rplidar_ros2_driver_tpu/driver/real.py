@@ -326,6 +326,8 @@ class RealLidarDriver(LidarDriverInterface):
         )
         if not self._engine.send_only(Cmd.EXPRESS_SCAN, payload):
             return False
+        # graftlint: disable=GL012 — helper reached only from start_scan/
+        # _start_old_type, whose public entries hold self._lock (RLock)
         self._scanning = True
         self.profile.active_mode = mode.name
         self.profile.active_rpm = target_rpm
@@ -383,6 +385,8 @@ class RealLidarDriver(LidarDriverInterface):
         self._begin_streaming()
         if not self._engine.send_only(Cmd.SCAN):
             return False
+        # graftlint: disable=GL012 — helper reached only from start_scan/
+        # _start_old_type, whose public entries hold self._lock (RLock)
         self._scanning = True
         self.profile.active_mode = "Standard"
         self.profile.active_rpm = DEFAULT_RPM

@@ -206,6 +206,7 @@ def publish_tiles(plane, cfg: TileConfig, version: int) -> TileSnapshot:
     )
 
 
+# graftlint: read-path
 def snapshot_grid(snap: TileSnapshot) -> np.ndarray:
     """Reconstruct the full (G, G) int32 serving grid from a
     snapshot: dropped tiles are zero, resident tiles dequantize at

@@ -374,6 +374,7 @@ class WorldMap:
         self._dirty = False
         return snap
 
+    # graftlint: read-path
     def snapshot(self) -> Optional[TileSnapshot]:
         """The latest published serving view — immutable; readers keep
         whatever version they grabbed.  None until first publication."""
@@ -405,6 +406,7 @@ class WorldMap:
         snap = self._snapshot.payload_bytes if self._snapshot else 0
         return acc + planes + snap
 
+    # graftlint: read-path
     def status(self) -> dict:
         """The /diagnostics "World Map" payload."""
         snap = self._snapshot

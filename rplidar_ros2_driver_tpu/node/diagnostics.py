@@ -50,6 +50,7 @@ class DiagnosticsUpdater:
         self._publisher = publisher
         self.last: Optional[DiagnosticStatus] = None
 
+    # graftlint: read-path
     def update(
         self,
         lifecycle: LifecycleState,

@@ -136,8 +136,11 @@ class DeskewConfig:
             )
         if not (2 <= self.recon_window <= 64):
             raise ValueError("sweep_reconstruct_window must be in [2, 64]")
-        if self.recon_beams < 8:
-            raise ValueError("recon_beams must be >= 8")
+        if not (8 <= self.recon_beams <= 8192):
+            raise ValueError(
+                "recon_beams must be in [8, 8192] (the declared GL011 "
+                "reconstruction-sum bound)"
+            )
         if not (0 < self.max_trans_q2 <= (1 << 11)):
             raise ValueError(
                 "deskew max_trans_q2 must be in (0, 2^11] (the int32 "
@@ -327,7 +330,12 @@ def estimate_motion(prev_prof, cur_prof, cfg: DeskewConfig):
     den_y = jnp.sum(s7 * s7 * bi)
     dx = jnp.clip(-(num_x // jnp.maximum(den_x >> 7, 1)), -mt, mt)
     dy = jnp.clip(-(num_y // jnp.maximum(den_y >> 7, 1)), -mt, mt)
-    dth = s_best * (65536 // d)
+    # DeskewConfig.__post_init__ guarantees shift_window * (65536 // d)
+    # <= 2^13, so the clip is a numeric no-op — but apply_deskew later
+    # computes rem * motion[2] with rem up to 2^16, so motion[2] must be
+    # BOUNDED, not merely bounded-in-practice, for that product to stay
+    # inside int32.
+    dth = jnp.clip(s_best * (65536 // d), -(1 << 13), 1 << 13)
     motion = jnp.stack([dx, dy, dth]).astype(jnp.int32)
     return jnp.where(usable, motion, jnp.zeros((3,), jnp.int32))
 

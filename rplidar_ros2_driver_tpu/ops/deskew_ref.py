@@ -102,7 +102,7 @@ def estimate_motion_np(prev_prof, cur_prof, cfg: DeskewConfig):
     den_y = int(np.sum(s7 * s7 * bi))
     dx = int(np.clip(-(num_x // max(den_x >> 7, 1)), -mt, mt))
     dy = int(np.clip(-(num_y // max(den_y >> 7, 1)), -mt, mt))
-    dth = s_best * (65536 // d)
+    dth = int(np.clip(s_best * (65536 // d), -(1 << 13), 1 << 13))
     return np.asarray([dx, dy, dth], np.int32)
 
 

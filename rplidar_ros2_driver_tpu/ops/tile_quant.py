@@ -154,6 +154,9 @@ def rle_payload_bytes(n_runs: int, bits: int) -> int:
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+# graftlint: disable=GL011 — the accumulation is RAW int32 with wrap as
+# the documented group contract (retract is the exact inverse); no bound
+# exists to declare
 def fuse_accumulate(acc, plane):
     """``acc + plane`` with the accumulation donated in place — the
     world merge op.  int32 addition is associative/commutative (wrap
@@ -163,6 +166,7 @@ def fuse_accumulate(acc, plane):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+# graftlint: disable=GL011 — same wrap-group contract as fuse_accumulate
 def fuse_retract(acc, plane):
     """``acc - plane`` with the accumulation donated — submap
     EVICTION.  Addition forms a group over int32, so retracting a
@@ -171,6 +175,7 @@ def fuse_retract(acc, plane):
     return acc - plane
 
 
+# graftlint: disable=GL011 — host twin of the wrap-group accumulation
 def fuse_planes_np(planes) -> np.ndarray:
     """Host twin of an arbitrary-order fusion: the plain int32 sum of
     a sequence of planes (the shuffled-order oracle the bench and

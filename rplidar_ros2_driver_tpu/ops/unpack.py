@@ -70,6 +70,8 @@ def _u16(f: jax.Array, off: int) -> jax.Array:
 
 
 def _u32(f: jax.Array, off: int) -> jax.Array:
+    # graftlint: disable=GL011 — little-endian u32 assembly: byte<<24
+    # wraps int32 by design, consumers read the bit pattern only
     return f[:, off] | (f[:, off + 1] << 8) | (f[:, off + 2] << 16) | (f[:, off + 3] << 24)
 
 
@@ -243,6 +245,9 @@ _VBS_TARGET = np.array(
 
 def _varbitscale_decode(scaled: jax.Array):
     lvl = jnp.sum(scaled[..., None] >= jnp.asarray(_VBS_SCALED)[None, :], -1) - 1
+    # graftlint: disable=GL011 — lvl in [0, 4] by construction (sum over
+    # the 5-entry threshold axis), so the shift is <= 4 bits on a 12-bit
+    # residual; the interpreter over-approximates the axis sum
     value = jnp.asarray(_VBS_TARGET)[lvl] + ((scaled - jnp.asarray(_VBS_SCALED)[lvl]) << lvl)
     return value, lvl
 
@@ -286,10 +291,15 @@ def unpack_ultra_capsules(frames) -> DecodedNodes:
         p[:, cab_off]
         | (p[:, cab_off + 1] << 8)
         | (p[:, cab_off + 2] << 16)
+        # graftlint: disable=GL011 — u32 cabin assembly: byte<<24 wraps
+        # int32 BY DESIGN; only the bit pattern is consumed below
         | (p[:, cab_off + 3] << 24)
     )  # int32, may be "negative" — bit pattern is what matters
 
     major_raw = w & 0xFFF
+    # graftlint: disable=GL011 — (w<<10)>>22 is the sign-extending field
+    # extract from the C decoder: the left shift wraps deliberately so
+    # the arithmetic right shift reproduces the 10-bit two's complement
     predict1 = (w << 10) >> 22   # arithmetic shifts reproduce the C magic
     predict2 = w >> 22
     # next cabin's major: shift within frame; last cabin takes cabin 0 of cur
@@ -304,9 +314,17 @@ def unpack_ultra_capsules(frames) -> DecodedNodes:
 
     d0 = major << 2
     inval1 = (predict1 == -512) | (predict1 == 511)
+    # graftlint: disable=GL011 — predict is a 10-bit two's-complement
+    # field (|predict| <= 512) and lvl <= 4 by varbitscale construction:
+    # (512<<4 + 28656) << 2 < 2^18, but the interpreter cannot see the
+    # data-dependent lvl cap
     d1 = jnp.where(inval1, 0, ((predict1 << lvl1) + base1) << 2)
     inval2 = (predict2 == -512) | (predict2 == 511)
+    # graftlint: disable=GL011 — same 10-bit predict / lvl<=4 argument
     d2 = jnp.where(inval2, 0, ((predict2 << lvl2) + major2) << 2)
+    # graftlint: disable=GL011 — |dist| <= (512<<4 + 28656) << 2 < 2^18
+    # by the predict/varbitscale widths above; clipping here would break
+    # bit-parity with unpack_ref.UltraCapsuleDecoder on garbage cabins
     dist = jnp.stack([d0, d1, d2], -1).reshape(p.shape[0], 96)
 
     k2 = jnp.asarray(98361, jnp.int32) // jnp.maximum(dist, 1)
@@ -401,19 +419,19 @@ def unpack_dense_capsules(frames, last_sync_out=0, sample_duration_us: int = 476
 _UD_T1, _UD_T2, _UD_T3 = 2046, 8187, 24567
 
 
-def _ud_decode_words(w: jax.Array):
+def _ud_decode_words(w20: jax.Array):
     """(raw dist_q2, quality) from 20-bit words — branchless 4-level scale
     (handler_capsules.cpp:991-1017)."""
-    scale = w & 0x3
-    d0 = (w & 0xFFC) * 2
-    d1 = (w & 0x1FFC) * 3 + (_UD_T1 << 2)
-    d2 = (w & 0x3FFC) * 4 + (_UD_T2 << 2)
-    d3 = (w & 0x7FFC) * 5 + (_UD_T3 << 2)
+    scale = w20 & 0x3
+    d0 = (w20 & 0xFFC) * 2
+    d1 = (w20 & 0x1FFC) * 3 + (_UD_T1 << 2)
+    d2 = (w20 & 0x3FFC) * 4 + (_UD_T2 << 2)
+    d3 = (w20 & 0x7FFC) * 5 + (_UD_T3 << 2)
     dist = jnp.select([scale == 0, scale == 1, scale == 2], [d0, d1, d2], d3)
-    q0 = w >> 12
-    q1 = ((w >> 13) << 1) & 0xFF
-    q2 = ((w >> 14) << 2) & 0xFF
-    q3 = ((w >> 15) << 3) & 0xFF
+    q0 = w20 >> 12
+    q1 = ((w20 >> 13) << 1) & 0xFF
+    q2 = ((w20 >> 14) << 2) & 0xFF
+    q3 = ((w20 >> 15) << 3) & 0xFF
     qual = jnp.select([scale == 0, scale == 1, scale == 2], [q0, q1, q2], q3)
     return dist, qual, scale
 
@@ -507,6 +525,8 @@ def unpack_hq_capsules(frames, crc_ok=None) -> DecodedNodes:
     frame_valid = sync_ok if crc_ok is None else sync_ok & jnp.asarray(crc_ok)
     off = 9 + 8 * jnp.arange(HQ_NODES_PER_CAPSULE, dtype=jnp.int32)
     angle_q14 = f[:, off] | (f[:, off + 1] << 8)
+    # graftlint: disable=GL011 — u32 dist field assembly: byte<<24 wraps
+    # int32 by design (the wire field is 32-bit little-endian)
     dist = f[:, off + 2] | (f[:, off + 3] << 8) | (f[:, off + 4] << 16) | (f[:, off + 5] << 24)
     quality = f[:, off + 6]
     flag = f[:, off + 7]

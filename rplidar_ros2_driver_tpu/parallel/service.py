@@ -742,6 +742,7 @@ class ShardedFilterService:
                 self.world.publish()
         return outs
 
+    # graftlint: read-path
     def scheduler_status(self) -> Optional[dict]:
         """The /diagnostics scheduler value group's payload (None when
         no shaper is attached)."""
@@ -2651,6 +2652,7 @@ class ElasticFleetService:
             ),
         }
 
+    # graftlint: read-path
     def scheduler_status(self) -> Optional[dict]:
         """The /diagnostics scheduler value group's payload (None when
         no shaper is attached): current rungs, per-stream backlog

@@ -32,14 +32,41 @@ line, and ``# graftlint: policed — reason`` blesses a float→int cast):
   GL008  structural consistency: jitted ``ops/`` entries reachable from
          a ``precompile()``; every ``bench.py --config N`` pinned in
          ``test_bench_meta.py``; every ``DriverParams`` field present in
-         ``param/rplidar.yaml`` and validated in ``core/config.py``
+         ``param/rplidar.yaml`` and validated in ``core/config.py``;
+         every headline scans/s metric in ``bench.py`` computed via
+         ``TimedWindow.rate()`` (one numerator/denominator seam)
+  GL009  unbounded retry loops: ``while True`` sleeping a constant
+         delay with no attempt cap, deadline, or computed backoff
+  GL010  ``pl.pallas_call`` under ``ops/`` not threaded through the
+         ``_lowering_dispatch`` compiled-vs-interpret selector
+  GL011  fixed-point overflow prover: an interval abstract interpreter
+         propagates the ranges declared in
+         ``[tool.graftlint.gl011.bounds]`` through the bit-exact zones
+         and flags any product / left shift / sum-reduce / scatter-add
+         not provably inside int32 (an undeclared int-typed zone
+         entry-point parameter is itself a finding)
+  GL012  lock-discipline race detector: a ``self._x`` written from two
+         or more thread contexts (``threading.Thread``/``Timer``
+         targets + the caller context) must hold the lock declared for
+         it in ``[tool.graftlint.locks]``; nested acquisitions build a
+         global lock-order graph and cycles are flagged as deadlocks
+  GL013  zero-dispatch read-path prover: reachability from a
+         ``# graftlint: read-path``-marked def to anything dispatching
+         (jitted callables, ``device_put``/``device_get``, ``jnp.*``
+         ops, engine ``submit_*``) is a finding, with the call path as
+         the witness
 
 Per-module invariant declarations (zones, hot files, naming-convention
-dtype patterns, exemptions) live in ``pyproject.toml`` under
-``[tool.graftlint]``; findings must reconcile against the checked-in
-baseline (empty in a healthy tree — every entry needs a justification).
+dtype patterns, value bounds, lock maps, exemptions) live in
+``pyproject.toml`` under ``[tool.graftlint]``; findings must reconcile
+against the checked-in baseline (empty in a healthy tree — every entry
+needs a justification).
 
-CLI: ``python -m rplidar_ros2_driver_tpu.tools.graftlint [--json]``.
+CLI: ``python -m rplidar_ros2_driver_tpu.tools.graftlint``
+with ``--json`` / ``--json-out PATH`` (machine output / CI artifact),
+``--github`` (PR-inline ``::error`` annotations), ``--jobs N|auto``
+(process-pool parse), and ``--explain GLxxx`` (rationale + the
+interval/lock/path witness behind each finding).
 """
 
 from rplidar_ros2_driver_tpu.tools.graftlint.config import LintConfig, load_config

@@ -38,6 +38,25 @@ class LintConfig:
     bool_names: tuple = ()          # regexes: names carrying masks
     # GL007 hot-loop files
     hot_files: tuple = ()
+    # GL011: fixed-point overflow prover — the declared input ranges.
+    # bounds/call_bounds are ((name, lo, hi), ...); sum_elems is
+    # ((zone relpath, element-count cap), ...) for reductions.
+    gl011_zones: tuple = ()
+    gl011_bounds: tuple = ()
+    gl011_call_bounds: tuple = ()
+    gl011_sum_elems: tuple = ()
+    gl011_sum_elems_default: int = 4096
+    # GL012: lock discipline — ((class, lock attr, (guarded fields...)),
+    # ...) plus extra thread roots ("relpath::Class.method") for
+    # callback entry points static analysis can't see registered
+    locks: tuple = ()
+    gl012_extra_roots: tuple = ()
+    # GL013: what counts as "dispatching" on a read path
+    gl013_dispatch_calls: tuple = (
+        "device_put", "device_get", "block_until_ready",
+    )
+    gl013_dispatch_prefixes: tuple = ("submit_",)
+    gl013_dispatch_heads: tuple = ("jax", "jnp", "jax.numpy", "jax.lax", "lax")
     # GL008 structural-consistency inputs
     bench: str = "bench.py"
     bench_meta_test: str = "tests/test_bench_meta.py"
@@ -50,6 +69,24 @@ class LintConfig:
         return tuple(re.compile(p) for p in self.int_names), tuple(
             re.compile(p) for p in self.float_names
         ), tuple(re.compile(p) for p in self.bool_names)
+
+    # tuple-of-tuples storage keeps the dataclass frozen; the rules want
+    # dict views
+    def gl011_bound_map(self) -> dict:
+        return {n: (lo, hi) for n, lo, hi in self.gl011_bounds}
+
+    def gl011_call_bound_map(self) -> dict:
+        return {n: (lo, hi) for n, lo, hi in self.gl011_call_bounds}
+
+    def gl011_sum_elems_map(self) -> dict:
+        return {rel: n for rel, n in self.gl011_sum_elems}
+
+    def lock_map(self) -> dict:
+        """{class: {lock attr: frozenset(guarded fields)}}"""
+        out: dict = {}
+        for cls, lock, fields in self.locks:
+            out.setdefault(cls, {})[lock] = frozenset(fields)
+        return out
 
 
 def load_config(root: str) -> LintConfig:
@@ -64,6 +101,16 @@ def load_config(root: str) -> LintConfig:
     g4 = t.get("gl004", {})
     g7 = t.get("gl007", {})
     g8 = t.get("gl008", {})
+    g11 = t.get("gl011", {})
+    g12 = t.get("gl012", {})
+    g13 = t.get("gl013", {})
+    locks_t = t.get("locks", {})
+    locks = tuple(
+        (cls, lock, tuple(fields))
+        for cls, table in sorted(locks_t.items())
+        for lock, fields in sorted(table.items())
+    )
+    dflt = LintConfig(root=root)
     return LintConfig(
         root=root,
         paths=tuple(t.get("paths", ("rplidar_ros2_driver_tpu",))),
@@ -83,6 +130,30 @@ def load_config(root: str) -> LintConfig:
         params_yaml=g8.get("params_yaml", "param/rplidar.yaml"),
         unvalidated_params_ok=tuple(g8.get("unvalidated_params_ok", ())),
         precompile_exempt=tuple(g8.get("precompile_exempt", ())),
+        gl011_zones=tuple(g11.get("zones", ())),
+        gl011_bounds=tuple(
+            (n, lo, hi) for n, (lo, hi) in sorted(
+                g11.get("bounds", {}).items()
+            )
+        ),
+        gl011_call_bounds=tuple(
+            (n, lo, hi) for n, (lo, hi) in sorted(
+                g11.get("call_bounds", {}).items()
+            )
+        ),
+        gl011_sum_elems=tuple(sorted(g11.get("sum_elems", {}).items())),
+        gl011_sum_elems_default=g11.get("sum_elems_default", 4096),
+        locks=locks,
+        gl012_extra_roots=tuple(g12.get("extra_roots", ())),
+        gl013_dispatch_calls=tuple(
+            g13.get("dispatch_calls", dflt.gl013_dispatch_calls)
+        ),
+        gl013_dispatch_prefixes=tuple(
+            g13.get("dispatch_prefixes", dflt.gl013_dispatch_prefixes)
+        ),
+        gl013_dispatch_heads=tuple(
+            g13.get("dispatch_heads", dflt.gl013_dispatch_heads)
+        ),
     )
 
 

@@ -27,6 +27,7 @@ _SUPPRESS_RE = re.compile(
 _POLICED_RE = re.compile(r"#\s*graftlint:\s*policed\s*[—–-]\s*\S")
 _HOT_RE = re.compile(r"#\s*graftlint:\s*hot-loop\b")
 _HOT_END_RE = re.compile(r"#\s*graftlint:\s*end-hot-loop\b")
+_READ_PATH_RE = re.compile(r"#\s*graftlint:\s*read-path\b")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,6 +36,11 @@ class Finding:
     path: str       # repo-relative
     line: int
     message: str
+    # the proof artifact behind the finding (an interval trace, an
+    # unlocked write pair, a call path) — shown by `--explain`, NOT part
+    # of key(): witnesses carry line numbers and interval endpoints that
+    # churn with unrelated edits, and baseline identity must not
+    witness: str = ""
 
     def key(self) -> tuple:
         # line numbers churn with unrelated edits; identity is
@@ -82,6 +88,7 @@ class ModuleFile:
         self._index_imports(self.tree)
         self._index_functions()
         self._index_hot_regions()
+        self.read_path_funcs: tuple = self._index_read_paths()
 
     # -- suppression / marker surface ------------------------------------
 
@@ -152,6 +159,32 @@ class ModuleFile:
             nxt = next((d for d in defs if d[0] > ln), None)
             if nxt is not None:
                 self.hot_regions.append((nxt[0], nxt[1]))
+
+    def _index_read_paths(self) -> tuple:
+        """``# graftlint: read-path`` on a standalone comment line marks
+        the NEXT ``def`` as a zero-dispatch read-path root (GL013): the
+        function and everything it can reach must never dispatch.  The
+        marker is a contract, not documentation — the prover starts
+        here."""
+        marks = sorted(
+            ln for ln, c in self.comments.items() if _READ_PATH_RE.search(c)
+        )
+        if not marks:
+            return ()
+        defs = sorted(
+            (
+                f.node.decorator_list[0].lineno
+                if f.node.decorator_list else f.node.lineno,
+                qn,
+            )
+            for qn, f in self.functions.items()
+        )
+        out = []
+        for ln in marks:
+            nxt = next((qn for d, qn in defs if d > ln), None)
+            if nxt is not None:
+                out.append(nxt)
+        return tuple(out)
 
     # -- imports ----------------------------------------------------------
 
@@ -273,28 +306,65 @@ def _int_tuple(node) -> tuple:
     return ()
 
 
-class RepoIndex:
-    """All scanned modules + the cross-module call graph."""
+def _parse_one(root: str, rel: str):
+    """Pool worker: parse one file (module-level so it pickles).
+    Returns ``(rel, ModuleFile | None)`` — parse failures stay CI's
+    problem, exactly as in the serial path."""
+    try:
+        return rel.replace(os.sep, "/"), ModuleFile(root, rel)
+    except (SyntaxError, UnicodeDecodeError):
+        return rel.replace(os.sep, "/"), None
 
-    def __init__(self, cfg: LintConfig) -> None:
+
+class RepoIndex:
+    """All scanned modules + the cross-module call graph.
+
+    ``jobs > 1`` parses modules in a process pool — the per-file
+    parse/tokenize phase is embarrassingly parallel, while everything
+    cross-module (call graph, rules) runs after the pool joins, so the
+    barrier is the constructor returning.  Any pool failure falls back
+    to the serial path: parallelism is a speedup, never a behavior."""
+
+    def __init__(self, cfg: LintConfig, jobs: int = 0) -> None:
         self.cfg = cfg
         self.modules: dict[str, ModuleFile] = {}
+        rels: list[str] = []
         for top in cfg.paths:
             full = os.path.join(cfg.root, top)
             if os.path.isfile(full) and top.endswith(".py"):
-                self._load(top)
+                rels.append(top)
                 continue
             for dirpath, _dirs, files in os.walk(full):
                 for f in sorted(files):
                     if f.endswith(".py"):
-                        rel = os.path.relpath(
-                            os.path.join(dirpath, f), cfg.root
+                        rels.append(
+                            os.path.relpath(os.path.join(dirpath, f), cfg.root)
                         )
-                        self._load(rel)
+        rels = [
+            r for r in rels
+            # the linter does not lint itself (fixtures live in tests)
+            if "tools/graftlint" not in r.replace(os.sep, "/")
+        ]
+        if jobs and jobs > 1:
+            try:
+                import concurrent.futures
+
+                with concurrent.futures.ProcessPoolExecutor(
+                    max_workers=jobs
+                ) as pool:
+                    for rel, mod in pool.map(
+                        _parse_one, [cfg.root] * len(rels), rels,
+                        chunksize=max(1, len(rels) // (jobs * 4)),
+                    ):
+                        if mod is not None:
+                            self.modules[rel] = mod
+                return
+            except Exception:  # pragma: no cover - platform-dependent
+                self.modules.clear()
+        for rel in rels:
+            self._load(rel)
 
     def _load(self, rel: str) -> None:
-        if "tools/graftlint" in rel.replace(os.sep, "/"):
-            return  # the linter does not lint itself (fixtures live in tests)
         try:
             self.modules[rel.replace(os.sep, "/")] = ModuleFile(cfg_root(self), rel)
         except (SyntaxError, UnicodeDecodeError):
@@ -330,16 +400,22 @@ class RepoIndex:
             return None
         return fn.module.functions.get(f"{fn.cls}.{attr}")
 
-    def reachable_from(self, roots) -> set:
+    def reachable_from(self, roots, stop=()) -> set:
         """Closure over the call graph: every FunctionInfo reachable
         from ``roots`` by call OR bare function reference (references
-        cover indirect dispatch — kernel tables, functools.partial)."""
+        cover indirect dispatch — kernel tables, functools.partial).
+
+        ``stop`` is a set of ``(relpath, qualname)`` keys the closure
+        must not expand INTO: GL012 passes the other thread entry
+        points, because ``Thread(target=self._loop)`` is a reference
+        the walk would otherwise follow — the spawner does not execute
+        the spawned body in its own context."""
         seen: set = set()
         frontier = list(roots)
         while frontier:
             fn = frontier.pop()
             key = (fn.module.relpath, fn.qualname)
-            if key in seen:
+            if key in seen or key in stop:
                 continue
             seen.add(key)
             # function-local lazy imports participate in resolution
@@ -362,6 +438,43 @@ class RepoIndex:
                 ):
                     frontier.append(tgt)
         return seen
+
+    def reachable_paths(self, roots) -> dict:
+        """Like ``reachable_from`` but each reached function also gets
+        ONE witness call path back to a root: ``{key: (root_key, ...,
+        key)}``.  The path is what ``--explain`` prints — a reachability
+        finding without the chain that proves it is unactionable."""
+        paths: dict = {}
+        frontier = []
+        for fn in roots:
+            key = (fn.module.relpath, fn.qualname)
+            if key not in paths:
+                paths[key] = (key,)
+                frontier.append(fn)
+        while frontier:
+            fn = frontier.pop(0)
+            base = paths[(fn.module.relpath, fn.qualname)]
+            fn.module._index_imports(fn.node)
+            for n in ast.walk(fn.node):
+                tgt = None
+                if isinstance(n, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(n, "ctx", None), ast.Load
+                ):
+                    if (
+                        isinstance(n, ast.Attribute)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"
+                    ):
+                        tgt = self.resolve_method(fn, n.attr)
+                    else:
+                        tgt = self.resolve_call(fn.module, n)
+                if tgt is None or isinstance(tgt.node, ast.ClassDef):
+                    continue
+                key = (tgt.module.relpath, tgt.qualname)
+                if key not in paths:
+                    paths[key] = base + (key,)
+                    frontier.append(tgt)
+        return paths
 
     def jit_roots(self):
         return [
@@ -648,3 +761,558 @@ def is_array_producing(node) -> bool:
             ):
                 return True
     return False
+
+
+# ---------------------------------------------------------------------------
+# integer-interval abstract domain (GL011)
+# ---------------------------------------------------------------------------
+#
+# One layer below the dtype lattice: once ExprTyper says an expression is
+# INT, the interval interpreter asks HOW BIG.  Values are abstracted to
+# [lo, hi] over the extended integers (±inf = "unbounded"); every
+# transfer function is conservative — the concrete value is always
+# inside the computed interval, so "fits in int32" is a proof, while a
+# blown interval is only a *may*-overflow (the finding invites a
+# declared bound, a clamp the interpreter can see, or a suppression
+# explaining the wrap).
+
+_INF = float("inf")
+_I32_MIN, _I32_MAX = -(2 ** 31), 2 ** 31 - 1
+
+
+def _gmul(a: float, b: float) -> float:
+    # extended-integer product where 0 * inf = 0 (an empty stack of
+    # unbounded values is still empty), not NaN
+    if a == 0 or b == 0:
+        return 0
+    return a * b
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    lo: float
+    hi: float
+
+    def __post_init__(self):
+        if self.lo > self.hi:  # pragma: no cover - transfer fns keep order
+            raise ValueError(f"inverted interval [{self.lo}, {self.hi}]")
+
+    # -- lattice ---------------------------------------------------------
+    def join(self, other: "Interval") -> "Interval":
+        return Interval(min(self.lo, other.lo), max(self.hi, other.hi))
+
+    def fits_int32(self) -> bool:
+        return self.lo >= _I32_MIN and self.hi <= _I32_MAX
+
+    def __str__(self) -> str:
+        def f(v):
+            if v == _INF:
+                return "+inf"
+            if v == -_INF:
+                return "-inf"
+            return str(int(v))
+        return f"[{f(self.lo)}, {f(self.hi)}]"
+
+    # -- arithmetic transfer functions -----------------------------------
+    def add(self, o: "Interval") -> "Interval":
+        return Interval(self.lo + o.lo, self.hi + o.hi)
+
+    def sub(self, o: "Interval") -> "Interval":
+        return Interval(self.lo - o.hi, self.hi - o.lo)
+
+    def neg(self) -> "Interval":
+        return Interval(-self.hi, -self.lo)
+
+    def abs(self) -> "Interval":
+        if self.lo >= 0:
+            return self
+        if self.hi <= 0:
+            return self.neg()
+        return Interval(0, max(-self.lo, self.hi))
+
+    def invert(self) -> "Interval":
+        # ~x == -x - 1
+        return self.neg().sub(Interval(1, 1))
+
+    def mul(self, o: "Interval") -> "Interval":
+        c = [_gmul(a, b) for a in (self.lo, self.hi) for b in (o.lo, o.hi)]
+        return Interval(min(c), max(c))
+
+    def floordiv(self, o: "Interval") -> "Interval":
+        import math
+
+        def fd(a, b):
+            # a/b is monotone in each variable while b keeps one sign,
+            # so the 4 corners bound it; floor is monotone too.  The
+            # infinite-divisor corner rounds toward 0, which can only
+            # WIDEN the result (a/±inf limits to ±0 and the finite
+            # corners dominate the other side).
+            if a in (_INF, -_INF):
+                return a if b > 0 else -a
+            if b in (_INF, -_INF):
+                return 0
+            return math.floor(a / b)
+
+        if o.lo > 0 or o.hi < 0:
+            c = [fd(a, b) for a in (self.lo, self.hi) for b in (o.lo, o.hi)]
+            return Interval(min(c), max(c))
+        return TOP  # divisor may be 0 — nothing provable
+
+    def mod(self, o: "Interval") -> "Interval":
+        # Python/NumPy semantics: result sign follows the divisor
+        if o.lo > 0 and o.hi < _INF:
+            return Interval(0, o.hi - 1)
+        if o.hi < 0 and o.lo > -_INF:
+            return Interval(o.lo + 1, 0)
+        return TOP
+
+    def lshift(self, o: "Interval") -> "Interval":
+        if o.lo < 0 or o.hi > 63:
+            return TOP  # silly shift counts prove nothing
+        return self.mul(Interval(2 ** int(o.lo), 2 ** int(o.hi)))
+
+    def rshift(self, o: "Interval") -> "Interval":
+        if o.lo < 0 or o.hi > 63:
+            return TOP
+        return self.floordiv(Interval(2 ** int(o.lo), 2 ** int(o.hi)))
+
+    def band(self, o: "Interval") -> "Interval":
+        # x & m with m >= 0 lands in [0, m] regardless of x's sign
+        # (two's complement); take the tightest non-negative side
+        caps = [s.hi for s in (self, o) if s.lo >= 0 and s.hi < _INF]
+        if caps:
+            return Interval(0, min(caps))
+        return TOP
+
+    def bor(self, o: "Interval") -> "Interval":
+        # for non-negative x, y: x | y <= x + y (and x ^ y <= x | y)
+        if self.lo >= 0 and o.lo >= 0:
+            return Interval(0, self.hi + o.hi)
+        return TOP
+
+    def imin(self, o: "Interval") -> "Interval":
+        return Interval(min(self.lo, o.lo), min(self.hi, o.hi))
+
+    def imax(self, o: "Interval") -> "Interval":
+        return Interval(max(self.lo, o.lo), max(self.hi, o.hi))
+
+    def clip(self, lo: "Interval", hi: "Interval") -> "Interval":
+        # clip(x, a, b) == min(max(x, a), b)
+        return self.imax(lo).imin(hi)
+
+    def summed(self, count: int) -> "Interval":
+        """Sum of up to ``count`` elements each in this interval (the
+        empty reduction is 0, so 0 is always included)."""
+        return Interval(
+            min(0, _gmul(count, self.lo)), max(0, _gmul(count, self.hi))
+        )
+
+
+TOP = Interval(-_INF, _INF)
+_UNIT = Interval(0, 1)
+
+
+_IVL_PASS_CALLS = {
+    "take", "take_along_axis", "roll", "reshape", "ravel", "broadcast_to",
+    "transpose", "flip", "squeeze", "sort", "copy", "asarray", "tile",
+    "repeat", "dynamic_slice", "dynamic_update_slice",
+    "dynamic_index_in_dim", "dynamic_update_index_in_dim", "array",
+    "floor", "ceil", "round", "rint", "flatten", "astype", "stop_gradient",
+    "max", "min", "amax", "amin",
+}
+_IVL_INDEX_CALLS = {"argmax", "argmin", "argsort", "searchsorted",
+                    "count_nonzero", "broadcasted_iota", "nonzero"}
+_IVL_MODULE_ALIASES = {"jnp", "np", "jax", "lax", "jsp", "numpy", "math"}
+
+
+class IntervalEvaluator:
+    """Forward interval propagation over one function body.
+
+    Seeds come from three places, in priority order: local assignments
+    (tracked flow-insensitively, same compromise as ExprTyper), the
+    declared ``[tool.graftlint.gl011.bounds]`` name bounds (parameters
+    AND ``cfg.<attr>`` leaves), and ``call_bounds`` for calls whose
+    result range is a contract of their own parity tests.  Reductions
+    use the per-zone ``sum_elems`` element-count cap.  Anything else is
+    TOP = [-inf, +inf]: unprovable, which for a checked op means a
+    finding — the fix is a declaration, not a shrug."""
+
+    def __init__(
+        self,
+        bounds: dict,
+        call_bounds: dict,
+        sum_elems: int,
+        module_env: dict | None = None,
+        is_bool=None,
+    ) -> None:
+        self.bounds = bounds
+        self.call_bounds = call_bounds
+        self.sum_elems = sum_elems
+        self.module_env = dict(module_env or {})
+        # naming-convention bool names (masks, validity planes) are
+        # [0, 1] once cast to int — the typer's patterns decide
+        self.is_bool = is_bool or (lambda _n: False)
+
+    # -- environment ------------------------------------------------------
+
+    def build_env(self, fn_node, params) -> dict:
+        env = dict(self.module_env)
+        for p in params:
+            if p in self.bounds:
+                env[p] = self.bounds[p]
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                t = n.targets[0]
+                if isinstance(t, ast.Name):
+                    env[t.id] = self.eval(n.value, env)
+                elif isinstance(t, ast.Tuple):
+                    self._unpack(t, n.value, env)
+            elif isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                cur = env.get(n.target.id, self._name_ivl(n.target.id))
+                env[n.target.id] = self._binop(
+                    n.op, cur, self.eval(n.value, env)
+                )
+            elif isinstance(n, ast.For) and isinstance(n.target, ast.Name):
+                env[n.target.id] = self._loop_ivl(n.iter, env)
+        return env
+
+    def _unpack(self, tgt: ast.Tuple, value, env) -> None:
+        if isinstance(value, ast.Tuple) and len(value.elts) == len(tgt.elts):
+            for te, ve in zip(tgt.elts, value.elts):
+                if isinstance(te, ast.Name):
+                    env[te.id] = self.eval(ve, env)
+            return
+        # `a, b = f(...)` — a call contract bounds every element
+        ivl = self.eval(value, env)
+        for te in tgt.elts:
+            if isinstance(te, ast.Name):
+                env[te.id] = ivl
+
+    def _loop_ivl(self, it, env) -> Interval:
+        if isinstance(it, ast.Call) and _name_of(it.func).rsplit(
+            ".", 1
+        )[-1] == "range":
+            args = [self.eval(a, env) for a in it.args]
+            if len(args) == 1 and args[0].hi > -_INF:
+                return Interval(0, max(0, args[0].hi - 1))
+            if len(args) >= 2:
+                return Interval(min(args[0].lo, args[1].hi - 1), max(
+                    args[0].lo, args[1].hi - 1
+                ))
+        return TOP
+
+    def _name_ivl(self, name: str) -> Interval:
+        if name in self.bounds:
+            return self.bounds[name]
+        if self.is_bool(name):
+            return _UNIT
+        return TOP
+
+    # -- expression evaluation --------------------------------------------
+
+    def eval(self, node, env) -> Interval:  # noqa: C901 - a domain is a switch
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return _UNIT
+            if isinstance(node.value, (int, float)):
+                return Interval(node.value, node.value)
+            return TOP
+        if isinstance(node, ast.Name):
+            # a derived env entry that collapsed to TOP must not shadow
+            # a DECLARED bound (or the bool [0,1] convention): declared
+            # bounds are contracts, and assignments that violate them
+            # are flagged separately by the GL011 escape check — so the
+            # contract stays usable even where derivation gives up
+            v = env.get(node.id)
+            if v is not None and v != TOP:
+                return v
+            return self._name_ivl(node.id)
+        if isinstance(node, ast.Attribute):
+            # cfg.clamp_q — the declared bounds speak for config leaves
+            return self.bounds.get(node.attr, TOP)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, env)
+            if isinstance(node.op, ast.USub):
+                return v.neg()
+            if isinstance(node.op, ast.Invert):
+                return v.invert()
+            if isinstance(node.op, ast.Not):
+                return _UNIT
+            return v
+        if isinstance(node, (ast.Compare, ast.BoolOp)):
+            return _UNIT
+        if isinstance(node, ast.BinOp):
+            return self._binop(
+                node.op, self.eval(node.left, env), self.eval(node.right, env)
+            )
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body, env).join(
+                self.eval(node.orelse, env)
+            )
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = None
+            for e in node.elts:
+                v = self.eval(e, env)
+                out = v if out is None else out.join(v)
+            return out if out is not None else TOP
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Call):
+            return self._call(node, env)
+        return TOP
+
+    def _binop(self, op, lv: Interval, rv: Interval) -> Interval:
+        if isinstance(op, ast.Add):
+            return lv.add(rv)
+        if isinstance(op, ast.Sub):
+            return lv.sub(rv)
+        if isinstance(op, ast.Mult):
+            return lv.mul(rv)
+        if isinstance(op, ast.FloorDiv):
+            return lv.floordiv(rv)
+        if isinstance(op, ast.Mod):
+            return lv.mod(rv)
+        if isinstance(op, ast.LShift):
+            return lv.lshift(rv)
+        if isinstance(op, ast.RShift):
+            return lv.rshift(rv)
+        if isinstance(op, ast.BitAnd):
+            return lv.band(rv)
+        if isinstance(op, (ast.BitOr, ast.BitXor)):
+            return lv.bor(rv)
+        return TOP  # Div (float), Pow, MatMult: outside the int32 story
+
+    def _call(self, node: ast.Call, env) -> Interval:  # noqa: C901
+        name = _name_of(node.func)
+        # the leaf must come from the Attribute itself, not the dotted
+        # path: `jnp.stack(...).reshape(...)` has no plain dotted name
+        # (the receiver is a Call), but its leaf is still `reshape`
+        if isinstance(node.func, ast.Attribute):
+            leaf = node.func.attr
+        else:
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+        # x.at[i].add(v) / .set(v) / .min(v) / .max(v) — the scatter forms
+        if (
+            isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at"
+        ):
+            base = self.eval(node.func.value.value.value, env)
+            val = self.eval(node.args[0], env) if node.args else TOP
+            if node.func.attr == "add":
+                return base.add(val.summed(self.sum_elems))
+            if node.func.attr in ("set", "min", "max"):
+                return base.join(val)
+            return TOP
+        if leaf in self.call_bounds:
+            return self.call_bounds[leaf]
+        # `x.clip(a, b)` / `x.sum()` are method forms whose receiver
+        # carries the interval — but `jnp.clip(x, a, b)` spells the same
+        # leaf with a MODULE receiver and the array in args[0]; treating
+        # `jnp` as the receiver would hand every such call TOP (or worse,
+        # shift the clip bounds by one argument), so module-qualified
+        # calls fall through to the free-function transfers below.
+        recv_root = (
+            _name_of(node.func.value).split(".", 1)[0]
+            if isinstance(node.func, ast.Attribute) else ""
+        )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and recv_root not in _IVL_MODULE_ALIASES
+            and leaf in (
+                "astype", "clip", "sum", "cumsum", "min", "max", "reshape",
+                "ravel", "take", "copy", "flatten", "astype", "squeeze",
+            )
+        ):
+            base = self.eval(node.func.value, env)
+            if leaf == "clip" and len(node.args) >= 2:
+                return base.clip(
+                    self.eval(node.args[0], env), self.eval(node.args[1], env)
+                )
+            if leaf in ("sum", "cumsum"):
+                return base.summed(self.sum_elems)
+            return base
+        if leaf == "clip" and len(node.args) >= 3:
+            return self.eval(node.args[0], env).clip(
+                self.eval(node.args[1], env), self.eval(node.args[2], env)
+            )
+        if leaf in ("sum", "cumsum") and node.args:
+            return self.eval(node.args[0], env).summed(self.sum_elems)
+        if leaf == "where" and len(node.args) == 3:
+            return self.eval(node.args[1], env).join(
+                self.eval(node.args[2], env)
+            )
+        if leaf == "select" and len(node.args) == 3:
+            return self.eval(node.args[1], env).join(
+                self.eval(node.args[2], env)
+            )
+        if leaf in ("abs", "absolute"):
+            return self.eval(node.args[0], env).abs() if node.args else TOP
+        if leaf == "minimum" and len(node.args) == 2:
+            return self.eval(node.args[0], env).imin(
+                self.eval(node.args[1], env)
+            )
+        if leaf == "maximum" and len(node.args) == 2:
+            return self.eval(node.args[0], env).imax(
+                self.eval(node.args[1], env)
+            )
+        if leaf == "mod" and len(node.args) == 2:
+            return self.eval(node.args[0], env).mod(
+                self.eval(node.args[1], env)
+            )
+        if leaf in ("zeros", "zeros_like", "empty", "empty_like"):
+            return Interval(0, 0)
+        if leaf in ("ones", "ones_like"):
+            return Interval(1, 1)
+        if leaf == "full" and len(node.args) >= 2:
+            return self.eval(node.args[1], env)
+        if leaf == "full_like" and len(node.args) >= 2:
+            return self.eval(node.args[1], env)
+        if leaf == "arange":
+            args = [self.eval(a, env) for a in node.args]
+            if len(args) == 1 and args[0].hi < _INF:
+                return Interval(0, max(0, args[0].hi - 1))
+            if len(args) >= 2 and args[1].hi < _INF:
+                return Interval(min(args[0].lo, 0), max(args[1].hi - 1, 0))
+            return TOP
+        if leaf == "sign":
+            return Interval(-1, 1)
+        if leaf in _IVL_INDEX_CALLS:
+            return Interval(0, max(0, self.sum_elems))
+        if leaf == "pad" and node.args:
+            return self.eval(node.args[0], env).join(Interval(0, 0))
+        if leaf in ("concatenate", "stack", "hstack", "vstack") and node.args:
+            return self.eval(node.args[0], env)
+        if leaf in ("int32", "int16", "int8", "int64", "int",
+                    "uint8", "uint16", "uint32",
+                    "float32", "float64", "float16", "bfloat16", "float"):
+            return self.eval(node.args[0], env) if node.args else TOP
+        if leaf == "len":
+            return Interval(0, _INF)
+        if leaf in _IVL_PASS_CALLS and node.args:
+            return self.eval(node.args[0], env)
+        return TOP
+
+
+# ---------------------------------------------------------------------------
+# thread-entry points + lock discovery (GL012)
+# ---------------------------------------------------------------------------
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def thread_roots(mod: ModuleFile) -> list:
+    """Every function this module hands to a thread: the ``target=`` of
+    a ``threading.Thread`` and the callback of a ``threading.Timer``,
+    resolved to a FunctionInfo when the target is ``self.X`` (a sibling
+    method) or a bare module-level name.  Each is the entry point of a
+    distinct execution context."""
+    out = []
+    for fn in mod.functions.values():
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            leaf = _name_of(n.func).rsplit(".", 1)[-1]
+            tgt_expr = None
+            if leaf == "Thread":
+                tgt_expr = next(
+                    (kw.value for kw in n.keywords if kw.arg == "target"),
+                    None,
+                )
+            elif leaf == "Timer":
+                tgt_expr = next(
+                    (kw.value for kw in n.keywords if kw.arg == "function"),
+                    n.args[1] if len(n.args) >= 2 else None,
+                )
+            if tgt_expr is None:
+                continue
+            tgt = None
+            if (
+                isinstance(tgt_expr, ast.Attribute)
+                and isinstance(tgt_expr.value, ast.Name)
+                and tgt_expr.value.id == "self"
+                and fn.cls is not None
+            ):
+                tgt = mod.functions.get(f"{fn.cls}.{tgt_expr.attr}")
+            elif isinstance(tgt_expr, ast.Name):
+                tgt = mod.functions.get(tgt_expr.id)
+            if tgt is not None and tgt not in out:
+                out.append(tgt)
+    return out
+
+
+def class_locks(mod: ModuleFile) -> dict:
+    """``{class name: {attrs assigned threading.Lock()/RLock()/
+    Condition()/Semaphore()}}`` — the lock inventory GL012's
+    acquisition-order graph is built over (the guarded-field map itself
+    is declared in pyproject, but which attributes ARE locks is a code
+    fact)."""
+    out: dict = {}
+    for n in ast.walk(mod.tree):
+        if not isinstance(n, ast.ClassDef):
+            continue
+        attrs = set()
+        for a in ast.walk(n):
+            if isinstance(a, ast.Assign) and isinstance(a.value, ast.Call):
+                leaf = _name_of(a.value.func).rsplit(".", 1)[-1]
+                if leaf in _LOCK_CTORS:
+                    for t in a.targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            attrs.add(t.attr)
+        if attrs:
+            out[n.name] = attrs
+    return out
+
+
+def locks_held_at(fn_node, line: int, lock_attrs: set) -> set:
+    """The set of ``self.<lock>`` attributes held at ``line``: every
+    ``with self.L:`` (or ``with self.L1, self.L2:``) whose body spans
+    the line.  Purely lexical — helper-acquired locks don't count, which
+    is the right bias for a race DETECTOR (claiming a lock is held when
+    it isn't would hide races)."""
+    held = set()
+    for w in ast.walk(fn_node):
+        if not isinstance(w, ast.With):
+            continue
+        if not (w.lineno <= line <= (w.end_lineno or w.lineno)):
+            continue
+        for item in w.items:
+            e = item.context_expr
+            if (
+                isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == "self"
+                and e.attr in lock_attrs
+            ):
+                held.add(e.attr)
+    return held
+
+
+def self_attr_writes(fn_node):
+    """Yield ``(attr, lineno)`` for every ``self.X = ...`` /
+    ``self.X += ...`` in the function (nested defs included — a closure
+    still runs on its thread)."""
+    for n in ast.walk(fn_node):
+        targets = ()
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = (n.target,) if n.target is not None else ()
+        for t in targets:
+            for leaf in ast.walk(t):
+                if (
+                    isinstance(leaf, ast.Attribute)
+                    and isinstance(leaf.value, ast.Name)
+                    and leaf.value.id == "self"
+                    and isinstance(leaf.ctx, ast.Store)
+                ):
+                    yield leaf.attr, n.lineno

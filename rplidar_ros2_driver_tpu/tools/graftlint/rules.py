@@ -1,5 +1,6 @@
-"""The ten graftlint rules.  Each takes the RepoIndex and yields
-Findings; suppression/baseline handling lives in the runner."""
+"""The thirteen graftlint rules.  Each takes the RepoIndex and yields
+Findings; suppression/baseline handling lives in the runner.  Rule
+docstrings double as the rationale text ``--explain GLxxx`` prints."""
 
 from __future__ import annotations
 
@@ -13,14 +14,20 @@ from rplidar_ros2_driver_tpu.tools.graftlint.model import (
     UNKNOWN,
     ExprTyper,
     Finding,
+    Interval,
+    IntervalEvaluator,
     RepoIndex,
     _name_of,
     build_taint,
+    class_locks,
     dtype_kind,
     expr_mentions_tainted,
     is_array_producing,
     is_static_name,
+    locks_held_at,
     scalar_annotated,
+    self_attr_writes,
+    thread_roots,
 )
 
 _NP_HEADS = {"np", "numpy"}
@@ -551,6 +558,7 @@ def rule_gl007(index: RepoIndex):
 def rule_gl008(index: RepoIndex):
     yield from _gl008_precompile(index)
     yield from _gl008_bench(index)
+    yield from _gl008_bench_window(index)
     yield from _gl008_params(index)
 
 
@@ -613,6 +621,83 @@ def _gl008_bench(index: RepoIndex):
                 f"{index.cfg.bench_meta_test} — an accidental rename "
                 "would orphan its recorded series",
             )
+
+
+def _rate_resolved(expr, assigns: dict, depth: int = 0) -> bool:
+    """Does a headline metric's ``"value"`` expression resolve to a
+    ``<window>.rate()`` call?  Unwraps ``round``/``float``/``min``/
+    ``max`` and follows function-local single-name assignment chains —
+    anything else (a raw division, a subscript into some dict) is
+    exactly the shape that let warm-inclusive numerators ship twice."""
+    if depth > 8:
+        return False
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "rate":
+            return True
+        leaf = _name_of(expr.func).rsplit(".", 1)[-1]
+        if leaf in ("round", "float", "min", "max") and expr.args:
+            return _rate_resolved(expr.args[0], assigns, depth + 1)
+        return False
+    if isinstance(expr, ast.Name):
+        nxt = assigns.get(expr.id)
+        return nxt is not None and _rate_resolved(nxt, assigns, depth + 1)
+    return False
+
+
+def _gl008_bench_window(index: RepoIndex):
+    """Headline scans/s metrics must take their value from
+    ``TimedWindow.rate()`` — the one helper whose numerator and
+    wall-clock denominator come from the same start/stop window.  Review
+    caught the warm-inclusive-numerator inflation class twice (PR 13
+    config-18, PR 14 config-19: scans counted across warmup divided by
+    timed-only seconds); this makes the discipline structural."""
+    import os
+
+    bench = os.path.join(index.cfg.root, index.cfg.bench)
+    if not os.path.exists(bench):
+        return
+    with open(bench, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        assigns = {
+            n.targets[0].id: n.value
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+        }
+        for d in ast.walk(fn):
+            if not isinstance(d, ast.Dict):
+                continue
+            unit = value = None
+            for k, v in zip(d.keys, d.values):
+                if isinstance(k, ast.Constant):
+                    if k.value == "unit":
+                        unit = v
+                    elif k.value == "value":
+                        value = v
+            if not (
+                isinstance(unit, ast.Constant)
+                and isinstance(unit.value, str)
+                and unit.value.startswith("scans/")
+            ):
+                continue
+            if value is None or not _rate_resolved(value, assigns):
+                yield Finding(
+                    "GL008", index.cfg.bench, d.lineno,
+                    f"headline `{unit.value}` metric in {fn.name} does not "
+                    "take its value from TimedWindow.rate() — the "
+                    "numerator and wall-clock must come from the same "
+                    "timed window (warm-inclusive numerators inflated "
+                    "configs 18 and 19 before review caught them)",
+                    witness=(
+                        "value expression: "
+                        + (ast.unparse(value)[:80] if value is not None
+                           else "<missing>")
+                    ),
+                )
 
 
 def _gl008_params(index: RepoIndex):
@@ -841,7 +926,565 @@ def rule_gl010(index: RepoIndex):
                     yield Finding("GL010", rel, n.lineno, msg)
 
 
+# ---------------------------------------------------------------------------
+# GL011 — fixed-point overflow prover
+# ---------------------------------------------------------------------------
+
+_GL011_SUM_LEAVES = {"sum", "cumsum"}
+
+
+def _gl011_top_functions(mod):
+    for fn in mod.functions.values():
+        if "." in fn.qualname and fn.qualname.rsplit(".", 1)[0] in (
+            mod.functions
+        ):
+            continue
+        yield fn
+
+
+def _gl011_check_sites(fn_node, typer, tenv):
+    """Yield ``(kind, node, operands)`` for every site GL011 must
+    prove: integer products, left shifts, integer sum-reductions, and
+    ``.at[...].add`` scatter accumulations."""
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+            if typer.etype(n, tenv) == INT:
+                yield "product", n, (n.left, n.right)
+        elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.LShift):
+            yield "left shift", n, (n.left, n.right)
+        elif isinstance(n, ast.Call):
+            name = _name_of(n.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if (
+                isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Subscript)
+                and isinstance(n.func.value.value, ast.Attribute)
+                and n.func.value.value.attr == "at"
+                and n.func.attr == "add"
+            ):
+                base = n.func.value.value.value
+                if typer.etype(base, tenv) == INT:
+                    yield "scatter-add", n, (base,) + tuple(n.args[:1])
+            elif leaf in _GL011_SUM_LEAVES:
+                operand = None
+                head, _, _tail = name.rpartition(".")
+                if head in _ARRAY_HEADS and n.args:
+                    operand = n.args[0]
+                elif isinstance(n.func, ast.Attribute) and head not in (
+                    _ARRAY_HEADS
+                ):
+                    operand = n.func.value
+                if operand is not None and typer.etype(
+                    operand, tenv
+                ) == INT:
+                    yield "sum-reduce", n, (operand,)
+
+
+def rule_gl011(index: RepoIndex):
+    """GL011 — fixed-point overflow prover.
+
+    The bit-exact zones do all arithmetic in int32: quantized
+    millimeters, Q-format trig, log-odds counts.  Every multiply, shift
+    and reduction there was hand-argued to stay inside ±2^31 in a
+    comment — and a comment cannot fail CI.  This rule runs an interval
+    abstract interpreter over the zones: input ranges are declared once
+    in [tool.graftlint.gl011.bounds] (parameters and cfg.<attr> leaves)
+    and [tool.graftlint.gl011.call_bounds] (calls whose result range is
+    a contract of their own parity tests), transfer functions propagate
+    them through +, -, *, //, %, shifts, masks, clips, where/select and
+    reductions (capped by the per-zone sum_elems element count), and any
+    product / left shift / sum-reduce / scatter-add whose result
+    interval escapes int32 is a finding.  An int-typed parameter of a
+    zone entry point with no declared bound is itself a finding: an
+    undeclared input is an unproved theorem.  And because a declared
+    bound is a contract other functions' proofs consume, an assignment
+    to a declared name whose derivable interval is wider than the
+    declaration is ALSO a finding — declaring ``motion ∈ ±2^13`` while
+    computing an unclamped ``dth`` up to ±2^17 is how a fixed-point
+    overflow hides behind a true-looking comment.  The witness is the
+    interval trace — the machine-checked version of the old comment."""
+    cfg = index.cfg
+    statics = _statics(index)
+    bounds = {
+        n: Interval(lo, hi) for n, (lo, hi) in cfg.gl011_bound_map().items()
+    }
+    call_bounds = {
+        n: Interval(lo, hi)
+        for n, (lo, hi) in cfg.gl011_call_bound_map().items()
+    }
+    sum_map = cfg.gl011_sum_elems_map()
+    for rel in cfg.gl011_zones:
+        mod = index.modules.get(rel)
+        if mod is None:
+            continue
+        elems = sum_map.get(rel, cfg.gl011_sum_elems_default)
+        ev = IntervalEvaluator(bounds, call_bounds, elems)
+        base_typer = ExprTyper(cfg)
+        module_tenv: dict = {}
+        module_ienv: dict = {}
+        for n in mod.tree.body:
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t = n.targets[0]
+            if isinstance(t, ast.Name):
+                module_tenv[t.id] = base_typer.etype(n.value, module_tenv)
+                module_ienv[t.id] = ev.eval(n.value, module_ienv)
+            elif isinstance(t, ast.Tuple) and isinstance(
+                n.value, ast.Tuple
+            ) and len(t.elts) == len(n.value.elts):
+                # _UD_T1, _UD_T2, _UD_T3 = 2046, 8187, 24567
+                for te, ve in zip(t.elts, n.value.elts):
+                    if isinstance(te, ast.Name):
+                        module_tenv[te.id] = base_typer.etype(
+                            ve, module_tenv
+                        )
+                        module_ienv[te.id] = ev.eval(ve, module_ienv)
+        typer = ExprTyper(cfg, module_tenv)
+        ev = IntervalEvaluator(
+            bounds, call_bounds, elems, module_ienv,
+            is_bool=lambda n: typer.name_kind(n) == BOOL,
+        )
+        for fn in _gl011_top_functions(mod):
+            first_line = (
+                fn.node.decorator_list[0].lineno
+                if fn.node.decorator_list else fn.node.lineno
+            )
+            scalars = scalar_annotated(fn.node)
+            for p in fn.params:
+                if (
+                    p in bounds
+                    or p in fn.static_names
+                    or p in scalars
+                    or is_static_name(p, statics)
+                    or typer.name_kind(p) != INT
+                ):
+                    continue
+                if not mod.suppressed(
+                    "GL011", fn.node.lineno
+                ) and not mod.suppressed("GL011", first_line):
+                    yield Finding(
+                        "GL011", rel, fn.node.lineno,
+                        f"zone entry-point parameter `{p}` of "
+                        f"{fn.qualname} is int-typed but has no declared "
+                        "bound in [tool.graftlint.gl011.bounds] — the "
+                        "overflow prover cannot see its range, so nothing "
+                        "downstream of it is proved",
+                        witness=f"`{p}`: int by naming convention, "
+                        "no [lo, hi] declaration",
+                    )
+            params = set(fn.params)
+            for inner in ast.walk(fn.node):
+                if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    params.update(
+                        a.arg for a in inner.args.posonlyargs
+                        + inner.args.args + inner.args.kwonlyargs
+                    )
+            env = ev.build_env(fn.node, sorted(params))
+            tenv = typer.build_env(fn.node)
+            for kind, n, operands in _gl011_check_sites(
+                fn.node, typer, tenv
+            ):
+                ivl = ev.eval(n, env)
+                if ivl.fits_int32():
+                    continue
+                if mod.suppressed("GL011", n.lineno):
+                    continue
+                opw = ", ".join(
+                    f"`{ast.unparse(o)[:40]}` ∈ {ev.eval(o, env)}"
+                    for o in operands
+                )
+                yield Finding(
+                    "GL011", rel, n.lineno,
+                    f"{kind} `{ast.unparse(n)[:70]}` in {fn.qualname} is "
+                    "not provably inside int32 — declare tighter bounds, "
+                    "clamp where the interpreter can see it, or suppress "
+                    "with the wrap rationale",
+                    witness=f"{opw} → result ∈ {ivl}"
+                    + (f" (sum over ≤{elems} elements)"
+                       if kind in ("sum-reduce", "scatter-add") else ""),
+                )
+            # A declared bound is a CONTRACT, not just an assumption: a
+            # local assignment to a declared name must provably stay
+            # inside its bound, or the declaration proves theorems from
+            # a false premise everywhere else the name is consumed.
+            # (This is exactly how an unclamped `dth` slips an
+            # over-range θ-rate into apply_deskew's proved ±8192 chain.)
+            for n in ast.walk(fn.node):
+                if not (
+                    isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and n.targets[0].id in bounds
+                ):
+                    continue
+                declared = bounds[n.targets[0].id]
+                got = ev.eval(n.value, env)
+                if got.lo >= declared.lo and got.hi <= declared.hi:
+                    continue
+                if mod.suppressed("GL011", n.lineno):
+                    continue
+                yield Finding(
+                    "GL011", rel, n.lineno,
+                    f"assignment to `{n.targets[0].id}` in {fn.qualname} "
+                    "escapes its declared bound — the interval the prover "
+                    "can derive is wider than the contract every other "
+                    "use of the name relies on; clamp the value where "
+                    "the interpreter can see it or widen the declaration",
+                    witness=f"declared {declared}, assigned "
+                    f"`{ast.unparse(n.value)[:60]}` ∈ {got}",
+                )
+
+
+# ---------------------------------------------------------------------------
+# GL012 — lock-discipline race detector
+# ---------------------------------------------------------------------------
+
+def _gl012_class_roots(index: RepoIndex, rel, mod):
+    """Thread entry points per class, as ``{cls: [(context, fn), ...]}``:
+    Thread/Timer targets found in the module (each spawn target is its
+    own context, named after the method) plus the configured extra_roots
+    (callback methods that run on another component's thread —
+    registration is a runtime fact the analyzer cannot see, so it is
+    declared).  An extra_root ``relpath::Class.method@ctx`` assigns the
+    method to the named context: several entry points invoked by the
+    SAME foreign thread (e.g. every driver method the scan-loop FSM
+    calls) share one context instead of inflating the count."""
+    by_cls: dict = {}
+    for r in thread_roots(mod):
+        if r.cls is not None:
+            by_cls.setdefault(r.cls, []).append((r.qualname.split(".")[-1], r))
+    for spec in index.cfg.gl012_extra_roots:
+        srel, _, qn = spec.partition("::")
+        if srel != rel:
+            continue
+        qn, _, ctx = qn.partition("@")
+        fn = mod.functions.get(qn)
+        if fn is not None and fn.cls is not None:
+            lst = by_cls.setdefault(fn.cls, [])
+            entry = (ctx or qn.split(".")[-1], fn)
+            if entry not in lst:
+                lst.append(entry)
+    return by_cls
+
+
+def rule_gl012(index: RepoIndex):
+    """GL012 — lock-discipline race detector.
+
+    The driver layer is genuinely threaded: reader/scan loops in the sim
+    device, the protocol engine's pump thread, timer callbacks, the
+    ingest producer/consumer pair.  PR 6 shipped a real interleaved-
+    write tear (`sim_device._send` answering two clients at once) that
+    only a live-wire drive caught.  This rule makes the locking story
+    declarative: [tool.graftlint.locks] maps class → lock attribute →
+    the fields it guards.  Every `self._x = ...` write in a method
+    reachable from two or more execution contexts (each
+    threading.Thread/Timer target is a context; everything not reachable
+    from one is the caller context "main") must hold a declared guarding
+    lock at the write — lexically, via `with self.<lock>:`.  A shared
+    field with no declared lock at all is its own finding.  Separately,
+    nested `with self.<lock>` acquisitions (direct or one call deep)
+    build a global acquisition-order graph; a cycle is a potential
+    deadlock and is flagged wherever one of its edges is taken."""
+    lock_decl = index.cfg.lock_map()
+    for rel, mod in sorted(index.modules.items()):
+        locksets = class_locks(mod)
+        by_cls = _gl012_class_roots(index, rel, mod)
+        for cls, roots in sorted(by_cls.items()):
+            methods = {
+                qn: f for qn, f in mod.functions.items()
+                if f.cls == cls and qn.count(".") == 1
+            }
+            # Each context's closure must not expand INTO another context's
+            # entry points: `Thread(target=self._loop)` is a reference the
+            # generic walk follows, but spawning a thread does not run
+            # its body in the spawner's context — without the stop set,
+            # "main" (which calls start()) would leak into every thread
+            # body and every field would look multi-context.
+            by_ctx: dict = {}
+            for ctx, r in roots:
+                by_ctx.setdefault(ctx, []).append(r)
+            root_keys = {(rel, r.qualname) for _, r in roots}
+            reach = {}
+            for ctx, fns in by_ctx.items():
+                own = {(rel, f.qualname) for f in fns}
+                reach[ctx] = index.reachable_from(
+                    fns, stop=root_keys - own
+                )
+            thread_reached = set().union(*reach.values()) if reach else set()
+            main_roots = [
+                f for qn, f in methods.items()
+                if (rel, qn) not in root_keys
+                and (rel, qn) not in thread_reached
+                and not qn.endswith("__init__")
+            ]
+            main_reach = (
+                index.reachable_from(main_roots, stop=root_keys)
+                if main_roots else set()
+            )
+
+            def contexts(key):
+                ctxs = {c for c, r in reach.items() if key in r}
+                if key in main_reach:
+                    ctxs.add("main")
+                return ctxs
+
+            lock_attrs = set(locksets.get(cls, set())) | set(
+                lock_decl.get(cls, {})
+            )
+            writes: dict = {}
+            for qn, f in sorted(methods.items()):
+                ctxs = contexts((rel, qn))
+                if not ctxs:
+                    continue  # __init__ / pre-thread setup / unused
+                for attr, line in self_attr_writes(f.node):
+                    if attr in lock_attrs:
+                        continue
+                    writes.setdefault(attr, []).append(
+                        (qn, line, locks_held_at(f.node, line, lock_attrs),
+                         ctxs)
+                    )
+            declared = lock_decl.get(cls, {})
+            for attr, ws in sorted(writes.items()):
+                all_ctxs = sorted(set().union(*(w[3] for w in ws)))
+                if len(all_ctxs) < 2:
+                    continue
+                guarding = {
+                    lock for lock, fields in declared.items()
+                    if attr in fields
+                }
+                pair = "; ".join(
+                    f"{qn}:{line} holds {sorted(held) or 'no lock'} "
+                    f"(contexts: {', '.join(sorted(ctxs))})"
+                    for qn, line, held, ctxs in ws[:4]
+                )
+                if not guarding:
+                    line0 = ws[0][1]
+                    if not mod.suppressed("GL012", line0):
+                        yield Finding(
+                            "GL012", rel, line0,
+                            f"self.{attr} of {cls} is written from "
+                            f"{len(all_ctxs)} execution contexts "
+                            f"({', '.join(all_ctxs)}) but no declared lock "
+                            "guards it — declare the guarding lock in "
+                            f"[tool.graftlint.locks.{cls}] (or fix the "
+                            "race)",
+                            witness=pair,
+                        )
+                    continue
+                for qn, line, held, _ctxs in ws:
+                    if held & guarding:
+                        continue
+                    if not mod.suppressed("GL012", line):
+                        yield Finding(
+                            "GL012", rel, line,
+                            f"write to self.{attr} in {cls}."
+                            f"{qn.split('.')[-1]} without holding "
+                            f"{'/'.join(sorted(guarding))} — the field is "
+                            f"shared across contexts "
+                            f"({', '.join(all_ctxs)}) and every write "
+                            "must take the declared lock",
+                            witness=pair,
+                        )
+    yield from _gl012_lock_order(index)
+
+
+def _gl012_lock_order(index: RepoIndex):
+    edges: dict = {}  # (cls, l1) -> {(cls, l2): (rel, line)}
+    for rel, mod in sorted(index.modules.items()):
+        locksets = class_locks(mod)
+        for qn, f in sorted(mod.functions.items()):
+            if f.cls is None:
+                continue
+            lock_attrs = locksets.get(f.cls, set())
+            if not lock_attrs:
+                continue
+            for w in ast.walk(f.node):
+                if not isinstance(w, ast.With):
+                    continue
+                outer = [
+                    item.context_expr.attr for item in w.items
+                    if isinstance(item.context_expr, ast.Attribute)
+                    and isinstance(item.context_expr.value, ast.Name)
+                    and item.context_expr.value.id == "self"
+                    and item.context_expr.attr in lock_attrs
+                ]
+                if not outer:
+                    continue
+                # multi-item `with self.a, self.b:` acquires in order
+                for a, b in zip(outer, outer[1:]):
+                    if a != b:
+                        edges.setdefault((f.cls, a), {}).setdefault(
+                            (f.cls, b), (rel, w.lineno)
+                        )
+                held = outer[-1]
+                for inner in ast.walk(w):
+                    if inner is w:
+                        continue
+                    if isinstance(inner, ast.With):
+                        for item in inner.items:
+                            e = item.context_expr
+                            if (
+                                isinstance(e, ast.Attribute)
+                                and isinstance(e.value, ast.Name)
+                                and e.value.id == "self"
+                                and e.attr in lock_attrs
+                                # re-acquiring the same (R)Lock is the
+                                # reentrant idiom, not an order edge
+                                and e.attr != held
+                            ):
+                                edges.setdefault(
+                                    (f.cls, held), {}
+                                ).setdefault(
+                                    (f.cls, e.attr), (rel, inner.lineno)
+                                )
+                    elif isinstance(inner, ast.Call):
+                        # one hop: a sibling method acquiring its own lock
+                        name = _name_of(inner.func)
+                        if name.startswith("self."):
+                            tgt = index.resolve_method(
+                                f, name.split(".", 1)[1]
+                            )
+                            if tgt is not None:
+                                for w2 in ast.walk(tgt.node):
+                                    if isinstance(w2, ast.With):
+                                        for it2 in w2.items:
+                                            e2 = it2.context_expr
+                                            if (
+                                                isinstance(e2, ast.Attribute)
+                                                and isinstance(
+                                                    e2.value, ast.Name
+                                                )
+                                                and e2.value.id == "self"
+                                                and e2.attr in lock_attrs
+                                                and e2.attr != held
+                                            ):
+                                                edges.setdefault(
+                                                    (f.cls, held), {}
+                                                ).setdefault(
+                                                    (f.cls, e2.attr),
+                                                    (rel, inner.lineno),
+                                                )
+    # cycle detection (DFS, deterministic order)
+    seen_cycles = set()
+    for start in sorted(edges):
+        stack = [(start, (start,))]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == path[0]:
+                    cyc = frozenset(path)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    rel, line = edges[node][nxt]
+                    mod = index.modules.get(rel)
+                    if mod is not None and mod.suppressed("GL012", line):
+                        continue
+                    desc = " -> ".join(
+                        f"{c}.{l}" for c, l in path + (nxt,)
+                    )
+                    yield Finding(
+                        "GL012", rel, line,
+                        f"lock acquisition-order cycle {desc} — two "
+                        "threads taking these locks in opposite orders "
+                        "can deadlock; pick one global order",
+                        witness=desc,
+                    )
+                elif nxt not in path and len(path) < 8:
+                    stack.append((nxt, path + (nxt,)))
+
+
+# ---------------------------------------------------------------------------
+# GL013 — zero-dispatch read-path prover
+# ---------------------------------------------------------------------------
+
+def rule_gl013(index: RepoIndex):
+    """GL013 — zero-dispatch read-path prover.
+
+    The tile-serving design promise (PR 18) is that a map read touches
+    only the immutable TileSnapshot — no jit dispatch, no transfer, no
+    device round trip, ever.  Runtime counters assert it per test; this
+    rule proves it statically.  A standalone `# graftlint: read-path`
+    comment above a def marks a read-path root (TileSnapshot readers,
+    /diagnostics rendering, scheduler_status).  The call graph is
+    closed over from the roots — calls, bare references, self-method
+    resolution, lazy imports — and reaching anything that dispatches is
+    a finding: a jitted function, jax.device_put/device_get/
+    block_until_ready, any jax.*/jnp.*/lax.* call (op-by-op dispatch is
+    still dispatch), or an engine submit_* method.  The witness is the
+    call path from the marked root to the offender, which is the whole
+    debugging story: you see exactly which edge let the device sneak
+    into the read path."""
+    cfg = index.cfg
+    roots = []
+    for _rel, mod in sorted(index.modules.items()):
+        for qn in mod.read_path_funcs:
+            fn = mod.functions.get(qn)
+            if fn is not None:
+                roots.append(fn)
+    if not roots:
+        return
+    paths = index.reachable_paths(roots)
+    by_key = index.functions_by_key()
+    heads = set(cfg.gl013_dispatch_heads)
+    calls = set(cfg.gl013_dispatch_calls)
+    prefixes = tuple(cfg.gl013_dispatch_prefixes)
+    for key in sorted(paths):
+        fn = by_key.get(key)
+        if fn is None:
+            continue
+        mod = fn.module
+        chain = " -> ".join(q for _r, q in paths[key])
+        if fn.jitted:
+            first_line = (
+                fn.node.decorator_list[0].lineno
+                if fn.node.decorator_list else fn.node.lineno
+            )
+            if not mod.suppressed(
+                "GL013", fn.node.lineno
+            ) and not mod.suppressed("GL013", first_line):
+                yield Finding(
+                    "GL013", mod.relpath, fn.node.lineno,
+                    f"jitted {fn.qualname} is reachable from a "
+                    "`# graftlint: read-path` root — a marked read path "
+                    "must never enter a compiled callable",
+                    witness=chain,
+                )
+            continue
+        for n in ast.walk(fn.node):
+            if not isinstance(n, ast.Call):
+                continue
+            name = _name_of(n.func)
+            head, _, leaf = name.rpartition(".")
+            offender = None
+            if leaf in calls or (not head and name in calls):
+                offender = name or leaf
+            elif head and (head in heads or head.split(".")[0] == "jax"):
+                offender = name
+            elif any(
+                leaf.startswith(p) or (not head and name.startswith(p))
+                for p in prefixes
+            ):
+                offender = name or leaf
+            if offender is None:
+                continue
+            if not mod.suppressed("GL013", n.lineno):
+                yield Finding(
+                    "GL013", mod.relpath, n.lineno,
+                    f"dispatching call `{offender}` in {fn.qualname} is "
+                    "reachable from a `# graftlint: read-path` root — "
+                    "the read path must be pure host work on the "
+                    "immutable snapshot",
+                    witness=f"{chain} -> {offender}()",
+                )
+
+
 ALL_RULES = (
     rule_gl001, rule_gl002, rule_gl003, rule_gl004, rule_gl005,
     rule_gl006, rule_gl007, rule_gl008, rule_gl009, rule_gl010,
+    rule_gl011, rule_gl012, rule_gl013,
 )
+
+# rule id ("GL011") -> the rule function; --explain uses the docstrings
+RULES_BY_ID = {
+    fn.__name__.removeprefix("rule_").upper(): fn for fn in ALL_RULES
+}

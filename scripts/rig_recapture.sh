@@ -49,8 +49,10 @@ mkdir -p artifacts
 # violates the repo invariants (trace-safety, donation, bit-exactness —
 # tools/graftlint) is not publishable evidence.  Cheap (AST-only, no
 # device), so it runs before any link probing.
-if ! JAX_PLATFORMS=cpu python -m rplidar_ros2_driver_tpu.tools.graftlint >> "$out.log" 2>&1; then
-  echo '{"error": "graftlint found unbaselined findings - fix the tree before burning a rig window (see the sidecar log)"}' >> "$out"
+JAX_PLATFORMS=cpu python -m rplidar_ros2_driver_tpu.tools.graftlint --jobs auto >> "$out.log" 2>&1
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+  echo '{"error": "graftlint found unbaselined findings - fix the tree before burning a rig window (see the sidecar log)", "graftlint_exit": '"$lint_rc"'}' >> "$out"
   echo "$out"
   exit 4
 fi

@@ -865,6 +865,446 @@ class TestBaseline:
 
 
 # ---------------------------------------------------------------------------
+# GL011 — fixed-point overflow prover
+# ---------------------------------------------------------------------------
+
+GL011_CONFIG = """
+[tool.graftlint]
+paths = ["pkg"]
+static_params = ["cfg", "self"]
+
+[tool.graftlint.gl004]
+zones = []
+int_names = ["d_q2", "rate_q8", "steps"]
+
+[tool.graftlint.gl011]
+zones = ["pkg/fx.py", "pkg/fx_ok.py"]
+sum_elems_default = 16384
+
+[tool.graftlint.gl011.sum_elems]
+"pkg/fx_ok.py" = 1024
+
+[tool.graftlint.gl011.bounds]
+d_q2 = [0, 262143]
+rate_q8 = [-32768, 32767]
+"""
+
+
+class TestGL011:
+    def test_fires_on_unprovable_product(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            def scale(d_q2, rate_q8):
+                return d_q2 * rate_q8
+        """}, config=GL011_CONFIG)
+        mine = [f for f in fs if f.rule == "GL011"]
+        assert any("not provably inside int32" in f.message for f in mine)
+        # the witness is the interval trace: operands and result range
+        assert any("∈" in (f.witness or "") for f in mine)
+
+    def test_quiet_when_clamp_is_visible(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            import jax.numpy as jnp
+
+            def scale(d_q2, rate_q8):
+                r = jnp.clip(rate_q8, -128, 127)
+                return d_q2 * r
+        """}, config=GL011_CONFIG)
+        assert "GL011" not in _rules(fs)
+
+    def test_fires_on_undeclared_int_entry_param(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            def advance(steps):
+                return steps + 1
+        """}, config=GL011_CONFIG)
+        assert any(
+            f.rule == "GL011" and "`steps`" in f.message
+            and "no declared bound" in f.message for f in fs
+        )
+
+    def test_fires_when_assignment_escapes_declared_bound(self, tmp_path):
+        # the dth-shape bug: a declared name rebound to a derivably
+        # WIDER value poisons every proof that consumes the declaration
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            def rebind(d_q2, rate_q8):
+                rate_q8 = d_q2 * 64
+                return rate_q8
+        """}, config=GL011_CONFIG)
+        assert any(
+            f.rule == "GL011" and "escapes its declared bound" in f.message
+            for f in fs
+        )
+
+    def test_escape_quiet_when_clamped(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            import jax.numpy as jnp
+
+            def rebind(d_q2, rate_q8):
+                rate_q8 = jnp.clip(d_q2 * 64, -32768, 32767)
+                return rate_q8
+        """}, config=GL011_CONFIG)
+        assert "GL011" not in _rules(fs)
+
+    def test_sum_reduce_uses_per_zone_element_cap(self, tmp_path):
+        # identical source; fx.py uses the 16384 default (sum escapes
+        # int32), fx_ok.py's declared 1024-element cap proves it
+        src = """
+            import jax.numpy as jnp
+
+            def fold(d_q2):
+                return jnp.sum(d_q2)
+        """
+        fs = _lint(
+            tmp_path, {"pkg/fx.py": src, "pkg/fx_ok.py": src},
+            config=GL011_CONFIG,
+        )
+        mine = [f for f in fs if f.rule == "GL011"]
+        assert [f.path for f in mine] == ["pkg/fx.py"]
+        assert "sum-reduce" in mine[0].message
+        assert "elements" in (mine[0].witness or "")
+
+    def test_suppression_with_reason_works(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/fx.py": """
+            def scale(d_q2, rate_q8):
+                # graftlint: disable=GL011 — fixture-sanctioned wrap
+                return d_q2 * rate_q8
+        """}, config=GL011_CONFIG)
+        assert "GL011" not in _rules(fs)
+
+    def test_baseline_reconcile_covers_gl011(self, tmp_path):
+        (tmp_path / "pyproject.toml").write_text(GL011_CONFIG)
+        (tmp_path / "pkg").mkdir()
+        (tmp_path / "pkg" / "fx.py").write_text(textwrap.dedent("""
+            def scale(d_q2, rate_q8):
+                return d_q2 * rate_q8
+        """))
+        findings, new, stale = run_lint(str(tmp_path))
+        target = [f for f in findings if f.rule == "GL011"][0]
+        (tmp_path / "graftlint.baseline.json").write_text(json.dumps({
+            "findings": [{
+                "rule": target.rule, "path": target.path,
+                "message": target.message,
+                "justification": "fixture: known wrap site",
+            }]
+        }))
+        findings, new, stale = run_lint(str(tmp_path))
+        assert not any(f.rule == "GL011" for f in new)
+        assert stale == []
+        # fix the code -> the baseline entry must go stale and FAIL
+        (tmp_path / "pkg" / "fx.py").write_text(textwrap.dedent("""
+            def scale(d_q2, rate_q8):
+                return d_q2 + rate_q8
+        """))
+        findings, new, stale = run_lint(str(tmp_path))
+        assert len(stale) == 1 and stale[0]["rule"] == "GL011"
+
+
+# ---------------------------------------------------------------------------
+# GL012 — lock-discipline race detector
+# ---------------------------------------------------------------------------
+
+# the PR 6 tear, distilled: _send reachable from BOTH sim threads,
+# writing shared tx state with no lock — the bug a live-wire drive
+# caught at runtime, now caught at parse time
+SEND_TEAR_SRC = """
+    import threading
+
+    class SimDevice:
+        def __init__(self):
+            self._tx_lock = threading.Lock()
+            self._tx_buf = b""
+
+        def start(self):
+            t = threading.Thread(target=self._rx_loop, daemon=True)
+            t.start()
+            s = threading.Thread(target=self._stream_loop, daemon=True)
+            s.start()
+
+        def _send(self, payload):
+            self._tx_buf = payload
+
+        def _rx_loop(self):
+            self._send(b"descriptor")
+
+        def _stream_loop(self):
+            self._send(b"scan")
+"""
+
+GL012_LOCKED_CONFIG = BASE_CONFIG + """
+[tool.graftlint.locks.SimDevice]
+_tx_lock = ["_tx_buf"]
+"""
+
+
+class TestGL012:
+    def test_pr6_send_tear_refires(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/dev.py": SEND_TEAR_SRC})
+        mine = [f for f in fs if f.rule == "GL012"]
+        assert any(
+            "self._tx_buf of SimDevice" in f.message
+            and "no declared lock" in f.message for f in mine
+        )
+        # the witness names the write site and its execution contexts
+        assert any("_rx_loop" in (f.witness or "")
+                   or "_stream_loop" in (f.witness or "") for f in mine)
+
+    def test_declared_lock_must_be_held_at_the_write(self, tmp_path):
+        # declaring the lock is not enough: the unheld write still fires
+        fs = _lint(
+            tmp_path, {"pkg/dev.py": SEND_TEAR_SRC},
+            config=GL012_LOCKED_CONFIG,
+        )
+        assert any(
+            f.rule == "GL012"
+            and "without holding _tx_lock" in f.message for f in fs
+        )
+
+    def test_quiet_when_declared_lock_is_held(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/dev.py": SEND_TEAR_SRC.replace(
+            "            self._tx_buf = payload",
+            "            with self._tx_lock:\n"
+            "                self._tx_buf = payload",
+        )}, config=GL012_LOCKED_CONFIG)
+        assert "GL012" not in _rules(fs)
+
+    def test_single_context_field_needs_no_lock(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/dev.py": """
+            import threading
+
+            class Dev:
+                def __init__(self):
+                    self._t = None
+
+                def start(self):
+                    self._t = threading.Thread(target=self._loop)
+                    self._t.start()
+
+                def _loop(self):
+                    pass
+        """})
+        assert "GL012" not in _rules(fs)
+
+    def test_lock_order_cycle_fires(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/dev.py": """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def f(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def g(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """})
+        assert any(
+            f.rule == "GL012"
+            and "acquisition-order cycle" in f.message for f in fs
+        )
+
+    def test_suppression_with_reason_works(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/dev.py": SEND_TEAR_SRC.replace(
+            "            self._tx_buf = payload",
+            "            # graftlint: disable=GL012 — fixture-sanctioned"
+            " tear\n"
+            "            self._tx_buf = payload",
+        )})
+        assert "GL012" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL013 — zero-dispatch read-path prover
+# ---------------------------------------------------------------------------
+
+
+class TestGL013:
+    def test_fires_on_dispatching_call_with_path_witness(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/serve.py": """
+            import jax.numpy as jnp
+            import numpy as np
+
+            # graftlint: read-path
+            def read_grid(snap):
+                return helper(snap)
+
+            def helper(snap):
+                return jnp.asarray(snap.grid)
+        """})
+        mine = [f for f in fs if f.rule == "GL013"]
+        assert any("jnp.asarray" in f.message for f in mine)
+        # the witness is the call path from the marked root
+        assert any("read_grid -> helper" in (f.witness or "") for f in mine)
+
+    def test_fires_when_path_reaches_a_jitted_fn(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/serve.py": """
+            import jax
+
+            @jax.jit
+            def fetch(grid):
+                return grid + 1
+
+            # graftlint: read-path
+            def read_grid(snap):
+                return fetch(snap.grid)
+        """})
+        assert any(
+            f.rule == "GL013"
+            and "jitted fetch is reachable" in f.message for f in fs
+        )
+
+    def test_quiet_on_pure_host_read_path(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/serve.py": """
+            import numpy as np
+
+            # graftlint: read-path
+            def read_grid(snap):
+                return np.repeat(snap.values, snap.runs)
+        """})
+        assert "GL013" not in _rules(fs)
+
+    def test_unmarked_dispatch_is_not_a_read_path_finding(self, tmp_path):
+        fs = _lint(tmp_path, {"pkg/serve.py": """
+            import jax.numpy as jnp
+
+            def hot_path(x):
+                return jnp.asarray(x)
+        """})
+        assert "GL013" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# GL008 bench-window hygiene + the TimedWindow seam itself
+# ---------------------------------------------------------------------------
+
+
+class TestBenchWindow:
+    def test_raw_division_headline_fires(self, tmp_path):
+        fs = _lint(tmp_path, {
+            "bench.py": """
+                import time
+
+                GRADED = {}
+
+                def bench_x():
+                    t0 = time.perf_counter()
+                    n = 100
+                    dt = time.perf_counter() - t0
+                    return {"metric": "m", "value": n / dt,
+                            "unit": "scans/s"}
+            """,
+            "pkg/m.py": "x = 1\n",
+        })
+        assert any(
+            f.rule == "GL008"
+            and "TimedWindow.rate()" in f.message for f in fs
+        )
+
+    def test_rate_through_assign_chain_is_quiet(self, tmp_path):
+        fs = _lint(tmp_path, {
+            "bench.py": """
+                GRADED = {}
+
+                def bench_y(win):
+                    sps = win.rate()
+                    return {"metric": "m", "value": round(sps, 2),
+                            "unit": "scans/s",
+                            "vs_baseline": round(sps / 10.0, 3)}
+            """,
+            "pkg/m.py": "x = 1\n",
+        })
+        assert not any(
+            f.rule == "GL008" and "TimedWindow" in f.message for f in fs
+        )
+
+    def test_timed_window_live_and_paired(self):
+        from bench import TimedWindow
+
+        win = TimedWindow()
+        with win:
+            pass
+        win.add(10).add(5)
+        assert win.count == 15
+        assert win.rate() == 15 / max(win.seconds, 1e-9)
+        assert TimedWindow.paired(300, 2.0).rate() == pytest.approx(150.0)
+
+    def test_timed_window_guards_misuse(self):
+        from bench import TimedWindow
+
+        win = TimedWindow().start()
+        with pytest.raises(RuntimeError):
+            win.rate()  # still running
+        with pytest.raises(RuntimeError):
+            win.start()  # double start
+        win.stop()
+        with pytest.raises(RuntimeError):
+            win.stop()  # double stop
+
+
+# ---------------------------------------------------------------------------
+# --explain: rationale + concrete witnesses
+# ---------------------------------------------------------------------------
+
+
+class TestExplain:
+    def _tree(self, tmp_path, files, config):
+        (tmp_path / "pyproject.toml").write_text(config)
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+
+    def test_explain_gl011_prints_interval_witness(self, tmp_path, capsys):
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import main
+
+        self._tree(tmp_path, {"pkg/fx.py": """
+            def scale(d_q2, rate_q8):
+                return d_q2 * rate_q8
+        """}, GL011_CONFIG)
+        rc = main(["--explain", "GL011", "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0  # informational, never gates
+        assert "fixed-point overflow prover" in out
+        assert "witness:" in out and "∈" in out
+
+    def test_explain_gl012_prints_write_pair(self, tmp_path, capsys):
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import main
+
+        self._tree(tmp_path, {"pkg/dev.py": SEND_TEAR_SRC}, BASE_CONFIG)
+        rc = main(["--explain", "GL012", "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "lock-discipline race detector" in out
+        assert "witness:" in out and "contexts:" in out
+
+    def test_explain_gl013_prints_call_path(self, tmp_path, capsys):
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import main
+
+        self._tree(tmp_path, {"pkg/serve.py": """
+            import jax.numpy as jnp
+
+            # graftlint: read-path
+            def read_grid(snap):
+                return jnp.asarray(snap.grid)
+        """}, BASE_CONFIG)
+        rc = main(["--explain", "GL013", "--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "zero-dispatch read-path prover" in out
+        assert "witness:" in out and "jnp.asarray()" in out
+
+    def test_explain_unknown_rule_errors(self, tmp_path, capsys):
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import main
+
+        self._tree(tmp_path, {"pkg/m.py": "x = 1\n"}, BASE_CONFIG)
+        assert main(["--explain", "GL999", "--root", str(tmp_path)]) == 2
+
+
+# ---------------------------------------------------------------------------
 # the repo itself
 # ---------------------------------------------------------------------------
 
@@ -876,7 +1316,7 @@ class TestRepoClean:
         from rplidar_ros2_driver_tpu.tools.graftlint.rules import ALL_RULES
         from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
 
-        assert len(ALL_RULES) >= 10
+        assert len(ALL_RULES) >= 13
         findings, new, stale = run_lint(repo_root())
         assert new == [], [f"{f.path}:{f.line} {f.rule} {f.message}"
                            for f in new]
@@ -889,3 +1329,36 @@ class TestRepoClean:
         assert any("ops/ingest.py" in z for z in cfg.zones)
         assert any("ops/scan_match" in z for z in cfg.zones)
         assert any("driver/ingest.py" in h for h in cfg.hot_files)
+
+    def test_repo_declares_prover_inputs(self):
+        """The v2 rules are armed, not dormant: the real config carries
+        GL011 bounds over the fixed-point zones, a GL012 lock map, and
+        at least one marked GL013 read-path root."""
+        from rplidar_ros2_driver_tpu.tools.graftlint.model import RepoIndex
+        from rplidar_ros2_driver_tpu.tools.graftlint.runner import repo_root
+
+        cfg = load_config(repo_root())
+        assert any("ops/deskew.py" in z for z in cfg.gl011_zones)
+        assert cfg.gl011_bound_map().get("motion") == (-8192, 8192)
+        assert cfg.lock_map(), "no [tool.graftlint.locks] declarations"
+        index = RepoIndex(cfg)
+        roots = [
+            qn for _rel, mod in index.modules.items()
+            for qn in mod.read_path_funcs
+        ]
+        assert "snapshot_grid" in roots
+
+    def test_jobs_parallel_parse_matches_serial(self, tmp_path):
+        """--jobs N must be a pure speedup: identical findings."""
+        (tmp_path / "pyproject.toml").write_text(GL011_CONFIG)
+        (tmp_path / "pkg").mkdir()
+        (tmp_path / "pkg" / "fx.py").write_text(textwrap.dedent("""
+            def scale(d_q2, rate_q8):
+                return d_q2 * rate_q8
+        """))
+        (tmp_path / "pkg" / "dev.py").write_text(textwrap.dedent(
+            SEND_TEAR_SRC
+        ))
+        serial, _, _ = run_lint(str(tmp_path))
+        parallel, _, _ = run_lint(str(tmp_path), jobs=2)
+        assert [f.key() for f in serial] == [f.key() for f in parallel]
